@@ -1,0 +1,114 @@
+"""horovod_tpu — a TPU-native distributed training framework.
+
+A ground-up rebuild of the capabilities of Horovod (reference:
+``/root/reference``, see ``SURVEY.md``) designed for TPU hardware:
+
+* Collectives (``allreduce`` / ``allgather`` / ``broadcast`` /
+  ``reducescatter`` / ``alltoall``) execute as XLA collectives
+  (``lax.psum`` / ``lax.all_gather`` / ``lax.ppermute`` / ``lax.all_to_all``)
+  over a :class:`jax.sharding.Mesh` spanning ICI (intra-slice) and DCN
+  (cross-slice) axes — not NCCL/MPI rings.
+* Under ``jit`` / ``shard_map`` the coordination problem Horovod solves with a
+  C++ background thread (reference ``horovod/common/operations.cc:303-498``)
+  disappears: SPMD guarantees every device issues the same collectives in the
+  same order.  The asynchronous, name-negotiated eager path (for op-by-op
+  frameworks like PyTorch) survives as a native C++ runtime with a TCP
+  controller — see ``horovod_tpu/native``.
+* The user-facing API keeps Horovod's contract
+  (reference ``horovod/tensorflow/__init__.py``, ``horovod/torch/__init__.py``):
+  ``init``/``rank``/``size``/``local_rank``/``local_size``,
+  named collectives, ``DistributedOptimizer``, ``broadcast_parameters``,
+  ``Compression`` — so a Horovod user can switch with minimal edits.
+
+Quick start (single host, all local TPU chips)::
+
+    import horovod_tpu as hvd
+    hvd.init()
+    mesh = hvd.mesh()                       # 1-D 'data' mesh over all chips
+    step = hvd.make_training_step(loss_fn, optimizer, mesh)
+"""
+
+from horovod_tpu import basics as _basics
+from horovod_tpu.basics import (
+    init,
+    shutdown,
+    is_initialized,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    cross_rank,
+    cross_size,
+    num_devices,
+    local_devices,
+    mesh,
+    mpi_threads_supported,
+    mpi_built,
+    mpi_enabled,
+    gloo_built,
+    gloo_enabled,
+    nccl_built,
+    ddl_built,
+    mlsl_built,
+    tpu_built,
+    tpu_enabled,
+)
+from horovod_tpu.ops.collective import (
+    Average,
+    Sum,
+    Adasum,
+    Min,
+    Max,
+    allreduce,
+    allreduce_,
+    allreduce_async,
+    allreduce_async_,
+    grouped_allreduce,
+    allgather,
+    allgather_async,
+    allgather_object,
+    broadcast,
+    broadcast_,
+    broadcast_async,
+    broadcast_async_,
+    broadcast_object,
+    reducescatter,
+    alltoall,
+    synchronize,
+    poll,
+    join,
+)
+from horovod_tpu.ops.compression import Compression
+from horovod_tpu.parallel.data import (
+    DistributedOptimizer,
+    DistributedGradientTape,
+    make_training_step,
+    broadcast_parameters,
+    broadcast_optimizer_state,
+    broadcast_variables,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    # lifecycle / topology
+    "init", "shutdown", "is_initialized",
+    "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",
+    "num_devices", "local_devices", "mesh",
+    "mpi_threads_supported",
+    "mpi_built", "mpi_enabled", "gloo_built", "gloo_enabled",
+    "nccl_built", "ddl_built", "mlsl_built", "tpu_built", "tpu_enabled",
+    # collectives
+    "Average", "Sum", "Adasum", "Min", "Max",
+    "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
+    "grouped_allreduce",
+    "allgather", "allgather_async", "allgather_object",
+    "broadcast", "broadcast_", "broadcast_async", "broadcast_async_",
+    "broadcast_object",
+    "reducescatter", "alltoall",
+    "synchronize", "poll", "join",
+    # training
+    "Compression",
+    "DistributedOptimizer", "DistributedGradientTape", "make_training_step",
+    "broadcast_parameters", "broadcast_optimizer_state", "broadcast_variables",
+]

@@ -1,0 +1,304 @@
+"""Process / topology state — the ``hvd.init()`` surface.
+
+Horovod equivalent: ``horovod/common/basics.py`` (ctypes ``HorovodBasics``,
+reference ``basics.py:22-198``) backed by the C API in
+``horovod/common/operations.cc:611-732``.
+
+TPU-native redesign
+-------------------
+Horovod runs **one process per accelerator** and discovers topology from
+MPI/Gloo communicators.  JAX on TPU runs **one process per host**, each owning
+several chips, with SPMD executing over all of them.  We therefore keep both
+notions first-class:
+
+* ``rank()`` / ``size()`` — *process*-level (controller) rank and world size,
+  read from the ``HOROVOD_RANK`` / ``HOROVOD_SIZE`` env contract that the
+  launcher sets (the same env names Horovod's gloo path uses, reference
+  ``horovod/common/gloo/gloo_context.cc:113-157``).
+* ``num_devices()`` — the *chip*-level world size (``len(jax.devices())``
+  after multi-process initialization), which is what SPMD collectives span.
+
+Multi-host bootstrap: Horovod's gloo rendezvous (HTTP KV full-mesh TCP
+bootstrap, reference ``gloo_context.cc:56-76``) maps to
+``jax.distributed.initialize(coordinator_address, ...)`` which bootstraps the
+PJRT distributed runtime over DCN; the launcher provides
+``HOROVOD_COORDINATOR_ADDR``.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from horovod_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+# Error message contract, mirroring reference horovod/common/operations.cc:96-100
+NOT_INITIALIZED_ERROR = (
+    "horovod_tpu has not been initialized; use hvd.init()."
+)
+
+
+class _State:
+    """Per-process global state (Horovod: ``HorovodGlobalState``,
+    reference ``horovod/common/global_state.h:42-112``).  In the TPU rebuild
+    most of that struct (background thread handle, fusion manager, response
+    cache...) lives in the native runtime; the Python side holds topology and
+    the mesh cache."""
+
+    def __init__(self):
+        self.initialized = False
+        self.rank = 0
+        self.size = 1
+        self.local_rank = 0
+        self.local_size = 1
+        self.cross_rank = 0
+        self.cross_size = 1
+        self.ranks: Optional[Sequence[int]] = None
+        self.mesh_cache = {}
+        self.runtime = None       # native runtime handle (horovod_tpu.native)
+        self.lock = threading.Lock()
+
+
+_state = _State()
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return default if v is None or v == "" else int(v)
+
+
+def init(comm=None, ranks: Optional[Sequence[int]] = None) -> None:
+    """Initialize horovod_tpu.
+
+    Mirrors ``hvd.init`` (reference ``basics.py:29-61``): may be called with a
+    subset of ranks to restrict the collective group.  ``comm`` (an mpi4py
+    communicator in the reference) is accepted for API compatibility and, if
+    given, must expose ``Get_rank``/``Get_size`` which override the env.
+
+    Topology resolution order:
+      1. explicit ``comm``
+      2. ``HOROVOD_RANK``/``HOROVOD_SIZE``/``HOROVOD_LOCAL_RANK``/... env
+         (set by the ``hvdrun`` launcher; same contract as reference
+         ``run/gloo_run.py:211-254``)
+      3. ``jax.process_index()``/``jax.process_count()`` (TPU pod metadata)
+    """
+    with _state.lock:
+        if _state.initialized:
+            return
+
+        coord = os.environ.get("HOROVOD_COORDINATOR_ADDR")
+        if coord and os.environ.get("HOROVOD_JAX_DISTRIBUTED", "0") == "1":
+            # Multi-host JAX bootstrap (replaces gloo full-mesh rendezvous,
+            # reference gloo_context.cc:56-157).  Must run before ANY other
+            # jax call that would initialize the XLA backend, so no
+            # jax.process_count() guard here.  CPU multi-process testing
+            # instead uses the native TCP runtime for data movement.
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=_env_int("HOROVOD_SIZE", 1),
+                process_id=_env_int("HOROVOD_RANK", 0),
+            )
+
+        if comm is not None and hasattr(comm, "Get_rank"):
+            _state.rank = comm.Get_rank()
+            _state.size = comm.Get_size()
+            _state.local_rank = _state.rank
+            _state.local_size = _state.size
+        else:
+            _state.rank = _env_int("HOROVOD_RANK", jax.process_index())
+            _state.size = _env_int("HOROVOD_SIZE", jax.process_count())
+            _state.local_rank = _env_int("HOROVOD_LOCAL_RANK", _state.rank)
+            _state.local_size = _env_int("HOROVOD_LOCAL_SIZE", _state.size)
+            _state.cross_rank = _env_int("HOROVOD_CROSS_RANK",
+                                         _state.rank // max(_state.local_size, 1))
+            _state.cross_size = _env_int("HOROVOD_CROSS_SIZE",
+                                         -(-_state.size // max(_state.local_size, 1)))
+
+        _state.ranks = tuple(ranks) if ranks is not None else None
+        if _state.ranks is not None:
+            # Rank-subset init (reference operations.cc:613-622): processes
+            # outside the subset become inactive no-op members.
+            if _state.rank in _state.ranks:
+                _state.size = len(_state.ranks)
+                _state.rank = list(_state.ranks).index(_state.rank)
+            else:
+                _state.size = 1
+                _state.rank = 0
+
+        _state.runtime = None
+        if _state.size > 1:
+            from horovod_tpu import native
+            runtime = native.Runtime(
+                rank=_state.rank,
+                size=_state.size,
+                local_rank=_state.local_rank,
+                local_size=_state.local_size,
+            )
+            try:
+                runtime.start()
+            except Exception:
+                # Leave the process cleanly un-initialized (reference keeps
+                # a hard ErrorOp fallback instead; we surface the error).
+                raise
+            _state.runtime = runtime
+
+        _state.initialized = True
+        log.debug("initialized: rank=%d size=%d local_rank=%d local_size=%d "
+                  "devices=%d", _state.rank, _state.size, _state.local_rank,
+                  _state.local_size, len(jax.local_devices()))
+
+
+def shutdown() -> None:
+    """Shut down horovod_tpu (reference ``basics.py:63-67`` →
+    ``horovod_shutdown``, ``operations.cc:624-629``)."""
+    with _state.lock:
+        if not _state.initialized:
+            return
+        if _state.runtime is not None:
+            _state.runtime.stop()
+            _state.runtime = None
+        _state.mesh_cache.clear()
+        _state.initialized = False
+
+
+atexit.register(shutdown)
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+def _check_initialized() -> None:
+    # Reference CheckInitialized: operations.cc:603-609.
+    if not _state.initialized:
+        raise ValueError(NOT_INITIALIZED_ERROR)
+
+
+def rank() -> int:
+    """Process rank in the job (reference ``basics.py:110-118``)."""
+    _check_initialized()
+    return _state.rank
+
+
+def size() -> int:
+    """Number of processes in the job (reference ``basics.py:99-108``)."""
+    _check_initialized()
+    return _state.size
+
+
+def local_rank() -> int:
+    """Rank within this host (reference ``basics.py:120-129``)."""
+    _check_initialized()
+    return _state.local_rank
+
+
+def local_size() -> int:
+    """Processes on this host (reference ``basics.py:131-139``)."""
+    _check_initialized()
+    return _state.local_size
+
+
+def cross_rank() -> int:
+    """Node index (reference LOCAL/CROSS communicators, ``common.h:105-109``)."""
+    _check_initialized()
+    return _state.cross_rank
+
+
+def cross_size() -> int:
+    _check_initialized()
+    return _state.cross_size
+
+
+def num_devices() -> int:
+    """Chip-level world size — what SPMD collectives span.  No reference
+    equivalent (Horovod is one-process-per-device); on TPU this is the number
+    a Horovod user would call ``size()``."""
+    _check_initialized()
+    return len(jax.devices())
+
+
+def local_devices():
+    _check_initialized()
+    return jax.local_devices()
+
+
+def mesh(axes=None, shape=None):
+    """Return (and cache) the device mesh for SPMD collectives.
+
+    Default: a 1-D mesh named ``('data',)`` over all devices — the TPU
+    equivalent of Horovod's single global communicator
+    (``common.h:105-109`` GLOBAL).  Pass ``axes``/``shape`` for hybrid
+    layouts, e.g. ``axes=('replica', 'data')`` with
+    ``shape=(num_slices, chips_per_slice)`` — the LOCAL/CROSS (ICI/DCN)
+    hierarchy of reference ``nccl_operations.cc:151-346`` expressed as mesh
+    axes.  See :mod:`horovod_tpu.parallel.hierarchical`.
+    """
+    _check_initialized()
+    from horovod_tpu.topology import build_mesh
+    axes = tuple(axes) if axes is not None else ("data",)
+    shape = tuple(shape) if shape is not None else None
+    key = (axes, shape)
+    m = _state.mesh_cache.get(key)
+    if m is None:
+        m = build_mesh(axes=axes, shape=shape)
+        _state.mesh_cache[key] = m
+    return m
+
+
+def runtime():
+    """The native eager runtime, or None in single-process mode."""
+    _check_initialized()
+    return _state.runtime
+
+
+# ---------------------------------------------------------------------------
+# Build-capability introspection (reference basics.py:141-198,
+# operations.cc:651-732).  In this build there is exactly one backend: TPU/XLA.
+# ---------------------------------------------------------------------------
+
+def mpi_threads_supported() -> bool:
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    return False
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def mlsl_built() -> bool:
+    return False
+
+
+def tpu_built() -> bool:
+    """True: XLA/ICI collectives are compiled into this build."""
+    return True
+
+
+def tpu_enabled() -> bool:
+    return True

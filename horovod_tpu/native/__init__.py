@@ -1,0 +1,15 @@
+"""Native eager runtime — the C++ heir of Horovod's background thread.
+
+Horovod's core runtime (reference ``horovod/common/``: ``operations.cc``
+background loop, ``controller.cc`` negotiation, ``tensor_queue``,
+``fusion_buffer_manager``, ``response_cache``, ``stall_inspector``,
+``timeline``, ``parameter_manager``) is rebuilt here as ``libhorovod_tpu.so``
+(sources in ``horovod_tpu/native/cc``), loaded via ctypes — the same loading
+strategy as reference ``horovod/common/basics.py:22-28``.
+
+The runtime serves the *eager* plane only: op-by-op frameworks (PyTorch) and
+concrete-array calls in multi-process jobs.  The SPMD/jit plane never touches
+it — XLA collectives over the mesh are the data path there.
+"""
+
+from horovod_tpu.native.runtime import Runtime  # noqa: F401

@@ -1,0 +1,537 @@
+"""Collective operations: allreduce / allgather / broadcast / reducescatter /
+alltoall, in both SPMD (jit) and eager (async, name-negotiated) forms.
+
+Horovod equivalents: the op kernels in ``horovod/tensorflow/mpi_ops.cc:276-463``
+and ``horovod/torch/mpi_ops_v2.cc:52-235``, the enqueue API
+``EnqueueTensorAllreduce/Allgather/Broadcast``
+(``horovod/common/operations.cc:736-843``) and the handle/poll model of
+``horovod/torch/handle_manager.{h,cc}``.
+
+TPU-native redesign — the two planes
+------------------------------------
+* **SPMD plane** (the performance path): when a collective is called on a
+  *traced* value — inside ``jit`` / ``shard_map`` / ``pmap`` with a mesh axis
+  in scope — it lowers directly to the XLA collective
+  (``lax.psum`` / ``lax.all_gather`` / ``lax.psum_scatter`` /
+  ``lax.all_to_all``).  No queue, no negotiation, no fusion buffer: XLA
+  guarantees identical program order on every device, which is the invariant
+  Horovod's whole controller exists to establish (design rationale at
+  reference ``operations.cc:281-300``).
+* **Eager plane** (the compatibility path): on concrete arrays in a
+  multi-process job, ops are enqueued by *name* to the native runtime — a C++
+  background thread with a TCP controller that negotiates readiness across
+  ranks, fuses small tensors, and executes — the faithful heir of
+  ``BackgroundThreadLoop``/``ComputeResponseList``
+  (``operations.cc:303-550``, ``controller.cc:54-298``).  In a single-process
+  job the eager collectives are local arithmetic (a 1-rank ring), matching
+  Horovod's 1-process behavior.
+
+Both planes share one user API; ``hvd.allreduce`` does the right thing in
+either context.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from horovod_tpu import basics
+from horovod_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Reduction ops (reference message.h / later horovod.common Average/Sum/Adasum)
+# ---------------------------------------------------------------------------
+
+class ReduceOp:
+    def __init__(self, name: str, code: int):
+        self.name = name
+        self.code = code
+
+    def __repr__(self):
+        return f"ReduceOp.{self.name}"
+
+
+Average = ReduceOp("Average", 0)
+Sum = ReduceOp("Sum", 1)
+Adasum = ReduceOp("Adasum", 2)   # accepted; falls back to Average semantics
+Min = ReduceOp("Min", 3)
+Max = ReduceOp("Max", 4)
+
+# Error-message contract (reference horovod/common/common.h:155-158).
+DUPLICATE_NAME_ERROR_FMT = (
+    "Requested to %s a tensor with the same name as another tensor that is "
+    "currently being processed.  If you want to request another tensor, use "
+    "a different tensor name. Tensor name: %s"
+)
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _axis_bound(axis_name: str) -> bool:
+    """True when ``axis_name`` is a live mesh axis in the current trace
+    (i.e. we are under ``shard_map``/``pmap``) — the condition under which
+    collectives lower to XLA ops instead of the eager runtime."""
+    try:
+        lax.axis_size(axis_name)
+        return True
+    except Exception:
+        return False
+
+
+def _plain_jit_fallback(tensor, kind: str):
+    """A tracer with no bound mesh axis: user code under plain ``jit``.
+    With one process this degenerates to local semantics (identical to the
+    eager 1-rank result); with more we cannot reach the runtime from inside
+    a traced program, so fail loudly rather than silently not reducing."""
+    basics._check_initialized()
+    if basics.size() > 1:
+        raise RuntimeError(
+            f"hvd.{kind} was traced inside jit without a mesh axis in scope "
+            f"in a {basics.size()}-process job. Wrap the computation in "
+            f"jax.shard_map over hvd.mesh() (SPMD plane), or call {kind} on "
+            f"concrete arrays outside jit (eager plane).")
+    return tensor
+
+
+def _resolve_op(op, average):
+    """Reconcile the v0.18 ``average=`` bool with the op enum."""
+    if op is not None:
+        return op
+    if average is None or average:
+        return Average
+    return Sum
+
+
+def _default_axis(axis_name):
+    return "data" if axis_name is None else axis_name
+
+
+# ---------------------------------------------------------------------------
+# Handle manager for the async eager API
+# (reference horovod/torch/handle_manager.{h,cc}: int handle -> Status table)
+# ---------------------------------------------------------------------------
+
+class _Handle:
+    __slots__ = ("id", "name", "event", "result", "error")
+
+    def __init__(self, hid: int, name: str):
+        self.id = hid
+        self.name = name
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[Exception] = None
+
+
+class HandleManager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next = 0
+        self._handles: Dict[int, _Handle] = {}
+        self._inflight_names: set = set()
+
+    def allocate(self, name: str, op_kind: str) -> _Handle:
+        with self._lock:
+            if name in self._inflight_names:
+                raise ValueError(DUPLICATE_NAME_ERROR_FMT % (op_kind, name))
+            self._inflight_names.add(name)
+            h = _Handle(self._next, name)
+            self._next += 1
+            self._handles[h.id] = h
+            return h
+
+    def complete(self, h: _Handle, result=None, error: Optional[Exception] = None):
+        with self._lock:
+            h.result = result
+            h.error = error
+            self._inflight_names.discard(h.name)
+        h.event.set()
+
+    def get(self, hid) -> _Handle:
+        if isinstance(hid, _Handle):
+            return hid
+        with self._lock:
+            h = self._handles.get(hid)
+        if h is None:
+            raise ValueError(f"Handle {hid} was not created or has been cleared")
+        return h
+
+    def clear(self, h: _Handle):
+        with self._lock:
+            self._handles.pop(h.id, None)
+
+
+_handles = HandleManager()
+
+_name_lock = threading.Lock()
+_name_counter = 0
+
+
+def _auto_name(kind: str, name: Optional[str]) -> str:
+    # Reference: ops get node-name-derived names in TF, handle-derived in
+    # torch (mpi_ops.py:58-90); we use a per-process counter.
+    global _name_counter
+    if name is not None:
+        return name
+    with _name_lock:
+        n = _name_counter
+        _name_counter += 1
+    return f"{kind}.noname.{n}"
+
+
+def poll(handle) -> bool:
+    """Non-blocking completion check (reference ``horovod_torch_poll``,
+    ``torch/mpi_ops_v2.cc:222-226``)."""
+    return _handles.get(handle).event.is_set()
+
+
+def synchronize(handle):
+    """Block until the async op completes and return its output (reference
+    ``torch/mpi_ops.py:429-445`` → ``wait_and_clear``)."""
+    h = _handles.get(handle)
+    h.event.wait()
+    _handles.clear(h)
+    if h.error is not None:
+        raise h.error
+    return h.result
+
+
+# ---------------------------------------------------------------------------
+# Eager execution (concrete arrays)
+# ---------------------------------------------------------------------------
+
+def _eager_allreduce(x, op: ReduceOp, name: str, prescale_factor, postscale_factor):
+    rt = basics.runtime()
+    arr = np.asarray(x)
+    if prescale_factor != 1.0:
+        arr = arr * prescale_factor
+    if rt is None:
+        out = arr.copy()
+    else:
+        out = rt.allreduce(name, arr, op.code)
+    if op is Average or op is Adasum:
+        out = out / basics.size()
+    if postscale_factor != 1.0:
+        out = out * postscale_factor
+    return out
+
+
+def _eager_allgather(x, name: str):
+    rt = basics.runtime()
+    arr = np.asarray(x)
+    if rt is None:
+        return arr.copy()
+    return rt.allgather(name, arr)
+
+
+def _eager_broadcast(x, root_rank: int, name: str):
+    rt = basics.runtime()
+    arr = np.asarray(x)
+    if rt is None:
+        if root_rank != 0:
+            raise ValueError(
+                f"broadcast root_rank {root_rank} out of range for size 1")
+        return arr.copy()
+    return rt.broadcast(name, arr, root_rank)
+
+
+def _eager_alltoall(x, splits, name: str):
+    rt = basics.runtime()
+    arr = np.asarray(x)
+    if rt is None:
+        return arr.copy()
+    return rt.alltoall(name, arr, splits)
+
+
+def _eager_reducescatter(x, op: ReduceOp, name: str):
+    rt = basics.runtime()
+    arr = np.asarray(x)
+    if rt is None:
+        return arr / basics.size() if op is Average else arr.copy()
+    out = rt.reducescatter(name, arr, op.code)
+    if op is Average:
+        out = out / basics.size()
+    return out
+
+
+_executor = None
+_executor_lock = threading.Lock()
+
+
+def _get_executor():
+    """A small shared pool, not thread-per-op: the moral equivalent of the
+    single background thread servicing the queue in the reference
+    (``operations.cc:303-498``).  A few workers let independent named tensors
+    overlap, mirroring multi-stream dispatch."""
+    global _executor
+    with _executor_lock:
+        if _executor is None:
+            from concurrent.futures import ThreadPoolExecutor
+            _executor = ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="hvd-eager")
+        return _executor
+
+
+def _async_dispatch(fn, kind: str, name: str, to_jnp=True):
+    """Submit ``fn`` to the eager worker pool, completing a handle — the
+    Python face of the enqueue-with-callback contract (reference
+    ``operations.cc:736-843``: enqueue returns immediately, callback fires
+    from the background loop)."""
+    h = _handles.allocate(name, kind)
+
+    def work():
+        try:
+            out = fn()
+            _handles.complete(h, jnp.asarray(out) if to_jnp else out)
+        except Exception as e:  # delivered via synchronize(), like statuses
+            _handles.complete(h, error=e)
+
+    _get_executor().submit(work)
+    return h
+
+
+# ---------------------------------------------------------------------------
+# Public collectives
+# ---------------------------------------------------------------------------
+
+def allreduce(tensor, average=None, name=None, op=None,
+              prescale_factor=1.0, postscale_factor=1.0,
+              compression=None, axis_name=None):
+    """Allreduce across all workers/devices.
+
+    SPMD plane: ``lax.psum``/``pmean`` over ``axis_name`` (default ``'data'``).
+    Eager plane: name-negotiated runtime allreduce
+    (reference ``EnqueueTensorAllreduce``, ``operations.cc:736-775``).
+
+    ``compression`` (see :class:`horovod_tpu.ops.compression.Compression`)
+    casts before the wire and back after, as in reference
+    ``tensorflow/__init__.py:38-83``.
+    """
+    rop = _resolve_op(op, average)
+    if compression is not None:
+        tensor, ctx = compression.compress(tensor)
+    else:
+        ctx = None
+    ax = _default_axis(axis_name)
+    if _axis_bound(ax):
+        t = tensor * prescale_factor if prescale_factor != 1.0 else tensor
+        if rop is Average or rop is Adasum:
+            out = lax.pmean(t, ax)
+        elif rop is Sum:
+            out = lax.psum(t, ax)
+        elif rop is Min:
+            out = lax.pmin(t, ax)
+        elif rop is Max:
+            out = lax.pmax(t, ax)
+        else:
+            raise ValueError(f"unknown op {rop}")
+        if postscale_factor != 1.0:
+            out = out * postscale_factor
+    elif _is_traced(tensor):
+        out = _plain_jit_fallback(tensor, "allreduce")
+        scale = prescale_factor * postscale_factor
+        if scale != 1.0:
+            out = out * scale
+    else:
+        basics._check_initialized()
+        nm = _auto_name("allreduce", name)
+        out = jnp.asarray(_eager_allreduce(
+            tensor, rop, nm, prescale_factor, postscale_factor))
+    if ctx is not None:
+        out = compression.decompress(out, ctx)
+    return out
+
+
+def allreduce_(tensor, average=None, name=None, op=None, **kw):
+    """In-place-flavored alias.  JAX arrays are immutable, so this returns the
+    reduced value; kept for API parity with reference ``torch/mpi_ops.py``."""
+    return allreduce(tensor, average=average, name=name, op=op, **kw)
+
+
+def allreduce_async(tensor, average=None, name=None, op=None,
+                    prescale_factor=1.0, postscale_factor=1.0):
+    """Asynchronous eager allreduce returning a handle for
+    :func:`synchronize`/:func:`poll` (reference ``torch/mpi_ops.py:58-116``)."""
+    basics._check_initialized()
+    rop = _resolve_op(op, average)
+    nm = _auto_name("allreduce", name)
+    return _async_dispatch(
+        lambda: _eager_allreduce(np.asarray(tensor), rop, nm,
+                                 prescale_factor, postscale_factor),
+        "allreduce", nm)
+
+
+def allreduce_async_(tensor, average=None, name=None, op=None, **kw):
+    return allreduce_async(tensor, average=average, name=name, op=op, **kw)
+
+
+def grouped_allreduce(tensors, average=None, name=None, op=None, axis_name=None):
+    """Reduce a list of tensors as one logical request.  SPMD plane: a single
+    fused ``psum`` over the flattened concatenation (the moral equivalent of
+    the fusion buffer, reference ``fusion_buffer_manager.{h,cc}``)."""
+    rop = _resolve_op(op, average)
+    if not tensors:
+        return []
+    ax = _default_axis(axis_name)
+    if _axis_bound(ax):
+        from horovod_tpu.ops.fusion import fused_psum
+        return fused_psum(tensors, ax,
+                          mean=(rop is Average or rop is Adasum))
+    if any(_is_traced(t) for t in tensors):
+        return [_plain_jit_fallback(t, "grouped_allreduce") for t in tensors]
+    return [allreduce(t, name=f"{_auto_name('grouped', name)}.{i}", op=rop)
+            for i, t in enumerate(tensors)]
+
+
+def allgather(tensor, name=None, axis_name=None):
+    """Concatenate each worker's tensor along dim 0 (reference TF op shape fn
+    ``tensorflow/mpi_ops.cc:369-391``: first dims may differ, others must
+    match).  SPMD plane: ``lax.all_gather(..., tiled=True)``."""
+    ax = _default_axis(axis_name)
+    if _axis_bound(ax):
+        return lax.all_gather(tensor, ax, axis=0, tiled=True)
+    if _is_traced(tensor):
+        return _plain_jit_fallback(tensor, "allgather")
+    basics._check_initialized()
+    nm = _auto_name("allgather", name)
+    return jnp.asarray(_eager_allgather(tensor, nm))
+
+
+def allgather_async(tensor, name=None):
+    basics._check_initialized()
+    nm = _auto_name("allgather", name)
+    return _async_dispatch(lambda: _eager_allgather(np.asarray(tensor), nm),
+                           "allgather", nm)
+
+
+def allgather_object(obj, name=None):
+    """Pickle-based object allgather (parity with later-Horovod
+    ``allgather_object``; built on the same variable-dim-0 gather)."""
+    import pickle
+    basics._check_initialized()
+    data = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    nm = _auto_name("allgather_object", name)
+    sizes = _eager_allgather(np.array([data.size], np.int64), nm + ".size")
+    gathered = _eager_allgather(data, nm)
+    out, off = [], 0
+    for s in np.asarray(sizes).ravel():
+        out.append(pickle.loads(gathered[off:off + int(s)].tobytes()))
+        off += int(s)
+    return out
+
+
+def broadcast(tensor, root_rank=0, name=None, axis_name=None):
+    """Broadcast from ``root_rank`` to all (reference
+    ``EnqueueTensorBroadcast``, ``operations.cc:806-843``).
+
+    SPMD plane: implemented as a masked ``psum`` — XLA turns the
+    select+all-reduce into an efficient broadcast on ICI; there is no explicit
+    collective-broadcast primitive in ``lax``."""
+    ax = _default_axis(axis_name)
+    if _axis_bound(ax):
+        idx = lax.axis_index(ax)
+        masked = jnp.where(idx == root_rank, tensor,
+                           jnp.zeros_like(tensor))
+        return lax.psum(masked, ax)
+    if _is_traced(tensor):
+        return _plain_jit_fallback(tensor, "broadcast")
+    basics._check_initialized()
+    nm = _auto_name("broadcast", name)
+    return jnp.asarray(_eager_broadcast(tensor, root_rank, nm))
+
+
+def broadcast_(tensor, root_rank=0, name=None, **kw):
+    return broadcast(tensor, root_rank=root_rank, name=name, **kw)
+
+
+def broadcast_async(tensor, root_rank=0, name=None):
+    basics._check_initialized()
+    nm = _auto_name("broadcast", name)
+    return _async_dispatch(
+        lambda: _eager_broadcast(np.asarray(tensor), root_rank, nm),
+        "broadcast", nm)
+
+
+def broadcast_async_(tensor, root_rank=0, name=None):
+    return broadcast_async(tensor, root_rank=root_rank, name=name)
+
+
+def broadcast_object(obj, root_rank=0, name=None):
+    """Pickle-based broadcast, used for optimizer state / RNG / config
+    (reference ``torch/__init__.py:287-403`` wraps scalars in tensors; we
+    ship pickled bytes with a size prologue)."""
+    import pickle
+    basics._check_initialized()
+    nm = _auto_name("broadcast_object", name)
+    if basics.rank() == root_rank:
+        data = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        sz = np.array([data.size], np.int64)
+    else:
+        data = np.zeros(0, np.uint8)
+        sz = np.zeros(1, np.int64)
+    sz = _eager_broadcast(sz, root_rank, nm + ".size")
+    n = int(np.asarray(sz).ravel()[0])
+    if basics.rank() != root_rank:
+        data = np.zeros(n, np.uint8)
+    data = _eager_broadcast(data, root_rank, nm)
+    return pickle.loads(np.asarray(data).tobytes())
+
+
+def reducescatter(tensor, op=None, name=None, axis_name=None):
+    """Reduce then scatter along dim 0.  SPMD plane: ``lax.psum_scatter``.
+    Not in the v0.18 reference (its collectives are only
+    allreduce/allgather/broadcast, ``message.h:47-49``) but the clean
+    collective layer exposes it since XLA provides it natively."""
+    rop = _resolve_op(op, None)
+    if rop not in (Average, Sum):
+        raise ValueError(f"reducescatter supports Average/Sum, got {rop}")
+    ax = _default_axis(axis_name)
+    if _axis_bound(ax):
+        out = lax.psum_scatter(tensor, ax, scatter_dimension=0, tiled=True)
+        if rop is Average:
+            out = out / lax.axis_size(ax)
+        return out
+    if _is_traced(tensor):
+        return _plain_jit_fallback(tensor, "reducescatter")
+    basics._check_initialized()
+    nm = _auto_name("reducescatter", name)
+    return jnp.asarray(_eager_reducescatter(tensor, rop, nm))
+
+
+def alltoall(tensor, splits=None, name=None, axis_name=None):
+    """Exchange dim-0 chunks between workers (the EP/MoE primitive; absent
+    from the v0.18 reference, present in later Horovod).  SPMD plane:
+    ``lax.all_to_all(tiled=True)`` with equal splits."""
+    ax = _default_axis(axis_name)
+    if _axis_bound(ax):
+        if splits is not None:
+            raise NotImplementedError(
+                "uneven splits are not supported in the SPMD plane; "
+                "pad to equal chunks (static shapes) or use the eager path")
+        return lax.all_to_all(tensor, ax, split_axis=0, concat_axis=0,
+                              tiled=True)
+    if _is_traced(tensor):
+        return _plain_jit_fallback(tensor, "alltoall")
+    basics._check_initialized()
+    nm = _auto_name("alltoall", name)
+    return jnp.asarray(_eager_alltoall(tensor, splits, nm))
+
+
+def join() -> int:
+    """Signal this rank has no more work; returns the last joining rank.
+    (Parity with later-Horovod ``join``; the v0.18 reference instead shuts
+    down via the shutdown bit, ``message.h:110-122``.)"""
+    basics._check_initialized()
+    rt = basics.runtime()
+    if rt is None:
+        return 0
+    return rt.join()

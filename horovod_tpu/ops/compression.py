@@ -1,0 +1,68 @@
+"""Gradient compression (reference ``horovod/tensorflow/compression.py:20-75``
+and the torch/mxnet twins): an algorithm that casts tensors before the wire
+and restores them after.
+
+TPU-native note: on TPU the natural wire dtype is **bfloat16** (MXU-native,
+same exponent range as fp32 — no loss-scale gymnastics), so ``Compression.bf16``
+is provided alongside the reference's ``fp16``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Compressor:
+    """Interface (reference compression.py:20-33)."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """Pass-through (reference compression.py:36-44)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype = None
+
+    @classmethod
+    def compress(cls, tensor):
+        dtype = tensor.dtype
+        if jnp.issubdtype(dtype, jnp.floating) and dtype != cls.wire_dtype:
+            return tensor.astype(cls.wire_dtype), dtype
+        return tensor, None
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        return tensor if ctx is None else tensor.astype(ctx)
+
+
+class FP16Compressor(_CastCompressor):
+    """Cast fp32/fp64 → fp16 on the wire (reference compression.py:46-63)."""
+    wire_dtype = jnp.float16
+
+
+class BF16Compressor(_CastCompressor):
+    """TPU-idiomatic: bfloat16 on the wire."""
+    wire_dtype = jnp.bfloat16
+
+
+class Compression:
+    """Optional wire compression algorithms (reference compression.py:66-75)."""
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

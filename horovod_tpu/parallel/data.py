@@ -1,0 +1,205 @@
+"""Data parallelism: the ``DistributedOptimizer`` / ``DistributedGradientTape``
+surface and the SPMD training-step factory.
+
+Horovod equivalents:
+* TF ``_DistributedOptimizer`` wrapping ``compute_gradients`` with allreduce
+  (reference ``horovod/tensorflow/__init__.py:230-320``).
+* ``DistributedGradientTape`` (reference ``tensorflow/__init__.py:323-376``).
+* torch ``_DistributedOptimizer`` with per-parameter backward hooks
+  (reference ``horovod/torch/__init__.py:47-252``) — the torch twin lives in
+  :mod:`horovod_tpu.torch`.
+* ``broadcast_parameters`` / ``broadcast_optimizer_state``
+  (reference ``torch/__init__.py:255-403``), ``broadcast_variables`` /
+  ``BroadcastGlobalVariablesHook`` (``tensorflow/__init__.py:104-192``).
+
+TPU-native redesign: in JAX the optimizer is a pure gradient transformation
+(optax), so "wrap the optimizer" means composing a gradient-averaging
+transform in front of it.  Inside ``shard_map`` the averaging is a fused
+``pmean`` (bucketed, see :mod:`horovod_tpu.ops.fusion`); on concrete arrays it
+is an eager runtime allreduce.  :func:`make_training_step` packages the whole
+Horovod recipe — shard batch, replicate params, average grads, apply — as one
+jitted SPMD step.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from horovod_tpu import basics
+from horovod_tpu.topology import data_axis
+from horovod_tpu.ops import collective
+from horovod_tpu.ops.compression import Compression
+
+
+def _allreduce_tree(grads, axis_name: str, compression=Compression.none,
+                    op=collective.Average):
+    """Average a gradient pytree across workers — either plane."""
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    compressed = [compression.compress(l) for l in leaves]
+    cleaves = [c[0] for c in compressed]
+    ctxs = [c[1] for c in compressed]
+    if collective._axis_bound(axis_name):
+        from horovod_tpu.ops.fusion import fused_psum
+        mean = op is collective.Average or op is collective.Adasum
+        reduced = fused_psum(cleaves, axis_name, mean=mean)
+    elif cleaves and isinstance(cleaves[0], jax.core.Tracer):
+        reduced = [collective._plain_jit_fallback(l, "DistributedOptimizer")
+                   for l in cleaves]
+    else:
+        reduced = [
+            collective.allreduce(l, op=op, name=f"DistributedGrad.{i}")
+            for i, l in enumerate(cleaves)]
+    out = [compression.decompress(l, c) for l, c in zip(reduced, ctxs)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def distributed_gradients(compression=Compression.none,
+                          axis_name: str = "data",
+                          op=collective.Average) -> optax.GradientTransformation:
+    """An optax transform that averages incoming gradients across the mesh
+    axis (SPMD) or across processes (eager) — the TPU-native core of
+    ``DistributedOptimizer``."""
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        del params
+        return _allreduce_tree(updates, axis_name, compression, op), state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def DistributedOptimizer(optimizer: optax.GradientTransformation,
+                         named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         op=collective.Average,
+                         axis_name: str = "data") -> optax.GradientTransformation:
+    """Wrap an optax optimizer so gradients are averaged across all workers
+    before the update — API parity with reference
+    ``hvd.DistributedOptimizer`` (``tensorflow/__init__.py:230-320``,
+    ``torch/__init__.py:47-252``).
+
+    ``named_parameters`` and ``backward_passes_per_step`` are accepted for
+    signature parity; gradient accumulation in JAX is expressed by the caller
+    (e.g. ``optax.MultiSteps``) and is composed automatically when
+    ``backward_passes_per_step > 1``.
+    """
+    del named_parameters
+    chain = optax.chain(
+        distributed_gradients(compression=compression, axis_name=axis_name,
+                              op=op),
+        optimizer,
+    )
+    if backward_passes_per_step > 1:
+        chain = optax.MultiSteps(chain, every_k_schedule=backward_passes_per_step)
+    return chain
+
+
+def DistributedGradientTape(grad_fn: Callable, *,
+                            compression=Compression.none,
+                            axis_name: str = "data",
+                            op=collective.Average) -> Callable:
+    """Wrap a gradient function so its output pytree is averaged across
+    workers — the JAX rendition of reference ``DistributedGradientTape``
+    (``tensorflow/__init__.py:323-376``), where ``grad_fn`` is typically
+    ``jax.grad(loss_fn)`` or ``jax.value_and_grad(loss_fn)``.
+    """
+
+    @functools.wraps(grad_fn)
+    def wrapped(*args, **kwargs):
+        out = grad_fn(*args, **kwargs)
+        if isinstance(out, tuple) and len(out) == 2:
+            value, grads = out
+            return value, _allreduce_tree(grads, axis_name, compression, op)
+        return _allreduce_tree(out, axis_name, compression, op)
+
+    return wrapped
+
+
+def broadcast_parameters(params, root_rank: int = 0):
+    """Broadcast a parameter pytree from ``root_rank`` to all processes
+    (reference ``torch/__init__.py:255-285``, ``broadcast_variables``
+    ``tensorflow/__init__.py:104-125``).  Under SPMD, parameters are
+    replicated arrays and stay consistent by construction; this is the
+    checkpoint-restore / cold-start synchronization path (SURVEY §5.4)."""
+    basics._check_initialized()
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    out = [collective.broadcast(l, root_rank=root_rank,
+                                name=f"broadcast_parameters.{i}")
+           for i, l in enumerate(leaves)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def broadcast_variables(variables, root_rank: int = 0):
+    """TF-API-parity alias of :func:`broadcast_parameters`."""
+    return broadcast_parameters(variables, root_rank=root_rank)
+
+
+def broadcast_optimizer_state(opt_state, root_rank: int = 0):
+    """Broadcast optimizer state from ``root_rank`` (reference
+    ``torch/__init__.py:287-403``, which pickles non-tensor leaves — here the
+    optax state is a pytree whose non-array leaves ride
+    :func:`horovod_tpu.ops.collective.broadcast_object`)."""
+    basics._check_initialized()
+    leaves, treedef = jax.tree_util.tree_flatten(opt_state)
+    out = []
+    for i, l in enumerate(leaves):
+        if isinstance(l, (jax.Array, np.ndarray)) or np.isscalar(l):
+            arr = collective.broadcast(jnp.asarray(l), root_rank=root_rank,
+                                       name=f"broadcast_opt_state.{i}")
+            if np.isscalar(l) or (hasattr(l, "ndim") and l.ndim == 0):
+                arr = arr.reshape(())
+            out.append(arr)
+        else:
+            out.append(collective.broadcast_object(
+                l, root_rank=root_rank, name=f"broadcast_opt_state.obj.{i}"))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def make_training_step(loss_fn: Callable,
+                       optimizer: optax.GradientTransformation,
+                       mesh: Mesh,
+                       axis_name: Optional[str] = None,
+                       donate: bool = True,
+                       compression=Compression.none):
+    """Build the flagship SPMD training step.
+
+    ``loss_fn(params, batch) -> scalar loss``.  The returned
+    ``step(params, opt_state, batch) -> (params, opt_state, loss)`` is jitted
+    over ``mesh`` with the batch sharded on the data axis and parameters
+    replicated; gradients are averaged with fused ``pmean`` — the whole
+    Horovod DP recipe (shard data / replicate model / allreduce grads /
+    identical update) as one compiled program.
+    """
+    ax = axis_name or data_axis(mesh)
+    dist_opt = optax.chain(
+        distributed_gradients(compression=compression, axis_name=ax),
+        optimizer)
+
+    def _step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, new_opt_state = dist_opt.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        # loss is per-shard; report the global mean like the reference's
+        # MetricAverageCallback (_keras/callbacks.py:46-72).
+        return new_params, new_opt_state, lax.pmean(loss, ax)
+
+    replicated = P()
+    sharded_batch = P(ax)
+    smapped = jax.shard_map(
+        _step, mesh=mesh,
+        in_specs=(replicated, replicated, sharded_batch),
+        out_specs=(replicated, replicated, replicated),
+        check_vma=False)
+    return jax.jit(smapped, donate_argnums=(0, 1) if donate else ())

@@ -1,0 +1,72 @@
+"""Device-mesh construction — the TPU replacement for communicators.
+
+Horovod's communicator topology is GLOBAL / LOCAL (intra-node) / CROSS
+(one-rank-per-node) (reference ``horovod/common/common.h:105-109``,
+``mpi_context.h:78-87``), built from MPI ``COMM_TYPE_SHARED`` splits
+(``mpi_controller.cc:25-81``).  On TPU the same hierarchy is *mesh axes*:
+the fast axis rides ICI within a slice, the slow axis rides DCN across
+slices/hosts.  XLA then lowers ``psum`` over either axis to the right
+interconnect — the explicit two-level dance of
+``NCCLHierarchicalAllreduce`` (``nccl_operations.cc:151-346``) becomes a
+sharding annotation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+
+def build_mesh(axes: Sequence[str] = ("data",),
+               shape: Optional[Tuple[int, ...]] = None,
+               devices=None) -> Mesh:
+    """Build a :class:`jax.sharding.Mesh` over ``devices``.
+
+    * 1 axis, no shape: all devices on one axis (GLOBAL communicator).
+    * N axes + shape: reshape devices into that grid.  For real multi-slice
+      TPU jobs ``mesh_utils.create_hybrid_device_mesh`` is used so the
+      leading axis maps to DCN and trailing axes to ICI.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = len(devices)
+    axes = tuple(axes)
+    if shape is None:
+        if len(axes) != 1:
+            raise ValueError(f"shape required for multi-axis mesh {axes}")
+        shape = (n,)
+    if int(np.prod(shape)) != n:
+        raise ValueError(
+            f"mesh shape {shape} does not cover {n} devices")
+
+    if len(axes) > 1 and jax.process_count() > 1:
+        try:
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                mesh_shape=shape[1:], dcn_mesh_shape=(shape[0],) + (1,) * (len(shape) - 1))
+            return Mesh(dev_array, axes)
+        except Exception:  # heterogeneous/virtual platforms: fall through
+            pass
+    try:
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception:
+        # Virtual CPU meshes (forced host platform count) lack topology
+        # info; a plain reshape preserves the launcher's rank order.
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axes)
+
+
+def data_axis(mesh: Mesh) -> str:
+    """The axis gradients are averaged over (the GLOBAL communicator
+    equivalent): by convention the axis named 'data', else the last axis."""
+    return "data" if "data" in mesh.axis_names else mesh.axis_names[-1]
+
+
+def mesh_size(mesh: Mesh, axis=None) -> int:
+    if axis is None:
+        return int(np.prod(list(mesh.shape.values())))
+    if isinstance(axis, (tuple, list)):
+        return int(np.prod([mesh.shape[a] for a in axis]))
+    return mesh.shape[axis]

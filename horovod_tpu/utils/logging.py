@@ -1,0 +1,50 @@
+"""Logging, mirroring the reference's env-controlled logger.
+
+Horovod equivalent: ``horovod/common/logging.{h,cc}`` — ``LOG(severity)``
+stream macros with level from ``HOROVOD_LOG_LEVEL`` and a timestamp toggle
+``HOROVOD_LOG_HIDE_TIME`` (reference ``logging.h:10-60``).  The native C++
+runtime has its own copy of this scheme; this module is the Python face.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_LEVELS = {
+    "trace": logging.DEBUG,   # python has no TRACE; map to DEBUG
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+    "none": logging.CRITICAL + 10,
+}
+
+_configured = False
+
+
+def _configure() -> None:
+    global _configured
+    if _configured:
+        return
+    level_name = os.environ.get("HOROVOD_LOG_LEVEL", "warning").lower()
+    level = _LEVELS.get(level_name, logging.WARNING)
+    hide_time = os.environ.get("HOROVOD_LOG_HIDE_TIME", "0") == "1"
+    fmt = "[%(levelname).1s %(name)s] %(message)s" if hide_time else \
+          "[%(asctime)s.%(msecs)03d %(levelname).1s %(name)s] %(message)s"
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(fmt, datefmt="%Y-%m-%d %H:%M:%S"))
+    root = logging.getLogger("horovod_tpu")
+    root.addHandler(handler)
+    root.setLevel(level)
+    root.propagate = False
+    _configured = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    _configure()
+    if not name.startswith("horovod_tpu"):
+        name = "horovod_tpu." + name
+    return logging.getLogger(name)
