@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Driver benchmark: ResNet-50 synthetic training throughput per chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline anchor (BASELINE.md): the reference's published absolute number is
+ResNet-101 at 1656.82 img/sec on 16 Pascal GPUs (reference
+``docs/benchmarks.rst:26-43``) = 103.55 img/sec/GPU; that is the
+``vs_baseline`` denominator for our ResNet-50-per-chip number (the closest
+published absolute-throughput anchor the reference ships).
+"""
+
+import json
+import os
+import sys
+
+BASELINE_IMG_SEC_PER_CHIP = 1656.82 / 16.0
+
+
+def main():
+    batch_size = int(os.environ.get("BENCH_BATCH_SIZE", "128"))
+    import horovod_tpu as hvd
+    from horovod_tpu.benchmark import run_synthetic_benchmark
+
+    hvd.init()
+    res = run_synthetic_benchmark(
+        model_name=os.environ.get("BENCH_MODEL", "resnet50"),
+        batch_size=batch_size,
+        num_warmup_batches=int(os.environ.get("BENCH_WARMUP", "5")),
+        num_batches_per_iter=int(os.environ.get("BENCH_BATCHES", "10")),
+        num_iters=int(os.environ.get("BENCH_ITERS", "5")),
+        verbose=os.environ.get("BENCH_VERBOSE", "0") == "1",
+    )
+    value = res["img_sec_per_chip"]
+    print(json.dumps({
+        "metric": "resnet50_synthetic_img_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "img/sec/chip",
+        "vs_baseline": round(value / BASELINE_IMG_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -69,6 +69,18 @@ class _State:
 _state = _State()
 
 
+def _reset_state_locked() -> None:
+    """Restore topology fields to their pre-init defaults (caller holds the
+    lock)."""
+    _state.rank, _state.size = 0, 1
+    _state.local_rank, _state.local_size = 0, 1
+    _state.cross_rank, _state.cross_size = 0, 1
+    _state.ranks = None
+    _state.runtime = None
+    _state.mesh_cache.clear()
+    _state.initialized = False
+
+
 def _env_int(name: str, default: int) -> int:
     v = os.environ.get(name)
     return default if v is None or v == "" else int(v)
@@ -109,8 +121,30 @@ def init(comm=None, ranks: Optional[Sequence[int]] = None) -> None:
         if comm is not None and hasattr(comm, "Get_rank"):
             _state.rank = comm.Get_rank()
             _state.size = comm.Get_size()
-            _state.local_rank = _state.rank
-            _state.local_size = _state.size
+            # Derive the LOCAL/CROSS topology the way the reference does
+            # (MPI_Comm_split_type COMM_TYPE_SHARED, mpi_controller.cc:25-81);
+            # env overrides win, then an mpi4py shared split, then the
+            # single-node assumption.
+            local_rank = os.environ.get("HOROVOD_LOCAL_RANK")
+            local_size = os.environ.get("HOROVOD_LOCAL_SIZE")
+            if local_rank is not None and local_size is not None:
+                _state.local_rank = int(local_rank)
+                _state.local_size = int(local_size)
+            elif hasattr(comm, "Split_type"):
+                try:
+                    from mpi4py import MPI
+                    local = comm.Split_type(MPI.COMM_TYPE_SHARED)
+                    _state.local_rank = local.Get_rank()
+                    _state.local_size = local.Get_size()
+                    local.Free()
+                except Exception:
+                    _state.local_rank = _state.rank
+                    _state.local_size = _state.size
+            else:
+                _state.local_rank = _state.rank
+                _state.local_size = _state.size
+            _state.cross_rank = _state.rank // max(_state.local_size, 1)
+            _state.cross_size = -(-_state.size // max(_state.local_size, 1))
         else:
             _state.rank = _env_int("HOROVOD_RANK", jax.process_index())
             _state.size = _env_int("HOROVOD_SIZE", jax.process_count())
@@ -144,8 +178,10 @@ def init(comm=None, ranks: Optional[Sequence[int]] = None) -> None:
             try:
                 runtime.start()
             except Exception:
-                # Leave the process cleanly un-initialized (reference keeps
-                # a hard ErrorOp fallback instead; we surface the error).
+                # Leave the process cleanly un-initialized so a corrected
+                # re-init is possible (the reference instead falls back to a
+                # hard ErrorOp; we surface the error).
+                _reset_state_locked()
                 raise
             _state.runtime = runtime
 

@@ -1,0 +1,160 @@
+"""Synthetic training benchmark — the measurement harness of record.
+
+Faithful to the reference harness (``examples/tensorflow2_synthetic_benchmark.py``:
+synthetic fixed batch, ``--num-warmup-batches`` then ``num_iters`` rounds of
+``num_batches_per_iter`` steps, img/sec mean ± 1.96σ over rounds,
+``:86-132``), rebuilt as one jitted SPMD program over the device mesh.
+
+The whole Horovod DP recipe — shard the batch over chips, replicate
+parameters, allreduce (fused ``pmean``) gradients, identical update — is a
+single XLA program here; the gradient averaging that the reference performs
+with its background thread + NCCL rings lowers to ICI collectives that XLA
+overlaps with backprop compute.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.ops.fusion import fused_pytree_mean
+from horovod_tpu.topology import data_axis, mesh_size
+
+
+def make_train_step(model, optimizer, mesh, axis_name: Optional[str] = None):
+    """One SPMD training step for a flax model with BatchNorm state.
+
+    Returns ``step(params, batch_stats, opt_state, images, labels) ->
+    (params, batch_stats, opt_state, loss)`` jitted over ``mesh`` with the
+    batch sharded on the data axis, everything else replicated.
+    """
+    ax = axis_name or data_axis(mesh)
+
+    def _step(params, batch_stats, opt_state, images, labels):
+        def loss_fn(p):
+            logits, mutated = model.apply(
+                {"params": p, "batch_stats": batch_stats}, images,
+                train=True, mutable=["batch_stats"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+            return loss, mutated["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        # The Horovod step: average gradients across the mesh (fused psum —
+        # reference fusion_buffer_manager + NCCLAllreduce, here one bf16-safe
+        # bucketed pmean riding ICI).
+        grads = fused_pytree_mean(grads, ax)
+        updates, new_opt_state = optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_stats, new_opt_state, lax.pmean(loss, ax)
+
+    repl, shard = P(), P(ax)
+    smapped = jax.shard_map(
+        _step, mesh=mesh,
+        in_specs=(repl, repl, repl, shard, shard),
+        out_specs=(repl, repl, repl, repl),
+        check_vma=False)
+    return jax.jit(smapped, donate_argnums=(0, 1, 2))
+
+
+def run_synthetic_benchmark(model_name: str = "resnet50",
+                            batch_size: int = 64,
+                            image_size: int = 224,
+                            num_classes: int = 1000,
+                            num_warmup_batches: int = 5,
+                            num_batches_per_iter: int = 10,
+                            num_iters: int = 10,
+                            learning_rate: float = 0.01,
+                            mesh=None,
+                            verbose: bool = True) -> dict:
+    """Run the ResNet synthetic benchmark; returns a result dict.
+
+    ``batch_size`` is per chip, as in the reference (``--batch-size`` is per
+    worker, ``tensorflow2_synthetic_benchmark.py:20``).
+    """
+    from horovod_tpu.models import get_model
+
+    if not hvd.is_initialized():
+        hvd.init()
+    mesh = mesh if mesh is not None else hvd.mesh()
+    ax = data_axis(mesh)
+    n_chips = mesh_size(mesh)
+    global_bs = batch_size * n_chips
+
+    model = get_model(model_name, num_classes=num_classes)
+    rng = jax.random.PRNGKey(0)
+    variables = model.init(rng, jnp.zeros((1, image_size, image_size, 3),
+                                          jnp.float32), train=False)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    optimizer = optax.sgd(learning_rate, momentum=0.9)
+    opt_state = optimizer.init(params)
+
+    # Fixed synthetic batch, placed sharded on the data axis (reference keeps
+    # one random batch for the whole run, :40-43).
+    images = jax.device_put(
+        np.random.default_rng(0).standard_normal(
+            (global_bs, image_size, image_size, 3), dtype=np.float32),
+        NamedSharding(mesh, P(ax)))
+    labels = jax.device_put(
+        np.random.default_rng(1).integers(0, num_classes, (global_bs,),
+                                          dtype=np.int32),
+        NamedSharding(mesh, P(ax)))
+    repl = NamedSharding(mesh, P())
+    params, batch_stats, opt_state = jax.device_put(
+        (params, batch_stats, opt_state), repl)
+
+    step = make_train_step(model, optimizer, mesh, ax)
+
+    if verbose:
+        print(f"Model: {model_name}", flush=True)
+        print(f"Batch size: {batch_size} per chip, {global_bs} global "
+              f"({n_chips} chips)", flush=True)
+
+    # Sync point: a tiny scalar D2H transfer of the loss.  On tunneled/remote
+    # PJRT platforms `block_until_ready` can return before device execution
+    # finishes; fetching the scalar output is the reliable barrier (and the
+    # loss of step N depends on every prior step's params, so it fences the
+    # whole round).
+    for _ in range(num_warmup_batches):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, images, labels)
+    float(np.asarray(loss))
+
+    img_secs = []
+    for i in range(num_iters):
+        t0 = time.perf_counter()
+        for _ in range(num_batches_per_iter):
+            params, batch_stats, opt_state, loss = step(
+                params, batch_stats, opt_state, images, labels)
+        float(np.asarray(loss))
+        dt = time.perf_counter() - t0
+        img_sec = global_bs * num_batches_per_iter / dt
+        img_secs.append(img_sec)
+        if verbose:
+            print(f"Iter #{i}: {img_sec:.1f} img/sec total", flush=True)
+
+    img_sec_mean = float(np.mean(img_secs))
+    img_sec_conf = float(1.96 * np.std(img_secs))
+    if verbose:
+        print(f"Img/sec per chip: {img_sec_mean / n_chips:.1f} "
+              f"+-{img_sec_conf / n_chips:.1f}", flush=True)
+        print(f"Total img/sec on {n_chips} chip(s): "
+              f"{img_sec_mean:.1f} +-{img_sec_conf:.1f}", flush=True)
+    return {
+        "model": model_name,
+        "batch_size_per_chip": batch_size,
+        "n_chips": n_chips,
+        "img_sec_total": img_sec_mean,
+        "img_sec_conf": img_sec_conf,
+        "img_sec_per_chip": img_sec_mean / n_chips,
+        "loss": float(np.asarray(loss)),
+    }

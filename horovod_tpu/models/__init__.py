@@ -1,0 +1,19 @@
+"""Model zoo for benchmarks and examples.
+
+The reference has no model code of its own — it benchmarks with
+``tf.keras.applications`` / ``torchvision`` models pulled in by the example
+scripts (reference ``examples/tensorflow2_synthetic_benchmark.py:24-30``,
+``examples/pytorch_synthetic_benchmark.py:28-35``).  A standalone TPU
+framework cannot lean on those, so the models live here, written
+TPU-first (NHWC, bfloat16 matmuls/convs on the MXU, fp32 accumulation).
+"""
+
+from horovod_tpu.models.resnet import (
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)
+from horovod_tpu.models.registry import get_model, list_models

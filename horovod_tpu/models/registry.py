@@ -1,0 +1,39 @@
+"""Name -> model-constructor registry, so the benchmark scripts can select a
+model by flag exactly like the reference's ``--model`` argument
+(``examples/tensorflow2_synthetic_benchmark.py:18`` resolves any
+``tf.keras.applications`` attribute by name)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register(name: str, ctor: Callable) -> None:
+    _REGISTRY[name.lower()] = ctor
+
+
+def get_model(name: str, **kwargs):
+    try:
+        ctor = _REGISTRY[name.lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown model {name!r}; available: {sorted(_REGISTRY)}")
+    return ctor(**kwargs)
+
+
+def list_models():
+    return sorted(_REGISTRY)
+
+
+def _register_defaults():
+    from horovod_tpu.models import resnet
+    register("resnet18", resnet.ResNet18)
+    register("resnet34", resnet.ResNet34)
+    register("resnet50", resnet.ResNet50)
+    register("resnet101", resnet.ResNet101)
+    register("resnet152", resnet.ResNet152)
+
+
+_register_defaults()

@@ -69,6 +69,11 @@ class Runtime:
         self.local_rank = local_rank
         self.local_size = local_size
         self._lib = None
+        # handle -> input buffer: the native thread reads the enqueued
+        # pointer asynchronously, so the array must stay referenced from
+        # enqueue until the wait completes.
+        self._inflight: dict = {}
+        self._inflight_lock = __import__("threading").Lock()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -128,10 +133,14 @@ class Runtime:
             arr.size, code, arg, first_dim)
         if h < 0:
             raise RuntimeError(self._lib.hvd_last_error().decode())
+        with self._inflight_lock:
+            self._inflight[h] = arr
         return h
 
     def _wait_read(self, h: int, dtype, trailing_shape) -> np.ndarray:
         rc = self._lib.hvd_wait(h)
+        with self._inflight_lock:
+            self._inflight.pop(h, None)
         if rc != 0:
             raise RuntimeError(self._lib.hvd_last_error().decode())
         n = self._lib.hvd_output_size(h)

@@ -441,7 +441,9 @@ def broadcast(tensor, root_rank=0, name=None, axis_name=None):
         idx = lax.axis_index(ax)
         masked = jnp.where(idx == root_rank, tensor,
                            jnp.zeros_like(tensor))
-        return lax.psum(masked, ax)
+        # psum promotes bool -> int32; restore the caller's dtype so the
+        # result aval matches the input (donation/apply_updates safety).
+        return lax.psum(masked, ax).astype(jnp.asarray(tensor).dtype)
     if _is_traced(tensor):
         return _plain_jit_fallback(tensor, "broadcast")
     basics._check_initialized()

@@ -54,9 +54,13 @@ def _allreduce_tree(grads, axis_name: str, compression=Compression.none,
         reduced = [collective._plain_jit_fallback(l, "DistributedOptimizer")
                    for l in cleaves]
     else:
-        reduced = [
-            collective.allreduce(l, op=op, name=f"DistributedGrad.{i}")
+        # Enqueue every leaf before waiting on any — restores the overlap
+        # Horovod's background loop provides (grads stream to the runtime
+        # while earlier ones are still reducing).
+        handles = [
+            collective.allreduce_async(l, op=op, name=f"DistributedGrad.{i}")
             for i, l in enumerate(cleaves)]
+        reduced = [collective.synchronize(h) for h in handles]
     out = [compression.decompress(l, c) for l, c in zip(reduced, ctxs)]
     return jax.tree_util.tree_unflatten(treedef, out)
 
@@ -109,17 +113,34 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
 def DistributedGradientTape(grad_fn: Callable, *,
                             compression=Compression.none,
                             axis_name: str = "data",
-                            op=collective.Average) -> Callable:
+                            op=collective.Average,
+                            has_value: Optional[bool] = None) -> Callable:
     """Wrap a gradient function so its output pytree is averaged across
     workers — the JAX rendition of reference ``DistributedGradientTape``
     (``tensorflow/__init__.py:323-376``), where ``grad_fn`` is typically
     ``jax.grad(loss_fn)`` or ``jax.value_and_grad(loss_fn)``.
+
+    ``has_value`` declares whether ``grad_fn`` returns ``(value, grads)``
+    (``jax.value_and_grad``) or just ``grads`` (``jax.grad``).  When left
+    unset it is inferred: a 2-tuple whose first element is a scalar array is
+    treated as ``(value, grads)``.  Pass it explicitly for outputs where the
+    inference is ambiguous (e.g. ``jax.grad(..., argnums=(0, 1))`` whose
+    first gradient is itself a scalar).
     """
+
+    def _looks_like_value(v) -> bool:
+        try:
+            return jnp.ndim(v) == 0
+        except TypeError:
+            return False
 
     @functools.wraps(grad_fn)
     def wrapped(*args, **kwargs):
         out = grad_fn(*args, **kwargs)
-        if isinstance(out, tuple) and len(out) == 2:
+        is_pair = (has_value if has_value is not None
+                   else isinstance(out, tuple) and len(out) == 2
+                   and _looks_like_value(out[0]))
+        if is_pair:
             value, grads = out
             return value, _allreduce_tree(grads, axis_name, compression, op)
         return _allreduce_tree(out, axis_name, compression, op)
