@@ -1,0 +1,73 @@
+"""Build the native runtime: ``python -m horovod_tpu.native.build``.
+
+Reference equivalent: the compile steps of setup.py (a 1449-line monolith
+probing MPI/CUDA/NCCL/framework ABIs, SURVEY §2.4); the TPU rebuild needs
+none of that detection — one g++-compiled shared library with no external
+dependencies.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+CC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "cc")
+LIB_PATH = os.path.join(CC_DIR, "build", "libhorovod_tpu.so")
+
+
+def build(force: bool = False, quiet: bool = False) -> str:
+    """Run make; returns the library path."""
+    if force:
+        subprocess.run(["make", "-C", CC_DIR, "clean"], check=True,
+                       capture_output=quiet)
+    proc = subprocess.run(
+        ["make", "-C", CC_DIR, "-j", str(os.cpu_count() or 4)],
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stdout)
+        sys.stderr.write(proc.stderr)
+        raise RuntimeError("native build failed")
+    if not quiet and proc.stdout.strip():
+        print(proc.stdout, end="")
+    return LIB_PATH
+
+
+def _up_to_date() -> bool:
+    if not os.path.exists(LIB_PATH):
+        return False
+    lib_mtime = os.path.getmtime(LIB_PATH)
+    newest = 0.0
+    for root, _, files in os.walk(CC_DIR):
+        if os.path.basename(root) == "build":
+            continue
+        for f in files:
+            newest = max(newest, os.path.getmtime(os.path.join(root, f)))
+    return newest <= lib_mtime
+
+
+def ensure_built(quiet: bool = True) -> str:
+    """Build only if the library is missing or sources are newer.
+
+    Serialized across processes with an flock: every local rank of a fresh
+    checkout calls this concurrently, and parallel `make` runs in one build
+    directory would corrupt the .so mid-dlopen.
+    """
+    if _up_to_date():
+        return LIB_PATH
+    import fcntl
+
+    os.makedirs(os.path.join(CC_DIR, "build"), exist_ok=True)
+    lock_path = os.path.join(CC_DIR, "build", ".build.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if _up_to_date():   # another rank built while we waited
+                return LIB_PATH
+            return build(quiet=quiet)
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+
+
+if __name__ == "__main__":
+    print(build(force="--force" in sys.argv))

@@ -1,0 +1,55 @@
+// C ABI consumed by horovod_tpu/native/runtime.py over ctypes.
+//
+// Reference equivalent: the extern "C" surface of
+// horovod/common/operations.cc:611-732 (lifecycle + introspection) plus the
+// enqueue layer (operations.cc:736-843), collapsed to a handle-based API in
+// the style of horovod/torch/handle_manager.
+#ifndef HVD_C_API_H
+#define HVD_C_API_H
+
+#include <stdint.h>
+
+extern "C" {
+
+// Start the runtime: spawns the background thread, performs rendezvous with
+// rank 0 at addr:port, builds the data-plane mesh.  Returns 0 on success.
+int hvd_init(int rank, int size, int local_rank, int local_size,
+             const char* rendezvous_addr, int rendezvous_port);
+
+// Graceful shutdown: negotiated with all ranks; pending ops fail with a
+// shutdown error.
+void hvd_shutdown();
+
+int hvd_rank();
+int hvd_size();
+int hvd_local_rank();
+int hvd_local_size();
+int hvd_is_initialized();
+
+// Enqueue a collective.  `shape` has `ndim` dims (scalar: ndim=0).
+// `arg` = reduce-op code (allreduce/reducescatter) or root rank (broadcast).
+// Returns a handle >= 0, or -1 (error text via hvd_last_error).
+int64_t hvd_enqueue(int op_type, const char* name, const void* data,
+                    const int64_t* shape, int32_t ndim, int dtype, int arg);
+
+// 1 when the op has completed (successfully or not).
+int hvd_poll(int64_t handle);
+
+// Block until completion; returns 0 on success, else sets hvd_last_error.
+int hvd_wait(int64_t handle);
+
+// Element count of the output (valid after successful wait).
+int64_t hvd_output_size(int64_t handle);
+
+// Copy `count` output elements into `dst` and release the handle.
+int hvd_read_output(int64_t handle, void* dst, int64_t count);
+
+// Release a handle without reading (error cases).
+void hvd_release(int64_t handle);
+
+// Last error message for this process (not cleared on success).
+const char* hvd_last_error();
+
+}  // extern "C"
+
+#endif  // HVD_C_API_H

@@ -1,0 +1,76 @@
+// Coordination protocol: which named tensors are ready on ALL ranks, in what
+// (identical) order, with full cross-rank validation.
+//
+// Reference equivalent: horovod/common/controller.{h,cc} (ComputeResponseList,
+// IncrementTensorCount, ConstructResponse, FuseResponses; protocol spec in
+// controller.h:62-96) with the MPI/Gloo transports replaced by a TCP
+// master-worker exchange (rank 0 = coordinator, as in the reference).
+//
+// Unlike the reference's MPI_Gather/Bcast rounds, each cycle here is one
+// framed request/response exchange per worker over persistent sockets.
+#ifndef HVD_CONTROLLER_H
+#define HVD_CONTROLLER_H
+
+#include <chrono>
+#include <deque>
+#include <unordered_map>
+#include <vector>
+
+#include "data_plane.h"
+#include "message.h"
+#include "socket.h"
+#include "stall_inspector.h"
+
+namespace hvd {
+
+class Controller {
+ public:
+  // Rendezvous + topology exchange.  Rank 0 listens on master_addr:port;
+  // workers connect, announce their data-plane endpoint, and receive the
+  // full peer table (reference gloo rendezvous, gloo_context.cc:56-157).
+  Status Init(int rank, int size, const std::string& master_addr,
+              int master_port, const std::string& my_data_host,
+              int my_data_port, std::vector<PeerAddr>* peers_out);
+
+  // One lock-step negotiation cycle (reference RunLoopOnce ->
+  // ComputeResponseList).  `mine` is consumed; `out` receives the verdict
+  // list identical on every rank.
+  Status Cycle(RequestList& mine, ResponseList* out);
+
+  void Shutdown();
+
+  int64_t fusion_threshold() const { return fusion_threshold_; }
+  StallInspector& stall_inspector() { return stall_; }
+
+ private:
+  struct PendingTensor {
+    std::vector<Request> requests;           // one per submitting rank
+    std::vector<bool> submitted;             // [size]
+    std::chrono::steady_clock::time_point first_seen;
+    int count = 0;
+  };
+
+  Status MasterCycle(const RequestList& mine, ResponseList* out);
+  // Record one rank's announcements (reference IncrementTensorCount,
+  // controller.cc:700-723); names becoming ready join ready_ in arrival
+  // order (identical on all ranks because only the master defines it).
+  void Ingest(const RequestList& list, int from_rank);
+  Response ConstructResponse(const std::string& name);
+  void Fuse(std::vector<Response>* responses);
+
+  int rank_ = 0;
+  int size_ = 1;
+  TcpSocket listener_;
+  std::vector<TcpSocket> workers_;  // master: control conns, index = rank
+  TcpSocket master_;                // worker: conn to rank 0
+
+  std::unordered_map<std::string, PendingTensor> table_;
+  std::deque<std::string> ready_;
+  std::vector<bool> shutdown_ranks_;
+  int64_t fusion_threshold_ = 0;
+  StallInspector stall_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_CONTROLLER_H

@@ -1,0 +1,74 @@
+// Eager data plane: host-memory collectives over a full TCP mesh.
+//
+// Reference equivalent: the communication backends of horovod/common/ops/
+// (gloo_operations.cc for CPU tensors).  Topology: every rank holds a
+// persistent connection to every other rank (gloo-style full mesh,
+// reference gloo_context.cc:56-76).  Algorithms:
+//   allreduce      — ring reduce-scatter + ring allgather (bandwidth-optimal,
+//                    the same algorithm NCCL rings implement)
+//   reducescatter  — the ring reduce-scatter half
+//   allgather      — full-duplex pairwise rotation
+//   broadcast      — root fan-out
+//   alltoall       — full-duplex pairwise rotation
+#ifndef HVD_DATA_PLANE_H
+#define HVD_DATA_PLANE_H
+
+#include <memory>
+#include <vector>
+
+#include "hvd_common.h"
+#include "socket.h"
+
+namespace hvd {
+
+struct PeerAddr {
+  std::string host;
+  int port = 0;
+};
+
+class DataPlane {
+ public:
+  // Start the listener; the bound port is advertised through the controller
+  // rendezvous.
+  Status Listen(const std::string& bind_addr);
+  int port() const { return listener_.bound_port(); }
+
+  // Establish the full mesh: connect to lower ranks, accept from higher
+  // ranks (deadlock-free order).
+  Status Connect(int rank, int size, const std::vector<PeerAddr>& peers);
+
+  // In-place ring allreduce over buf (count elements).
+  Status Allreduce(void* buf, int64_t count, DataType dtype, ReduceOp op);
+  // Reduce across ranks, keep my dim-0 chunk: in has count elems,
+  // out has count/size.
+  Status Reducescatter(const void* in, void* out, int64_t count,
+                       DataType dtype, ReduceOp op);
+  // out = concat of every rank's block; counts[r] = rank r's BYTE count
+  // (dtype-agnostic; callers multiply by element size).
+  Status Allgather(const void* in, void* out,
+                   const std::vector<int64_t>& counts);
+  Status Broadcast(void* buf, int64_t count, DataType dtype, int root);
+  // Equal splits: count divisible by size; block i goes to rank i.
+  Status Alltoall(const void* in, void* out, int64_t count, DataType dtype);
+
+  void Shutdown();
+
+ private:
+  // Full-duplex send+recv with one peer (avoids head-of-line deadlock on
+  // large payloads).
+  Status SendRecv(int send_peer, const void* sbuf, size_t sbytes,
+                  int recv_peer, void* rbuf, size_t rbytes);
+
+  int rank_ = 0;
+  int size_ = 1;
+  TcpSocket listener_;
+  std::vector<std::unique_ptr<TcpSocket>> peers_;  // [size], self = null
+};
+
+// Typed reduction: acc[i] op= val[i].  Exposed for the fusion layer.
+void ReduceInto(void* acc, const void* val, int64_t count, DataType dtype,
+                ReduceOp op);
+
+}  // namespace hvd
+
+#endif  // HVD_DATA_PLANE_H

@@ -1,0 +1,186 @@
+// Common types for the horovod_tpu native runtime.
+//
+// Reference equivalents: horovod/common/common.h (DataType, StatusType,
+// TensorTableEntry), horovod/common/logging.{h,cc} (LOG macros),
+// horovod/common/utils/env_parser.{h,cc} (typed env getters).
+//
+// This runtime serves the *eager* plane of a TPU-native framework: host-memory
+// tensors negotiated by name across processes and moved over TCP (the moral
+// equivalent of the reference's Gloo CPU path).  The SPMD/jit plane never
+// enters this library — XLA emits ICI collectives directly.
+#ifndef HVD_COMMON_H
+#define HVD_COMMON_H
+
+#include <strings.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace hvd {
+
+// Wire dtype codes; must match horovod_tpu/native/runtime.py _DTYPE_CODES.
+enum class DataType : int32_t {
+  kUint8 = 0,
+  kInt8 = 1,
+  kUint16 = 2,
+  kInt16 = 3,
+  kInt32 = 4,
+  kInt64 = 5,
+  kFloat16 = 6,
+  kFloat32 = 7,
+  kFloat64 = 8,
+  kBool = 9,
+  kBfloat16 = 10,
+};
+
+inline size_t DataTypeSize(DataType t) {
+  switch (t) {
+    case DataType::kUint8: case DataType::kInt8: case DataType::kBool:
+      return 1;
+    case DataType::kUint16: case DataType::kInt16:
+    case DataType::kFloat16: case DataType::kBfloat16:
+      return 2;
+    case DataType::kInt32: case DataType::kFloat32:
+      return 4;
+    case DataType::kInt64: case DataType::kFloat64:
+      return 8;
+  }
+  return 0;
+}
+
+inline const char* DataTypeName(DataType t) {
+  switch (t) {
+    case DataType::kUint8: return "uint8";
+    case DataType::kInt8: return "int8";
+    case DataType::kUint16: return "uint16";
+    case DataType::kInt16: return "int16";
+    case DataType::kInt32: return "int32";
+    case DataType::kInt64: return "int64";
+    case DataType::kFloat16: return "float16";
+    case DataType::kFloat32: return "float32";
+    case DataType::kFloat64: return "float64";
+    case DataType::kBool: return "bool";
+    case DataType::kBfloat16: return "bfloat16";
+  }
+  return "unknown";
+}
+
+// Collective kinds; must match runtime.py hvd_enqueue op codes.
+enum class OpType : int32_t {
+  kAllreduce = 0,
+  kAllgather = 1,
+  kBroadcast = 2,
+  kAlltoall = 3,
+  kReducescatter = 4,
+  kBarrier = 5,
+  kJoin = 6,
+};
+
+inline const char* OpTypeName(OpType t) {
+  switch (t) {
+    case OpType::kAllreduce: return "allreduce";
+    case OpType::kAllgather: return "allgather";
+    case OpType::kBroadcast: return "broadcast";
+    case OpType::kAlltoall: return "alltoall";
+    case OpType::kReducescatter: return "reducescatter";
+    case OpType::kBarrier: return "barrier";
+    case OpType::kJoin: return "join";
+  }
+  return "unknown";
+}
+
+// Reduction codes (match ops/collective.py ReduceOp codes).
+enum class ReduceOp : int32_t {
+  kAverage = 0,   // executed as Sum; the Python layer divides
+  kSum = 1,
+  kAdasum = 2,    // executed as Sum
+  kMin = 3,
+  kMax = 4,
+};
+
+// Status model (reference common.h StatusType + Status).
+enum class StatusCode : int32_t {
+  kOk = 0,
+  kUnknownError = 1,
+  kPreconditionError = 2,
+  kAborted = 3,
+  kInvalidArgument = 4,
+  kInProgress = 5,
+};
+
+struct Status {
+  StatusCode code = StatusCode::kOk;
+  std::string reason;
+
+  static Status OK() { return Status(); }
+  static Status Error(StatusCode c, std::string r) { return Status{c, std::move(r)}; }
+  static Status Unknown(std::string r) { return Error(StatusCode::kUnknownError, std::move(r)); }
+  static Status Precondition(std::string r) { return Error(StatusCode::kPreconditionError, std::move(r)); }
+  static Status InvalidArgument(std::string r) { return Error(StatusCode::kInvalidArgument, std::move(r)); }
+  static Status Aborted(std::string r) { return Error(StatusCode::kAborted, std::move(r)); }
+  bool ok() const { return code == StatusCode::kOk; }
+};
+
+// ---------------------------------------------------------------------------
+// Logging (reference logging.h:10-60): LOG(LEVEL) << "...";
+// level from HOROVOD_LOG_LEVEL in {trace,debug,info,warning,error,fatal}.
+// ---------------------------------------------------------------------------
+
+enum class LogLevel : int { kTrace = 0, kDebug, kInfo, kWarning, kError, kFatal };
+
+LogLevel MinLogLevel();
+
+class LogMessage {
+ public:
+  LogMessage(const char* file, int line, LogLevel level);
+  ~LogMessage();
+  std::ostringstream& stream() { return stream_; }
+
+ private:
+  std::ostringstream stream_;
+  LogLevel level_;
+};
+
+#define HVD_LOG_IS_ON(lvl) (::hvd::LogLevel::lvl >= ::hvd::MinLogLevel())
+#define LOG(lvl)                                        \
+  if (HVD_LOG_IS_ON(k##lvl))                            \
+  ::hvd::LogMessage(__FILE__, __LINE__, ::hvd::LogLevel::k##lvl).stream()
+
+// ---------------------------------------------------------------------------
+// Env helpers (reference env_parser.cc:119-160).
+// ---------------------------------------------------------------------------
+
+inline int64_t EnvInt(const char* name, int64_t dflt) {
+  const char* v = std::getenv(name);
+  if (v == nullptr || *v == '\0') return dflt;
+  return std::strtoll(v, nullptr, 10);
+}
+
+inline double EnvDouble(const char* name, double dflt) {
+  const char* v = std::getenv(name);
+  if (v == nullptr || *v == '\0') return dflt;
+  return std::strtod(v, nullptr);
+}
+
+inline std::string EnvStr(const char* name, const std::string& dflt = "") {
+  const char* v = std::getenv(name);
+  return (v == nullptr) ? dflt : std::string(v);
+}
+
+inline bool EnvBool(const char* name, bool dflt) {
+  const char* v = std::getenv(name);
+  if (v == nullptr || *v == '\0') return dflt;
+  return std::strcmp(v, "0") != 0 && ::strcasecmp(v, "false") != 0;
+}
+
+}  // namespace hvd
+
+#endif  // HVD_COMMON_H

@@ -1,0 +1,64 @@
+// Control-plane wire format.
+//
+// Reference equivalent: horovod/common/message.{h,cc} + wire/message.fbs
+// (FlatBuffers).  The payloads are tiny (names + shapes), exchanged once per
+// cycle, so a hand-rolled length-prefixed binary format is simpler than a
+// vendored serializer and keeps this runtime dependency-free.
+#ifndef HVD_MESSAGE_H
+#define HVD_MESSAGE_H
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "hvd_common.h"
+
+namespace hvd {
+
+// A worker's per-tensor announcement (reference message.h:45-110).
+struct Request {
+  int32_t rank = 0;
+  OpType op_type = OpType::kAllreduce;
+  DataType dtype = DataType::kFloat32;
+  int32_t arg = 0;          // reduce-op code or broadcast root
+  std::string name;
+  std::vector<int64_t> shape;
+};
+
+// Everything a worker tells the coordinator each cycle
+// (reference RequestList, message.h:110-140).
+struct RequestList {
+  std::vector<Request> requests;
+  bool shutdown = false;
+  std::vector<uint64_t> cache_hits;   // response-cache bit vector
+
+  std::string Serialize() const;
+  static Status Parse(const std::string& buf, RequestList* out);
+};
+
+// A coordinator verdict for one (possibly fused) collective
+// (reference Response, message.h:140-199).
+struct Response {
+  OpType op_type = OpType::kAllreduce;
+  DataType dtype = DataType::kFloat32;
+  int32_t arg = 0;
+  bool error = false;
+  std::string error_message;
+  std::vector<std::string> names;
+  // Allgather/alltoall: first-dim sizes of every rank (reference
+  // Response::tensor_sizes); empty otherwise.
+  std::vector<int64_t> first_dims;
+};
+
+struct ResponseList {
+  std::vector<Response> responses;
+  bool shutdown = false;
+  std::vector<uint64_t> cache_valid;  // synchronized cache bits (AND)
+
+  std::string Serialize() const;
+  static Status Parse(const std::string& buf, ResponseList* out);
+};
+
+}  // namespace hvd
+
+#endif  // HVD_MESSAGE_H

@@ -1,0 +1,59 @@
+// Minimal TCP layer for the control plane and the eager data plane.
+//
+// Reference equivalent: the vendored gloo TCP transport + the rendezvous
+// bootstrap of horovod/common/gloo/gloo_context.cc:56-157.  We need far less:
+// persistent framed streams between a fixed set of ranks on a trusted
+// cluster network.
+#ifndef HVD_SOCKET_H
+#define HVD_SOCKET_H
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "hvd_common.h"
+
+namespace hvd {
+
+class TcpSocket {
+ public:
+  TcpSocket() = default;
+  explicit TcpSocket(int fd) : fd_(fd) {}
+  ~TcpSocket();
+  TcpSocket(const TcpSocket&) = delete;
+  TcpSocket& operator=(const TcpSocket&) = delete;
+  TcpSocket(TcpSocket&& o) noexcept : fd_(o.fd_) { o.fd_ = -1; }
+  TcpSocket& operator=(TcpSocket&& o) noexcept;
+
+  // Listen on addr:port (port 0 = ephemeral); sets bound port.
+  Status Listen(const std::string& addr, int port);
+  // Accept one connection (blocking, with optional timeout).
+  Status Accept(TcpSocket* out, int timeout_ms = -1) const;
+  // Connect with retry until deadline (the peer may not be up yet —
+  // reference rendezvous has the same grace logic).
+  Status Connect(const std::string& addr, int port, int timeout_ms = 30000);
+
+  Status SendAll(const void* data, size_t n) const;
+  Status RecvAll(void* data, size_t n) const;
+
+  // Length-prefixed frames.
+  Status SendFrame(const void* data, size_t n) const;
+  Status SendFrame(const std::string& s) const {
+    return SendFrame(s.data(), s.size());
+  }
+  Status RecvFrame(std::string* out) const;
+
+  void Close();
+  bool valid() const { return fd_ >= 0; }
+  int fd() const { return fd_; }
+  int bound_port() const { return bound_port_; }
+  std::string peer_addr() const;
+
+ private:
+  int fd_ = -1;
+  int bound_port_ = 0;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_SOCKET_H

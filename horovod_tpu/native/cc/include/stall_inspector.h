@@ -1,0 +1,40 @@
+// Coordinator-side watchdog for ranks that stopped submitting.
+//
+// Reference equivalent: horovod/common/stall_inspector.{h,cc} —
+// CheckForStalledTensors warns after HOROVOD_STALL_CHECK_TIME_SECONDS
+// (default 60 s) listing the missing ranks, and optionally aborts the job
+// after HOROVOD_STALL_SHUTDOWN_TIME_SECONDS (stall_inspector.h:67-80).
+#ifndef HVD_STALL_INSPECTOR_H
+#define HVD_STALL_INSPECTOR_H
+
+#include <chrono>
+#include <string>
+#include <vector>
+
+#include "hvd_common.h"
+
+namespace hvd {
+
+class StallInspector {
+ public:
+  StallInspector();
+
+  // Called by the coordinator for each pending tensor each cycle.
+  // Returns true if the tensor crossed the shutdown threshold (the caller
+  // emits a coordinated error response for it).
+  bool Check(const std::string& name,
+             const std::vector<bool>& submitted,
+             std::chrono::steady_clock::time_point first_seen);
+
+  double warning_seconds() const { return warn_s_; }
+  double shutdown_seconds() const { return shutdown_s_; }
+
+ private:
+  double warn_s_;
+  double shutdown_s_;   // <= 0 disables hard shutdown
+  std::chrono::steady_clock::time_point last_report_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_STALL_INSPECTOR_H

@@ -1,0 +1,92 @@
+// Tensor table + pending-announcement queue + handle table.
+//
+// Reference equivalents: horovod/common/tensor_queue.{h,cc} (mutex-guarded
+// name->entry table, message queue, duplicate-name rejection, shutdown
+// drain) and horovod/torch/handle_manager.{h,cc} (int handle -> status for
+// poll/wait).  Here the two are fused: every entry IS a handle, waited on
+// via condition variable instead of a poll loop.
+#ifndef HVD_TENSOR_QUEUE_H
+#define HVD_TENSOR_QUEUE_H
+
+#include <condition_variable>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <unordered_map>
+
+#include "message.h"
+
+namespace hvd {
+
+// One in-flight collective on this rank (reference common.h:225-242
+// TensorTableEntry).
+struct TensorTableEntry {
+  int64_t handle = -1;
+  std::string name;
+  OpType op_type = OpType::kAllreduce;
+  DataType dtype = DataType::kFloat32;
+  int32_t arg = 0;
+  std::vector<int64_t> shape;
+  const void* input = nullptr;   // caller keeps alive until done
+  int64_t count = 0;             // input element count
+
+  std::vector<char> output;      // filled at execution
+  int64_t output_count = 0;
+  Status status;
+  bool done = false;
+};
+
+using EntryPtr = std::shared_ptr<TensorTableEntry>;
+
+// Error-message contract (reference common.h:155-158).
+inline std::string DuplicateNameError(OpType op, const std::string& name) {
+  return std::string("Requested to ") + OpTypeName(op) +
+         " a tensor with the same name as another tensor that is currently "
+         "being processed.  If you want to request another tensor, use a "
+         "different tensor name. Tensor name: " + name;
+}
+
+class TensorQueue {
+ public:
+  // Enqueue a new collective; assigns entry->handle.  Fails on duplicate
+  // in-flight name (DUPLICATE_NAME_ERROR).
+  Status Add(const EntryPtr& entry);
+
+  // Drain announcements not yet sent to the coordinator (once each).
+  std::vector<Request> PopAnnouncements(int32_t rank);
+
+  // Fetch + remove table entries for a response's names.
+  std::vector<EntryPtr> TakeEntries(const Response& response);
+
+  // Re-queue announcements (cache-invalidation path: a hit that must be
+  // renegotiated as a full request).
+  void Reannounce(const std::string& name);
+
+  // Complete an entry and wake waiters.
+  void Complete(const EntryPtr& entry, Status status);
+
+  // Fail every in-flight entry (reference FinalizeTensorQueue:
+  // shutdown delivers SHUT_DOWN_ERROR to all callbacks).
+  void FailAll(const Status& status);
+
+  // Handle API.
+  bool Poll(int64_t handle);
+  // Blocks until done; returns entry (still owned by table until Release).
+  Status Wait(int64_t handle, EntryPtr* out);
+  EntryPtr Get(int64_t handle);
+  void Release(int64_t handle);
+
+  size_t NumPending();
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  int64_t next_handle_ = 0;
+  std::unordered_map<std::string, EntryPtr> by_name_;
+  std::unordered_map<int64_t, EntryPtr> by_handle_;
+  std::deque<std::string> to_announce_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_TENSOR_QUEUE_H

@@ -1,0 +1,74 @@
+// Chrome-tracing JSON profiler.
+//
+// Reference equivalent: horovod/common/timeline.{h,cc} — per-tensor state
+// machine (NEGOTIATING -> TOP_LEVEL -> ACTIVITY, timeline.h:77-126), enabled
+// by HOROVOD_TIMELINE=<file> on rank 0 (operations.cc:363-371), events
+// drained by an async writer thread so tracing never blocks the cycle
+// (timeline.h:47-75; the boost lockfree SPSC queue becomes a mutexed deque —
+// event rates here are far below the reference's 1M-record budget).
+// Open the output in chrome://tracing or Perfetto.
+#ifndef HVD_TIMELINE_H
+#define HVD_TIMELINE_H
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdio>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+
+#include "hvd_common.h"
+
+namespace hvd {
+
+class Timeline {
+ public:
+  // No-op unless `filename` is non-empty and rank == 0.
+  void Initialize(const std::string& filename, int rank);
+  ~Timeline();
+
+  bool Initialized() const { return initialized_.load(); }
+
+  // Phase events, per tensor (rows keyed by tensor name).
+  void NegotiateStart(const std::string& tensor, OpType op);
+  void NegotiateEnd(const std::string& tensor);
+  void Start(const std::string& tensor, const std::string& op_name);
+  void ActivityStart(const std::string& tensor, const std::string& activity);
+  void ActivityEnd(const std::string& tensor);
+  void End(const std::string& tensor);
+  // Instant marker once per background cycle when
+  // HOROVOD_TIMELINE_MARK_CYCLES=1 (reference operations.cc:375).
+  void MarkCycleStart();
+
+  void Shutdown();
+
+ private:
+  struct Event {
+    char phase;          // 'B', 'E', 'i'
+    std::string name;
+    std::string tensor;
+    int64_t ts_us;
+  };
+
+  void Emit(char phase, const std::string& name, const std::string& tensor);
+  void WriterLoop();
+  int64_t TidFor(const std::string& tensor);
+
+  std::atomic<bool> initialized_{false};
+  std::atomic<bool> stop_{false};
+  bool mark_cycles_ = false;
+  FILE* file_ = nullptr;
+  std::thread writer_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<Event> queue_;
+  std::unordered_map<std::string, int64_t> tids_;
+  int64_t next_tid_ = 1;
+  std::chrono::steady_clock::time_point start_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_TIMELINE_H

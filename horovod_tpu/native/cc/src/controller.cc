@@ -1,0 +1,319 @@
+#include "controller.h"
+
+#include <algorithm>
+#include <sstream>
+
+namespace hvd {
+
+namespace {
+
+std::string ShapeStr(const std::vector<int64_t>& shape) {
+  std::ostringstream os;
+  os << "[";
+  for (size_t i = 0; i < shape.size(); ++i)
+    os << (i ? ", " : "") << shape[i];
+  os << "]";
+  return os.str();
+}
+
+int64_t NumElements(const std::vector<int64_t>& shape) {
+  int64_t n = 1;
+  for (auto d : shape) n *= d;
+  return n;
+}
+
+}  // namespace
+
+Status Controller::Init(int rank, int size, const std::string& master_addr,
+                        int master_port, const std::string& my_data_host,
+                        int my_data_port, std::vector<PeerAddr>* peers_out) {
+  rank_ = rank;
+  size_ = size;
+  fusion_threshold_ =
+      EnvInt("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024);
+  shutdown_ranks_.assign(size, false);
+  peers_out->assign(size, PeerAddr{});
+
+  if (rank == 0) {
+    Status s = listener_.Listen("", master_port);
+    if (!s.ok()) return s;
+    workers_.resize(size);
+    (*peers_out)[0] = PeerAddr{my_data_host, my_data_port};
+    for (int n = 0; n < size - 1; ++n) {
+      TcpSocket conn;
+      s = listener_.Accept(&conn, 60000);
+      if (!s.ok()) return s;
+      // hello frame: "rank data_port"
+      std::string hello;
+      s = conn.RecvFrame(&hello);
+      if (!s.ok()) return s;
+      int r = -1, dport = 0;
+      if (std::sscanf(hello.c_str(), "%d %d", &r, &dport) != 2 || r <= 0 ||
+          r >= size || workers_[r].valid())
+        return Status::Unknown("bad controller hello: " + hello);
+      std::string host = conn.peer_addr();
+      if (host.empty() || host == "0.0.0.0") host = "127.0.0.1";
+      (*peers_out)[r] = PeerAddr{host, dport};
+      workers_[r] = std::move(conn);
+    }
+    // Broadcast the peer table: "host port\n" per rank.
+    std::ostringstream table;
+    for (int r = 0; r < size; ++r)
+      table << (*peers_out)[r].host << " " << (*peers_out)[r].port << "\n";
+    for (int r = 1; r < size; ++r) {
+      s = workers_[r].SendFrame(table.str());
+      if (!s.ok()) return s;
+    }
+    return Status::OK();
+  }
+
+  Status s = master_.Connect(master_addr, master_port);
+  if (!s.ok()) return s;
+  std::ostringstream hello;
+  hello << rank << " " << my_data_port;
+  s = master_.SendFrame(hello.str());
+  if (!s.ok()) return s;
+  std::string table;
+  s = master_.RecvFrame(&table);
+  if (!s.ok()) return s;
+  std::istringstream in(table);
+  for (int r = 0; r < size; ++r) {
+    in >> (*peers_out)[r].host >> (*peers_out)[r].port;
+    if (in.fail())
+      return Status::Unknown("bad peer table from coordinator");
+  }
+  return Status::OK();
+}
+
+void Controller::Shutdown() {
+  master_.Close();
+  for (auto& w : workers_) w.Close();
+  listener_.Close();
+}
+
+Status Controller::Cycle(RequestList& mine, ResponseList* out) {
+  if (size_ == 1) {
+    // Degenerate single-rank job: everything is immediately ready.
+    Ingest(mine, 0);
+    return MasterCycle(RequestList{}, out);
+  }
+  if (rank_ == 0) return MasterCycle(mine, out);
+  Status s = master_.SendFrame(mine.Serialize());
+  if (!s.ok()) return s;
+  std::string buf;
+  s = master_.RecvFrame(&buf);
+  if (!s.ok()) return s;
+  return ResponseList::Parse(buf, out);
+}
+
+Status Controller::MasterCycle(const RequestList& mine, ResponseList* out) {
+  // Gather every worker's announcements (reference RecvReadyTensors /
+  // MPI_Gather, mpi_controller.cc:107-150).  Lock-step: every rank sends
+  // exactly one list per cycle.
+  Ingest(mine, 0);
+  for (int r = 1; r < size_; ++r) {
+    std::string buf;
+    RequestList rl;
+    Status s = workers_[r].RecvFrame(&buf);
+    if (!s.ok()) return s;
+    s = RequestList::Parse(buf, &rl);
+    if (!s.ok()) return s;
+    Ingest(rl, r);
+  }
+
+  out->responses.clear();
+  out->shutdown = false;
+
+  // Ready tensors -> validated responses, in the master-defined order.
+  while (!ready_.empty()) {
+    std::string name = ready_.front();
+    ready_.pop_front();
+    out->responses.push_back(ConstructResponse(name));
+    table_.erase(name);
+  }
+
+  // Stall inspection over still-pending tensors (reference
+  // CheckForStalledTensors, stall_inspector.cc:26).
+  std::vector<std::string> stalled;
+  for (auto& kv : table_)
+    if (stall_.Check(kv.first, kv.second.submitted, kv.second.first_seen))
+      stalled.push_back(kv.first);
+  for (auto& name : stalled) {
+    Response r;
+    r.error = true;
+    r.names.push_back(name);
+    r.error_message =
+        "Stalled collective: tensor " + name +
+        " exceeded HOROVOD_STALL_SHUTDOWN_TIME_SECONDS without being "
+        "submitted on all ranks.";
+    out->responses.push_back(std::move(r));
+    table_.erase(name);
+  }
+
+  // Shutdown agreement: once every rank has signaled, the whole job stops
+  // (reference shutdown bit, message.h:110-122).
+  if (std::all_of(shutdown_ranks_.begin(), shutdown_ranks_.end(),
+                  [](bool b) { return b; }))
+    out->shutdown = true;
+
+  Fuse(&out->responses);
+
+  // Broadcast verdicts (reference SendFinalTensors / 2x MPI_Bcast,
+  // mpi_controller.cc:152-161).
+  if (size_ > 1) {
+    std::string payload = out->Serialize();
+    for (int r = 1; r < size_; ++r) {
+      Status s = workers_[r].SendFrame(payload);
+      if (!s.ok()) return s;
+    }
+  }
+  return Status::OK();
+}
+
+void Controller::Ingest(const RequestList& list, int from_rank) {
+  if (list.shutdown) shutdown_ranks_[from_rank] = true;
+  for (const auto& req : list.requests) {
+    auto& p = table_[req.name];
+    if (p.submitted.empty()) {
+      p.submitted.assign(size_, false);
+      p.first_seen = std::chrono::steady_clock::now();
+    }
+    if (p.submitted[from_rank]) continue;  // duplicate guard
+    p.submitted[from_rank] = true;
+    p.requests.push_back(req);
+    if (++p.count == size_) ready_.push_back(req.name);
+  }
+}
+
+Response Controller::ConstructResponse(const std::string& name) {
+  // Cross-rank agreement validation (reference ConstructResponse,
+  // controller.cc:320-522: op/dtype/shape/root mismatches become a clean
+  // coordinated ERROR response instead of a hang or corruption).
+  auto& p = table_[name];
+  const Request& first = p.requests.front();
+  Response resp;
+  resp.op_type = first.op_type;
+  resp.dtype = first.dtype;
+  resp.arg = first.arg;
+  resp.names.push_back(name);
+
+  auto fail = [&](const std::string& msg) {
+    resp.error = true;
+    resp.error_message = msg;
+    return resp;
+  };
+
+  for (const auto& r : p.requests) {
+    if (r.op_type != first.op_type)
+      return fail("Mismatched collective operations: rank " +
+                  std::to_string(first.rank) + " requested " +
+                  OpTypeName(first.op_type) + " but rank " +
+                  std::to_string(r.rank) + " requested " +
+                  OpTypeName(r.op_type) + " for tensor " + name + ".");
+    if (r.dtype != first.dtype)
+      return fail("Mismatched data types: rank " +
+                  std::to_string(first.rank) + " has " +
+                  DataTypeName(first.dtype) + " but rank " +
+                  std::to_string(r.rank) + " has " + DataTypeName(r.dtype) +
+                  " for tensor " + name + ".");
+    if (r.arg != first.arg)
+      return fail(first.op_type == OpType::kBroadcast
+                      ? "Mismatched broadcast root ranks for tensor " + name +
+                            "."
+                      : "Mismatched reduction operations for tensor " + name +
+                            ".");
+  }
+
+  switch (first.op_type) {
+    case OpType::kAllreduce:
+      // first_dims[0] carries the tensor's element count so Fuse() can
+      // respect the byte threshold without re-consulting the table.
+      resp.first_dims.assign(1, NumElements(first.shape));
+      [[fallthrough]];
+    case OpType::kBroadcast:
+    case OpType::kBarrier:
+    case OpType::kJoin:
+      for (const auto& r : p.requests)
+        if (r.shape != first.shape)
+          return fail("Mismatched " + std::string(OpTypeName(first.op_type)) +
+                      " tensor shapes: rank " + std::to_string(first.rank) +
+                      " has " + ShapeStr(first.shape) + " but rank " +
+                      std::to_string(r.rank) + " has " + ShapeStr(r.shape) +
+                      " for tensor " + name + ".");
+      if (first.op_type == OpType::kBroadcast &&
+          (first.arg < 0 || first.arg >= size_))
+        return fail("Broadcast root rank " + std::to_string(first.arg) +
+                    " out of range for job size " + std::to_string(size_) +
+                    " (tensor " + name + ").");
+      if (first.op_type == OpType::kJoin)
+        // Joins carry the identity of the LAST rank to arrive (reference
+        // later-Horovod join() contract); requests are in arrival order.
+        resp.arg = p.requests.back().rank;
+      break;
+    case OpType::kAllgather: {
+      // Dim-0 may differ; trailing dims must agree (reference
+      // controller.cc:393-452).
+      for (const auto& r : p.requests) {
+        if (r.shape.size() != first.shape.size() || r.shape.empty())
+          return fail("Mismatched allgather tensor ranks for tensor " + name +
+                      ".");
+        if (!std::equal(r.shape.begin() + 1, r.shape.end(),
+                        first.shape.begin() + 1))
+          return fail("Mismatched allgather trailing dimensions: rank " +
+                      std::to_string(first.rank) + " has " +
+                      ShapeStr(first.shape) + " but rank " +
+                      std::to_string(r.rank) + " has " + ShapeStr(r.shape) +
+                      " for tensor " + name + ".");
+      }
+      resp.first_dims.assign(size_, 0);
+      for (const auto& r : p.requests)
+        resp.first_dims[r.rank] = r.shape[0];
+      break;
+    }
+    case OpType::kAlltoall:
+    case OpType::kReducescatter:
+      for (const auto& r : p.requests)
+        if (r.shape != first.shape)
+          return fail("Mismatched " + std::string(OpTypeName(first.op_type)) +
+                      " tensor shapes for tensor " + name + ".");
+      if (first.shape.empty() || first.shape[0] % size_ != 0)
+        return fail(std::string(OpTypeName(first.op_type)) +
+                    " requires the first dimension (" +
+                    (first.shape.empty() ? std::string("scalar")
+                                         : std::to_string(first.shape[0])) +
+                    ") to be divisible by the job size " +
+                    std::to_string(size_) + " (tensor " + name + ").");
+      break;
+  }
+  return resp;
+}
+
+void Controller::Fuse(std::vector<Response>* responses) {
+  // Batch consecutive small same-dtype allreduces into one response so they
+  // execute as a single ring pass over the fusion buffer (reference
+  // FuseResponses, controller.cc:551-672; threshold default 64 MB,
+  // operations.cc:379).  Sizes come from the request shapes recorded before
+  // table_ cleanup — here we re-derive conservatively from the response's
+  // own bookkeeping kept in fused_bytes.
+  std::vector<Response> fused;
+  for (auto& r : *responses) {
+    bool fusible = !r.error && r.op_type == OpType::kAllreduce;
+    if (fusible && !fused.empty()) {
+      Response& prev = fused.back();
+      if (!prev.error && prev.op_type == OpType::kAllreduce &&
+          prev.dtype == r.dtype && prev.arg == r.arg &&
+          prev.first_dims.size() == 1 && r.first_dims.size() == 1 &&
+          (prev.first_dims[0] + r.first_dims[0]) *
+                  static_cast<int64_t>(DataTypeSize(r.dtype)) <=
+              fusion_threshold_) {
+        prev.names.push_back(r.names[0]);
+        prev.first_dims[0] += r.first_dims[0];
+        continue;
+      }
+    }
+    fused.push_back(std::move(r));
+  }
+  *responses = std::move(fused);
+}
+
+}  // namespace hvd

@@ -1,0 +1,440 @@
+#include "data_plane.h"
+
+#include <fcntl.h>
+#include <poll.h>
+#include <sys/socket.h>
+
+#include <algorithm>
+#include <cstring>
+
+namespace hvd {
+
+// ---------------------------------------------------------------------------
+// Typed reduction kernels.
+//
+// float16/bfloat16 accumulate via float32 (reference half.cc:42-78 does the
+// same through F16C; scalar conversion is fine at TCP bandwidths — the wire,
+// not the ALU, is the bottleneck on this plane).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+inline float HalfToFloat(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t man = h & 0x3ffu;
+  uint32_t bits;
+  if (exp == 0) {
+    if (man == 0) {
+      bits = sign;
+    } else {  // subnormal
+      exp = 127 - 15 + 1;
+      while ((man & 0x400u) == 0) {
+        man <<= 1;
+        exp--;
+      }
+      man &= 0x3ffu;
+      bits = sign | (exp << 23) | (man << 13);
+    }
+  } else if (exp == 0x1f) {
+    bits = sign | 0x7f800000u | (man << 13);
+  } else {
+    bits = sign | ((exp + 127 - 15) << 23) | (man << 13);
+  }
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+inline uint16_t FloatToHalf(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint32_t sign = (bits >> 16) & 0x8000u;
+  int32_t exp = static_cast<int32_t>((bits >> 23) & 0xff) - 127 + 15;
+  uint32_t man = bits & 0x7fffffu;
+  if (exp >= 0x1f) return static_cast<uint16_t>(sign | 0x7c00u |
+      (((bits >> 23) & 0xff) == 0xff && man ? 0x200u : 0));
+  if (exp <= 0) {
+    if (exp < -10) return static_cast<uint16_t>(sign);
+    man |= 0x800000u;
+    uint32_t shift = static_cast<uint32_t>(14 - exp);
+    uint32_t half_man = man >> shift;
+    // round to nearest even
+    uint32_t rem = man & ((1u << shift) - 1);
+    uint32_t halfway = 1u << (shift - 1);
+    if (rem > halfway || (rem == halfway && (half_man & 1))) half_man++;
+    return static_cast<uint16_t>(sign | half_man);
+  }
+  uint32_t half_man = man >> 13;
+  uint32_t rem = man & 0x1fffu;
+  if (rem > 0x1000u || (rem == 0x1000u && (half_man & 1))) {
+    half_man++;
+    if (half_man == 0x400u) {
+      half_man = 0;
+      exp++;
+      if (exp >= 0x1f) return static_cast<uint16_t>(sign | 0x7c00u);
+    }
+  }
+  return static_cast<uint16_t>(sign | (static_cast<uint32_t>(exp) << 10) |
+                               half_man);
+}
+
+inline float Bf16ToFloat(uint16_t b) {
+  uint32_t bits = static_cast<uint32_t>(b) << 16;
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+inline uint16_t FloatToBf16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  // round to nearest even
+  uint32_t lsb = (bits >> 16) & 1;
+  bits += 0x7fffu + lsb;
+  return static_cast<uint16_t>(bits >> 16);
+}
+
+template <typename T>
+void ReduceTyped(T* acc, const T* val, int64_t n, ReduceOp op) {
+  switch (op) {
+    case ReduceOp::kSum:
+    case ReduceOp::kAverage:
+    case ReduceOp::kAdasum:
+      for (int64_t i = 0; i < n; ++i) acc[i] = acc[i] + val[i];
+      break;
+    case ReduceOp::kMin:
+      for (int64_t i = 0; i < n; ++i) acc[i] = std::min(acc[i], val[i]);
+      break;
+    case ReduceOp::kMax:
+      for (int64_t i = 0; i < n; ++i) acc[i] = std::max(acc[i], val[i]);
+      break;
+  }
+}
+
+template <float (*ToF)(uint16_t), uint16_t (*FromF)(float)>
+void Reduce16(uint16_t* acc, const uint16_t* val, int64_t n, ReduceOp op) {
+  for (int64_t i = 0; i < n; ++i) {
+    float a = ToF(acc[i]), v = ToF(val[i]);
+    float r;
+    switch (op) {
+      case ReduceOp::kMin: r = std::min(a, v); break;
+      case ReduceOp::kMax: r = std::max(a, v); break;
+      default: r = a + v; break;
+    }
+    acc[i] = FromF(r);
+  }
+}
+
+}  // namespace
+
+void ReduceInto(void* acc, const void* val, int64_t count, DataType dtype,
+                ReduceOp op) {
+  switch (dtype) {
+    case DataType::kUint8:
+      ReduceTyped(static_cast<uint8_t*>(acc),
+                  static_cast<const uint8_t*>(val), count, op);
+      break;
+    case DataType::kInt8:
+      ReduceTyped(static_cast<int8_t*>(acc),
+                  static_cast<const int8_t*>(val), count, op);
+      break;
+    case DataType::kUint16:
+      ReduceTyped(static_cast<uint16_t*>(acc),
+                  static_cast<const uint16_t*>(val), count, op);
+      break;
+    case DataType::kInt16:
+      ReduceTyped(static_cast<int16_t*>(acc),
+                  static_cast<const int16_t*>(val), count, op);
+      break;
+    case DataType::kInt32:
+      ReduceTyped(static_cast<int32_t*>(acc),
+                  static_cast<const int32_t*>(val), count, op);
+      break;
+    case DataType::kInt64:
+      ReduceTyped(static_cast<int64_t*>(acc),
+                  static_cast<const int64_t*>(val), count, op);
+      break;
+    case DataType::kFloat32:
+      ReduceTyped(static_cast<float*>(acc),
+                  static_cast<const float*>(val), count, op);
+      break;
+    case DataType::kFloat64:
+      ReduceTyped(static_cast<double*>(acc),
+                  static_cast<const double*>(val), count, op);
+      break;
+    case DataType::kFloat16:
+      Reduce16<HalfToFloat, FloatToHalf>(
+          static_cast<uint16_t*>(acc), static_cast<const uint16_t*>(val),
+          count, op);
+      break;
+    case DataType::kBfloat16:
+      Reduce16<Bf16ToFloat, FloatToBf16>(
+          static_cast<uint16_t*>(acc), static_cast<const uint16_t*>(val),
+          count, op);
+      break;
+    case DataType::kBool: {
+      auto* a = static_cast<uint8_t*>(acc);
+      const auto* v = static_cast<const uint8_t*>(val);
+      if (op == ReduceOp::kMin) {
+        for (int64_t i = 0; i < count; ++i) a[i] = a[i] && v[i];
+      } else {  // sum/max = logical or
+        for (int64_t i = 0; i < count; ++i) a[i] = a[i] || v[i];
+      }
+      break;
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Mesh bootstrap
+// ---------------------------------------------------------------------------
+
+Status DataPlane::Listen(const std::string& bind_addr) {
+  return listener_.Listen(bind_addr, 0);
+}
+
+Status DataPlane::Connect(int rank, int size,
+                          const std::vector<PeerAddr>& peers) {
+  rank_ = rank;
+  size_ = size;
+  peers_.clear();
+  peers_.resize(size);
+  // Connect to lower ranks; accept from higher ranks.  The rank id travels
+  // first so accepts can be matched to slots.
+  for (int r = 0; r < rank; ++r) {
+    auto sock = std::unique_ptr<TcpSocket>(new TcpSocket());
+    Status s = sock->Connect(peers[r].host, peers[r].port);
+    if (!s.ok()) return s;
+    int32_t me = rank;
+    s = sock->SendAll(&me, sizeof(me));
+    if (!s.ok()) return s;
+    peers_[r] = std::move(sock);
+  }
+  for (int n = 0; n < size - rank - 1; ++n) {
+    TcpSocket conn;
+    Status s = listener_.Accept(&conn, 60000);
+    if (!s.ok()) return s;
+    int32_t who = -1;
+    s = conn.RecvAll(&who, sizeof(who));
+    if (!s.ok()) return s;
+    if (who <= rank || who >= size || peers_[who])
+      return Status::Unknown("bad data-plane hello from rank " +
+                             std::to_string(who));
+    peers_[who] = std::unique_ptr<TcpSocket>(new TcpSocket(std::move(conn)));
+  }
+  return Status::OK();
+}
+
+void DataPlane::Shutdown() {
+  for (auto& p : peers_) p.reset();
+  listener_.Close();
+}
+
+// Full-duplex exchange: non-blocking send+recv driven by poll so neither
+// side can deadlock on TCP buffers (the role cuda streams + NCCL play in
+// reference nccl_operations.cc — here it's just careful socket plumbing).
+Status DataPlane::SendRecv(int send_peer, const void* sbuf, size_t sbytes,
+                           int recv_peer, void* rbuf, size_t rbytes) {
+  if (send_peer == rank_ && recv_peer == rank_) {
+    if (rbytes != sbytes) return Status::Unknown("self sendrecv size mismatch");
+    std::memcpy(rbuf, sbuf, sbytes);
+    return Status::OK();
+  }
+  TcpSocket* ssock = send_peer == rank_ ? nullptr : peers_[send_peer].get();
+  TcpSocket* rsock = recv_peer == rank_ ? nullptr : peers_[recv_peer].get();
+  if (send_peer == rank_) std::memcpy(rbuf, sbuf, sbytes);
+
+  const char* sp = static_cast<const char*>(sbuf);
+  char* rp = static_cast<char*>(rbuf);
+  size_t sleft = ssock ? sbytes : 0;
+  size_t rleft = rsock ? rbytes : 0;
+  while (sleft > 0 || rleft > 0) {
+    pollfd fds[2];
+    int nf = 0;
+    int si = -1, ri = -1;
+    if (sleft > 0) {
+      si = nf;
+      fds[nf++] = {ssock->fd(), POLLOUT, 0};
+    }
+    if (rleft > 0) {
+      ri = nf;
+      fds[nf++] = {rsock->fd(), POLLIN, 0};
+    }
+    int rc = ::poll(fds, nf, 60000);
+    if (rc == 0) return Status::Unknown("data-plane exchange timed out");
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return Status::Unknown(std::string("poll: ") + std::strerror(errno));
+    }
+    if (si >= 0 && (fds[si].revents & (POLLOUT | POLLERR | POLLHUP))) {
+      ssize_t w = ::send(ssock->fd(), sp, sleft, MSG_NOSIGNAL | MSG_DONTWAIT);
+      if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+        return Status::Unknown(std::string("send: ") + std::strerror(errno));
+      if (w > 0) {
+        sp += w;
+        sleft -= static_cast<size_t>(w);
+      }
+    }
+    if (ri >= 0 && (fds[ri].revents & (POLLIN | POLLERR | POLLHUP))) {
+      ssize_t r = ::recv(rsock->fd(), rp, rleft, MSG_DONTWAIT);
+      if (r == 0) return Status::Aborted("peer closed during exchange");
+      if (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+        return Status::Unknown(std::string("recv: ") + std::strerror(errno));
+      if (r > 0) {
+        rp += r;
+        rleft -= static_cast<size_t>(r);
+      }
+    }
+  }
+  return Status::OK();
+}
+
+// ---------------------------------------------------------------------------
+// Collectives
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// Dim-0 chunk boundaries for the ring: chunk c covers
+// [offsets[c], offsets[c+1]) elements.
+std::vector<int64_t> ChunkOffsets(int64_t count, int size) {
+  std::vector<int64_t> off(size + 1, 0);
+  int64_t base = count / size, rem = count % size;
+  for (int c = 0; c < size; ++c)
+    off[c + 1] = off[c] + base + (c < rem ? 1 : 0);
+  return off;
+}
+
+}  // namespace
+
+Status DataPlane::Allreduce(void* buf, int64_t count, DataType dtype,
+                            ReduceOp op) {
+  if (size_ == 1) return Status::OK();
+  const size_t esz = DataTypeSize(dtype);
+  auto off = ChunkOffsets(count, size_);
+  auto bytes_of = [&](int c) {
+    return static_cast<size_t>(off[c + 1] - off[c]) * esz;
+  };
+  auto ptr_of = [&](int c) {
+    return static_cast<char*>(buf) + static_cast<size_t>(off[c]) * esz;
+  };
+  const int right = (rank_ + 1) % size_;
+  const int left = (rank_ - 1 + size_) % size_;
+  int64_t max_chunk = 0;
+  for (int c = 0; c < size_; ++c)
+    max_chunk = std::max(max_chunk, off[c + 1] - off[c]);
+  std::vector<char> scratch(static_cast<size_t>(max_chunk) * esz);
+
+  // Phase 1: ring reduce-scatter.  After size-1 steps, chunk (rank+1)%size
+  // holds the full reduction on this rank.
+  for (int s = 0; s < size_ - 1; ++s) {
+    int send_c = (rank_ - s + size_) % size_;
+    int recv_c = (rank_ - s - 1 + size_) % size_;
+    Status st = SendRecv(right, ptr_of(send_c), bytes_of(send_c),
+                         left, scratch.data(), bytes_of(recv_c));
+    if (!st.ok()) return st;
+    ReduceInto(ptr_of(recv_c), scratch.data(), off[recv_c + 1] - off[recv_c],
+               dtype, op);
+  }
+  // Phase 2: ring allgather of the reduced chunks.
+  for (int s = 0; s < size_ - 1; ++s) {
+    int send_c = (rank_ + 1 - s + size_) % size_;
+    int recv_c = (rank_ - s + size_) % size_;
+    Status st = SendRecv(right, ptr_of(send_c), bytes_of(send_c),
+                         left, ptr_of(recv_c), bytes_of(recv_c));
+    if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
+Status DataPlane::Reducescatter(const void* in, void* out, int64_t count,
+                                DataType dtype, ReduceOp op) {
+  const size_t esz = DataTypeSize(dtype);
+  if (size_ == 1) {
+    std::memcpy(out, in, static_cast<size_t>(count) * esz);
+    return Status::OK();
+  }
+  if (count % size_ != 0)
+    return Status::InvalidArgument("reducescatter count not divisible");
+  // Work on a copy so the caller's input stays intact, then run the
+  // reduce-scatter half of the ring and keep our chunk.
+  std::vector<char> work(static_cast<size_t>(count) * esz);
+  std::memcpy(work.data(), in, work.size());
+  auto off = ChunkOffsets(count, size_);
+  const size_t chunk_bytes = static_cast<size_t>(count / size_) * esz;
+  auto ptr_of = [&](int c) {
+    return work.data() + static_cast<size_t>(off[c]) * esz;
+  };
+  const int right = (rank_ + 1) % size_;
+  const int left = (rank_ - 1 + size_) % size_;
+  std::vector<char> scratch(chunk_bytes);
+  for (int s = 0; s < size_ - 1; ++s) {
+    int send_c = (rank_ - s + size_) % size_;
+    int recv_c = (rank_ - s - 1 + size_) % size_;
+    Status st = SendRecv(right, ptr_of(send_c), chunk_bytes,
+                         left, scratch.data(), chunk_bytes);
+    if (!st.ok()) return st;
+    ReduceInto(ptr_of(recv_c), scratch.data(), count / size_, dtype, op);
+  }
+  // After size-1 steps this rank holds the complete reduction of chunk
+  // (rank+1)%size; chunk `rank` is complete on the left neighbor.  One more
+  // rotation hands every rank its own chunk.
+  int done_c = (rank_ + 1) % size_;
+  return SendRecv(right, ptr_of(done_c), chunk_bytes,
+                  left, out, chunk_bytes);
+}
+
+Status DataPlane::Allgather(const void* in, void* out,
+                            const std::vector<int64_t>& counts) {
+  // counts[r] is rank r's byte count (dtype-agnostic).
+  std::vector<int64_t> displ(size_ + 1, 0);
+  for (int r = 0; r < size_; ++r) displ[r + 1] = displ[r] + counts[r];
+  char* o = static_cast<char*>(out);
+  std::memcpy(o + displ[rank_], in, static_cast<size_t>(counts[rank_]));
+  for (int k = 1; k < size_; ++k) {
+    int to = (rank_ + k) % size_;
+    int from = (rank_ - k + size_) % size_;
+    Status st = SendRecv(to, in, static_cast<size_t>(counts[rank_]),
+                         from, o + displ[from],
+                         static_cast<size_t>(counts[from]));
+    if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
+Status DataPlane::Broadcast(void* buf, int64_t count, DataType dtype,
+                            int root) {
+  if (size_ == 1) return Status::OK();
+  const size_t nbytes = static_cast<size_t>(count) * DataTypeSize(dtype);
+  if (rank_ == root) {
+    for (int r = 0; r < size_; ++r) {
+      if (r == rank_) continue;
+      Status st = peers_[r]->SendAll(buf, nbytes);
+      if (!st.ok()) return st;
+    }
+    return Status::OK();
+  }
+  return peers_[root]->RecvAll(buf, nbytes);
+}
+
+Status DataPlane::Alltoall(const void* in, void* out, int64_t count,
+                           DataType dtype) {
+  const size_t esz = DataTypeSize(dtype);
+  if (count % size_ != 0)
+    return Status::InvalidArgument("alltoall count not divisible by size");
+  const size_t block = static_cast<size_t>(count / size_) * esz;
+  const char* i = static_cast<const char*>(in);
+  char* o = static_cast<char*>(out);
+  std::memcpy(o + block * rank_, i + block * rank_, block);
+  for (int k = 1; k < size_; ++k) {
+    int to = (rank_ + k) % size_;
+    int from = (rank_ - k + size_) % size_;
+    Status st = SendRecv(to, i + block * to, block,
+                         from, o + block * from, block);
+    if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
+}  // namespace hvd

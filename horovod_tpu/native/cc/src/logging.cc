@@ -1,0 +1,54 @@
+// Logging implementation (reference horovod/common/logging.cc).
+#include "hvd_common.h"
+
+#include <chrono>
+#include <ctime>
+#include <iostream>
+
+namespace hvd {
+
+static LogLevel ParseLevel(const std::string& s) {
+  if (s == "trace") return LogLevel::kTrace;
+  if (s == "debug") return LogLevel::kDebug;
+  if (s == "info") return LogLevel::kInfo;
+  if (s == "warning" || s == "warn") return LogLevel::kWarning;
+  if (s == "error") return LogLevel::kError;
+  if (s == "fatal") return LogLevel::kFatal;
+  return LogLevel::kWarning;
+}
+
+LogLevel MinLogLevel() {
+  static LogLevel level = ParseLevel(EnvStr("HOROVOD_LOG_LEVEL", "warning"));
+  return level;
+}
+
+static const char* kLevelNames[] = {"TRACE", "DEBUG", "INFO",
+                                    "WARNING", "ERROR", "FATAL"};
+
+LogMessage::LogMessage(const char* file, int line, LogLevel level)
+    : level_(level) {
+  static bool hide_time = EnvBool("HOROVOD_LOG_HIDE_TIME", false);
+  if (!hide_time) {
+    auto now = std::chrono::system_clock::now();
+    auto us = std::chrono::duration_cast<std::chrono::microseconds>(
+                  now.time_since_epoch()).count() % 1000000;
+    std::time_t tt = std::chrono::system_clock::to_time_t(now);
+    struct tm tm_buf;
+    localtime_r(&tt, &tm_buf);
+    char buf[32];
+    std::strftime(buf, sizeof(buf), "%F %T", &tm_buf);
+    stream_ << "[" << buf << "." << us << "] ";
+  }
+  const char* base = std::strrchr(file, '/');
+  stream_ << "[" << kLevelNames[static_cast<int>(level)] << " "
+          << (base ? base + 1 : file) << ":" << line << "] ";
+}
+
+LogMessage::~LogMessage() {
+  static std::mutex mu;
+  std::lock_guard<std::mutex> lk(mu);
+  std::cerr << stream_.str() << std::endl;
+  if (level_ == LogLevel::kFatal) std::abort();
+}
+
+}  // namespace hvd

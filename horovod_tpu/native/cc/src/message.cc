@@ -1,0 +1,147 @@
+#include "message.h"
+
+namespace hvd {
+
+namespace {
+
+template <typename T>
+void PutPod(std::string* buf, T v) {
+  buf->append(reinterpret_cast<const char*>(&v), sizeof(T));
+}
+
+void PutStr(std::string* buf, const std::string& s) {
+  PutPod<uint32_t>(buf, static_cast<uint32_t>(s.size()));
+  buf->append(s);
+}
+
+template <typename T>
+void PutVec(std::string* buf, const std::vector<T>& v) {
+  PutPod<uint32_t>(buf, static_cast<uint32_t>(v.size()));
+  if (!v.empty())
+    buf->append(reinterpret_cast<const char*>(v.data()), v.size() * sizeof(T));
+}
+
+class Reader {
+ public:
+  explicit Reader(const std::string& buf) : buf_(buf) {}
+
+  template <typename T>
+  bool GetPod(T* v) {
+    if (off_ + sizeof(T) > buf_.size()) return false;
+    std::memcpy(v, buf_.data() + off_, sizeof(T));
+    off_ += sizeof(T);
+    return true;
+  }
+
+  bool GetStr(std::string* s) {
+    uint32_t n;
+    if (!GetPod(&n) || off_ + n > buf_.size()) return false;
+    s->assign(buf_.data() + off_, n);
+    off_ += n;
+    return true;
+  }
+
+  template <typename T>
+  bool GetVec(std::vector<T>* v) {
+    uint32_t n;
+    if (!GetPod(&n) || off_ + static_cast<size_t>(n) * sizeof(T) > buf_.size())
+      return false;
+    v->resize(n);
+    if (n) std::memcpy(v->data(), buf_.data() + off_, n * sizeof(T));
+    off_ += static_cast<size_t>(n) * sizeof(T);
+    return true;
+  }
+
+ private:
+  const std::string& buf_;
+  size_t off_ = 0;
+};
+
+Status Malformed(const char* what) {
+  return Status::InvalidArgument(std::string("malformed message: ") + what);
+}
+
+}  // namespace
+
+std::string RequestList::Serialize() const {
+  std::string buf;
+  PutPod<uint8_t>(&buf, shutdown ? 1 : 0);
+  PutVec(&buf, cache_hits);
+  PutPod<uint32_t>(&buf, static_cast<uint32_t>(requests.size()));
+  for (const auto& r : requests) {
+    PutPod<int32_t>(&buf, r.rank);
+    PutPod<int32_t>(&buf, static_cast<int32_t>(r.op_type));
+    PutPod<int32_t>(&buf, static_cast<int32_t>(r.dtype));
+    PutPod<int32_t>(&buf, r.arg);
+    PutStr(&buf, r.name);
+    PutVec(&buf, r.shape);
+  }
+  return buf;
+}
+
+Status RequestList::Parse(const std::string& buf, RequestList* out) {
+  Reader rd(buf);
+  uint8_t sd;
+  if (!rd.GetPod(&sd)) return Malformed("shutdown");
+  out->shutdown = sd != 0;
+  if (!rd.GetVec(&out->cache_hits)) return Malformed("cache_hits");
+  uint32_t n;
+  if (!rd.GetPod(&n)) return Malformed("count");
+  out->requests.resize(n);
+  for (auto& r : out->requests) {
+    int32_t op, dt;
+    if (!rd.GetPod(&r.rank) || !rd.GetPod(&op) || !rd.GetPod(&dt) ||
+        !rd.GetPod(&r.arg) || !rd.GetStr(&r.name) || !rd.GetVec(&r.shape))
+      return Malformed("request");
+    r.op_type = static_cast<OpType>(op);
+    r.dtype = static_cast<DataType>(dt);
+  }
+  return Status::OK();
+}
+
+std::string ResponseList::Serialize() const {
+  std::string buf;
+  PutPod<uint8_t>(&buf, shutdown ? 1 : 0);
+  PutVec(&buf, cache_valid);
+  PutPod<uint32_t>(&buf, static_cast<uint32_t>(responses.size()));
+  for (const auto& r : responses) {
+    PutPod<int32_t>(&buf, static_cast<int32_t>(r.op_type));
+    PutPod<int32_t>(&buf, static_cast<int32_t>(r.dtype));
+    PutPod<int32_t>(&buf, r.arg);
+    PutPod<uint8_t>(&buf, r.error ? 1 : 0);
+    PutStr(&buf, r.error_message);
+    PutPod<uint32_t>(&buf, static_cast<uint32_t>(r.names.size()));
+    for (const auto& nm : r.names) PutStr(&buf, nm);
+    PutVec(&buf, r.first_dims);
+  }
+  return buf;
+}
+
+Status ResponseList::Parse(const std::string& buf, ResponseList* out) {
+  Reader rd(buf);
+  uint8_t sd;
+  if (!rd.GetPod(&sd)) return Malformed("shutdown");
+  out->shutdown = sd != 0;
+  if (!rd.GetVec(&out->cache_valid)) return Malformed("cache_valid");
+  uint32_t n;
+  if (!rd.GetPod(&n)) return Malformed("count");
+  out->responses.resize(n);
+  for (auto& r : out->responses) {
+    int32_t op, dt;
+    uint8_t err;
+    uint32_t nn;
+    if (!rd.GetPod(&op) || !rd.GetPod(&dt) || !rd.GetPod(&r.arg) ||
+        !rd.GetPod(&err) || !rd.GetStr(&r.error_message) || !rd.GetPod(&nn))
+      return Malformed("response");
+    r.op_type = static_cast<OpType>(op);
+    r.dtype = static_cast<DataType>(dt);
+    r.error = err != 0;
+    r.names.resize(nn);
+    for (auto& nm : r.names)
+      if (!rd.GetStr(&nm)) return Malformed("name");
+    if (!rd.GetVec(&r.first_dims)) return Malformed("first_dims");
+  }
+  return Status::OK();
+}
+
+}  // namespace hvd

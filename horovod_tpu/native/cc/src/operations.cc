@@ -1,0 +1,432 @@
+// Runtime lifecycle + background loop + execution + C API.
+//
+// Reference equivalent: horovod/common/operations.cc —
+// InitializeHorovodOnce (:554-600), BackgroundThreadLoop (:303-498),
+// RunLoopOnce (:500-550), PerformOperation (:211-279), the enqueue layer
+// (:736-843) and the extern "C" query API (:611-732).  The GPU stream/event
+// machinery of cuda_operations.cc has no counterpart here: this plane moves
+// host memory; device collectives belong to XLA.
+#include "c_api.h"
+
+#include <atomic>
+#include <chrono>
+#include <cstring>
+#include <memory>
+#include <thread>
+
+#include "controller.h"
+#include "data_plane.h"
+#include "hvd_common.h"
+#include "tensor_queue.h"
+#include "timeline.h"
+
+namespace hvd {
+namespace {
+
+constexpr const char* kShutdownError =
+    "Horovod has been shut down. This was caused by an exception on one of "
+    "the ranks or an attempt to enqueue after shutdown.";
+
+// Reference HorovodGlobalState (global_state.h:42-112).
+struct GlobalState {
+  int rank = 0;
+  int size = 1;
+  int local_rank = 0;
+  int local_size = 1;
+  std::string rendezvous_addr;
+  int rendezvous_port = 0;
+
+  std::atomic<bool> initialized{false};
+  std::atomic<bool> shutting_down{false};
+  std::atomic<bool> background_done{false};
+  Status init_status;
+  std::mutex init_mu;
+  std::condition_variable init_cv;
+  bool init_finished = false;
+
+  std::thread background;
+  TensorQueue queue;
+  Controller controller;
+  DataPlane data_plane;
+  Timeline timeline;
+  std::vector<char> fusion_buffer;
+  double cycle_time_ms = 1.0;
+
+  std::mutex err_mu;
+  std::string last_error;
+};
+
+GlobalState* g = nullptr;
+std::mutex g_mu;
+
+void SetLastError(const std::string& msg) {
+  if (g == nullptr) return;
+  std::lock_guard<std::mutex> lk(g->err_mu);
+  g->last_error = msg;
+}
+
+// ---------------------------------------------------------------------------
+// Execution (reference PerformOperation, operations.cc:211-279)
+// ---------------------------------------------------------------------------
+
+int64_t TrailingElems(const std::vector<int64_t>& shape) {
+  int64_t n = 1;
+  for (size_t i = 1; i < shape.size(); ++i) n *= shape[i];
+  return n;
+}
+
+void ExecuteResponse(const Response& resp) {
+  auto entries = g->queue.TakeEntries(resp);
+  for (auto& e : entries) g->timeline.NegotiateEnd(e->name);
+  if (entries.empty()) return;
+
+  if (resp.error) {
+    Status st = Status::Precondition(resp.error_message);
+    for (auto& e : entries) g->queue.Complete(e, st);
+    return;
+  }
+
+  auto complete_all = [&](const Status& st) {
+    for (auto& e : entries) g->queue.Complete(e, st);
+  };
+
+  const size_t esz = DataTypeSize(resp.dtype);
+  Status st;
+  switch (resp.op_type) {
+    case OpType::kAllreduce: {
+      ReduceOp rop = static_cast<ReduceOp>(resp.arg);
+      if (entries.size() == 1) {
+        auto& e = entries[0];
+        g->timeline.Start(e->name, "ALLREDUCE");
+        e->output.resize(static_cast<size_t>(e->count) * esz);
+        std::memcpy(e->output.data(), e->input, e->output.size());
+        e->output_count = e->count;
+        g->timeline.ActivityStart(e->name, "TCP_ALLREDUCE");
+        st = g->data_plane.Allreduce(e->output.data(), e->count, resp.dtype,
+                                     rop);
+        g->timeline.ActivityEnd(e->name);
+        g->timeline.End(e->name);
+      } else {
+        // Fused path (reference fusion_buffer_manager +
+        // MPIAllreduce::Execute memcpy-in/reduce/memcpy-out,
+        // mpi_operations.cc:25-72).
+        size_t total = 0;
+        for (auto& e : entries) total += static_cast<size_t>(e->count) * esz;
+        if (g->fusion_buffer.size() < total) g->fusion_buffer.resize(total);
+        char* buf = g->fusion_buffer.data();
+        size_t off = 0;
+        for (auto& e : entries) {
+          g->timeline.Start(e->name, "ALLREDUCE");
+          g->timeline.ActivityStart(e->name, "MEMCPY_IN_FUSION_BUFFER");
+          std::memcpy(buf + off, e->input,
+                      static_cast<size_t>(e->count) * esz);
+          g->timeline.ActivityEnd(e->name);
+          off += static_cast<size_t>(e->count) * esz;
+        }
+        if (!entries.empty())
+          g->timeline.ActivityStart(entries[0]->name, "TCP_ALLREDUCE");
+        st = g->data_plane.Allreduce(buf, static_cast<int64_t>(total / esz),
+                                     resp.dtype, rop);
+        if (!entries.empty()) g->timeline.ActivityEnd(entries[0]->name);
+        off = 0;
+        for (auto& e : entries) {
+          size_t nbytes = static_cast<size_t>(e->count) * esz;
+          g->timeline.ActivityStart(e->name, "MEMCPY_OUT_FUSION_BUFFER");
+          e->output.assign(buf + off, buf + off + nbytes);
+          e->output_count = e->count;
+          g->timeline.ActivityEnd(e->name);
+          g->timeline.End(e->name);
+          off += nbytes;
+        }
+      }
+      break;
+    }
+    case OpType::kAllgather: {
+      auto& e = entries[0];
+      g->timeline.Start(e->name, "ALLGATHER");
+      int64_t trailing = TrailingElems(e->shape);
+      std::vector<int64_t> counts(g->size);
+      int64_t total_elems = 0;
+      for (int r = 0; r < g->size; ++r) {
+        counts[r] = resp.first_dims[r] * trailing *
+                    static_cast<int64_t>(esz);  // bytes
+        total_elems += resp.first_dims[r] * trailing;
+      }
+      e->output.resize(static_cast<size_t>(total_elems) * esz);
+      e->output_count = total_elems;
+      g->timeline.ActivityStart(e->name, "TCP_ALLGATHER");
+      st = g->data_plane.Allgather(e->input, e->output.data(), counts);
+      g->timeline.ActivityEnd(e->name);
+      g->timeline.End(e->name);
+      break;
+    }
+    case OpType::kBroadcast: {
+      auto& e = entries[0];
+      g->timeline.Start(e->name, "BROADCAST");
+      e->output.resize(static_cast<size_t>(e->count) * esz);
+      std::memcpy(e->output.data(), e->input, e->output.size());
+      e->output_count = e->count;
+      g->timeline.ActivityStart(e->name, "TCP_BROADCAST");
+      st = g->data_plane.Broadcast(e->output.data(), e->count, resp.dtype,
+                                   resp.arg);
+      g->timeline.ActivityEnd(e->name);
+      g->timeline.End(e->name);
+      break;
+    }
+    case OpType::kAlltoall: {
+      auto& e = entries[0];
+      g->timeline.Start(e->name, "ALLTOALL");
+      e->output.resize(static_cast<size_t>(e->count) * esz);
+      e->output_count = e->count;
+      g->timeline.ActivityStart(e->name, "TCP_ALLTOALL");
+      st = g->data_plane.Alltoall(e->input, e->output.data(), e->count,
+                                  resp.dtype);
+      g->timeline.ActivityEnd(e->name);
+      g->timeline.End(e->name);
+      break;
+    }
+    case OpType::kReducescatter: {
+      auto& e = entries[0];
+      g->timeline.Start(e->name, "REDUCESCATTER");
+      int64_t out_count = e->count / g->size;
+      e->output.resize(static_cast<size_t>(out_count) * esz);
+      e->output_count = out_count;
+      g->timeline.ActivityStart(e->name, "TCP_REDUCESCATTER");
+      st = g->data_plane.Reducescatter(e->input, e->output.data(), e->count,
+                                       resp.dtype,
+                                       static_cast<ReduceOp>(resp.arg));
+      g->timeline.ActivityEnd(e->name);
+      g->timeline.End(e->name);
+      break;
+    }
+    case OpType::kBarrier: {
+      // Negotiation itself proved every rank arrived; nothing to move.
+      entries[0]->output_count = 0;
+      break;
+    }
+    case OpType::kJoin: {
+      // Output: the last rank to join, as int32 (coordinator recorded it
+      // in resp.arg).
+      auto& e = entries[0];
+      e->output.resize(sizeof(int32_t));
+      int32_t last = resp.arg;
+      std::memcpy(e->output.data(), &last, sizeof(last));
+      e->output_count = 1;
+      break;
+    }
+  }
+  complete_all(st);
+}
+
+// ---------------------------------------------------------------------------
+// Background loop (reference BackgroundThreadLoop + RunLoopOnce)
+// ---------------------------------------------------------------------------
+
+void BackgroundThread() {
+  // Bootstrap: data-plane listener, controller rendezvous, full mesh.
+  Status s = g->data_plane.Listen("");
+  if (s.ok()) {
+    std::vector<PeerAddr> peers;
+    std::string host = EnvStr("HOROVOD_HOSTNAME", "127.0.0.1");
+    s = g->controller.Init(g->rank, g->size, g->rendezvous_addr,
+                           g->rendezvous_port, host, g->data_plane.port(),
+                           &peers);
+    if (s.ok() && g->size > 1)
+      s = g->data_plane.Connect(g->rank, g->size, peers);
+  }
+  g->timeline.Initialize(EnvStr("HOROVOD_TIMELINE"), g->rank);
+  g->cycle_time_ms = EnvDouble("HOROVOD_CYCLE_TIME", 1.0);
+
+  if (s.ok()) g->initialized.store(true);  // before the init_cv handshake:
+  // the caller may enqueue the moment hvd_init returns.
+  {
+    std::lock_guard<std::mutex> lk(g->init_mu);
+    g->init_status = s;
+    g->init_finished = true;
+  }
+  g->init_cv.notify_all();
+  if (!s.ok()) {
+    g->background_done.store(true);
+    return;
+  }
+
+  bool shutdown_seen = false;
+  while (!shutdown_seen) {
+    auto cycle_start = std::chrono::steady_clock::now();
+    g->timeline.MarkCycleStart();
+
+    RequestList mine;
+    mine.requests = g->queue.PopAnnouncements(g->rank);
+    for (const auto& r : mine.requests)
+      g->timeline.NegotiateStart(r.name, r.op_type);
+    mine.shutdown = g->shutting_down.load();
+
+    ResponseList responses;
+    s = g->controller.Cycle(mine, &responses);
+    if (!s.ok()) {
+      LOG(Error) << "controller cycle failed: " << s.reason;
+      SetLastError(s.reason);
+      g->queue.FailAll(Status::Aborted(s.reason));
+      break;
+    }
+    for (const auto& resp : responses.responses) ExecuteResponse(resp);
+    shutdown_seen = responses.shutdown;
+
+    if (!shutdown_seen) {
+      auto elapsed = std::chrono::steady_clock::now() - cycle_start;
+      auto budget = std::chrono::duration<double, std::milli>(
+          g->cycle_time_ms);
+      if (elapsed < budget &&
+          g->queue.NumPending() == 0)  // hot when work is in flight
+        std::this_thread::sleep_for(budget - elapsed);
+    }
+  }
+
+  g->queue.FailAll(Status::Aborted(kShutdownError));
+  g->data_plane.Shutdown();
+  g->controller.Shutdown();
+  g->timeline.Shutdown();
+  g->initialized.store(false);
+  g->background_done.store(true);
+}
+
+}  // namespace
+}  // namespace hvd
+
+// ---------------------------------------------------------------------------
+// C API
+// ---------------------------------------------------------------------------
+
+using namespace hvd;
+
+int hvd_init(int rank, int size, int local_rank, int local_size,
+             const char* rendezvous_addr, int rendezvous_port) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (g != nullptr && !g->background_done.load()) {
+    SetLastError("hvd_init called twice");
+    return 1;
+  }
+  if (g != nullptr) {
+    if (g->background.joinable()) g->background.join();
+    // Intentionally leaked, never freed: a thread may still be blocked in
+    // hvd_wait on the old state's queue (see hvd_shutdown); the queue and
+    // its entries must outlive it.  One GlobalState per init is a bounded,
+    // reference-style leak (the reference likewise never frees
+    // HorovodGlobalState).
+  }
+  g = new GlobalState();
+  g->rank = rank;
+  g->size = size;
+  g->local_rank = local_rank;
+  g->local_size = local_size;
+  g->rendezvous_addr = rendezvous_addr ? rendezvous_addr : "127.0.0.1";
+  g->rendezvous_port = rendezvous_port;
+  g->background = std::thread(BackgroundThread);
+
+  // Reference busy-waits initialization_done (operations.cc:596-598).
+  std::unique_lock<std::mutex> ilk(g->init_mu);
+  g->init_cv.wait(ilk, [] { return g->init_finished; });
+  if (!g->init_status.ok()) {
+    SetLastError(g->init_status.reason);
+    g->background.join();
+    return 1;
+  }
+  return 0;
+}
+
+void hvd_shutdown() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (g == nullptr) return;
+  g->shutting_down.store(true);
+  if (g->background.joinable()) g->background.join();
+  // Keep `g` allocated: concurrent hvd_wait callers woken by FailAll are
+  // still inside g->queue; freeing here would be a use-after-free.  The
+  // state is inert (initialized=false) and reused checks in hvd_init
+  // handle re-initialization.
+}
+
+int hvd_rank() { return g ? g->rank : -1; }
+int hvd_size() { return g ? g->size : -1; }
+int hvd_local_rank() { return g ? g->local_rank : -1; }
+int hvd_local_size() { return g ? g->local_size : -1; }
+int hvd_is_initialized() { return g && g->initialized.load() ? 1 : 0; }
+
+int64_t hvd_enqueue(int op_type, const char* name, const void* data,
+                    const int64_t* shape, int32_t ndim, int dtype, int arg) {
+  if (g == nullptr || !g->initialized.load()) {
+    SetLastError("runtime not initialized");
+    return -1;
+  }
+  auto e = std::make_shared<TensorTableEntry>();
+  e->name = name;
+  e->op_type = static_cast<OpType>(op_type);
+  e->dtype = static_cast<DataType>(dtype);
+  e->arg = arg;
+  e->shape.assign(shape, shape + ndim);
+  e->input = data;
+  e->count = 1;
+  for (int i = 0; i < ndim; ++i) e->count *= shape[i];
+  Status s = g->queue.Add(e);
+  if (!s.ok()) {
+    SetLastError(s.reason);
+    return -1;
+  }
+  return e->handle;
+}
+
+int hvd_poll(int64_t handle) {
+  if (g == nullptr) return 1;
+  return g->queue.Poll(handle) ? 1 : 0;
+}
+
+int hvd_wait(int64_t handle) {
+  if (g == nullptr) {
+    SetLastError("runtime not initialized");
+    return 1;
+  }
+  EntryPtr e;
+  Status s = g->queue.Wait(handle, &e);
+  if (!s.ok()) {
+    SetLastError(s.reason);
+    return static_cast<int>(s.code);
+  }
+  return 0;
+}
+
+int64_t hvd_output_size(int64_t handle) {
+  if (g == nullptr) return -1;
+  auto e = g->queue.Get(handle);
+  return e ? e->output_count : -1;
+}
+
+int hvd_read_output(int64_t handle, void* dst, int64_t count) {
+  if (g == nullptr) {
+    SetLastError("runtime not initialized");
+    return 1;
+  }
+  auto e = g->queue.Get(handle);
+  if (!e || !e->done) {
+    SetLastError("output not ready");
+    return 1;
+  }
+  size_t nbytes = static_cast<size_t>(count) * DataTypeSize(e->dtype);
+  if (nbytes > e->output.size()) {
+    SetLastError("output read out of range");
+    return 1;
+  }
+  std::memcpy(dst, e->output.data(), nbytes);
+  g->queue.Release(handle);
+  return 0;
+}
+
+void hvd_release(int64_t handle) {
+  if (g != nullptr) g->queue.Release(handle);
+}
+
+const char* hvd_last_error() {
+  static thread_local std::string copy;
+  if (g == nullptr) return "runtime not initialized";
+  std::lock_guard<std::mutex> lk(g->err_mu);
+  copy = g->last_error;
+  return copy.c_str();
+}

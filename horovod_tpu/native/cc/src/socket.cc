@@ -1,0 +1,170 @@
+#include "socket.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <thread>
+
+namespace hvd {
+
+namespace {
+
+std::string Errno(const char* what) {
+  return std::string(what) + ": " + std::strerror(errno);
+}
+
+void SetCommonOpts(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+}  // namespace
+
+TcpSocket::~TcpSocket() { Close(); }
+
+TcpSocket& TcpSocket::operator=(TcpSocket&& o) noexcept {
+  if (this != &o) {
+    Close();
+    fd_ = o.fd_;
+    bound_port_ = o.bound_port_;
+    o.fd_ = -1;
+  }
+  return *this;
+}
+
+void TcpSocket::Close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+Status TcpSocket::Listen(const std::string& addr, int port) {
+  fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd_ < 0) return Status::Unknown(Errno("socket"));
+  int one = 1;
+  setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons(static_cast<uint16_t>(port));
+  sa.sin_addr.s_addr = addr.empty() ? INADDR_ANY : inet_addr(addr.c_str());
+  if (::bind(fd_, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0)
+    return Status::Unknown(Errno("bind"));
+  if (::listen(fd_, 128) != 0) return Status::Unknown(Errno("listen"));
+  socklen_t len = sizeof(sa);
+  if (::getsockname(fd_, reinterpret_cast<sockaddr*>(&sa), &len) == 0)
+    bound_port_ = ntohs(sa.sin_port);
+  return Status::OK();
+}
+
+Status TcpSocket::Accept(TcpSocket* out, int timeout_ms) const {
+  if (timeout_ms >= 0) {
+    pollfd pfd{fd_, POLLIN, 0};
+    int rc = ::poll(&pfd, 1, timeout_ms);
+    if (rc == 0) return Status::Unknown("accept timed out");
+    if (rc < 0) return Status::Unknown(Errno("poll"));
+  }
+  int cfd = ::accept(fd_, nullptr, nullptr);
+  if (cfd < 0) return Status::Unknown(Errno("accept"));
+  SetCommonOpts(cfd);
+  *out = TcpSocket(cfd);
+  return Status::OK();
+}
+
+Status TcpSocket::Connect(const std::string& addr, int port, int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons(static_cast<uint16_t>(port));
+  sa.sin_addr.s_addr = inet_addr(addr.c_str());
+  if (sa.sin_addr.s_addr == INADDR_NONE) {
+    // Hostname, not dotted quad: resolve it.
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    int rc = ::getaddrinfo(addr.c_str(), nullptr, &hints, &res);
+    if (rc != 0 || res == nullptr)
+      return Status::Unknown("could not resolve host " + addr + ": " +
+                             gai_strerror(rc));
+    sa.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+    ::freeaddrinfo(res);
+  }
+  while (true) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) return Status::Unknown(Errno("socket"));
+    if (::connect(fd_, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) == 0) {
+      SetCommonOpts(fd_);
+      return Status::OK();
+    }
+    Close();
+    if (std::chrono::steady_clock::now() >= deadline)
+      return Status::Unknown("connect to " + addr + ":" +
+                             std::to_string(port) + " timed out");
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+  }
+}
+
+Status TcpSocket::SendAll(const void* data, size_t n) const {
+  const char* p = static_cast<const char*>(data);
+  while (n > 0) {
+    ssize_t w = ::send(fd_, p, n, MSG_NOSIGNAL);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return Status::Unknown(Errno("send"));
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return Status::OK();
+}
+
+Status TcpSocket::RecvAll(void* data, size_t n) const {
+  char* p = static_cast<char*>(data);
+  while (n > 0) {
+    ssize_t r = ::recv(fd_, p, n, 0);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return Status::Unknown(Errno("recv"));
+    }
+    if (r == 0) return Status::Aborted("peer closed connection");
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return Status::OK();
+}
+
+Status TcpSocket::SendFrame(const void* data, size_t n) const {
+  uint64_t len = n;
+  Status s = SendAll(&len, sizeof(len));
+  if (!s.ok()) return s;
+  return n ? SendAll(data, n) : Status::OK();
+}
+
+Status TcpSocket::RecvFrame(std::string* out) const {
+  uint64_t len = 0;
+  Status s = RecvAll(&len, sizeof(len));
+  if (!s.ok()) return s;
+  out->resize(len);
+  return len ? RecvAll(&(*out)[0], len) : Status::OK();
+}
+
+std::string TcpSocket::peer_addr() const {
+  sockaddr_in sa{};
+  socklen_t len = sizeof(sa);
+  if (::getpeername(fd_, reinterpret_cast<sockaddr*>(&sa), &len) != 0)
+    return "";
+  char buf[INET_ADDRSTRLEN];
+  inet_ntop(AF_INET, &sa.sin_addr, buf, sizeof(buf));
+  return buf;
+}
+
+}  // namespace hvd

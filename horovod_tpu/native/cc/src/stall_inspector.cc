@@ -1,0 +1,34 @@
+#include "stall_inspector.h"
+
+#include <sstream>
+
+namespace hvd {
+
+StallInspector::StallInspector()
+    : warn_s_(EnvDouble("HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0)),
+      shutdown_s_(EnvDouble("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0)),
+      last_report_(std::chrono::steady_clock::now()) {}
+
+bool StallInspector::Check(const std::string& name,
+                           const std::vector<bool>& submitted,
+                           std::chrono::steady_clock::time_point first_seen) {
+  auto now = std::chrono::steady_clock::now();
+  double age = std::chrono::duration<double>(now - first_seen).count();
+  if (age < warn_s_) return false;
+  // Rate-limit warnings to one batch per warning interval.
+  if (std::chrono::duration<double>(now - last_report_).count() >= warn_s_) {
+    last_report_ = now;
+    std::ostringstream ready, missing;
+    for (size_t r = 0; r < submitted.size(); ++r)
+      (submitted[r] ? ready : missing) << r << " ";
+    LOG(Warning) << "One or more tensors were submitted to be reduced, "
+                 << "gathered or broadcasted by subset of ranks and are "
+                 << "waiting for remainder of ranks for more than "
+                 << warn_s_ << " seconds. Tensor: " << name
+                 << " ready ranks: [" << ready.str() << "] missing ranks: ["
+                 << missing.str() << "]";
+  }
+  return shutdown_s_ > 0 && age >= shutdown_s_;
+}
+
+}  // namespace hvd

@@ -1,0 +1,125 @@
+#include "tensor_queue.h"
+
+#include <algorithm>
+
+namespace hvd {
+
+Status TensorQueue::Add(const EntryPtr& entry) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (by_name_.count(entry->name))
+    return Status::Precondition(
+        DuplicateNameError(entry->op_type, entry->name));
+  entry->handle = next_handle_++;
+  by_name_[entry->name] = entry;
+  by_handle_[entry->handle] = entry;
+  to_announce_.push_back(entry->name);
+  return Status::OK();
+}
+
+std::vector<Request> TensorQueue::PopAnnouncements(int32_t rank) {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::vector<Request> out;
+  out.reserve(to_announce_.size());
+  for (const auto& name : to_announce_) {
+    auto it = by_name_.find(name);
+    if (it == by_name_.end()) continue;  // already failed/removed
+    const auto& e = it->second;
+    Request r;
+    r.rank = rank;
+    r.op_type = e->op_type;
+    r.dtype = e->dtype;
+    r.arg = e->arg;
+    r.name = e->name;
+    r.shape = e->shape;
+    out.push_back(std::move(r));
+  }
+  to_announce_.clear();
+  return out;
+}
+
+std::vector<EntryPtr> TensorQueue::TakeEntries(const Response& response) {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::vector<EntryPtr> out;
+  out.reserve(response.names.size());
+  for (const auto& name : response.names) {
+    auto it = by_name_.find(name);
+    if (it != by_name_.end()) {
+      out.push_back(it->second);
+      by_name_.erase(it);
+    }
+  }
+  return out;
+}
+
+void TensorQueue::Reannounce(const std::string& name) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (by_name_.count(name)) to_announce_.push_back(name);
+}
+
+void TensorQueue::Complete(const EntryPtr& entry, Status status) {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    entry->status = std::move(status);
+    entry->done = true;
+  }
+  cv_.notify_all();
+}
+
+void TensorQueue::FailAll(const Status& status) {
+  std::vector<EntryPtr> pending;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& kv : by_name_)
+      if (!kv.second->done) pending.push_back(kv.second);
+    by_name_.clear();
+    to_announce_.clear();
+    for (auto& e : pending) {
+      e->status = status;
+      e->done = true;
+    }
+  }
+  cv_.notify_all();
+}
+
+bool TensorQueue::Poll(int64_t handle) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = by_handle_.find(handle);
+  return it == by_handle_.end() || it->second->done;
+}
+
+Status TensorQueue::Wait(int64_t handle, EntryPtr* out) {
+  std::unique_lock<std::mutex> lk(mu_);
+  auto it = by_handle_.find(handle);
+  if (it == by_handle_.end())
+    return Status::InvalidArgument("unknown handle " + std::to_string(handle));
+  EntryPtr e = it->second;
+  cv_.wait(lk, [&] { return e->done; });
+  *out = e;
+  return e->status;
+}
+
+EntryPtr TensorQueue::Get(int64_t handle) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = by_handle_.find(handle);
+  return it == by_handle_.end() ? nullptr : it->second;
+}
+
+void TensorQueue::Release(int64_t handle) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = by_handle_.find(handle);
+  if (it != by_handle_.end()) {
+    // Only drop the name slot if it still maps to THIS entry — a new
+    // collective may legitimately reuse the name once this one completed.
+    auto nit = by_name_.find(it->second->name);
+    if (nit != by_name_.end() && nit->second == it->second)
+      by_name_.erase(nit);
+    by_handle_.erase(it);
+  }
+}
+
+size_t TensorQueue::NumPending() {
+  std::lock_guard<std::mutex> lk(mu_);
+  return by_name_.size();
+}
+
+}  // namespace hvd

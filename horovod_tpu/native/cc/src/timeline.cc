@@ -1,0 +1,124 @@
+#include "timeline.h"
+
+#include <chrono>
+
+namespace hvd {
+
+void Timeline::Initialize(const std::string& filename, int rank) {
+  if (filename.empty() || rank != 0 || initialized_.load()) return;
+  file_ = std::fopen(filename.c_str(), "w");
+  if (file_ == nullptr) {
+    LOG(Error) << "could not open timeline file " << filename;
+    return;
+  }
+  std::fputs("[\n", file_);
+  mark_cycles_ = EnvBool("HOROVOD_TIMELINE_MARK_CYCLES", false);
+  start_ = std::chrono::steady_clock::now();
+  stop_.store(false);
+  writer_ = std::thread(&Timeline::WriterLoop, this);
+  initialized_.store(true);
+}
+
+Timeline::~Timeline() { Shutdown(); }
+
+void Timeline::Shutdown() {
+  if (!initialized_.load()) return;
+  initialized_.store(false);
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    stop_.store(true);
+  }
+  cv_.notify_all();
+  if (writer_.joinable()) writer_.join();
+  if (file_ != nullptr) {
+    // Terminal no-comma event keeps the file valid JSON.
+    std::fputs("{\"name\": \"SHUTDOWN\", \"ph\": \"i\", \"pid\": 0, "
+               "\"tid\": 0, \"ts\": 0, \"s\": \"g\"}\n]\n", file_);
+    std::fclose(file_);
+    file_ = nullptr;
+  }
+}
+
+int64_t Timeline::TidFor(const std::string& tensor) {
+  auto it = tids_.find(tensor);
+  if (it != tids_.end()) return it->second;
+  int64_t tid = next_tid_++;
+  tids_[tensor] = tid;
+  // Name the row after the tensor (reference emits the same metadata event).
+  std::fprintf(file_,
+               "{\"name\": \"thread_name\", \"ph\": \"M\", \"pid\": 0, "
+               "\"tid\": %lld, \"args\": {\"name\": \"%s\"}},\n",
+               static_cast<long long>(tid), tensor.c_str());
+  return tid;
+}
+
+void Timeline::Emit(char phase, const std::string& name,
+                    const std::string& tensor) {
+  if (!initialized_.load()) return;
+  auto ts = std::chrono::duration_cast<std::chrono::microseconds>(
+                std::chrono::steady_clock::now() - start_).count();
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    queue_.push_back(Event{phase, name, tensor, ts});
+  }
+  cv_.notify_one();
+}
+
+void Timeline::WriterLoop() {
+  std::unique_lock<std::mutex> lk(mu_);
+  while (!stop_.load() || !queue_.empty()) {
+    cv_.wait(lk, [&] { return stop_.load() || !queue_.empty(); });
+    while (!queue_.empty()) {
+      Event e = std::move(queue_.front());
+      queue_.pop_front();
+      lk.unlock();
+      int64_t tid = e.tensor.empty() ? 0 : TidFor(e.tensor);
+      if (e.phase == 'i') {
+        std::fprintf(file_,
+                     "{\"name\": \"%s\", \"ph\": \"i\", \"pid\": 0, "
+                     "\"tid\": %lld, \"ts\": %lld, \"s\": \"g\"},\n",
+                     e.name.c_str(), static_cast<long long>(tid),
+                     static_cast<long long>(e.ts_us));
+      } else {
+        std::fprintf(file_,
+                     "{\"name\": \"%s\", \"ph\": \"%c\", \"pid\": 0, "
+                     "\"tid\": %lld, \"ts\": %lld},\n",
+                     e.name.c_str(), e.phase, static_cast<long long>(tid),
+                     static_cast<long long>(e.ts_us));
+      }
+      lk.lock();
+    }
+    std::fflush(file_);
+  }
+}
+
+void Timeline::NegotiateStart(const std::string& tensor, OpType op) {
+  Emit('B', std::string("NEGOTIATE_") +
+                [&] {
+                  std::string s = OpTypeName(op);
+                  for (auto& c : s) c = std::toupper(c);
+                  return s;
+                }(),
+       tensor);
+}
+
+void Timeline::NegotiateEnd(const std::string& tensor) { Emit('E', "", tensor); }
+
+void Timeline::Start(const std::string& tensor, const std::string& op_name) {
+  Emit('B', op_name, tensor);
+}
+
+void Timeline::ActivityStart(const std::string& tensor,
+                             const std::string& activity) {
+  Emit('B', activity, tensor);
+}
+
+void Timeline::ActivityEnd(const std::string& tensor) { Emit('E', "", tensor); }
+
+void Timeline::End(const std::string& tensor) { Emit('E', "", tensor); }
+
+void Timeline::MarkCycleStart() {
+  if (mark_cycles_) Emit('i', "CYCLE_START", "");
+}
+
+}  // namespace hvd

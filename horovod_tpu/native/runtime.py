@@ -48,13 +48,25 @@ def _find_library() -> str:
     ]
     env = os.environ.get("HOROVOD_TPU_NATIVE_LIB")
     if env:
-        candidates.insert(0, env)
+        # An explicit override must be honored or fail loudly — never
+        # silently substituted with the default build.
+        if not os.path.exists(env):
+            raise RuntimeError(
+                f"HOROVOD_TPU_NATIVE_LIB={env} does not exist")
+        return env
     for c in candidates:
         if os.path.exists(c):
             return c
-    raise RuntimeError(
-        f"{_LIB_NAME} not found (searched {candidates}). Build it with: "
-        f"python -m horovod_tpu.native.build")
+    # Sources ship with the package and g++ is cheap: build on demand
+    # (mirrors the reference's install-time extension build).
+    try:
+        from horovod_tpu.native.build import ensure_built
+        return ensure_built()
+    except Exception as e:
+        raise RuntimeError(
+            f"{_LIB_NAME} not found (searched {candidates}) and on-demand "
+            f"build failed: {e}. Build it with: "
+            f"python -m horovod_tpu.native.build")
 
 
 class Runtime:
@@ -85,13 +97,13 @@ class Runtime:
         lib.hvd_shutdown.argtypes = []
         lib.hvd_shutdown.restype = None
         lib.hvd_enqueue.argtypes = [
-            ctypes.c_int,            # op type (0=allreduce,1=allgather,2=bcast,3=alltoall,4=reducescatter,5=barrier/join)
+            ctypes.c_int,            # op type (0=allreduce,1=allgather,2=bcast,3=alltoall,4=reducescatter,5=barrier,6=join)
             ctypes.c_char_p,         # tensor name
             ctypes.c_void_p,         # input data
-            ctypes.c_longlong,       # element count
+            ctypes.POINTER(ctypes.c_longlong),  # shape
+            ctypes.c_int,            # ndim
             ctypes.c_int,            # dtype code
             ctypes.c_int,            # reduce-op code / root rank
-            ctypes.c_longlong,       # first-dim size (allgather shape exchange)
         ]
         lib.hvd_enqueue.restype = ctypes.c_longlong   # handle, <0 on error
         lib.hvd_poll.argtypes = [ctypes.c_longlong]
@@ -103,6 +115,8 @@ class Runtime:
         lib.hvd_read_output.argtypes = [ctypes.c_longlong, ctypes.c_void_p,
                                         ctypes.c_longlong]
         lib.hvd_read_output.restype = ctypes.c_int
+        lib.hvd_release.argtypes = [ctypes.c_longlong]
+        lib.hvd_release.restype = None
         lib.hvd_last_error.argtypes = []
         lib.hvd_last_error.restype = ctypes.c_char_p
         addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR", "127.0.0.1")
@@ -122,15 +136,15 @@ class Runtime:
 
     # -- collectives -------------------------------------------------------
 
-    def _submit(self, op: int, name: str, arr: np.ndarray, arg: int = 0,
-                first_dim: int = -1) -> int:
+    def _submit(self, op: int, name: str, arr: np.ndarray, arg: int = 0) -> int:
         arr = np.ascontiguousarray(arr)
         code = _DTYPE_CODES.get(arr.dtype)
         if code is None:
             raise ValueError(f"unsupported dtype for eager collective: {arr.dtype}")
+        shape = (ctypes.c_longlong * max(arr.ndim, 1))(*arr.shape)
         h = self._lib.hvd_enqueue(
             op, name.encode(), arr.ctypes.data_as(ctypes.c_void_p),
-            arr.size, code, arg, first_dim)
+            shape, arr.ndim, code, arg)
         if h < 0:
             raise RuntimeError(self._lib.hvd_last_error().decode())
         with self._inflight_lock:
@@ -142,7 +156,9 @@ class Runtime:
         with self._inflight_lock:
             self._inflight.pop(h, None)
         if rc != 0:
-            raise RuntimeError(self._lib.hvd_last_error().decode())
+            err = self._lib.hvd_last_error().decode()
+            self._lib.hvd_release(h)   # drop the native table entry
+            raise RuntimeError(err)
         n = self._lib.hvd_output_size(h)
         out = np.empty(int(n), dtype=dtype)
         rc = self._lib.hvd_read_output(
@@ -161,8 +177,9 @@ class Runtime:
 
     def allgather(self, name: str, arr: np.ndarray) -> np.ndarray:
         arr = np.asarray(arr)
-        first = arr.shape[0] if arr.ndim else 1
-        h = self._submit(1, name, arr, 0, first)
+        if arr.ndim == 0:
+            arr = arr.reshape(1)
+        h = self._submit(1, name, arr)
         return self._wait_read(h, arr.dtype, arr.shape[1:])
 
     def broadcast(self, name: str, arr: np.ndarray, root: int) -> np.ndarray:
@@ -183,14 +200,17 @@ class Runtime:
         h = self._submit(4, name, arr, op_code)
         return self._wait_read(h, arr.dtype, arr.shape[1:])
 
-    def barrier(self, name: str = "barrier") -> None:
+    def barrier(self, name: str = "hvd.barrier") -> None:
+        """Native barrier: the negotiation round IS the barrier (all ranks
+        must announce before the coordinator responds)."""
         arr = np.zeros(1, np.int32)
-        h = self._submit(0, name, arr, 1)
+        h = self._submit(5, name, arr)
         self._wait_read(h, arr.dtype, ())
 
     def join(self) -> int:
-        # TODO(native): track true join *order* in the controller and return
-        # the actually-last rank; max-of-ranks is a placeholder that is only
-        # correct when callers just need "some rank is done".
-        out = self.allreduce("hvd.join", np.array([self.rank], np.int32), 4)
+        """Returns the rank that joined LAST, as observed by the
+        coordinator (later-Horovod ``join()`` contract)."""
+        arr = np.zeros(1, np.int32)
+        h = self._submit(6, "hvd.join", arr)
+        out = self._wait_read(h, np.dtype(np.int32), ())
         return int(out.ravel()[0])

@@ -1,0 +1,3 @@
+"""``hvdrun`` — the launcher (reference ``horovodrun``, ``run/run.py``)."""
+
+from horovod_tpu.runner.run import main, run_command  # noqa: F401

@@ -1,0 +1,6 @@
+"""``python -m horovod_tpu.runner`` = hvdrun."""
+import sys
+
+from horovod_tpu.runner.run import main
+
+sys.exit(main())

@@ -1,0 +1,102 @@
+"""CLI args <-> HOROVOD_* environment, plus YAML config-file support.
+
+Reference equivalent: ``run/common/util/config_parser.py`` (arg->env
+``set_env_from_args``) and the ``--config-file`` handling with CLI-override
+precedence (``run/run.py:581-585``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+# arg attribute -> env var (reference config_parser.py constants).
+_ARG_ENV = {
+    "fusion_threshold_mb": "HOROVOD_FUSION_THRESHOLD",   # scaled to bytes
+    "cycle_time_ms": "HOROVOD_CYCLE_TIME",
+    "cache_capacity": "HOROVOD_CACHE_CAPACITY",
+    "timeline_filename": "HOROVOD_TIMELINE",
+    "timeline_mark_cycles": "HOROVOD_TIMELINE_MARK_CYCLES",
+    "stall_check_time_seconds": "HOROVOD_STALL_CHECK_TIME_SECONDS",
+    "stall_shutdown_time_seconds": "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS",
+    "autotune": "HOROVOD_AUTOTUNE",
+    "autotune_log_file": "HOROVOD_AUTOTUNE_LOG",
+    "log_level": "HOROVOD_LOG_LEVEL",
+    "log_hide_timestamp": "HOROVOD_LOG_HIDE_TIME",
+}
+
+# config-file YAML key -> arg attribute (reference run.py:374-587 arg names).
+_CONFIG_ARGS = {
+    "fusion-threshold-mb": "fusion_threshold_mb",
+    "cycle-time-ms": "cycle_time_ms",
+    "cache-capacity": "cache_capacity",
+    "timeline-filename": "timeline_filename",
+    "timeline-mark-cycles": "timeline_mark_cycles",
+    "stall-check-time-seconds": "stall_check_time_seconds",
+    "stall-shutdown-time-seconds": "stall_shutdown_time_seconds",
+    "autotune": "autotune",
+    "autotune-log-file": "autotune_log_file",
+    "verbose": "verbose",
+    "log-level": "log_level",
+    "log-hide-timestamp": "log_hide_timestamp",
+}
+
+
+def env_from_args(args) -> Dict[str, str]:
+    """Build the HOROVOD_* env dict from parsed launcher args (reference
+    ``set_env_from_args``)."""
+    env: Dict[str, str] = {}
+    for attr, var in _ARG_ENV.items():
+        v = getattr(args, attr, None)
+        if v is None or v is False:
+            continue
+        if attr == "fusion_threshold_mb":
+            env[var] = str(int(float(v) * 1024 * 1024))
+        elif isinstance(v, bool):
+            env[var] = "1"
+        else:
+            env[var] = str(v)
+    return env
+
+
+def apply_config_file(args, parser) -> None:
+    """Overlay YAML config values onto args, CLI flags winning (reference
+    run.py:581-585, tested by test_run.py:161-212)."""
+    if not getattr(args, "config_file", None):
+        return
+    import yaml
+
+    with open(args.config_file) as f:
+        config = yaml.safe_load(f) or {}
+    # Flags explicitly given on the CLI take precedence: compare against the
+    # parser defaults to detect explicit settings.
+    defaults = {a.dest: a.default for a in parser._actions}
+    for key, value in config.items():
+        attr = _CONFIG_ARGS.get(key)
+        if attr is None:
+            raise ValueError(
+                f"unknown config file key {key!r}; valid keys: "
+                f"{sorted(_CONFIG_ARGS)}")
+        if getattr(args, attr, None) == defaults.get(attr):
+            setattr(args, attr, value)
+
+
+def runtime_env(info, rendezvous_addr: str, rendezvous_port: int,
+                extra: Dict[str, str]) -> Dict[str, str]:
+    """Per-rank environment (reference gloo_run.py:211-254 env contract)."""
+    env = dict(os.environ)
+    env.update(extra)
+    env.update({
+        "HOROVOD_RANK": str(info.rank),
+        "HOROVOD_SIZE": str(info.size),
+        "HOROVOD_LOCAL_RANK": str(info.local_rank),
+        "HOROVOD_LOCAL_SIZE": str(info.local_size),
+        "HOROVOD_CROSS_RANK": str(info.cross_rank),
+        "HOROVOD_CROSS_SIZE": str(info.cross_size),
+        "HOROVOD_HOSTNAME": info.hostname,
+        "HOROVOD_RENDEZVOUS_ADDR": rendezvous_addr,
+        "HOROVOD_RENDEZVOUS_PORT": str(rendezvous_port),
+        "HOROVOD_CONTROLLER": "tcp",
+        "HOROVOD_CPU_OPERATIONS": "tcp",
+    })
+    return env

@@ -1,0 +1,92 @@
+"""Host list parsing and rank allocation.
+
+Reference equivalents: ``run/run.py:590-622`` (host/hostfile parsing) and
+``run/gloo_run.py:56-114`` (``_allocate``: rank / local_rank / cross_rank
+assignment from ``host:slots`` pairs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+
+@dataclass
+class HostSlots:
+    hostname: str
+    slots: int
+
+
+@dataclass
+class RankInfo:
+    rank: int
+    size: int
+    local_rank: int
+    local_size: int
+    cross_rank: int
+    cross_size: int
+    hostname: str
+
+
+def parse_hosts(hosts: str) -> List[HostSlots]:
+    """Parse ``"h1:2,h2:2"`` (reference run.py:590-607)."""
+    out = []
+    for part in hosts.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            name, slots = part.rsplit(":", 1)
+            out.append(HostSlots(name, int(slots)))
+        else:
+            out.append(HostSlots(part, 1))
+    if not out:
+        raise ValueError(f"no hosts found in {hosts!r}")
+    return out
+
+
+def parse_hostfile(path: str) -> List[HostSlots]:
+    """Parse a hostfile of ``hostname slots=N`` lines (reference
+    run.py:609-622)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            fields = line.split()
+            name = fields[0]
+            slots = 1
+            for fld in fields[1:]:
+                if fld.startswith("slots="):
+                    slots = int(fld[len("slots="):])
+            out.append(HostSlots(name, slots))
+    if not out:
+        raise ValueError(f"no hosts found in hostfile {path}")
+    return out
+
+
+def allocate(hosts: List[HostSlots], np_: int) -> List[RankInfo]:
+    """Assign ranks host-major (reference _allocate, gloo_run.py:56-114):
+    consecutive ranks fill a host before moving to the next; local_rank is
+    the slot index, cross_rank the host index."""
+    total = sum(h.slots for h in hosts)
+    if total < np_:
+        raise ValueError(
+            f"requested -np {np_} but hosts only provide {total} slots")
+    infos: List[RankInfo] = []
+    rank = 0
+    cross_size = 0
+    for host_idx, h in enumerate(hosts):
+        if rank >= np_:
+            break
+        cross_size += 1
+        use = min(h.slots, np_ - rank)
+        for slot in range(use):
+            infos.append(RankInfo(
+                rank=rank, size=np_, local_rank=slot, local_size=use,
+                cross_rank=host_idx, cross_size=0, hostname=h.hostname))
+            rank += 1
+    for info in infos:
+        info.cross_size = cross_size
+    return infos

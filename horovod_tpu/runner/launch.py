@@ -1,0 +1,174 @@
+"""Process spawn / supervision for ``hvdrun``.
+
+Reference equivalents: ``run/gloo_run.py:165-262`` (threaded per-rank launch,
+stdout/stderr capture with rank prefixes or per-rank files, kill fan-out on
+failure or signal) and ``run/common/util/safe_shell_exec.py`` (process-group
+kill).  Local ranks run via subprocess in their own process group; remote
+hosts ride ssh exactly like the reference's gloo path.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from horovod_tpu.runner.hosts import RankInfo
+
+
+def find_free_port() -> int:
+    s = socket.socket()
+    s.bind(("0.0.0.0", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def is_local(hostname: str) -> bool:
+    return hostname in ("localhost", "127.0.0.1", socket.gethostname())
+
+
+class RankProcess:
+    def __init__(self, info: RankInfo, command: List[str],
+                 env: Dict[str, str], output_dir: Optional[str],
+                 prefix_output: bool):
+        self.info = info
+        self.command = command
+        self.env = env
+        self.output_dir = output_dir
+        self.prefix_output = prefix_output
+        self.proc: Optional[subprocess.Popen] = None
+        self._pump: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if is_local(self.info.hostname):
+            cmd = self.command
+            env = self.env
+        else:
+            # Remote spawn over ssh with env inlined (reference
+            # gloo_run.py:211-254 builds the same kind of command line).
+            exports = " ".join(
+                f"{k}={shlex.quote(v)}" for k, v in sorted(self.env.items())
+                if k.startswith(("HOROVOD_", "PYTHONPATH", "PATH", "XLA_",
+                                 "JAX_")))
+            remote = f"cd {shlex.quote(os.getcwd())} && env {exports} " + \
+                " ".join(shlex.quote(c) for c in self.command)
+            cmd = ["ssh", "-o", "StrictHostKeyChecking=no",
+                   self.info.hostname, remote]
+            env = dict(os.environ)
+
+        stdout_target = subprocess.PIPE
+        if self.output_dir:
+            rank_dir = os.path.join(self.output_dir,
+                                    f"rank.{self.info.rank}")
+            os.makedirs(rank_dir, exist_ok=True)
+            self._stdout_f = open(os.path.join(rank_dir, "stdout"), "wb")
+            self._stderr_f = open(os.path.join(rank_dir, "stderr"), "wb")
+            self.proc = subprocess.Popen(
+                cmd, env=env, stdout=self._stdout_f, stderr=self._stderr_f,
+                start_new_session=True)
+            return
+        self.proc = subprocess.Popen(
+            cmd, env=env, stdout=stdout_target, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        self._pump = threading.Thread(target=self._pump_output, daemon=True)
+        self._pump.start()
+
+    def _pump_output(self) -> None:
+        prefix = f"[{self.info.rank}]<stdout>:" if self.prefix_output else ""
+        for line in iter(self.proc.stdout.readline, b""):
+            sys.stdout.write(prefix + line.decode(errors="replace"))
+            sys.stdout.flush()
+
+    def terminate(self) -> None:
+        if self.proc is None or self.proc.poll() is not None:
+            return
+        try:
+            os.killpg(self.proc.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    def kill(self) -> None:
+        if self.proc is None or self.proc.poll() is not None:
+            return
+        try:
+            os.killpg(self.proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+def launch_job(rank_infos: List[RankInfo], command: List[str],
+               env_per_rank: List[Dict[str, str]],
+               output_dir: Optional[str] = None,
+               prefix_output: bool = True,
+               start_timeout: Optional[float] = None) -> int:
+    """Run all ranks; on any non-zero exit terminate the rest (reference
+    gloo_run.py:256-262).  Returns the job exit code."""
+    procs = [RankProcess(info, command, env, output_dir, prefix_output)
+             for info, env in zip(rank_infos, env_per_rank)]
+
+    stop = threading.Event()
+
+    def handle_signal(signum, frame):
+        del frame
+        stop.set()
+        for p in procs:
+            p.terminate()
+
+    old_int = signal.signal(signal.SIGINT, handle_signal)
+    old_term = signal.signal(signal.SIGTERM, handle_signal)
+    try:
+        # start_timeout bounds LAUNCHING only (spawning every rank — ssh may
+        # block on remote hosts), never a healthy running job; rendezvous
+        # hangs are bounded by the runtime's own connect timeouts.
+        launch_deadline = (time.monotonic() + start_timeout
+                           if start_timeout else None)
+        for p in procs:
+            if launch_deadline and time.monotonic() > launch_deadline:
+                sys.stderr.write("hvdrun: start timeout exceeded while "
+                                 "launching ranks\n")
+                for q in procs:
+                    q.terminate()
+                return 1
+            p.start()
+        exit_code = 0
+        running = set(range(len(procs)))
+        while running and not stop.is_set():
+            for i in sorted(running):
+                rc = procs[i].proc.poll()
+                if rc is None:
+                    continue
+                running.discard(i)
+                if rc != 0:
+                    exit_code = rc
+                    sys.stderr.write(
+                        f"hvdrun: rank {procs[i].info.rank} exited with "
+                        f"code {rc}; terminating remaining ranks.\n")
+                    for j in sorted(running):
+                        procs[j].terminate()
+                    stop.set()
+                break
+            time.sleep(0.05)
+        # Grace period, then hard kill.
+        t0 = time.monotonic()
+        while any(p.proc.poll() is None for p in procs):
+            if time.monotonic() - t0 > 10:
+                for p in procs:
+                    p.kill()
+                break
+            time.sleep(0.05)
+        for p in procs:
+            p.proc.wait()
+            rc = p.proc.returncode
+            if rc not in (0, None) and exit_code == 0 and not stop.is_set():
+                exit_code = rc
+        return exit_code
+    finally:
+        signal.signal(signal.SIGINT, old_int)
+        signal.signal(signal.SIGTERM, old_term)

@@ -1,0 +1,160 @@
+"""``hvdrun`` CLI (reference ``horovodrun``, ``run/run.py:374-587``).
+
+Usage::
+
+    hvdrun -np 4 python train.py
+    hvdrun -np 8 -H host1:4,host2:4 python train.py
+    python -m horovod_tpu.runner -np 2 pytest -q tests/
+
+Replaces the reference's mpirun/ssh-gloo dispatch with direct process
+spawn + the native TCP rendezvous; on TPU pods one rank per host is the
+typical layout (each process drives all local chips through SPMD).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List
+
+import horovod_tpu
+from horovod_tpu.runner import config_parser, hosts, launch
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch a horovod_tpu distributed job.")
+    p.add_argument("-v", "--version", action="version",
+                   version=horovod_tpu.__version__)
+    p.add_argument("-np", "--num-proc", dest="np", type=int,
+                   help="Total number of processes to launch.")
+    p.add_argument("-H", "--hosts",
+                   help="Comma-separated host:slots pairs "
+                        "(default: localhost with -np slots).")
+    p.add_argument("--hostfile",
+                   help="Hostfile with 'hostname slots=N' lines.")
+    p.add_argument("--output-filename",
+                   help="Redirect per-rank output to "
+                        "<dir>/rank.N/stdout|stderr.")
+    p.add_argument("--start-timeout", type=float, default=None,
+                   help="Seconds to wait for the job to finish launching.")
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("--config-file",
+                   help="YAML config file; CLI flags take precedence.")
+    p.add_argument("--check-build", action="store_true",
+                   help="Print build capabilities and exit.")
+    p.add_argument("--rendezvous-port", type=int, default=0,
+                   help="Fixed controller rendezvous port (default: pick "
+                        "a free port).")
+
+    tune = p.add_argument_group("tunables")
+    tune.add_argument("--fusion-threshold-mb", type=float, default=None)
+    tune.add_argument("--cycle-time-ms", type=float, default=None)
+    tune.add_argument("--cache-capacity", type=int, default=None)
+    tune.add_argument("--autotune", action="store_true", default=False)
+    tune.add_argument("--autotune-log-file", default=None)
+
+    timeline = p.add_argument_group("timeline")
+    timeline.add_argument("--timeline-filename", default=None)
+    timeline.add_argument("--timeline-mark-cycles", action="store_true",
+                          default=False)
+
+    stall = p.add_argument_group("stall detection")
+    stall.add_argument("--stall-check-time-seconds", type=float, default=None)
+    stall.add_argument("--stall-shutdown-time-seconds", type=float,
+                       default=None)
+
+    logg = p.add_argument_group("logging")
+    logg.add_argument("--log-level", default=None,
+                      choices=["trace", "debug", "info", "warning", "error",
+                               "fatal"])
+    logg.add_argument("--log-hide-timestamp", action="store_true",
+                      default=False)
+
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="Command to run on every rank.")
+    return p
+
+
+def check_build() -> str:
+    import horovod_tpu as hvd
+    yes, no = "[X]", "[ ]"
+    lines = [
+        f"horovod_tpu v{horovod_tpu.__version__}:",
+        "",
+        "Available backends:",
+        f"    {yes if hvd.tpu_built() else no} TPU/XLA (SPMD plane)",
+        f"    {yes} TCP eager runtime",
+        f"    {no} MPI",
+        f"    {no} Gloo",
+        f"    {no} NCCL",
+        "",
+        "Available frameworks:",
+        "    [X] JAX",
+        f"    {_torch_mark()} PyTorch",
+    ]
+    return "\n".join(lines)
+
+
+def _torch_mark() -> str:
+    try:
+        import torch  # noqa: F401
+        return "[X]"
+    except ImportError:
+        return "[ ]"
+
+
+def run_command(args) -> int:
+    """Resolved-args entry, shared with tests."""
+    if args.hostfile:
+        host_list = hosts.parse_hostfile(args.hostfile)
+    elif args.hosts:
+        host_list = hosts.parse_hosts(args.hosts)
+    else:
+        if not args.np:
+            raise ValueError("either -np or -H/--hostfile is required")
+        host_list = [hosts.HostSlots("localhost", args.np)]
+    np_ = args.np or sum(h.slots for h in host_list)
+
+    infos = hosts.allocate(host_list, np_)
+    extra_env = config_parser.env_from_args(args)
+
+    # The coordinator lives on rank 0's host.  Only an all-local job may use
+    # loopback: with remote ranks in the mix they must reach rank 0 by its
+    # real hostname.
+    all_local = all(launch.is_local(i.hostname) for i in infos)
+    addr = "127.0.0.1" if all_local else infos[0].hostname
+    port = args.rendezvous_port or launch.find_free_port()
+    env_per_rank = [
+        config_parser.runtime_env(info, addr, port, extra_env)
+        for info in infos
+    ]
+    if args.verbose:
+        for info in infos:
+            print(f"hvdrun: rank {info.rank} -> {info.hostname} "
+                  f"(local {info.local_rank}/{info.local_size}, "
+                  f"cross {info.cross_rank}/{info.cross_size})")
+    return launch.launch_job(
+        infos, args.command, env_per_rank,
+        output_dir=args.output_filename,
+        start_timeout=args.start_timeout)
+
+
+def main(argv: List[str] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.check_build:
+        print(check_build())
+        return 0
+    config_parser.apply_config_file(args, parser)
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+    if not args.command:
+        parser.error("no command given")
+    return run_command(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,29 @@
+"""Fixtures for tests that run UNDER the launcher
+(``hvdrun -np N python -m pytest tests/distributed``).
+
+Unlike the parent conftest's per-test init/shutdown, the native runtime is
+initialized once per pytest session: the rendezvous is a job-wide event
+(reference tests likewise init once per process, test/test_torch.py).
+"""
+
+import atexit
+
+import pytest
+
+
+@pytest.fixture(scope="session")
+def hvd():
+    import horovod_tpu as hvd
+    hvd.init()
+    atexit.register(hvd.shutdown)
+    return hvd
+
+
+@pytest.fixture(scope="session")
+def rank(hvd):
+    return hvd.rank()
+
+
+@pytest.fixture(scope="session")
+def size(hvd):
+    return hvd.size()

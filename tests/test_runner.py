@@ -1,0 +1,96 @@
+"""Launcher unit tests (reference test/test_run.py:53-213: arg->env mapping,
+hostfile parsing, config precedence, validation)."""
+
+import os
+import textwrap
+
+import pytest
+
+from horovod_tpu.runner import config_parser, hosts
+from horovod_tpu.runner.run import build_parser, check_build
+
+
+def test_parse_hosts():
+    hs = hosts.parse_hosts("h1:2,h2:4, h3")
+    assert [(h.hostname, h.slots) for h in hs] == [
+        ("h1", 2), ("h2", 4), ("h3", 1)]
+    with pytest.raises(ValueError):
+        hosts.parse_hosts("")
+
+
+def test_parse_hostfile(tmp_path):
+    hf = tmp_path / "hosts"
+    hf.write_text(textwrap.dedent("""\
+        # comment
+        h1 slots=2
+        h2 slots=4  # trailing comment
+        h3
+    """))
+    hs = hosts.parse_hostfile(str(hf))
+    assert [(h.hostname, h.slots) for h in hs] == [
+        ("h1", 2), ("h2", 4), ("h3", 1)]
+
+
+def test_allocate_ranks():
+    infos = hosts.allocate(hosts.parse_hosts("h1:2,h2:2"), 4)
+    assert [i.rank for i in infos] == [0, 1, 2, 3]
+    assert [i.local_rank for i in infos] == [0, 1, 0, 1]
+    assert [i.cross_rank for i in infos] == [0, 0, 1, 1]
+    assert all(i.local_size == 2 and i.cross_size == 2 for i in infos)
+    # partial use of the last host
+    infos = hosts.allocate(hosts.parse_hosts("h1:2,h2:2"), 3)
+    assert [i.hostname for i in infos] == ["h1", "h1", "h2"]
+    assert infos[2].local_size == 1
+    with pytest.raises(ValueError, match="slots"):
+        hosts.allocate(hosts.parse_hosts("h1:2"), 4)
+
+
+def test_env_from_args():
+    parser = build_parser()
+    args = parser.parse_args([
+        "-np", "2", "--fusion-threshold-mb", "32", "--cycle-time-ms", "2.5",
+        "--timeline-filename", "/tmp/tl.json", "--log-level", "debug",
+        "echo", "hi"])
+    env = config_parser.env_from_args(args)
+    assert env["HOROVOD_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+    assert env["HOROVOD_CYCLE_TIME"] == "2.5"
+    assert env["HOROVOD_TIMELINE"] == "/tmp/tl.json"
+    assert env["HOROVOD_LOG_LEVEL"] == "debug"
+    assert "HOROVOD_AUTOTUNE" not in env
+
+
+def test_config_file_precedence(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text("fusion-threshold-mb: 16\ncycle-time-ms: 7\n")
+    parser = build_parser()
+    # CLI flag wins over config file; config fills the rest.
+    args = parser.parse_args(["-np", "2", "--config-file", str(cfg),
+                              "--fusion-threshold-mb", "8", "echo"])
+    config_parser.apply_config_file(args, parser)
+    assert args.fusion_threshold_mb == 8.0
+    assert args.cycle_time_ms == 7
+
+
+def test_config_file_unknown_key(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text("no-such-knob: 1\n")
+    parser = build_parser()
+    args = parser.parse_args(["-np", "2", "--config-file", str(cfg), "echo"])
+    with pytest.raises(ValueError, match="unknown config file key"):
+        config_parser.apply_config_file(args, parser)
+
+
+def test_check_build_output():
+    out = check_build()
+    assert "TPU/XLA" in out and "[X]" in out
+
+
+def test_runtime_env():
+    info = hosts.RankInfo(rank=1, size=2, local_rank=1, local_size=2,
+                          cross_rank=0, cross_size=1, hostname="localhost")
+    env = config_parser.runtime_env(info, "127.0.0.1", 1234, {"FOO": "bar"})
+    assert env["HOROVOD_RANK"] == "1"
+    assert env["HOROVOD_SIZE"] == "2"
+    assert env["HOROVOD_RENDEZVOUS_PORT"] == "1234"
+    assert env["FOO"] == "bar"
+    assert os.environ.get("PATH", "") == env.get("PATH", "")
