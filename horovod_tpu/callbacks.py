@@ -1,0 +1,186 @@
+"""Training-loop callbacks and schedules — Keras-callback parity for JAX.
+
+Reference equivalents: ``horovod/_keras/callbacks.py`` (shared by
+``horovod.keras`` and ``horovod.tensorflow.keras``):
+* ``BroadcastGlobalVariablesCallback`` (:20-43) — rank-0 state broadcast at
+  training start (the checkpoint-restore consistency pattern, SURVEY §5.4).
+* ``MetricAverageCallback`` (:46-72) — average epoch metrics over ranks.
+* ``LearningRateScheduleCallback`` (:75-130) — multiplier schedules.
+* ``LearningRateWarmupCallback`` (:133-185) — gradual warmup to
+  ``initial_lr * hvd.size()`` with momentum correction, per the linear
+  scaling rule (Goyal et al.).
+
+In JAX the optimizer is an optax schedule, so the LR callbacks are exposed
+both as optax schedules (the idiomatic form) and as callback objects with
+``on_epoch_begin``/``on_epoch_end`` hooks for hand-rolled training loops.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+
+class Callback:
+    """Minimal callback protocol for custom training loops."""
+
+    def on_train_begin(self, state=None):
+        return state
+
+    def on_epoch_begin(self, epoch: int, state=None):
+        return state
+
+    def on_batch_begin(self, batch: int, state=None):
+        return state
+
+    def on_batch_end(self, batch: int, state=None):
+        return state
+
+    def on_epoch_end(self, epoch: int, logs: Optional[Dict] = None,
+                     state=None):
+        return state
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast rank-0 model/optimizer state to all ranks at train start
+    (reference _keras/callbacks.py:20-43: on_batch_end fires once)."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+        self.broadcast_done = False
+
+    def on_batch_end(self, batch: int, state=None):
+        if not self.broadcast_done:
+            state = hvd.broadcast_parameters(state, root_rank=self.root_rank)
+            self.broadcast_done = True
+        return state
+
+    def on_train_begin(self, state=None):
+        return self.on_batch_end(0, state)
+
+
+class MetricAverageCallback(Callback):
+    """Average metric dicts across ranks at epoch end (reference
+    _keras/callbacks.py:46-72)."""
+
+    def on_epoch_end(self, epoch: int, logs: Optional[Dict] = None,
+                     state=None):
+        if logs:
+            for key in sorted(logs):
+                value = np.asarray(logs[key], np.float64)
+                logs[key] = float(np.asarray(hvd.allreduce(
+                    value, op=hvd.Average,
+                    name=f"metric.{key}.{epoch}")))
+        return state
+
+
+class LearningRateScheduleCallback(Callback):
+    """Multiply the base LR by ``multiplier(epoch)`` within
+    [start_epoch, end_epoch) (reference _keras/callbacks.py:75-130).
+
+    ``set_lr`` is how the schedule reaches the optimizer: a callable
+    receiving the new LR (for optax inject_hyperparams, mutate
+    ``opt_state.hyperparams['learning_rate']``).
+    """
+
+    def __init__(self, initial_lr: float, multiplier, start_epoch: int = 0,
+                 end_epoch: Optional[int] = None, staircase: bool = True,
+                 momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None,
+                 set_lr: Optional[Callable[[float], None]] = None):
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        self.set_lr = set_lr
+        self.current_lr = initial_lr
+        if isinstance(multiplier, (int, float)):
+            self.multiplier = lambda epoch: multiplier
+        else:
+            self.multiplier = multiplier
+
+    def _in_range(self, epoch: float) -> bool:
+        return (epoch >= self.start_epoch and
+                (self.end_epoch is None or epoch < self.end_epoch))
+
+    def _adjust(self, epoch: float):
+        if not self._in_range(epoch):
+            return
+        self.current_lr = self.initial_lr * self.multiplier(epoch)
+        if self.set_lr is not None:
+            self.set_lr(self.current_lr)
+
+    def on_epoch_begin(self, epoch: int, state=None):
+        if self.staircase:
+            self._adjust(epoch)
+        return state
+
+    def on_batch_begin(self, batch: int, state=None, epoch: int = 0):
+        if not self.staircase and self.steps_per_epoch:
+            self._adjust(epoch + batch / self.steps_per_epoch)
+        return state
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Warm up from ``initial_lr`` to ``initial_lr * hvd.size()`` over
+    ``warmup_epochs`` (reference _keras/callbacks.py:133-185)."""
+
+    def __init__(self, initial_lr: float, warmup_epochs: int = 5,
+                 momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None,
+                 set_lr: Optional[Callable[[float], None]] = None,
+                 verbose: bool = False):
+        self.warmup_epochs = warmup_epochs
+        self.verbose = verbose
+        size = hvd.size() if hvd.is_initialized() else 1
+
+        def multiplier(epoch):
+            if warmup_epochs <= 0:
+                return size
+            progress = min(epoch / warmup_epochs, 1.0)
+            return 1.0 + progress * (size - 1.0)
+
+        super().__init__(initial_lr, multiplier, start_epoch=0,
+                         end_epoch=warmup_epochs + 1, staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch, set_lr=set_lr)
+
+    def on_epoch_begin(self, epoch: int, state=None):
+        self._adjust(epoch)
+        return state
+
+    def on_epoch_end(self, epoch: int, logs=None, state=None):
+        if self.verbose and epoch == self.warmup_epochs and hvd.rank() == 0:
+            print(f"Epoch {epoch}: finished gradual learning rate warmup to "
+                  f"{self.current_lr}.")
+        return state
+
+
+# ---------------------------------------------------------------------------
+# optax-native forms (the idiomatic JAX spelling of the same callbacks)
+# ---------------------------------------------------------------------------
+
+def warmup_schedule(base_lr: float, warmup_epochs: int,
+                    steps_per_epoch: int, size: Optional[int] = None):
+    """optax schedule: linear warmup from base_lr to base_lr*size, then
+    flat — compose with optax.join_schedules for decay phases."""
+    import optax
+    size = size if size is not None else (
+        hvd.size() if hvd.is_initialized() else 1)
+    return optax.linear_schedule(
+        init_value=base_lr, end_value=base_lr * size,
+        transition_steps=max(warmup_epochs * steps_per_epoch, 1))
+
+
+def scaled_lr(base_lr: float, size: Optional[int] = None) -> float:
+    """The linear scaling rule: lr * world size (reference examples scale
+    lr by hvd.size(), e.g. examples/keras_imagenet_resnet50.py)."""
+    size = size if size is not None else (
+        hvd.size() if hvd.is_initialized() else 1)
+    return base_lr * size

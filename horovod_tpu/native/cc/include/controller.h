@@ -18,6 +18,7 @@
 
 #include "data_plane.h"
 #include "message.h"
+#include "response_cache.h"
 #include "socket.h"
 #include "stall_inspector.h"
 
@@ -28,9 +29,12 @@ class Controller {
   // Rendezvous + topology exchange.  Rank 0 listens on master_addr:port;
   // workers connect, announce their data-plane endpoint, and receive the
   // full peer table (reference gloo rendezvous, gloo_context.cc:56-157).
+  // `cache` (may be null) lets the coordinator expand bit-announced cached
+  // tensors back into requests.
   Status Init(int rank, int size, const std::string& master_addr,
               int master_port, const std::string& my_data_host,
-              int my_data_port, std::vector<PeerAddr>* peers_out);
+              int my_data_port, const ResponseCache* cache,
+              std::vector<PeerAddr>* peers_out);
 
   // One lock-step negotiation cycle (reference RunLoopOnce ->
   // ComputeResponseList).  `mine` is consumed; `out` receives the verdict
@@ -38,6 +42,11 @@ class Controller {
   Status Cycle(RequestList& mine, ResponseList* out);
 
   void Shutdown();
+
+  // Batch consecutive fusible responses (public: every rank fuses the
+  // received UNFUSED verdict list locally with this same deterministic
+  // walk, so per-name responses stay visible for cache updates).
+  void Fuse(std::vector<Response>* responses);
 
   int64_t fusion_threshold() const { return fusion_threshold_; }
   StallInspector& stall_inspector() { return stall_; }
@@ -56,7 +65,6 @@ class Controller {
   // order (identical on all ranks because only the master defines it).
   void Ingest(const RequestList& list, int from_rank);
   Response ConstructResponse(const std::string& name);
-  void Fuse(std::vector<Response>* responses);
 
   int rank_ = 0;
   int size_ = 1;
@@ -64,6 +72,7 @@ class Controller {
   std::vector<TcpSocket> workers_;  // master: control conns, index = rank
   TcpSocket master_;                // worker: conn to rank 0
 
+  const ResponseCache* cache_ = nullptr;
   std::unordered_map<std::string, PendingTensor> table_;
   std::deque<std::string> ready_;
   std::vector<bool> shutdown_ranks_;

@@ -1,0 +1,70 @@
+// Response cache: steady-state collectives skip full request serialization.
+//
+// Reference equivalent: horovod/common/response_cache.{h,cc} — an LRU of
+// Responses keyed by tensor name+params whose hit bits are synchronized
+// across ranks with bitvector allreduces so the steady state pays no
+// negotiation (response_cache.h:99-162; capacity default 1024,
+// global_state.h:88).
+//
+// TCP-controller adaptation: the lock-step protocol already exchanges one
+// frame per cycle, so what the cache eliminates here is the per-tensor
+// request payload (name + shape + params) — a worker announces a cached
+// tensor as ONE BIT.  The coordinator expands bits back into synthetic
+// requests from its identical cache and runs the normal
+// validation/response pipeline, so correctness (shape-agreement checks,
+// allgather dim exchange, error coordination) is byte-for-byte the same as
+// the uncached path.
+//
+// Determinism invariant: cache content is mutated only while processing the
+// (identical) response stream, in response order — so every rank's
+// name->slot assignment is identical without any extra synchronization.
+// This replaces the reference's 2-bitvector AND/OR sync rounds
+// (CacheCoordinator::sync).
+#ifndef HVD_RESPONSE_CACHE_H
+#define HVD_RESPONSE_CACHE_H
+
+#include <deque>
+#include <unordered_map>
+#include <vector>
+
+#include "message.h"
+
+namespace hvd {
+
+class ResponseCache {
+ public:
+  // capacity 0 disables the cache (HOROVOD_CACHE_CAPACITY).
+  void Initialize(int64_t capacity);
+  bool enabled() const { return capacity_ > 0; }
+
+  // Slot of a cached entry exactly matching this request's params, or -1.
+  int64_t Lookup(const Request& r) const;
+
+  // Rebuild synthetic requests (attributed to `rank`) from a hit bitvector.
+  std::vector<Request> Expand(const std::vector<uint64_t>& bits,
+                              int rank) const;
+
+  // Record params after a response executed for this tensor; replaces an
+  // existing same-name entry in place, else takes a free/evicted slot
+  // (FIFO eviction — deterministic across ranks).
+  void Put(const Request& params);
+
+  static void SetBit(std::vector<uint64_t>* bits, int64_t slot);
+
+  size_t size() const { return by_name_.size(); }
+
+ private:
+  struct Slot {
+    Request params;
+    bool used = false;
+  };
+
+  int64_t capacity_ = 0;
+  std::vector<Slot> slots_;
+  std::deque<int64_t> fifo_;              // insertion order for eviction
+  std::unordered_map<std::string, int64_t> by_name_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_RESPONSE_CACHE_H

@@ -26,9 +26,11 @@ int64_t NumElements(const std::vector<int64_t>& shape) {
 
 Status Controller::Init(int rank, int size, const std::string& master_addr,
                         int master_port, const std::string& my_data_host,
-                        int my_data_port, std::vector<PeerAddr>* peers_out) {
+                        int my_data_port, const ResponseCache* cache,
+                        std::vector<PeerAddr>* peers_out) {
   rank_ = rank;
   size_ = size;
+  cache_ = cache;
   fusion_threshold_ =
       EnvInt("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024);
   shutdown_ranks_.assign(size, false);
@@ -156,10 +158,10 @@ Status Controller::MasterCycle(const RequestList& mine, ResponseList* out) {
                   [](bool b) { return b; }))
     out->shutdown = true;
 
-  Fuse(&out->responses);
-
-  // Broadcast verdicts (reference SendFinalTensors / 2x MPI_Bcast,
-  // mpi_controller.cc:152-161).
+  // Broadcast verdicts UNFUSED (reference SendFinalTensors / 2x MPI_Bcast,
+  // mpi_controller.cc:152-161); every rank — this one included — fuses the
+  // list locally with the same deterministic walk after updating its
+  // response cache from the per-name entries.
   if (size_ > 1) {
     std::string payload = out->Serialize();
     for (int r = 1; r < size_; ++r) {
@@ -172,7 +174,14 @@ Status Controller::MasterCycle(const RequestList& mine, ResponseList* out) {
 
 void Controller::Ingest(const RequestList& list, int from_rank) {
   if (list.shutdown) shutdown_ranks_[from_rank] = true;
-  for (const auto& req : list.requests) {
+  std::vector<Request> expanded;
+  if (cache_ != nullptr && !list.cache_hits.empty())
+    // Bit-announced tensors: reconstruct full requests from the cache so
+    // the normal validation/readiness pipeline sees them.
+    expanded = cache_->Expand(list.cache_hits, from_rank);
+  for (const std::vector<Request>* reqs :
+       {&list.requests, const_cast<const std::vector<Request>*>(&expanded)})
+   for (const auto& req : *reqs) {
     auto& p = table_[req.name];
     if (p.submitted.empty()) {
       p.submitted.assign(size_, false);

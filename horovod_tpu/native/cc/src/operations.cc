@@ -17,6 +17,7 @@
 #include "controller.h"
 #include "data_plane.h"
 #include "hvd_common.h"
+#include "response_cache.h"
 #include "tensor_queue.h"
 #include "timeline.h"
 
@@ -49,6 +50,7 @@ struct GlobalState {
   Controller controller;
   DataPlane data_plane;
   Timeline timeline;
+  ResponseCache cache;
   std::vector<char> fusion_buffer;
   double cycle_time_ms = 1.0;
 
@@ -84,6 +86,25 @@ void ExecuteResponse(const Response& resp) {
     Status st = Status::Precondition(resp.error_message);
     for (auto& e : entries) g->queue.Complete(e, st);
     return;
+  }
+
+  // Refresh the response cache from this rank's own entry params — every
+  // rank sees the same response stream in the same order, which keeps
+  // name->slot assignment identical everywhere (see response_cache.h).
+  // Allgather is excluded: its dim-0 differs per rank, so the coordinator
+  // could not faithfully expand another rank's bit from its own params.
+  if (resp.op_type != OpType::kBarrier && resp.op_type != OpType::kJoin &&
+      resp.op_type != OpType::kAllgather) {
+    for (auto& e : entries) {
+      Request params;
+      params.rank = g->rank;
+      params.op_type = e->op_type;
+      params.dtype = e->dtype;
+      params.arg = e->arg;
+      params.name = e->name;
+      params.shape = e->shape;
+      g->cache.Put(params);
+    }
   }
 
   auto complete_all = [&](const Status& st) {
@@ -224,13 +245,15 @@ void ExecuteResponse(const Response& resp) {
 
 void BackgroundThread() {
   // Bootstrap: data-plane listener, controller rendezvous, full mesh.
+  // Capacity default mirrors the reference (global_state.h:88); 0 disables.
+  g->cache.Initialize(EnvInt("HOROVOD_CACHE_CAPACITY", 1024));
   Status s = g->data_plane.Listen("");
   if (s.ok()) {
     std::vector<PeerAddr> peers;
     std::string host = EnvStr("HOROVOD_HOSTNAME", "127.0.0.1");
     s = g->controller.Init(g->rank, g->size, g->rendezvous_addr,
                            g->rendezvous_port, host, g->data_plane.port(),
-                           &peers);
+                           &g->cache, &peers);
     if (s.ok() && g->size > 1)
       s = g->data_plane.Connect(g->rank, g->size, peers);
   }
@@ -256,9 +279,17 @@ void BackgroundThread() {
     g->timeline.MarkCycleStart();
 
     RequestList mine;
-    mine.requests = g->queue.PopAnnouncements(g->rank);
-    for (const auto& r : mine.requests)
+    for (auto& r : g->queue.PopAnnouncements(g->rank)) {
       g->timeline.NegotiateStart(r.name, r.op_type);
+      // Steady state: a tensor whose params match the cache travels as one
+      // bit instead of a serialized request (reference cached fast path,
+      // controller.cc:165-179).
+      int64_t slot = g->cache.Lookup(r);
+      if (slot >= 0 && r.op_type != OpType::kAllgather)
+        ResponseCache::SetBit(&mine.cache_hits, slot);
+      else
+        mine.requests.push_back(std::move(r));
+    }
     mine.shutdown = g->shutting_down.load();
 
     ResponseList responses;
@@ -269,6 +300,9 @@ void BackgroundThread() {
       g->queue.FailAll(Status::Aborted(s.reason));
       break;
     }
+    // The verdict list arrives unfused (per-name) so ExecuteResponse can
+    // refresh the cache; fuse locally with the master's own walk.
+    g->controller.Fuse(&responses.responses);
     for (const auto& resp : responses.responses) ExecuteResponse(resp);
     shutdown_seen = responses.shutdown;
 

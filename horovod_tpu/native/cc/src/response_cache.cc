@@ -1,0 +1,82 @@
+#include "response_cache.h"
+
+#include <algorithm>
+
+namespace hvd {
+
+void ResponseCache::Initialize(int64_t capacity) {
+  capacity_ = capacity;
+  slots_.assign(static_cast<size_t>(std::max<int64_t>(capacity, 0)), Slot{});
+  fifo_.clear();
+  by_name_.clear();
+}
+
+static bool SameParams(const Request& a, const Request& b) {
+  return a.op_type == b.op_type && a.dtype == b.dtype && a.arg == b.arg &&
+         a.shape == b.shape;
+}
+
+int64_t ResponseCache::Lookup(const Request& r) const {
+  if (!enabled()) return -1;
+  auto it = by_name_.find(r.name);
+  if (it == by_name_.end()) return -1;
+  const Slot& s = slots_[static_cast<size_t>(it->second)];
+  return SameParams(s.params, r) ? it->second : -1;
+}
+
+std::vector<Request> ResponseCache::Expand(const std::vector<uint64_t>& bits,
+                                           int rank) const {
+  std::vector<Request> out;
+  for (size_t w = 0; w < bits.size(); ++w) {
+    uint64_t word = bits[w];
+    while (word) {
+      int b = __builtin_ctzll(word);
+      word &= word - 1;
+      size_t slot = w * 64 + static_cast<size_t>(b);
+      if (slot < slots_.size() && slots_[slot].used) {
+        Request r = slots_[slot].params;
+        r.rank = rank;
+        out.push_back(std::move(r));
+      }
+    }
+  }
+  return out;
+}
+
+void ResponseCache::Put(const Request& params) {
+  if (!enabled()) return;
+  auto it = by_name_.find(params.name);
+  if (it != by_name_.end()) {
+    // Same tensor, possibly new params (e.g. changed batch dim): refresh in
+    // place, keeping the slot stable.
+    slots_[static_cast<size_t>(it->second)].params = params;
+    return;
+  }
+  int64_t slot;
+  if (static_cast<int64_t>(by_name_.size()) < capacity_) {
+    // First free slot; linear scan is fine at these capacities.
+    slot = -1;
+    for (size_t i = 0; i < slots_.size(); ++i)
+      if (!slots_[i].used) {
+        slot = static_cast<int64_t>(i);
+        break;
+      }
+  } else {
+    slot = fifo_.front();   // evict oldest (deterministic everywhere)
+    fifo_.pop_front();
+    by_name_.erase(slots_[static_cast<size_t>(slot)].params.name);
+  }
+  Slot& s = slots_[static_cast<size_t>(slot)];
+  s.params = params;
+  s.used = true;
+  by_name_[params.name] = slot;
+  fifo_.push_back(slot);
+}
+
+void ResponseCache::SetBit(std::vector<uint64_t>* bits, int64_t slot) {
+  size_t word = static_cast<size_t>(slot) / 64;
+  if (bits->size() <= word) bits->resize(word + 1, 0);
+  (*bits)[word] |= (1ull << (slot % 64));
+}
+
+}  // namespace hvd

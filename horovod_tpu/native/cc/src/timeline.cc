@@ -4,6 +4,35 @@
 
 namespace hvd {
 
+namespace {
+
+// Tensor names are user-controlled; escape them or one quote corrupts the
+// whole trace.
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      case '\r': out += "\\r"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+}  // namespace
+
 void Timeline::Initialize(const std::string& filename, int rank) {
   if (filename.empty() || rank != 0 || initialized_.load()) return;
   file_ = std::fopen(filename.c_str(), "w");
@@ -48,7 +77,7 @@ int64_t Timeline::TidFor(const std::string& tensor) {
   std::fprintf(file_,
                "{\"name\": \"thread_name\", \"ph\": \"M\", \"pid\": 0, "
                "\"tid\": %lld, \"args\": {\"name\": \"%s\"}},\n",
-               static_cast<long long>(tid), tensor.c_str());
+               static_cast<long long>(tid), JsonEscape(tensor).c_str());
   return tid;
 }
 
@@ -73,17 +102,18 @@ void Timeline::WriterLoop() {
       queue_.pop_front();
       lk.unlock();
       int64_t tid = e.tensor.empty() ? 0 : TidFor(e.tensor);
+      std::string ename = JsonEscape(e.name);
       if (e.phase == 'i') {
         std::fprintf(file_,
                      "{\"name\": \"%s\", \"ph\": \"i\", \"pid\": 0, "
                      "\"tid\": %lld, \"ts\": %lld, \"s\": \"g\"},\n",
-                     e.name.c_str(), static_cast<long long>(tid),
+                     ename.c_str(), static_cast<long long>(tid),
                      static_cast<long long>(e.ts_us));
       } else {
         std::fprintf(file_,
                      "{\"name\": \"%s\", \"ph\": \"%c\", \"pid\": 0, "
                      "\"tid\": %lld, \"ts\": %lld},\n",
-                     e.name.c_str(), e.phase, static_cast<long long>(tid),
+                     ename.c_str(), e.phase, static_cast<long long>(tid),
                      static_cast<long long>(e.ts_us));
       }
       lk.lock();

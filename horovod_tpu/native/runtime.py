@@ -164,7 +164,9 @@ class Runtime:
         rc = self._lib.hvd_read_output(
             h, out.ctypes.data_as(ctypes.c_void_p), n)
         if rc != 0:
-            raise RuntimeError(self._lib.hvd_last_error().decode())
+            err = self._lib.hvd_last_error().decode()
+            self._lib.hvd_release(h)
+            raise RuntimeError(err)
         if trailing_shape:
             inner = int(np.prod(trailing_shape)) or 1
             out = out.reshape((int(n) // inner,) + tuple(trailing_shape))

@@ -163,11 +163,14 @@ def launch_job(rank_infos: List[RankInfo], command: List[str],
                     p.kill()
                 break
             time.sleep(0.05)
+        interrupted = stop.is_set() and exit_code == 0
         for p in procs:
             p.proc.wait()
             rc = p.proc.returncode
-            if rc not in (0, None) and exit_code == 0 and not stop.is_set():
+            if rc not in (0, None) and exit_code == 0:
                 exit_code = rc
+        if interrupted and exit_code == 0:
+            exit_code = 130   # job was signalled; never report success
         return exit_code
     finally:
         signal.signal(signal.SIGINT, old_int)

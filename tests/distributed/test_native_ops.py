@@ -179,6 +179,28 @@ def test_broadcast_parameters(hvd, rank, size):
     np.testing.assert_allclose(np.asarray(out["b"]), 0.0)
 
 
+def test_response_cache_steady_state(hvd, rank, size):
+    """Training-loop pattern: the same tensor names every step ride the
+    response cache (bit announcements) after step 1; values must stay
+    exact, including after a shape change that forces re-negotiation
+    (reference response_cache.{h,cc} semantics)."""
+    for step in range(6):
+        for i in range(4):
+            out = np.asarray(hvd.allreduce(
+                np.full((8,), float(step + i + rank), np.float32),
+                op=hvd.Sum, name=f"t.cache.{i}"))
+            base = size * (step + i) + sum(range(size))
+            np.testing.assert_allclose(out, np.full((8,), base))
+    # Shape change on all ranks: cache entry must refresh, not corrupt.
+    out = np.asarray(hvd.allreduce(np.ones((3, 3), np.float32),
+                                   op=hvd.Sum, name="t.cache.0"))
+    np.testing.assert_allclose(out, np.full((3, 3), float(size)))
+    # And back to the cached shape.
+    out = np.asarray(hvd.allreduce(np.ones((8,), np.float32),
+                                   op=hvd.Sum, name="t.cache.0"))
+    np.testing.assert_allclose(out, np.full((8,), float(size)))
+
+
 def test_barrier_and_join(hvd, rank, size):
     """Native barrier + join (join returns the last-arriving rank)."""
     rt = __import__("horovod_tpu.basics", fromlist=["runtime"]).runtime()

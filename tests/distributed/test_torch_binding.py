@@ -1,0 +1,131 @@
+"""PyTorch binding tests (reference test/test_torch.py), rank-aware —
+run standalone (size 1) or under ``hvdrun -np N``."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture(scope="session")
+def thvd(hvd):
+    import horovod_tpu.torch as thvd
+    return thvd
+
+
+def test_torch_allreduce(thvd, rank, size):
+    x = torch.ones(4, 3) * (rank + 1)
+    out = thvd.allreduce(x, op=thvd.Sum, name="tt.sum")
+    assert torch.allclose(out, torch.full((4, 3),
+                                          float(sum(range(1, size + 1)))))
+    out = thvd.allreduce(x, name="tt.avg")
+    assert torch.allclose(out, torch.full((4, 3), (size + 1) / 2))
+
+
+def test_torch_allreduce_inplace(thvd, rank, size):
+    x = torch.ones(5) * (rank + 1)
+    thvd.allreduce_(x, op=thvd.Sum, name="tt.inplace")
+    assert torch.allclose(x, torch.full((5,), float(sum(range(1, size + 1)))))
+
+
+def test_torch_allreduce_fp16_compression(thvd, rank, size):
+    x = torch.ones(8) * (rank + 1)
+    out = thvd.allreduce(x, op=thvd.Sum, name="tt.fp16",
+                         compression=thvd.Compression.fp16)
+    assert out.dtype == torch.float32
+    assert torch.allclose(out, torch.full((8,),
+                                          float(sum(range(1, size + 1)))))
+
+
+def test_torch_allgather(thvd, rank, size):
+    x = torch.ones(rank + 1, 2) * rank
+    out = thvd.allgather(x, name="tt.ag")
+    assert out.shape == (size * (size + 1) // 2, 2)
+
+
+def test_torch_broadcast(thvd, rank, size):
+    x = torch.arange(6, dtype=torch.float32) * (rank + 1)
+    out = thvd.broadcast(x, 0, name="tt.bc")
+    assert torch.allclose(out, torch.arange(6, dtype=torch.float32))
+    thvd.broadcast_(x, 0, name="tt.bc_")
+    assert torch.allclose(x, torch.arange(6, dtype=torch.float32))
+
+
+def test_distributed_optimizer_sgd(thvd, rank, size):
+    """Gradients are averaged across ranks; parameters stay identical
+    (reference test_torch.py optimizer tests)."""
+    torch.manual_seed(0)
+    model = torch.nn.Linear(4, 2)
+    # Same initial weights everywhere (seed), rank-dependent data.
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    opt = thvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters())
+
+    x = torch.ones(3, 4) * (rank + 1)
+    y = model(x).sum()
+    y.backward()
+    opt.step()
+
+    gathered = thvd.allgather(
+        torch.cat([p.data.reshape(1, -1) for p in model.parameters()], 1),
+        name="tt.opt.params")
+    for r in range(size):
+        assert torch.allclose(gathered[0], gathered[r], atol=1e-6), \
+            f"rank {r} diverged"
+    opt.zero_grad()
+
+
+def test_distributed_optimizer_validation(thvd, rank, size):
+    model = torch.nn.Linear(2, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    with pytest.raises(ValueError, match="unique"):
+        thvd.DistributedOptimizer(
+            opt, named_parameters=[("w", model.weight), ("w", model.bias)])
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    with pytest.raises(ValueError, match="tuples"):
+        thvd.DistributedOptimizer(opt, named_parameters=[model.weight])
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    with pytest.raises(ValueError, match="does not cover all"):
+        thvd.DistributedOptimizer(
+            opt, named_parameters=[("w", model.weight)])
+
+
+def test_zero_grad_race_guard(thvd, rank, size):
+    """zero_grad between backward and step is prohibited (reference
+    torch/__init__.py:197-202)."""
+    if size < 2:
+        pytest.skip("hooks only active multi-process")
+    model = torch.nn.Linear(2, 1)
+    opt = thvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters())
+    model(torch.ones(1, 2)).sum().backward()
+    with pytest.raises(AssertionError, match="zero_grad"):
+        opt.zero_grad()
+    opt.step()   # drain handles so the session stays healthy
+
+
+def test_broadcast_parameters_state_dict(thvd, rank, size):
+    model = torch.nn.Linear(3, 3)
+    with torch.no_grad():
+        for p in model.parameters():
+            p.fill_(float(rank))
+    thvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    for p in model.parameters():
+        assert torch.allclose(p.data, torch.zeros_like(p))
+
+
+def test_broadcast_optimizer_state(thvd, rank, size):
+    torch.manual_seed(rank)  # deliberately diverged
+    model = torch.nn.Linear(3, 1)
+    opt = torch.optim.SGD(model.parameters(), lr=0.05, momentum=0.9)
+    if rank == 0:
+        model(torch.ones(2, 3)).sum().backward()
+        opt.step()
+        opt.zero_grad()
+    thvd.broadcast_optimizer_state(opt, root_rank=0)
+    state = opt.state_dict()
+    gathered = thvd.allgather_object(
+        {k: v for k, v in state["param_groups"][0].items()
+         if k != "params"})
+    assert all(g == gathered[0] for g in gathered)

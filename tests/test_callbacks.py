@@ -1,0 +1,67 @@
+"""Callback / schedule tests (reference test/test_keras.py callback
+coverage + _keras/callbacks.py semantics)."""
+
+import numpy as np
+import pytest
+
+
+def test_broadcast_global_variables_once(hvd):
+    from horovod_tpu.callbacks import BroadcastGlobalVariablesCallback
+
+    cb = BroadcastGlobalVariablesCallback(root_rank=0)
+    state = {"w": np.ones(3, np.float32)}
+    out = cb.on_train_begin(state)
+    assert cb.broadcast_done
+    np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
+    # second call is a no-op passthrough
+    out2 = cb.on_batch_end(1, out)
+    assert out2 is out
+
+
+def test_metric_average(hvd):
+    from horovod_tpu.callbacks import MetricAverageCallback
+
+    cb = MetricAverageCallback()
+    logs = {"loss": 2.0, "acc": 0.5}
+    cb.on_epoch_end(0, logs)
+    assert logs["loss"] == pytest.approx(2.0)   # size-1 average
+    assert isinstance(logs["loss"], float)
+
+
+def test_lr_schedule_callback(hvd):
+    from horovod_tpu.callbacks import LearningRateScheduleCallback
+
+    seen = []
+    cb = LearningRateScheduleCallback(
+        initial_lr=0.1, multiplier=lambda e: 0.5 ** e,
+        start_epoch=1, end_epoch=4, set_lr=seen.append)
+    cb.on_epoch_begin(0)
+    assert seen == []                       # before start_epoch
+    cb.on_epoch_begin(1)
+    assert seen[-1] == pytest.approx(0.05)
+    cb.on_epoch_begin(3)
+    assert seen[-1] == pytest.approx(0.1 * 0.5 ** 3)
+    cb.on_epoch_begin(5)
+    assert len(seen) == 2                   # past end_epoch
+
+
+def test_warmup_callback(hvd):
+    from horovod_tpu.callbacks import LearningRateWarmupCallback
+
+    seen = []
+    cb = LearningRateWarmupCallback(initial_lr=0.1, warmup_epochs=5,
+                                    set_lr=seen.append)
+    cb.on_epoch_begin(0)
+    assert seen[-1] == pytest.approx(0.1)   # size 1: multiplier == 1
+    cb.on_epoch_begin(5)
+    assert seen[-1] == pytest.approx(0.1)
+
+
+def test_warmup_schedule_optax(hvd):
+    from horovod_tpu.callbacks import warmup_schedule, scaled_lr
+
+    sched = warmup_schedule(0.1, warmup_epochs=2, steps_per_epoch=10, size=8)
+    assert float(sched(0)) == pytest.approx(0.1)
+    assert float(sched(20)) == pytest.approx(0.8)
+    assert float(sched(100)) == pytest.approx(0.8)
+    assert scaled_lr(0.1, size=4) == pytest.approx(0.4)
