@@ -1,0 +1,219 @@
+"""Decoder-only Transformer LM with composable data / tensor / sequence
+parallelism — the long-context flagship.
+
+No reference equivalent (Horovod ships no models; SURVEY §2.5/§5.7 shows no
+TP/SP anywhere) — this model exists to exercise the framework's mesh axes
+the way its CNN benchmark exercises DP.  Written functionally (explicit
+param pytree, manual-SPMD forward) so it drops straight into ``shard_map``:
+
+* data axis   — batch sharded, gradients averaged (fused pmean)
+* model axis  — Megatron-style TP: qkv/up-proj column-parallel, out/down
+  row-parallel, boundaries via :mod:`horovod_tpu.parallel.tensor`
+* seq axis    — ring attention over contiguous sequence chunks
+  (:mod:`horovod_tpu.parallel.sequence`)
+
+bf16 matmuls / fp32 params+softmax, MXU-friendly dims.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.parallel import sequence as seq_mod
+from horovod_tpu.parallel import tensor as tp
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 2048
+    max_seq: int = 2048
+    dtype: object = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_params(rng, cfg: TransformerConfig):
+    """GLOBAL-shape parameters; shard with :func:`param_specs` +
+    ``jax.device_put`` before use."""
+    keys = jax.random.split(rng, 2 + cfg.n_layers)
+    d, f, v = cfg.d_model, cfg.d_ff, cfg.vocab_size
+
+    def dense(key, shape, scale=None):
+        scale = scale if scale is not None else (shape[0] ** -0.5)
+        return (jax.random.normal(key, shape, jnp.float32) * scale)
+
+    layers = []
+    for i in range(cfg.n_layers):
+        k = jax.random.split(keys[2 + i], 6)
+        layers.append({
+            "ln1_scale": jnp.ones((d,), jnp.float32),
+            "ln2_scale": jnp.ones((d,), jnp.float32),
+            "wq": dense(k[0], (d, d)),
+            "wk": dense(k[1], (d, d)),
+            "wv": dense(k[2], (d, d)),
+            "wo": dense(k[3], (d, d)),
+            "w1": dense(k[4], (d, f)),
+            "w2": dense(k[5], (f, d)),
+        })
+    return {
+        "embed": dense(keys[0], (v, d), scale=0.02),
+        "pos": dense(keys[1], (cfg.max_seq, d), scale=0.02),
+        "ln_f_scale": jnp.ones((d,), jnp.float32),
+        "layers": layers,
+    }
+
+
+def param_specs(cfg: TransformerConfig, model_axis: Optional[str]):
+    """PartitionSpec tree matching :func:`init_params` output: Megatron TP
+    sharding over ``model_axis`` (column-parallel outputs, row-parallel
+    inputs), everything else replicated."""
+    m = model_axis
+    col = P(None, m)     # split output dim
+    row = P(m, None)     # split input dim
+    layer = {
+        "ln1_scale": P(), "ln2_scale": P(),
+        "wq": col, "wk": col, "wv": col, "wo": row,
+        "w1": col, "w2": row,
+    }
+    return {
+        "embed": P(),
+        "pos": P(),
+        "ln_f_scale": P(),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }
+
+
+def _rmsnorm(x, scale):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
+
+
+def forward(params, tokens, cfg: TransformerConfig,
+            model_axis: Optional[str] = None,
+            seq_axis: Optional[str] = None,
+            attention: str = "ring"):
+    """tokens: [B, T_local] int32 -> logits [B, T_local, vocab] fp32.
+
+    Inside shard_map, weight leaves arrive as LOCAL shards (per
+    :func:`param_specs`); outside (single device) they are global and the
+    axis args must be None.
+    """
+    dt = cfg.dtype
+    t_local = tokens.shape[1]
+    pos_offset = (lax.axis_index(seq_axis) * t_local) if seq_axis else 0
+    x = (params["embed"][tokens] +
+         lax.dynamic_slice_in_dim(params["pos"], pos_offset, t_local,
+                                  axis=0)[None]).astype(dt)
+
+    for layer in params["layers"]:
+        # --- attention block ---
+        h = _rmsnorm(x, layer["ln1_scale"])
+        hi = tp.region_input(h, model_axis) if model_axis else h
+        q = hi @ layer["wq"].astype(dt)
+        k = hi @ layer["wk"].astype(dt)
+        v = hi @ layer["wv"].astype(dt)
+        b, t, dh = q.shape
+        hd = cfg.head_dim
+        q, k, v = (z.reshape(b, t, dh // hd, hd) for z in (q, k, v))
+        if seq_axis is not None:
+            if attention == "ring":
+                o = seq_mod.ring_attention(q, k, v, seq_axis, causal=True)
+            else:
+                o = seq_mod.ulysses_attention(q, k, v, seq_axis, causal=True)
+        else:
+            o = seq_mod.local_attention(q, k, v, causal=True)
+        o = o.reshape(b, t, dh) @ layer["wo"].astype(dt)
+        if model_axis:
+            o = lax.psum(o, model_axis)
+        x = x + o
+        # --- mlp block ---
+        h = _rmsnorm(x, layer["ln2_scale"])
+        hi = tp.region_input(h, model_axis) if model_axis else h
+        u = jax.nn.gelu(hi @ layer["w1"].astype(dt))
+        dn = u @ layer["w2"].astype(dt)
+        if model_axis:
+            dn = lax.psum(dn, model_axis)
+        x = x + dn
+
+    x = _rmsnorm(x, params["ln_f_scale"])
+    return (x @ params["embed"].T.astype(dt)).astype(jnp.float32)
+
+
+def loss_fn(params, tokens, labels, cfg: TransformerConfig,
+            model_axis=None, seq_axis=None, attention="ring"):
+    """Mean next-token cross-entropy over the LOCAL shard (callers pmean
+    over data/seq axes)."""
+    logits = forward(params, tokens, cfg, model_axis, seq_axis, attention)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def make_train_step(cfg: TransformerConfig, optimizer, mesh,
+                    data_axis: str = "data",
+                    model_axis: Optional[str] = None,
+                    seq_axis: Optional[str] = None,
+                    attention: str = "ring",
+                    donate: bool = True):
+    """Jitted SPMD training step over dp x tp x sp.
+
+    Returns ``step(params, opt_state, tokens, labels) ->
+    (params, opt_state, loss)`` plus the param spec tree (for placing
+    params with ``jax.device_put``).
+    """
+    from horovod_tpu.ops.fusion import fused_pytree_mean
+
+    specs = param_specs(cfg, model_axis)
+    grad_axes = tuple(a for a in (data_axis, seq_axis) if a)
+
+    def _step(params, opt_state, tokens, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, tokens, labels, cfg, model_axis, seq_axis, attention)
+        # DP gradient averaging (fused psum) over data (+seq) axes; TP/f-op
+        # already settled the model axis.
+        grads = fused_pytree_mean(grads, grad_axes)
+        updates, new_opt = optimizer.update(grads, opt_state, params)
+        new_params = jax.tree_util.tree_map(lambda p, u: p + u, params,
+                                            updates)
+        return new_params, new_opt, lax.pmean(loss, grad_axes)
+
+    # opt_state leaves mirror param shapes; map shape -> spec (identical
+    # shapes always carry identical specs in this scheme).
+    shape_to_spec = {}
+    jax.tree_util.tree_map(
+        lambda p, s: shape_to_spec.setdefault(tuple(p.shape), s),
+        init_abstract(cfg), specs)
+
+    def opt_spec_of(leaf):
+        return shape_to_spec.get(tuple(leaf.shape), P())
+
+    opt_state_shapes = jax.eval_shape(optimizer.init, init_abstract(cfg))
+    opt_specs = jax.tree_util.tree_map(opt_spec_of, opt_state_shapes)
+
+    data_spec = P(data_axis, seq_axis) if seq_axis else P(data_axis)
+    step = jax.shard_map(
+        _step, mesh=mesh,
+        in_specs=(specs, opt_specs, data_spec, data_spec),
+        out_specs=(specs, opt_specs, P()),
+        check_vma=True)
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ()), specs, \
+        opt_specs
+
+
+def init_abstract(cfg: TransformerConfig):
+    """ShapeDtypeStructs of the params (for spec derivation without
+    materializing weights)."""
+    return jax.eval_shape(lambda: init_params(jax.random.PRNGKey(0), cfg))

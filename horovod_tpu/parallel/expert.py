@@ -1,0 +1,87 @@
+"""Expert parallelism: a Mixture-of-Experts layer over an 'expert' mesh axis.
+
+Not in the reference — the v0.18 reference does not even have an alltoall
+collective (SURVEY §2.5: ``message.h:47-49``).  TPU-native design: experts
+shard over the expert axis (one or more per chip), tokens route to their
+expert via ``lax.all_to_all`` over ICI, compute locally, and return the
+same way — the standard Switch-Transformer dispatch expressed in pure SPMD.
+
+Static shapes throughout (XLA requirement): routing uses fixed expert
+capacity with drop-on-overflow, the standard TPU MoE trick.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def top1_routing(logits, capacity: int):
+    """Switch-style top-1 routing with fixed capacity.
+
+    logits: [T, E] router scores for T local tokens.
+    Returns (dispatch [T, E, C] one-hot, combine [T, E, C] weights).
+    Tokens beyond an expert's capacity are dropped (contribute zero).
+    """
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)                   # [T]
+    gate = jnp.take_along_axis(probs, expert_idx[:, None],
+                               axis=-1)[:, 0]                 # [T]
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)   # [T, E]
+    # Position of each token within its expert's queue.
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1             # [T, E]
+    in_cap = (pos >= 0) & (pos < capacity)
+    dispatch = (jax.nn.one_hot(jnp.clip(pos, 0, capacity - 1), capacity) *
+                in_cap[..., None]).astype(jnp.float32)        # [T, E, C]
+    combine = dispatch * gate[:, None, None]
+    return dispatch, combine
+
+
+def moe_layer(x, router_w, expert_fn: Callable, expert_params,
+              axis_name: str = "expert", capacity_factor: float = 1.25):
+    """Apply a distributed MoE layer inside shard_map.
+
+    x: [T_local, D] local tokens; router_w: [D, E_total];
+    expert_params: this chip's expert parameters (leading dim =
+    experts-per-chip, here fixed to 1 for clarity);
+    expert_fn(params, tokens[C, D]) -> [C, D].
+
+    Total experts = axis size.  Returns [T_local, D].
+    """
+    size = lax.axis_size(axis_name)
+    t, d = x.shape
+    e = size
+    capacity = max(int(capacity_factor * t / e), 1)
+
+    logits = x @ router_w                                     # [T, E]
+    dispatch, combine = top1_routing(logits, capacity)
+
+    # Gather this shard's tokens per expert: [E, C, D].
+    buffers = jnp.einsum("td,tec->ecd", x, dispatch)
+    # all_to_all: dim 0 (experts) scatters so each chip receives ITS
+    # expert's buffer from every shard: [E_src=size, C, D] after exchange.
+    received = lax.all_to_all(buffers, axis_name, split_axis=0,
+                              concat_axis=0, tiled=True)
+    # Run the local expert over all received tokens.
+    flat = received.reshape(size * capacity, d)
+    out = expert_fn(expert_params, flat).reshape(size, capacity, d)
+    # Return results to their source shards.
+    returned = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                              tiled=True)                     # [E, C, D]
+    # Un-dispatch: weight by gate and scatter back to token positions.
+    return jnp.einsum("ecd,tec->td", returned, combine)
+
+
+def load_balancing_loss(logits, axis_name: str = "expert"):
+    """Switch-Transformer auxiliary loss: mean fraction routed per expert
+    times mean router prob per expert, scaled by E (encourages balance)."""
+    probs = jax.nn.softmax(logits, axis=-1)
+    e = probs.shape[-1]
+    hard = jax.nn.one_hot(jnp.argmax(probs, -1), e)
+    frac = lax.pmean(hard.mean(0), axis_name)
+    prob = lax.pmean(probs.mean(0), axis_name)
+    return e * jnp.sum(frac * prob)

@@ -1,0 +1,82 @@
+"""Pipeline parallelism: GPipe-style microbatching over a 'pipe' mesh axis.
+
+Not in the reference (SURVEY §2.5: Horovod has no PP).  TPU-native design:
+stage parameters are STACKED on a leading axis sharded over the pipe axis
+(device p holds stage p's slice), microbatch activations flow stage-to-stage
+with ``lax.ppermute`` over ICI, and the schedule is one ``lax.scan`` over
+M + P - 1 ticks.  Because the whole schedule is a differentiable JAX
+program, ``jax.grad`` through it yields the reverse (backward) pipeline
+automatically — no hand-written 1F1B bookkeeping.
+
+Layout inside shard_map:
+* ``stage_params``: pytree whose leaves have leading dim = stages/axis_size
+  (usually 1) — this device's stages.
+* ``microbatches``: [M, mb, ...] — every device receives the SAME
+  microbatch array (replicated over the pipe axis); stage 0 is the one that
+  feeds it in.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
+                   axis_name: str = "pipe"):
+    """Run ``stage_fn(params_slice, x) -> y`` as a pipeline over
+    ``axis_name``.
+
+    stage_fn must map activations of shape [mb, ...] to the SAME shape
+    (uniform stages — e.g. a group of transformer blocks).
+
+    Returns [M, mb, ...]: the last stage's outputs for every microbatch
+    (valid on every device — results are broadcast from the last stage).
+    """
+    size = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+    ticks = m + size - 1
+
+    right_perm = [(i, (i + 1) % size) for i in range(size)]
+
+    def tick(carry, t):
+        incoming, outputs = carry
+        # Stage 0 injects microbatch t (zeros once the supply runs out);
+        # other stages consume what arrived from the left.
+        feed = lax.dynamic_index_in_dim(
+            microbatches, jnp.minimum(t, m - 1), axis=0, keepdims=False)
+        x = jnp.where(idx == 0, feed, incoming)
+        y = stage_fn(stage_params, x)
+        # Valid only when the wavefront has reached this stage: stage s
+        # works on microbatch t - s for s <= t < s + m.
+        mb_idx = t - idx
+        valid = (mb_idx >= 0) & (mb_idx < m)
+        # Last stage records its result.
+        outputs = lax.cond(
+            valid & (idx == size - 1),
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, y, jnp.maximum(mb_idx, 0), axis=0),
+            lambda o: o,
+            outputs)
+        incoming = lax.ppermute(y, axis_name, right_perm)
+        return (incoming, outputs), None
+
+    init = (jnp.zeros(mb_shape, microbatches.dtype),
+            jnp.zeros((m,) + mb_shape, microbatches.dtype))
+    (_, outputs), _ = lax.scan(tick, init, jnp.arange(ticks))
+    # Broadcast final outputs from the last stage to every pipe rank so
+    # downstream (loss) code is uniform SPMD.
+    masked = jnp.where(idx == size - 1, outputs, jnp.zeros_like(outputs))
+    return lax.psum(masked, axis_name)
+
+
+def stack_stage_params(per_stage_params):
+    """Stack a list of per-stage pytrees into leading-dim-stacked leaves
+    (shard this output over the pipe axis with PartitionSpec('pipe', ...))."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params)

@@ -1,0 +1,150 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has NO sequence machinery (SURVEY §5.7: Horovod predates it;
+its closest primitive is dim-0 allgather).  A TPU-native framework makes
+long-context training first-class: the sequence axis is a mesh axis, K/V
+blocks ride ICI with ``lax.ppermute`` (ring attention, Liu et al. 2023) or
+heads/sequence are exchanged with ``lax.all_to_all`` (DeepSpeed-Ulysses,
+Jacobs et al. 2023).
+
+Both run inside ``shard_map`` with tensors laid out ``[batch, seq_local,
+heads, head_dim]``; sequence shards are contiguous chunks in rank order
+(shard i owns global positions [i*T, (i+1)*T)).
+
+Ring attention overlaps compute with the ICI transfer of the next K/V
+block and keeps memory at O(seq_local^2-per-block) via online (flash-style)
+softmax accumulation, so sequence length scales linearly with the number
+of chips.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_attention(q, k, v, m, l, o, *, q_offset, k_offset, causal, scale):
+    """One q-block x k-block update of the online-softmax state.
+
+    q: [B, Tq, H, D]; k, v: [B, Tk, H, D]
+    m, l: [B, H, Tq] running max / denominator; o: [B, Tq, H, D] running
+    numerator.  Returns updated (m, l, o).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # [B, H, Tq, Tk]
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        qpos = q_offset + jnp.arange(tq)[:, None]
+        kpos = k_offset + jnp.arange(tk)[None, :]
+        s = jnp.where(qpos >= kpos, s, -jnp.inf)
+    m_blk = jnp.max(s, axis=-1)                       # [B, H, Tq]
+    m_new = jnp.maximum(m, m_blk)
+    # Guard fully-masked rows: exp(-inf - -inf) -> nan without the select.
+    safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(s - safe_m[..., None])                # [B, H, Tq, Tk]
+    p = jnp.where(jnp.isneginf(s), 0.0, p)
+    corr = jnp.exp(jnp.where(jnp.isneginf(m), m_new, m) - safe_m)
+    corr = jnp.where(jnp.isneginf(m), 0.0, corr)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    o_new = (o * corr.transpose(0, 2, 1)[..., None] +
+             jnp.einsum("bhqk,bkhd->bqhd", p, v))
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
+                   scale: Optional[float] = None):
+    """Exact attention over a sequence sharded across ``axis_name``.
+
+    q/k/v: [B, T_local, H, D] (this shard's chunk).  K/V blocks rotate
+    around the ring via ``ppermute`` while each device accumulates its
+    queries' online softmax; after axis_size steps every query has seen
+    every key.  Returns [B, T_local, H, D].
+    """
+    size = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    scale = (d ** -0.5) if scale is None else scale
+
+    m = jnp.full((b, h, t), -jnp.inf, q.dtype)
+    l = jnp.zeros((b, h, t), q.dtype)
+    o = jnp.zeros_like(q)
+    # The carry becomes device-varying on the first step; mark the initial
+    # zeros accordingly so scan's vma typing is stable (no-op for values
+    # already varying, e.g. zeros_like of a varying input).
+    def _varying(x):
+        try:
+            return lax.pcast(x, axis_name, to="varying")
+        except ValueError:
+            return x
+
+    m, l, o = _varying(m), _varying(l), _varying(o)
+    q_offset = idx * t
+
+    def step(carry, s):
+        m, l, o, k_blk, v_blk = carry
+        # Block s arrived from rank (idx - s) mod size.
+        k_offset = ((idx - s) % size) * t
+        m, l, o = _block_attention(q, k_blk, v_blk, m, l, o,
+                                   q_offset=q_offset, k_offset=k_offset,
+                                   causal=causal, scale=scale)
+        # Rotate K/V to the right neighbor (ICI ring).
+        perm = [(i, (i + 1) % size) for i in range(size)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (m, l, o, k_blk, v_blk), None
+
+    (m, l, o, _, _), _ = lax.scan(step, (m, l, o, k, v), jnp.arange(size))
+    denom = jnp.where(l == 0.0, 1.0, l).transpose(0, 2, 1)[..., None]
+    return o / denom
+
+
+def ulysses_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
+                      scale: Optional[float] = None):
+    """DeepSpeed-Ulysses: all-to-all from sequence-sharded to head-sharded,
+    full local attention, all-to-all back.  Heads must divide axis size.
+
+    q/k/v: [B, T_local, H, D] -> returns [B, T_local, H, D].
+    """
+    size = lax.axis_size(axis_name)
+    b, t, h, d = q.shape
+    if h % size != 0:
+        raise ValueError(f"heads ({h}) must be divisible by axis size "
+                         f"({size}) for Ulysses attention")
+
+    def scatter_heads(x):
+        # [B, T_local, H, D] -> [B, T_global, H_local, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def gather_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    scale_ = (d ** -0.5) if scale is None else scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", qg, kg) * scale_
+    if causal:
+        tg = qg.shape[1]
+        mask = jnp.tril(jnp.ones((tg, tg), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, vg)
+    return gather_heads(out)
+
+
+def local_attention(q, k, v, causal: bool = True,
+                    scale: Optional[float] = None):
+    """Plain single-device attention (the no-SP reference path; also the
+    numerical oracle the SP tests compare against)."""
+    d = q.shape[-1]
+    scale = (d ** -0.5) if scale is None else scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)

@@ -1,0 +1,67 @@
+"""Tensor (model) parallelism helpers for manual-SPMD (shard_map) code.
+
+Not in the reference (SURVEY §2.5: Horovod is pure DP); provided because a
+TPU framework's mesh makes TP nearly free: weights shard over a 'model'
+axis, matmuls stay local, and one ``psum`` per parallel region rides ICI.
+
+The two Megatron-style boundary operators map onto JAX's varying-manual-axes
+(vma) calculus, which shard_map tracks when ``check_vma=True`` (the
+default everywhere in this framework):
+* "f" (identity forward, ``psum`` backward, on activations entering a TP
+  region): JAX inserts this automatically — an invariant activation hitting
+  a shard-varying weight is promoted varying, and the TRANSPOSE of that
+  promotion is exactly the psum that merges branch gradients once.
+  :func:`region_input` therefore only documents the boundary; adding an
+  explicit backward psum would double-count (empirically: size x inflated
+  dLoss/dx).
+* "g" (sum forward, identity backward, on row-parallel outputs):
+  ``lax.psum`` itself, whose vma-aware transpose is the identity.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def region_input(x, axis_name: str):
+    """Marks the activation boundary of a tensor-parallel region.
+
+    A no-op: under vma-tracked shard_map the invariant->varying promotion
+    transpose performs Megatron's "f" backward all-reduce automatically.
+    Kept as an explicit call site so TP regions are visible in model code
+    (and as the hook where a check_vma=False fallback would psum).
+    """
+    del axis_name
+    return x
+
+
+def column_parallel(x, w_local, axis_name: str, bias_local=None):
+    """Column-parallel matmul: weights split on the OUTPUT dim; result
+    stays sharded (no communication forward).  Wrap the input with the
+    region boundary so the backward reduces once."""
+    y = region_input(x, axis_name) @ w_local
+    if bias_local is not None:
+        y = y + bias_local
+    return y
+
+
+def row_parallel(x_local, w_local, axis_name: str, bias=None):
+    """Row-parallel matmul: weights split on the INPUT dim; partial results
+    are summed across shards (``psum`` forward, identity backward)."""
+    y = lax.psum(x_local @ w_local, axis_name)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def shard_dim(shape, axis_size: int, dim: int):
+    """Local shape for a weight sharded on ``dim`` over ``axis_size``."""
+    if shape[dim] % axis_size != 0:
+        raise ValueError(
+            f"dim {dim} of {shape} not divisible by model-parallel size "
+            f"{axis_size}")
+    out = list(shape)
+    out[dim] //= axis_size
+    return tuple(out)

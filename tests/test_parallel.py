@@ -1,0 +1,371 @@
+"""Correctness tests for the parallelism modules (8-device CPU mesh).
+
+Every SP/TP/PP/EP implementation is checked against a single-device
+numerical oracle — the strongest form of correctness test these admit.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _mesh(hvd, axes, shape):
+    from horovod_tpu.topology import build_mesh
+    return build_mesh(axes=axes, shape=shape)
+
+
+# ---------------------------------------------------------------------------
+# Sequence parallelism
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_local(hvd, causal):
+    from horovod_tpu.parallel.sequence import local_attention, ring_attention
+
+    mesh = _mesh(hvd, ("seq",), (8,))
+    b, t, h, d = 2, 32, 4, 16
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+               for _ in range(3))
+
+    oracle = local_attention(q, k, v, causal=causal)
+
+    ring = jax.jit(jax.shard_map(
+        functools.partial(ring_attention, axis_name="seq", causal=causal),
+        mesh=mesh, in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+        out_specs=P(None, "seq")))
+    out = ring(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(oracle),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_attention_matches_local(hvd):
+    from horovod_tpu.parallel.sequence import (local_attention,
+                                               ulysses_attention)
+
+    mesh = _mesh(hvd, ("seq",), (8,))
+    b, t, h, d = 2, 32, 8, 16
+    rng = np.random.default_rng(1)
+    q, k, v = (jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+               for _ in range(3))
+    oracle = local_attention(q, k, v, causal=True)
+    uly = jax.jit(jax.shard_map(
+        functools.partial(ulysses_attention, axis_name="seq", causal=True),
+        mesh=mesh, in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+        out_specs=P(None, "seq")))
+    out = uly(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(oracle),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_gradients(hvd):
+    """d(sum(attn))/dq must match the oracle's — exercises ppermute
+    transpose and the online-softmax backward."""
+    from horovod_tpu.parallel.sequence import local_attention, ring_attention
+
+    b, t, h, d = 1, 16, 2, 8
+    rng = np.random.default_rng(2)
+    q, k, v = (jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+               for _ in range(3))
+
+    g_oracle = jax.grad(lambda q: local_attention(q, k, v).sum())(q)
+
+    devs = jax.devices()[:4]
+    mesh4 = Mesh(np.array(devs), ("seq",))
+    ring_loss = jax.shard_map(
+        lambda q, k, v: lax.psum(
+            ring_attention(q, k, v, "seq").sum(), "seq"),
+        mesh=mesh4, in_specs=(P(None, "seq"),) * 3, out_specs=P(),
+        check_vma=True)
+    g_ring = jax.jit(jax.grad(lambda q: ring_loss(q, k, v)))(q)
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_oracle),
+                               rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Tensor parallelism
+# ---------------------------------------------------------------------------
+
+def test_tp_mlp_matches_dense(hvd):
+    """Column->row parallel MLP == dense MLP, values AND gradients."""
+    from horovod_tpu.parallel.tensor import (column_parallel, region_input,
+                                             row_parallel)
+
+    mesh = _mesh(hvd, ("model",), (8,))
+    d, f, n = 16, 64, 4
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+    w1 = jnp.asarray(rng.standard_normal((d, f)) * 0.1, jnp.float32)
+    w2 = jnp.asarray(rng.standard_normal((f, d)) * 0.1, jnp.float32)
+
+    def dense(x, w1, w2):
+        return jax.nn.gelu(x @ w1) @ w2
+
+    def tp_fwd(x, w1_l, w2_l):
+        u = jax.nn.gelu(column_parallel(x, w1_l, "model"))
+        return row_parallel(u, w2_l, "model")
+
+    tp_fn = jax.jit(jax.shard_map(
+        tp_fwd, mesh=mesh,
+        in_specs=(P(), P(None, "model"), P("model", None)),
+        out_specs=P()))
+    np.testing.assert_allclose(np.asarray(tp_fn(x, w1, w2)),
+                               np.asarray(dense(x, w1, w2)),
+                               rtol=2e-5, atol=2e-5)
+
+    # Gradients, computed INSIDE shard_map (the manual-SPMD pattern the
+    # boundary operators are designed for: each device differentiates its
+    # local program; region_input's backward psum merges branch gradients
+    # exactly once).
+    g_dense = jax.grad(lambda x, w1, w2: dense(x, w1, w2).sum(),
+                       argnums=(0, 1, 2))(x, w1, w2)
+
+    def local_grads(x, a, b):
+        return jax.grad(lambda *args: tp_fwd(*args).sum(),
+                        argnums=(0, 1, 2))(x, a, b)
+
+    g_tp = jax.jit(jax.shard_map(
+        local_grads, mesh=mesh,
+        in_specs=(P(), P(None, "model"), P("model", None)),
+        out_specs=(P(), P(None, "model"), P("model", None)),
+        check_vma=True))(x, w1, w2)
+    for got, want in zip(g_tp, g_dense):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical collectives
+# ---------------------------------------------------------------------------
+
+def test_hierarchical_allreduce_matches_flat_psum(hvd):
+    from horovod_tpu.parallel.hierarchical import hierarchical_allreduce
+
+    mesh = _mesh(hvd, ("dcn", "ici"), (2, 4))
+    x = jnp.arange(2 * 4 * 5, dtype=jnp.float32).reshape(8, 5)
+
+    def flat(x):
+        return lax.psum(x, ("dcn", "ici"))
+
+    def hier(x):
+        return hierarchical_allreduce(x, ici_axis="ici", dcn_axis="dcn")
+
+    args = dict(mesh=mesh, in_specs=P(("dcn", "ici")),
+                out_specs=P(("dcn", "ici")), check_vma=True)
+    a = jax.jit(jax.shard_map(flat, **args))(x)
+    b = jax.jit(jax.shard_map(hier, **args))(x)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_hierarchical_allreduce_uneven_payload(hvd):
+    """Payload not divisible by the ICI size exercises the pad path."""
+    from horovod_tpu.parallel.hierarchical import hierarchical_allreduce
+
+    mesh = _mesh(hvd, ("dcn", "ici"), (2, 4))
+    x = jnp.arange(7, dtype=jnp.float32)   # 7 % 4 != 0
+
+    out = jax.jit(jax.shard_map(
+        lambda x: hierarchical_allreduce(x, "ici", "dcn", average=True),
+        mesh=mesh, in_specs=P(), out_specs=P()))(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Pipeline parallelism
+# ---------------------------------------------------------------------------
+
+def test_pipeline_matches_sequential(hvd):
+    from horovod_tpu.parallel.pipeline import (pipeline_apply,
+                                               stack_stage_params)
+
+    mesh = _mesh(hvd, ("pipe",), (4,))
+    d, mb, m = 8, 2, 6
+    rng = np.random.default_rng(4)
+    stage_ws = [jnp.asarray(rng.standard_normal((d, d)) * 0.3, jnp.float32)
+                for _ in range(4)]
+    stacked = stack_stage_params([{"w": w} for w in stage_ws])
+    xs = jnp.asarray(rng.standard_normal((m, mb, d)), jnp.float32)
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"][0])
+
+    # Oracle: apply the 4 stages sequentially to each microbatch.
+    want = xs
+    for w in stage_ws:
+        want = jnp.tanh(want @ w)
+
+    run = jax.jit(jax.shard_map(
+        functools.partial(pipeline_apply, stage_fn, axis_name="pipe"),
+        mesh=mesh, in_specs=({"w": P("pipe", None, None)}, P()),
+        out_specs=P()))
+    got = run(stacked, xs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_gradients_flow(hvd):
+    from horovod_tpu.parallel.pipeline import (pipeline_apply,
+                                               stack_stage_params)
+
+    mesh = _mesh(hvd, ("pipe",), (2,))
+    d, mb, m = 4, 2, 3
+    rng = np.random.default_rng(5)
+    stage_ws = [jnp.asarray(rng.standard_normal((d, d)) * 0.3, jnp.float32)
+                for _ in range(2)]
+    stacked = stack_stage_params([{"w": w} for w in stage_ws])
+    xs = jnp.asarray(rng.standard_normal((m, mb, d)), jnp.float32)
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"][0])
+
+    def oracle_loss(ws, xs):
+        y = xs
+        for i in range(2):
+            y = jnp.tanh(y @ ws["w"][i])
+        return jnp.sum(y ** 2)
+
+    def pipe_loss(ws, xs):
+        y = pipeline_apply(stage_fn, ws, xs, axis_name="pipe")
+        return jnp.sum(y ** 2)
+
+    g_oracle = jax.grad(oracle_loss)(stacked, xs)
+    pipe = jax.shard_map(
+        pipe_loss, mesh=mesh,
+        in_specs=({"w": P("pipe", None, None)}, P()), out_specs=P(),
+        check_vma=True)
+    g_pipe = jax.jit(jax.grad(lambda ws: pipe(ws, xs)))(stacked)
+    np.testing.assert_allclose(np.asarray(g_pipe["w"]),
+                               np.asarray(g_oracle["w"]),
+                               rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Expert parallelism (MoE)
+# ---------------------------------------------------------------------------
+
+def test_top1_routing(hvd):
+    """Deterministic routing unit test: forced assignments, capacity
+    accounting, overflow drops."""
+    from horovod_tpu.parallel.expert import top1_routing
+
+    t, e = 32, 4
+    router_assign = np.arange(t) % e
+    logits = jax.nn.one_hot(jnp.asarray(router_assign), e) * 50.0
+    dispatch, combine = top1_routing(logits, capacity=t)
+    assert dispatch.shape == (t, e, t)
+    # every token dispatched exactly once; gate ~1.0 at this margin
+    np.testing.assert_allclose(np.asarray(dispatch.sum(axis=(1, 2))), 1.0)
+    np.testing.assert_allclose(np.asarray(combine.sum(axis=(1, 2))), 1.0,
+                               rtol=1e-5)
+    # capacity 1: only the first token per expert survives
+    dispatch, _ = top1_routing(logits, capacity=1)
+    kept = np.asarray(dispatch.sum(axis=(1, 2)))
+    assert kept.sum() == e
+    np.testing.assert_allclose(kept[:e], 1.0)
+    np.testing.assert_allclose(kept[e:], 0.0)
+
+
+def test_moe_layer_end_to_end(hvd):
+    """Full distributed MoE: zero router => every token to expert 0; with
+    identity experts output == input * gate (gate = 1/E uniform)."""
+    from horovod_tpu.parallel.expert import moe_layer
+
+    mesh = _mesh(hvd, ("expert",), (4,))
+    t, d = 8, 6
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.standard_normal((4 * t, d)), jnp.float32)
+
+    def expert_fn(params, tokens):
+        del params
+        return tokens
+
+    run = jax.jit(jax.shard_map(
+        lambda x: moe_layer(x, jnp.zeros((d, 4)), expert_fn, {},
+                            axis_name="expert", capacity_factor=4.0),
+        mesh=mesh, in_specs=P("expert"), out_specs=P("expert"),
+        check_vma=True))
+    out = run(x)
+    # uniform router: gate = 1/4 for the argmax expert, identity expert
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x) * 0.25,
+                               rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Transformer LM end-to-end
+# ---------------------------------------------------------------------------
+
+def _tiny_cfg():
+    from horovod_tpu.models.transformer import TransformerConfig
+    return TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                             n_layers=2, d_ff=64, max_seq=64,
+                             dtype=jnp.float32)
+
+
+def test_transformer_tp_sp_matches_single_device(hvd):
+    """forward() under model x seq sharding == single-device forward —
+    the composition test for TP boundaries + ring attention."""
+    import functools as ft
+
+    from horovod_tpu.models import transformer as tfm
+
+    cfg = _tiny_cfg()
+    mesh = _mesh(hvd, ("model", "seq"), (2, 4))
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(8).integers(0, cfg.vocab_size, (2, 32)),
+        jnp.int32)
+
+    oracle = tfm.forward(params, tokens, cfg)
+
+    specs = tfm.param_specs(cfg, "model")
+    fwd = jax.jit(jax.shard_map(
+        ft.partial(tfm.forward, cfg=cfg, model_axis="model",
+                   seq_axis="seq"),
+        mesh=mesh, in_specs=(specs, P(None, "seq")),
+        out_specs=P(None, "seq")))
+    out = fwd(params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(oracle),
+                               rtol=5e-4, atol=5e-4)
+
+
+def test_transformer_train_step_dp_tp_sp(hvd):
+    """Full 3-axis training step (2 data x 2 model x 2 seq): runs, loss
+    finite and decreasing."""
+    import optax
+
+    from horovod_tpu.models import transformer as tfm
+
+    cfg = _tiny_cfg()
+    mesh = _mesh(hvd, ("data", "model", "seq"), (2, 2, 2))
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    opt = optax.sgd(0.1)
+    opt_state = opt.init(params)
+
+    step, specs, opt_specs = tfm.make_train_step(
+        cfg, opt, mesh, data_axis="data", model_axis="model",
+        seq_axis="seq")
+
+    rng = np.random.default_rng(9)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 32)), jnp.int32)
+    labels = jnp.asarray(np.roll(np.asarray(tokens), -1, axis=1), jnp.int32)
+
+    params = jax.device_put(params, jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs))
+    opt_state = jax.device_put(opt_state, jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), opt_specs,
+        is_leaf=lambda x: isinstance(x, P)))
+    data_sharding = NamedSharding(mesh, P("data", "seq"))
+    tokens = jax.device_put(tokens, data_sharding)
+    labels = jax.device_put(labels, data_sharding)
+
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = step(params, opt_state, tokens, labels)
+        losses.append(float(np.asarray(loss)))
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
