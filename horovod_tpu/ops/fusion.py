@@ -35,25 +35,39 @@ def fusion_threshold_bytes() -> int:
     return int(v) if v else DEFAULT_FUSION_THRESHOLD
 
 
+def _vma_key(leaf):
+    """Sorted tuple of mesh axes the (traced) leaf varies over.
+
+    Fusion buckets must be vma-homogeneous: concatenating a TP-sharded
+    gradient (varying over 'model') with a replicated one would pvary the
+    whole bucket and the replicated leaf could no longer be returned
+    through a P() out_spec."""
+    try:
+        return tuple(sorted(jax.typeof(leaf).vma))
+    except AttributeError:
+        return ()
+
+
 def _bucket_leaves(leaves, threshold: int):
-    """Group leaf indices into buckets: same dtype, cumulative nbytes under
-    threshold (mirrors the dtype-homogeneous fusion walk with look-ahead in
-    ``controller.cc:551-672``; we sort by dtype instead of looking ahead)."""
-    order = sorted(range(len(leaves)),
-                   key=lambda i: (str(leaves[i].dtype), i))
+    """Group leaf indices into buckets: same dtype + same vma, cumulative
+    nbytes under threshold (mirrors the dtype-homogeneous fusion walk with
+    look-ahead in ``controller.cc:551-672``; we sort by (dtype, vma)
+    instead of looking ahead)."""
+    keys = [(str(leaves[i].dtype), _vma_key(leaves[i]))
+            for i in range(len(leaves))]
+    order = sorted(range(len(leaves)), key=lambda i: (keys[i], i))
     buckets: List[List[int]] = []
     cur: List[int] = []
     cur_bytes = 0
-    cur_dtype = None
+    cur_key = None
     for i in order:
         leaf = leaves[i]
         nbytes = int(np.prod(leaf.shape)) * leaf.dtype.itemsize
-        if cur and (leaf.dtype != cur_dtype or
-                    cur_bytes + nbytes > threshold):
+        if cur and (keys[i] != cur_key or cur_bytes + nbytes > threshold):
             buckets.append(cur)
             cur, cur_bytes = [], 0
         cur.append(i)
-        cur_dtype = leaf.dtype
+        cur_key = keys[i]
         cur_bytes += nbytes
     if cur:
         buckets.append(cur)

@@ -41,8 +41,19 @@ def hierarchical_allreduce(x, ici_axis: str, dcn_axis: str,
     shard = lax.psum_scatter(flat, ici_axis, scatter_dimension=0, tiled=True)
     # Cross-slice allreduce on the small shard (rides DCN).
     shard = lax.psum(shard, dcn_axis)
-    # Intra-slice allgather restores the full tensor.
-    full = lax.all_gather(shard, ici_axis, axis=0, tiled=True)
+    # Intra-slice gather restores the full tensor.  Expressed as a masked
+    # psum rather than lax.all_gather: the result is bitwise-replicated
+    # over the ICI axis, and psum is the only collective whose output JAX's
+    # vma inference marks *unvarying* — an all_gather output would be
+    # "possibly varying over {ici}" and could not be returned through a
+    # replicated out_spec (P()).  Cost note: if XLA does not fold the
+    # one-hot into a gather, a ring lowering moves ~2(n-1)/n of the full
+    # payload on ICI vs (n-1)/n for all_gather — an ICI-only overhead; the
+    # DCN leg (the scarce link this decomposition optimizes) still carries
+    # exactly 1/ici of the bytes.
+    idx = lax.axis_index(ici_axis)
+    buf = jnp.zeros((ici,) + shard.shape, shard.dtype).at[idx].set(shard)
+    full = lax.psum(buf, ici_axis).reshape(-1)
     if pad:
         full = full[:n]
     out = full.reshape(x.shape)

@@ -56,18 +56,40 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
         # works on microbatch t - s for s <= t < s + m.
         mb_idx = t - idx
         valid = (mb_idx >= 0) & (mb_idx < m)
-        # Last stage records its result.
-        outputs = lax.cond(
-            valid & (idx == size - 1),
-            lambda o: lax.dynamic_update_index_in_dim(
-                o, y, jnp.maximum(mb_idx, 0), axis=0),
-            lambda o: o,
-            outputs)
+        # Last stage records its result.  A select, not lax.cond: the
+        # updated array varies over the pipe axis (y depends on axis_index)
+        # while the untouched one may not, and cond requires both branches
+        # to have identical vma types — jnp.where unifies them.
+        updated = lax.dynamic_update_index_in_dim(
+            outputs, y, jnp.maximum(mb_idx, 0), axis=0)
+        outputs = jnp.where(valid & (idx == size - 1), updated, outputs)
         incoming = lax.ppermute(y, axis_name, right_perm)
         return (incoming, outputs), None
 
-    init = (jnp.zeros(mb_shape, microbatches.dtype),
-            jnp.zeros((m,) + mb_shape, microbatches.dtype))
+    # Carry is varying over the pipe axis from tick 1 on — and over every
+    # axis the inputs vary over (e.g. 'data' when composed with DP).  Pin
+    # the union at init so the scan carry type is stable across iterations.
+    def _vma(v):
+        try:
+            return set(jax.typeof(v).vma)
+        except AttributeError:
+            return set()
+
+    target = {axis_name} | _vma(microbatches)
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        target |= _vma(leaf)
+
+    def _pin(v):
+        missing = tuple(sorted(target - _vma(v)))
+        if not missing:
+            return v
+        try:
+            return lax.pcast(v, missing, to="varying")
+        except ValueError:  # no surrounding mesh context
+            return v
+
+    init = (_pin(jnp.zeros(mb_shape, microbatches.dtype)),
+            _pin(jnp.zeros((m,) + mb_shape, microbatches.dtype)))
     (_, outputs), _ = lax.scan(tick, init, jnp.arange(ticks))
     # Broadcast final outputs from the last stage to every pipe rank so
     # downstream (loss) code is uniform SPMD.

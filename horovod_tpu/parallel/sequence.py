@@ -71,16 +71,29 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
     m = jnp.full((b, h, t), -jnp.inf, q.dtype)
     l = jnp.zeros((b, h, t), q.dtype)
     o = jnp.zeros_like(q)
-    # The carry becomes device-varying on the first step; mark the initial
-    # zeros accordingly so scan's vma typing is stable (no-op for values
-    # already varying, e.g. zeros_like of a varying input).
-    def _varying(x):
+
+    # The scan carry's vma type must be stable: after one step the online
+    # state varies over EVERY axis q/k/v vary over (e.g. 'model' too when
+    # composed with tensor parallelism), not just the ring axis.  Pcast the
+    # initial zeros up to the union of the inputs' vma sets.
+    def _vma(x):
         try:
-            return lax.pcast(x, axis_name, to="varying")
-        except ValueError:
+            return set(jax.typeof(x).vma)
+        except AttributeError:  # outside shard_map / old tracer
+            return set()
+
+    target = _vma(q) | _vma(k) | _vma(v) | {axis_name}
+
+    def _match_vma(x):
+        missing = tuple(sorted(target - _vma(x)))
+        if not missing:
+            return x
+        try:
+            return lax.pcast(x, missing, to="varying")
+        except ValueError:  # no surrounding mesh context (vma untracked)
             return x
 
-    m, l, o = _varying(m), _varying(l), _varying(o)
+    m, l, o = _match_vma(m), _match_vma(l), _match_vma(o)
     q_offset = idx * t
 
     def step(carry, s):

@@ -38,7 +38,21 @@ def build_mesh(axes: Sequence[str] = ("data",),
         if len(axes) != 1:
             raise ValueError(f"shape required for multi-axis mesh {axes}")
         shape = (n,)
-    if int(np.prod(shape)) != n:
+    want = int(np.prod(shape))
+    if want < n:
+        # Underfilled meshes take a device prefix — the launcher's rank
+        # order is contiguous, so a prefix is the natural sub-communicator
+        # (mirrors the reference's rank-subset init, ``basics.py:29-61``).
+        # Warn loudly: an accidental undersized shape would silently
+        # exclude devices from gradient averaging.
+        import warnings
+        warnings.warn(
+            f"build_mesh: shape {shape} covers {want} of {n} available "
+            f"devices; using the first {want} (rank-order prefix)",
+            stacklevel=2)
+        devices = devices[:want]
+        n = want
+    if want != n:
         raise ValueError(
             f"mesh shape {shape} does not cover {n} devices")
 
