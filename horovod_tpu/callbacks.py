@@ -100,6 +100,8 @@ class LearningRateScheduleCallback(Callback):
         self.steps_per_epoch = steps_per_epoch
         self.set_lr = set_lr
         self.current_lr = initial_lr
+        self._epoch = 0   # tracked from on_epoch_begin (protocol-driven
+        # loops pass no epoch to on_batch_begin)
         if isinstance(multiplier, (int, float)):
             self.multiplier = lambda epoch: multiplier
         else:
@@ -117,13 +119,18 @@ class LearningRateScheduleCallback(Callback):
             self.set_lr(self.current_lr)
 
     def on_epoch_begin(self, epoch: int, state=None):
+        self._epoch = epoch
         if self.staircase:
             self._adjust(epoch)
         return state
 
-    def on_batch_begin(self, batch: int, state=None, epoch: int = 0):
+    def on_batch_begin(self, batch: int, state=None):
+        # Per-batch (non-staircase) schedules use the epoch recorded by
+        # on_epoch_begin — the Callback protocol passes only the batch
+        # index, so requiring an extra kwarg here would silently pin
+        # epoch=0 in any protocol-driven training loop.
         if not self.staircase and self.steps_per_epoch:
-            self._adjust(epoch + batch / self.steps_per_epoch)
+            self._adjust(self._epoch + batch / self.steps_per_epoch)
         return state
 
 
@@ -152,6 +159,7 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
                          steps_per_epoch=steps_per_epoch, set_lr=set_lr)
 
     def on_epoch_begin(self, epoch: int, state=None):
+        self._epoch = epoch
         self._adjust(epoch)
         return state
 

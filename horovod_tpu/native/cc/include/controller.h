@@ -57,7 +57,14 @@ class Controller {
     std::vector<bool> submitted;             // [size]
     std::chrono::steady_clock::time_point first_seen;
     int count = 0;
+    bool queued = false;                     // already pushed onto ready_
   };
+
+  // Reference join() contract (later-Horovod Join op): a rank that called
+  // join stops submitting but MUST keep participating (with zero payloads)
+  // in collectives still issued by active ranks.  The coordinator therefore
+  // treats joined ranks as implicit contributors when counting readiness.
+  bool IsReady(const PendingTensor& p, OpType op) const;
 
   Status MasterCycle(const RequestList& mine, ResponseList* out);
   // Record one rank's announcements (reference IncrementTensorCount,
@@ -76,6 +83,7 @@ class Controller {
   std::unordered_map<std::string, PendingTensor> table_;
   std::deque<std::string> ready_;
   std::vector<bool> shutdown_ranks_;
+  std::vector<bool> joined_;
   int64_t fusion_threshold_ = 0;
   StallInspector stall_;
 };

@@ -69,6 +69,11 @@ class TensorQueue {
   // shutdown delivers SHUT_DOWN_ERROR to all callbacks).
   void FailAll(const Status& status);
 
+  // Refuse all further Adds (checked under the queue mutex, closing the
+  // window where an enqueue races shutdown past the initialized flag and
+  // would strand its waiter after FailAll drained the table).
+  void Close();
+
   // Handle API.
   bool Poll(int64_t handle);
   // Blocks until done; returns entry (still owned by table until Release).
@@ -81,6 +86,7 @@ class TensorQueue {
  private:
   std::mutex mu_;
   std::condition_variable cv_;
+  bool closed_ = false;
   int64_t next_handle_ = 0;
   std::unordered_map<std::string, EntryPtr> by_name_;
   std::unordered_map<int64_t, EntryPtr> by_handle_;

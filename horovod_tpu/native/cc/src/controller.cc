@@ -34,26 +34,40 @@ Status Controller::Init(int rank, int size, const std::string& master_addr,
   fusion_threshold_ =
       EnvInt("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024);
   shutdown_ranks_.assign(size, false);
+  joined_.assign(size, false);
   peers_out->assign(size, PeerAddr{});
 
   if (rank == 0) {
     Status s = listener_.Listen("", master_port);
     if (!s.ok()) return s;
     workers_.resize(size);
-    (*peers_out)[0] = PeerAddr{my_data_host, my_data_port};
+    // "-" = unknown: rank 0 cannot observe its own externally reachable
+    // address; workers substitute the rendezvous address they dialed.
+    (*peers_out)[0] = PeerAddr{
+        my_data_host.empty() ? std::string("-") : my_data_host,
+        my_data_port};
     for (int n = 0; n < size - 1; ++n) {
       TcpSocket conn;
       s = listener_.Accept(&conn, 60000);
       if (!s.ok()) return s;
-      // hello frame: "rank data_port"
+      // hello frame: "rank data_port host".  The self-reported host (the
+      // worker's HOROVOD_HOSTNAME) is preferred over the observed peer
+      // address: on multi-host jobs a worker co-located with rank 0 — or
+      // one whose hostname resolves to loopback in /etc/hosts — is
+      // *observed* as 127.0.0.1, and broadcasting that in the peer table
+      // would make remote ranks dial loopback and hang.
       std::string hello;
       s = conn.RecvFrame(&hello);
       if (!s.ok()) return s;
       int r = -1, dport = 0;
-      if (std::sscanf(hello.c_str(), "%d %d", &r, &dport) != 2 || r <= 0 ||
-          r >= size || workers_[r].valid())
+      char hostbuf[256] = {0};
+      int n_parsed =
+          std::sscanf(hello.c_str(), "%d %d %255s", &r, &dport, hostbuf);
+      if (n_parsed < 2 || r <= 0 || r >= size || workers_[r].valid())
         return Status::Unknown("bad controller hello: " + hello);
-      std::string host = conn.peer_addr();
+      std::string host = (n_parsed >= 3) ? std::string(hostbuf) : "";
+      if (host == "-") host.clear();  // worker had no HOROVOD_HOSTNAME
+      if (host.empty()) host = conn.peer_addr();
       if (host.empty() || host == "0.0.0.0") host = "127.0.0.1";
       (*peers_out)[r] = PeerAddr{host, dport};
       workers_[r] = std::move(conn);
@@ -72,7 +86,8 @@ Status Controller::Init(int rank, int size, const std::string& master_addr,
   Status s = master_.Connect(master_addr, master_port);
   if (!s.ok()) return s;
   std::ostringstream hello;
-  hello << rank << " " << my_data_port;
+  hello << rank << " " << my_data_port << " "
+        << (my_data_host.empty() ? "-" : my_data_host);
   s = master_.SendFrame(hello.str());
   if (!s.ok()) return s;
   std::string table;
@@ -83,6 +98,10 @@ Status Controller::Init(int rank, int size, const std::string& master_addr,
     in >> (*peers_out)[r].host >> (*peers_out)[r].port;
     if (in.fail())
       return Status::Unknown("bad peer table from coordinator");
+    if ((*peers_out)[r].host == "-")
+      // Rank 0 didn't know its own external address; the rendezvous
+      // address this worker successfully dialed is it.
+      (*peers_out)[r].host = (r == 0) ? master_addr : "127.0.0.1";
   }
   return Status::OK();
 }
@@ -127,12 +146,25 @@ Status Controller::MasterCycle(const RequestList& mine, ResponseList* out) {
   out->shutdown = false;
 
   // Ready tensors -> validated responses, in the master-defined order.
+  // Joins are ordered LAST within the cycle: executing a join resets the
+  // joined state on every rank, so any same-cycle collective that relies
+  // on joined ranks' zero-participation must run first.
+  std::vector<Response> joins;
   while (!ready_.empty()) {
     std::string name = ready_.front();
     ready_.pop_front();
-    out->responses.push_back(ConstructResponse(name));
+    Response r = ConstructResponse(name);
     table_.erase(name);
+    if (!r.error && r.op_type == OpType::kJoin)
+      joins.push_back(std::move(r));
+    else
+      out->responses.push_back(std::move(r));
   }
+  for (auto& r : joins) out->responses.push_back(std::move(r));
+  if (!joins.empty())
+    // Join completed: reset so training can continue past the sync point
+    // (Horovod's join is used per-epoch with uneven data).
+    joined_.assign(size_, false);
 
   // Stall inspection over still-pending tensors (reference
   // CheckForStalledTensors, stall_inspector.cc:26).
@@ -172,6 +204,17 @@ Status Controller::MasterCycle(const RequestList& mine, ResponseList* out) {
   return Status::OK();
 }
 
+bool Controller::IsReady(const PendingTensor& p, OpType op) const {
+  // Join itself needs every rank to actually call join; everything else is
+  // ready once each rank has either submitted or joined (joined ranks
+  // contribute zero payloads at execution — reference Join semantics).
+  if (op == OpType::kJoin) return p.count == size_;
+  if (p.count == 0) return false;
+  for (int r = 0; r < size_; ++r)
+    if (!p.submitted[r] && !joined_[r]) return false;
+  return true;
+}
+
 void Controller::Ingest(const RequestList& list, int from_rank) {
   if (list.shutdown) shutdown_ranks_[from_rank] = true;
   std::vector<Request> expanded;
@@ -179,9 +222,14 @@ void Controller::Ingest(const RequestList& list, int from_rank) {
     // Bit-announced tensors: reconstruct full requests from the cache so
     // the normal validation/readiness pipeline sees them.
     expanded = cache_->Expand(list.cache_hits, from_rank);
+  bool join_arrived = false;
   for (const std::vector<Request>* reqs :
        {&list.requests, const_cast<const std::vector<Request>*>(&expanded)})
    for (const auto& req : *reqs) {
+    if (req.op_type == OpType::kJoin && !joined_[from_rank]) {
+      joined_[from_rank] = true;
+      join_arrived = true;
+    }
     auto& p = table_[req.name];
     if (p.submitted.empty()) {
       p.submitted.assign(size_, false);
@@ -190,7 +238,28 @@ void Controller::Ingest(const RequestList& list, int from_rank) {
     if (p.submitted[from_rank]) continue;  // duplicate guard
     p.submitted[from_rank] = true;
     p.requests.push_back(req);
-    if (++p.count == size_) ready_.push_back(req.name);
+    ++p.count;
+    if (!p.queued && IsReady(p, req.op_type)) {
+      p.queued = true;
+      ready_.push_back(req.name);
+    }
+  }
+  if (join_arrived) {
+    // A new join may complete the readiness of every tensor that was only
+    // waiting on the joined rank; sweep in first-seen order for a stable
+    // (coordinator-defined) execution order.
+    std::vector<std::pair<std::chrono::steady_clock::time_point,
+                          std::string>> newly;
+    for (auto& kv : table_) {
+      auto& p = kv.second;
+      if (!p.queued && !p.requests.empty() &&
+          IsReady(p, p.requests.front().op_type)) {
+        p.queued = true;
+        newly.emplace_back(p.first_seen, kv.first);
+      }
+    }
+    std::sort(newly.begin(), newly.end());
+    for (auto& kv : newly) ready_.push_back(kv.second);
   }
 }
 
@@ -233,12 +302,32 @@ Response Controller::ConstructResponse(const std::string& name) {
                             ".");
   }
 
+  const bool any_joined =
+      std::any_of(joined_.begin(), joined_.end(), [](bool b) { return b; });
+
   switch (first.op_type) {
-    case OpType::kAllreduce:
-      // first_dims[0] carries the tensor's element count so Fuse() can
-      // respect the byte threshold without re-consulting the table.
+    case OpType::kAllreduce: {
+      // Per-name element count (Fuse() appends — one entry per fused
+      // name) so the byte threshold is enforceable, partially-joined
+      // ranks can locate each name's offset in a fused buffer, and joined
+      // ranks can size their zero payload.
       resp.first_dims.assign(1, NumElements(first.shape));
+      ReduceOp rop = static_cast<ReduceOp>(first.arg);
+      if (any_joined && rop != ReduceOp::kSum && rop != ReduceOp::kAdasum)
+        // Zeros are the identity only for Sum.  Average is executed as
+        // Sum with the caller dividing by the FULL world size, so joined
+        // ranks' zeros would silently deflate the mean (the reference
+        // likewise rejects Average under Join); Min/Max/Prod are
+        // corrupted outright.
+        return fail("Allreduce with joined ranks supports only the Sum "
+                    "reduction (joined ranks contribute zeros; " +
+                    std::string(rop == ReduceOp::kAverage
+                                    ? "Average would divide the partial sum "
+                                      "by the full world size"
+                                    : "zeros corrupt Min/Max") +
+                    ") for tensor " + name + ".");
       [[fallthrough]];
+    }
     case OpType::kBroadcast:
     case OpType::kBarrier:
     case OpType::kJoin:
@@ -254,6 +343,13 @@ Response Controller::ConstructResponse(const std::string& name) {
         return fail("Broadcast root rank " + std::to_string(first.arg) +
                     " out of range for job size " + std::to_string(size_) +
                     " (tensor " + name + ").");
+      if (first.op_type == OpType::kBroadcast && joined_[first.arg])
+        return fail("Broadcast root rank " + std::to_string(first.arg) +
+                    " has already joined and holds no data for tensor " +
+                    name + ".");
+      if (first.op_type == OpType::kBroadcast)
+        // Payload size for joined ranks' zero-participation buffers.
+        resp.first_dims.assign(1, NumElements(first.shape));
       if (first.op_type == OpType::kJoin)
         // Joins carry the identity of the LAST rank to arrive (reference
         // later-Horovod join() contract); requests are in arrival order.
@@ -274,13 +370,32 @@ Response Controller::ConstructResponse(const std::string& name) {
                       std::to_string(r.rank) + " has " + ShapeStr(r.shape) +
                       " for tensor " + name + ".");
       }
+      // first_dims[r] = rank r's TOTAL element count (dim-0 x trailing),
+      // not just dim-0: executors — including joined ranks that have no
+      // local entry to read trailing dims from — size buffers directly
+      // from it.  Joined ranks contribute 0 elements.
       resp.first_dims.assign(size_, 0);
-      for (const auto& r : p.requests)
-        resp.first_dims[r.rank] = r.shape[0];
+      for (const auto& r : p.requests) {
+        int64_t trailing = 1;
+        for (size_t i = 1; i < r.shape.size(); ++i) trailing *= r.shape[i];
+        resp.first_dims[r.rank] = r.shape[0] * trailing;
+      }
       break;
     }
     case OpType::kAlltoall:
     case OpType::kReducescatter:
+      if (any_joined && first.op_type == OpType::kAlltoall)
+        // Zeros have no identity role in alltoall: active ranks would
+        // receive fabricated zero blocks indistinguishable from data and
+        // their blocks destined for the joined rank would be dropped.
+        return fail("Alltoall is not supported while any rank has joined "
+                    "(tensor " + name + ").");
+      if (any_joined &&
+          static_cast<ReduceOp>(first.arg) != ReduceOp::kSum &&
+          static_cast<ReduceOp>(first.arg) != ReduceOp::kAdasum &&
+          first.op_type == OpType::kReducescatter)
+        return fail("Reducescatter with joined ranks supports only the Sum "
+                    "reduction (tensor " + name + ").");
       for (const auto& r : p.requests)
         if (r.shape != first.shape)
           return fail("Mismatched " + std::string(OpTypeName(first.op_type)) +
@@ -292,6 +407,8 @@ Response Controller::ConstructResponse(const std::string& name) {
                                          : std::to_string(first.shape[0])) +
                     ") to be divisible by the job size " +
                     std::to_string(size_) + " (tensor " + name + ").");
+      // Payload size for joined ranks' zero-participation buffers.
+      resp.first_dims.assign(1, NumElements(first.shape));
       break;
   }
   return resp;
@@ -304,19 +421,26 @@ void Controller::Fuse(std::vector<Response>* responses) {
   // operations.cc:379).  Sizes come from the request shapes recorded before
   // table_ cleanup — here we re-derive conservatively from the response's
   // own bookkeeping kept in fused_bytes.
+  // first_dims stays PER-NAME (parallel to names): a rank holding only a
+  // subset of a fused response's entries (it joined mid-stream) needs each
+  // name's element count to lay out its buffer identically to everyone
+  // else's.
   std::vector<Response> fused;
   for (auto& r : *responses) {
     bool fusible = !r.error && r.op_type == OpType::kAllreduce;
     if (fusible && !fused.empty()) {
       Response& prev = fused.back();
+      int64_t prev_elems = 0;
+      for (auto d : prev.first_dims) prev_elems += d;
       if (!prev.error && prev.op_type == OpType::kAllreduce &&
           prev.dtype == r.dtype && prev.arg == r.arg &&
-          prev.first_dims.size() == 1 && r.first_dims.size() == 1 &&
-          (prev.first_dims[0] + r.first_dims[0]) *
+          prev.first_dims.size() == prev.names.size() &&
+          r.first_dims.size() == 1 &&
+          (prev_elems + r.first_dims[0]) *
                   static_cast<int64_t>(DataTypeSize(r.dtype)) <=
               fusion_threshold_) {
         prev.names.push_back(r.names[0]);
-        prev.first_dims[0] += r.first_dims[0];
+        prev.first_dims.push_back(r.first_dims[0]);
         continue;
       }
     }

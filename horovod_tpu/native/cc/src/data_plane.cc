@@ -391,7 +391,8 @@ Status DataPlane::Allgather(const void* in, void* out,
   std::vector<int64_t> displ(size_ + 1, 0);
   for (int r = 0; r < size_; ++r) displ[r + 1] = displ[r] + counts[r];
   char* o = static_cast<char*>(out);
-  std::memcpy(o + displ[rank_], in, static_cast<size_t>(counts[rank_]));
+  if (counts[rank_] > 0)  // joined ranks contribute 0 bytes with in=null
+    std::memcpy(o + displ[rank_], in, static_cast<size_t>(counts[rank_]));
   for (int k = 1; k < size_; ++k) {
     int to = (rank_ + k) % size_;
     int from = (rank_ - k + size_) % size_;

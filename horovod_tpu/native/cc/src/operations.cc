@@ -46,6 +46,7 @@ struct GlobalState {
   bool init_finished = false;
 
   std::thread background;
+  std::atomic<bool> joined{false};
   TensorQueue queue;
   Controller controller;
   DataPlane data_plane;
@@ -71,16 +72,79 @@ void SetLastError(const std::string& msg) {
 // Execution (reference PerformOperation, operations.cc:211-279)
 // ---------------------------------------------------------------------------
 
-int64_t TrailingElems(const std::vector<int64_t>& shape) {
-  int64_t n = 1;
-  for (size_t i = 1; i < shape.size(); ++i) n *= shape[i];
-  return n;
+// Zero-payload participation for a rank that has called join(): the data
+// plane's ring/pairwise algorithms involve every rank, so a joined rank
+// must still move bytes for collectives issued by active ranks (reference
+// Join semantics) — it contributes zeros / empty blocks and discards the
+// result.  Sizes come from resp.first_dims (element counts recorded by the
+// coordinator), since this rank holds no table entry to read shapes from.
+void ParticipateJoined(const Response& resp) {
+  const size_t esz = DataTypeSize(resp.dtype);
+  Status st;
+  switch (resp.op_type) {
+    case OpType::kAllreduce: {
+      // first_dims is per-name; the zero payload covers the fused total.
+      int64_t total = 0;
+      for (auto d : resp.first_dims) total += d;
+      if (total == 0) return;
+      std::vector<char> buf(static_cast<size_t>(total) * esz, 0);
+      st = g->data_plane.Allreduce(buf.data(), total, resp.dtype,
+                                   static_cast<ReduceOp>(resp.arg));
+      break;
+    }
+    case OpType::kAllgather: {
+      std::vector<int64_t> counts(g->size, 0);
+      int64_t total = 0;
+      for (int r = 0; r < g->size && r < (int)resp.first_dims.size(); ++r) {
+        counts[r] = resp.first_dims[r] * static_cast<int64_t>(esz);
+        total += resp.first_dims[r];
+      }
+      std::vector<char> out(static_cast<size_t>(total) * esz);
+      st = g->data_plane.Allgather(nullptr, out.data(), counts);
+      break;
+    }
+    case OpType::kBroadcast: {
+      if (resp.first_dims.empty()) return;
+      std::vector<char> buf(
+          static_cast<size_t>(resp.first_dims[0]) * esz, 0);
+      st = g->data_plane.Broadcast(buf.data(), resp.first_dims[0],
+                                   resp.dtype, resp.arg);
+      break;
+    }
+    case OpType::kAlltoall: {
+      if (resp.first_dims.empty()) return;
+      std::vector<char> in(static_cast<size_t>(resp.first_dims[0]) * esz, 0);
+      std::vector<char> out(in.size());
+      st = g->data_plane.Alltoall(in.data(), out.data(), resp.first_dims[0],
+                                  resp.dtype);
+      break;
+    }
+    case OpType::kReducescatter: {
+      if (resp.first_dims.empty()) return;
+      std::vector<char> in(static_cast<size_t>(resp.first_dims[0]) * esz, 0);
+      std::vector<char> out(in.size() / g->size);
+      st = g->data_plane.Reducescatter(in.data(), out.data(),
+                                       resp.first_dims[0], resp.dtype,
+                                       static_cast<ReduceOp>(resp.arg));
+      break;
+    }
+    case OpType::kBarrier:
+    case OpType::kJoin:
+      return;  // negotiation-only; no data movement
+  }
+  if (!st.ok()) {
+    LOG(Error) << "joined-rank participation failed: " << st.reason;
+    SetLastError(st.reason);
+  }
 }
 
 void ExecuteResponse(const Response& resp) {
   auto entries = g->queue.TakeEntries(resp);
   for (auto& e : entries) g->timeline.NegotiateEnd(e->name);
-  if (entries.empty()) return;
+  if (entries.empty()) {
+    if (g->joined.load() && !resp.error) ParticipateJoined(resp);
+    return;
+  }
 
   if (resp.error) {
     Status st = Status::Precondition(resp.error_message);
@@ -116,7 +180,7 @@ void ExecuteResponse(const Response& resp) {
   switch (resp.op_type) {
     case OpType::kAllreduce: {
       ReduceOp rop = static_cast<ReduceOp>(resp.arg);
-      if (entries.size() == 1) {
+      if (entries.size() == 1 && resp.names.size() == 1) {
         auto& e = entries[0];
         g->timeline.Start(e->name, "ALLREDUCE");
         e->output.resize(static_cast<size_t>(e->count) * esz);
@@ -130,19 +194,33 @@ void ExecuteResponse(const Response& resp) {
       } else {
         // Fused path (reference fusion_buffer_manager +
         // MPIAllreduce::Execute memcpy-in/reduce/memcpy-out,
-        // mpi_operations.cc:25-72).
+        // mpi_operations.cc:25-72).  Laid out by the response's per-name
+        // counts, NOT this rank's entry list: a rank that joined after
+        // async-submitting part of this bucket holds only a subset of the
+        // entries and must still match everyone else's buffer layout —
+        // missing names contribute zeros (the Sum identity; the
+        // coordinator rejects other reductions under join).
+        std::unordered_map<std::string, TensorTableEntry*> mine;
+        for (auto& e : entries) mine[e->name] = e.get();
         size_t total = 0;
-        for (auto& e : entries) total += static_cast<size_t>(e->count) * esz;
+        for (auto d : resp.first_dims)
+          total += static_cast<size_t>(d) * esz;
         if (g->fusion_buffer.size() < total) g->fusion_buffer.resize(total);
         char* buf = g->fusion_buffer.data();
         size_t off = 0;
-        for (auto& e : entries) {
-          g->timeline.Start(e->name, "ALLREDUCE");
-          g->timeline.ActivityStart(e->name, "MEMCPY_IN_FUSION_BUFFER");
-          std::memcpy(buf + off, e->input,
-                      static_cast<size_t>(e->count) * esz);
-          g->timeline.ActivityEnd(e->name);
-          off += static_cast<size_t>(e->count) * esz;
+        for (size_t i = 0; i < resp.names.size(); ++i) {
+          size_t nbytes = static_cast<size_t>(resp.first_dims[i]) * esz;
+          auto it = mine.find(resp.names[i]);
+          if (it != mine.end()) {
+            g->timeline.Start(it->second->name, "ALLREDUCE");
+            g->timeline.ActivityStart(it->second->name,
+                                      "MEMCPY_IN_FUSION_BUFFER");
+            std::memcpy(buf + off, it->second->input, nbytes);
+            g->timeline.ActivityEnd(it->second->name);
+          } else {
+            std::memset(buf + off, 0, nbytes);
+          }
+          off += nbytes;
         }
         if (!entries.empty())
           g->timeline.ActivityStart(entries[0]->name, "TCP_ALLREDUCE");
@@ -150,13 +228,17 @@ void ExecuteResponse(const Response& resp) {
                                      resp.dtype, rop);
         if (!entries.empty()) g->timeline.ActivityEnd(entries[0]->name);
         off = 0;
-        for (auto& e : entries) {
-          size_t nbytes = static_cast<size_t>(e->count) * esz;
-          g->timeline.ActivityStart(e->name, "MEMCPY_OUT_FUSION_BUFFER");
-          e->output.assign(buf + off, buf + off + nbytes);
-          e->output_count = e->count;
-          g->timeline.ActivityEnd(e->name);
-          g->timeline.End(e->name);
+        for (size_t i = 0; i < resp.names.size(); ++i) {
+          size_t nbytes = static_cast<size_t>(resp.first_dims[i]) * esz;
+          auto it = mine.find(resp.names[i]);
+          if (it != mine.end()) {
+            auto* e = it->second;
+            g->timeline.ActivityStart(e->name, "MEMCPY_OUT_FUSION_BUFFER");
+            e->output.assign(buf + off, buf + off + nbytes);
+            e->output_count = e->count;
+            g->timeline.ActivityEnd(e->name);
+            g->timeline.End(e->name);
+          }
           off += nbytes;
         }
       }
@@ -165,13 +247,13 @@ void ExecuteResponse(const Response& resp) {
     case OpType::kAllgather: {
       auto& e = entries[0];
       g->timeline.Start(e->name, "ALLGATHER");
-      int64_t trailing = TrailingElems(e->shape);
+      // first_dims[r] is rank r's TOTAL element count (coordinator folds
+      // trailing dims in so joined ranks can size buffers shape-free).
       std::vector<int64_t> counts(g->size);
       int64_t total_elems = 0;
       for (int r = 0; r < g->size; ++r) {
-        counts[r] = resp.first_dims[r] * trailing *
-                    static_cast<int64_t>(esz);  // bytes
-        total_elems += resp.first_dims[r] * trailing;
+        counts[r] = resp.first_dims[r] * static_cast<int64_t>(esz);  // bytes
+        total_elems += resp.first_dims[r];
       }
       e->output.resize(static_cast<size_t>(total_elems) * esz);
       e->output_count = total_elems;
@@ -227,7 +309,9 @@ void ExecuteResponse(const Response& resp) {
     }
     case OpType::kJoin: {
       // Output: the last rank to join, as int32 (coordinator recorded it
-      // in resp.arg).
+      // in resp.arg).  The join is over — drop the zero-participation mode
+      // so the next epoch's collectives take the normal path.
+      g->joined.store(false);
       auto& e = entries[0];
       e->output.resize(sizeof(int32_t));
       int32_t last = resp.arg;
@@ -250,7 +334,11 @@ void BackgroundThread() {
   Status s = g->data_plane.Listen("");
   if (s.ok()) {
     std::vector<PeerAddr> peers;
-    std::string host = EnvStr("HOROVOD_HOSTNAME", "127.0.0.1");
+    // Empty when unset: the controller then falls back to the address it
+    // OBSERVES on the rendezvous connection, which is correct for remote
+    // workers launched without hvdrun (a hardcoded 127.0.0.1 here would
+    // shadow that fallback and break manual multi-host launches).
+    std::string host = EnvStr("HOROVOD_HOSTNAME", "");
     s = g->controller.Init(g->rank, g->size, g->rendezvous_addr,
                            g->rendezvous_port, host, g->data_plane.port(),
                            &g->cache, &peers);
@@ -280,6 +368,7 @@ void BackgroundThread() {
 
     RequestList mine;
     for (auto& r : g->queue.PopAnnouncements(g->rank)) {
+      if (r.op_type == OpType::kJoin) g->joined.store(true);
       g->timeline.NegotiateStart(r.name, r.op_type);
       // Steady state: a tensor whose params match the cache travels as one
       // bit instead of a serialized request (reference cached fast path,
@@ -316,11 +405,16 @@ void BackgroundThread() {
     }
   }
 
+  // Order matters: refuse new enqueues (initialized flag + queue close,
+  // the latter checked under the queue mutex so a racing hvd_enqueue that
+  // already passed the flag check fails cleanly) BEFORE draining — an
+  // entry added after FailAll would strand its waiter forever.
+  g->initialized.store(false);
+  g->queue.Close();
   g->queue.FailAll(Status::Aborted(kShutdownError));
   g->data_plane.Shutdown();
   g->controller.Shutdown();
   g->timeline.Shutdown();
-  g->initialized.store(false);
   g->background_done.store(true);
 }
 

@@ -6,6 +6,10 @@ namespace hvd {
 
 Status TensorQueue::Add(const EntryPtr& entry) {
   std::lock_guard<std::mutex> lk(mu_);
+  if (closed_)
+    return Status::Aborted(
+        "Horovod has been shut down. This was caused by an exception on one "
+        "of the ranks or an attempt to enqueue after shutdown.");
   if (by_name_.count(entry->name))
     return Status::Precondition(
         DuplicateNameError(entry->op_type, entry->name));
@@ -79,6 +83,11 @@ void TensorQueue::FailAll(const Status& status) {
     }
   }
   cv_.notify_all();
+}
+
+void TensorQueue::Close() {
+  std::lock_guard<std::mutex> lk(mu_);
+  closed_ = true;
 }
 
 bool TensorQueue::Poll(int64_t handle) {

@@ -26,6 +26,8 @@ _LIB_NAME = "libhorovod_tpu.so"
 _DTYPE_CODES = {
     np.dtype(np.uint8): 0,
     np.dtype(np.int8): 1,
+    np.dtype(np.uint16): 2,
+    np.dtype(np.int16): 3,
     np.dtype(np.int32): 4,
     np.dtype(np.int64): 5,
     np.dtype(np.float16): 6,
@@ -210,8 +212,16 @@ class Runtime:
         self._wait_read(h, arr.dtype, ())
 
     def join(self) -> int:
-        """Returns the rank that joined LAST, as observed by the
-        coordinator (later-Horovod ``join()`` contract)."""
+        """Signal that this rank has no more work (uneven final batches).
+
+        Reference Join semantics: while blocked here, this rank's
+        background thread keeps participating — with zero payloads — in
+        collectives still issued by active ranks, so ranks with more
+        batches never deadlock.  Only Sum reductions are allowed while
+        ranks are joined (zeros are the Sum identity; Average would
+        deflate by the full world size, and a joined broadcast root or
+        alltoall is a coordinated error).  Returns the rank that joined
+        LAST, as observed by the coordinator."""
         arr = np.zeros(1, np.int32)
         h = self._submit(6, "hvd.join", arr)
         out = self._wait_read(h, np.dtype(np.int32), ())

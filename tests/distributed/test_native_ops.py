@@ -211,6 +211,33 @@ def test_barrier_and_join(hvd, rank, size):
     assert 0 <= last < size
 
 
+def test_join_uneven_batches(hvd, rank, size):
+    """Reference Join contract: ranks with MORE batches keep collecting
+    while joined ranks participate with zeros — no deadlock, and the sums
+    only include active ranks' data (joined ranks contribute 0).
+
+    Rank r processes (r + 1) batches: rank 0 joins first; the last rank's
+    final allreduces run with every other rank already joined."""
+    if size < 2:
+        pytest.skip("needs >= 2 ranks")
+    my_batches = rank + 1
+    for b in range(size):
+        if b < my_batches:
+            out = np.asarray(hvd.allreduce(
+                np.full((4,), 1.0, np.float32), op=hvd.Sum,
+                name=f"t.join.b{b}"))
+            # batch b is submitted by ranks with rank+1 > b.
+            active = size - b
+            np.testing.assert_allclose(out, np.full((4,), float(active)))
+    last = hvd.join()
+    assert last == size - 1  # most batches -> joins last
+    # joined state must RESET after the join completes: a normal
+    # all-ranks collective still works afterwards.
+    out = np.asarray(hvd.allreduce(np.ones(3, np.float32), op=hvd.Sum,
+                                   name="t.join.after"))
+    np.testing.assert_allclose(out, np.full((3,), float(size)))
+
+
 def test_poll_and_synchronize(hvd, rank, size):
     h = hvd.allreduce_async(np.ones(2, np.float32), op=hvd.Sum, name="t.poll")
     out = hvd.synchronize(h)
