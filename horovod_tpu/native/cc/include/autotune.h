@@ -1,0 +1,148 @@
+// Autotuning: Gaussian-process Bayesian optimization of the runtime's
+// tunable knobs (cycle time, fusion threshold, response cache).
+//
+// Reference equivalents: horovod/common/parameter_manager.{h,cc} (warmup ->
+// bytes/usec scoring -> tune -> converge-and-pin, parameter_manager.cc:142-176),
+// horovod/common/optim/bayesian_optimization.{h,cc} (EI acquisition) and
+// horovod/common/optim/gaussian_process.{h,cc} (GP surrogate).  This
+// implementation is self-contained (no Eigen/L-BFGS): the design points are
+// tiny (tens of samples, 3 dims), so a dense Cholesky solve and random-
+// candidate EI maximization are exact enough and dependency-free.
+//
+// Synchronization model: only the COORDINATOR scores and tunes; chosen
+// values piggyback on the ResponseList every cycle (TunedParams), so every
+// rank applies the same parameters at the same point in the response
+// stream — fusion walks and cache state never diverge.
+#ifndef HVD_AUTOTUNE_H
+#define HVD_AUTOTUNE_H
+
+#include <chrono>
+#include <cstdint>
+#include <fstream>
+#include <string>
+#include <vector>
+
+namespace hvd {
+
+// Dense GP regressor, RBF kernel + observation noise, zero prior mean on
+// standardized targets.
+class GaussianProcess {
+ public:
+  // xs: n points of d dims (unit box); ys: n scores.
+  void Fit(const std::vector<std::vector<double>>& xs,
+           const std::vector<double>& ys,
+           double length_scale = 0.25, double noise = 1e-4);
+  // Predictive mean/stddev at x (in the standardized-target scale the
+  // caller's EI uses; mean is de-standardized, std is scaled back).
+  void Predict(const std::vector<double>& x, double* mean,
+               double* stddev) const;
+  bool fitted() const { return n_ > 0; }
+
+ private:
+  double Kernel(const std::vector<double>& a,
+                const std::vector<double>& b) const;
+
+  std::vector<std::vector<double>> xs_;
+  std::vector<double> chol_;   // lower Cholesky factor of K+noise, n x n
+  std::vector<double> alpha_;  // (K+noise)^-1 y_standardized
+  double length_ = 0.25;
+  double y_mean_ = 0.0, y_std_ = 1.0;
+  int n_ = 0;
+};
+
+// Expected-improvement Bayesian optimizer over the unit box [0,1]^d
+// (reference bayesian_optimization.cc: GP surrogate + EI acquisition; the
+// L-BFGS acquisition maximizer is replaced by deterministic random-
+// candidate search — exact enough in 3-D and dependency-free).
+class BayesianOptimizer {
+ public:
+  explicit BayesianOptimizer(int dims, int n_init = 5);
+
+  std::vector<double> NextSample();
+  void Observe(const std::vector<double>& x, double score);
+
+  const std::vector<double>& best_x() const { return best_x_; }
+  double best_score() const { return best_score_; }
+  int num_observations() const { return static_cast<int>(ys_.size()); }
+
+ private:
+  double Rand01();
+
+  int dims_;
+  int n_init_;
+  uint64_t rng_state_ = 0x9e3779b97f4a7c15ull;
+  std::vector<std::vector<double>> xs_;
+  std::vector<double> ys_;
+  std::vector<double> best_x_;
+  double best_score_ = -1e300;
+  GaussianProcess gp_;
+};
+
+// Values broadcast from the coordinator inside every ResponseList while
+// autotuning (and once more to pin the final best).
+struct TunedParams {
+  bool present = false;        // wire: block attached
+  bool tuning = false;         // autotune still exploring
+  double cycle_time_ms = 1.0;
+  int64_t fusion_threshold = 64 * 1024 * 1024;
+  bool cache_enabled = true;
+};
+
+// Coordinator-side tuner: warmup -> samples of bytes/usec -> median score
+// per trial -> Bayesian proposal -> converge and pin best.
+class ParameterManager {
+ public:
+  // Seeds the search at the configured defaults; active iff
+  // HOROVOD_AUTOTUNE=1.  Env knobs (defaults in parens):
+  //   HOROVOD_AUTOTUNE_LOG               CSV of trials (unset: no log)
+  //   HOROVOD_AUTOTUNE_WARMUP_SAMPLES    discarded leading samples (3)
+  //   HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE  busy cycles per sample (10)
+  //   HOROVOD_AUTOTUNE_SAMPLES           samples per trial, median (5)
+  //   HOROVOD_AUTOTUNE_BAYES_TRIALS      max trials before pinning (20)
+  void Initialize(int rank, double cycle_ms, int64_t fusion_bytes,
+                  bool cache_enabled);
+
+  bool active() const { return active_; }
+
+  // Coordinator, once per cycle: `bytes` = payload the cycle's responses
+  // moved (0 = idle cycle, not scored).  Returns true when the current
+  // params changed (they ride the next ResponseList either way).
+  bool Update(int64_t bytes);
+
+  TunedParams Current() const;
+
+ private:
+  bool Tune(double median_score);
+  void ApplyPoint(const std::vector<double>& x);
+  std::vector<double> CurrentPoint() const;
+  void LogTrial(double score, bool pinned);
+
+  bool active_ = false;
+  int rank_ = 0;
+
+  // Current (or pinned-best) values.
+  double cycle_time_ms_ = 1.0;
+  int64_t fusion_threshold_ = 64 * 1024 * 1024;
+  bool cache_enabled_ = true;
+  bool cache_available_ = true;  // false: cache capacity 0, don't explore
+
+  // Sampling state.
+  int warmup_remaining_ = 3;
+  int steps_per_sample_ = 10;
+  int samples_per_trial_ = 5;
+  int max_trials_ = 20;
+  int steps_in_sample_ = 0;
+  int64_t bytes_in_sample_ = 0;
+  std::chrono::steady_clock::time_point sample_start_;
+  std::vector<double> scores_;
+  int trials_ = 0;
+  int no_improve_streak_ = 0;
+  double best_seen_ = -1e300;
+
+  BayesianOptimizer optimizer_{3};
+  std::ofstream log_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_AUTOTUNE_H

@@ -38,8 +38,12 @@ class Controller {
 
   // One lock-step negotiation cycle (reference RunLoopOnce ->
   // ComputeResponseList).  `mine` is consumed; `out` receives the verdict
-  // list identical on every rank.
-  Status Cycle(RequestList& mine, ResponseList* out);
+  // list identical on every rank.  On the coordinator, `tuned` (may be
+  // null) is attached to the outgoing list so every rank applies the
+  // autotuner's current knobs at the same stream position (reference
+  // SynchronizeParameters, controller.cc:32-46).
+  Status Cycle(RequestList& mine, ResponseList* out,
+               const TunedParams* tuned = nullptr);
 
   void Shutdown();
 
@@ -49,6 +53,9 @@ class Controller {
   void Fuse(std::vector<Response>* responses);
 
   int64_t fusion_threshold() const { return fusion_threshold_; }
+  // Autotune applies the threshold delivered in each ResponseList before
+  // fusing that list, keeping the fusion walk identical across ranks.
+  void set_fusion_threshold(int64_t t) { fusion_threshold_ = t; }
   StallInspector& stall_inspector() { return stall_; }
 
  private:
@@ -66,7 +73,8 @@ class Controller {
   // treats joined ranks as implicit contributors when counting readiness.
   bool IsReady(const PendingTensor& p, OpType op) const;
 
-  Status MasterCycle(const RequestList& mine, ResponseList* out);
+  Status MasterCycle(const RequestList& mine, ResponseList* out,
+                     const TunedParams* tuned);
   // Record one rank's announcements (reference IncrementTensorCount,
   // controller.cc:700-723); names becoming ready join ready_ in arrival
   // order (identical on all ranks because only the master defines it).

@@ -11,6 +11,7 @@
 #include <string>
 #include <vector>
 
+#include "autotune.h"
 #include "hvd_common.h"
 
 namespace hvd {
@@ -54,6 +55,11 @@ struct ResponseList {
   std::vector<Response> responses;
   bool shutdown = false;
   std::vector<uint64_t> cache_valid;  // synchronized cache bits (AND)
+  // Autotuned knobs, attached by the coordinator while tuning (reference
+  // SynchronizeParameters, controller.cc:32-46).  Every rank applies them
+  // when processing THIS list, so fusion walks and cache gating change at
+  // the same point in the response stream everywhere.
+  TunedParams params;
 
   std::string Serialize() const;
   static Status Parse(const std::string& buf, ResponseList* out);

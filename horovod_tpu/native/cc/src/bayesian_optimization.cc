@@ -1,0 +1,76 @@
+// Expected-improvement Bayesian optimization over the unit box.
+//
+// Reference equivalent: horovod/common/optim/bayesian_optimization.{h,cc}
+// (GP surrogate + EI acquisition maximized with vendored L-BFGS).  The
+// acquisition here is maximized by deterministic random-candidate search:
+// in <= 3 dimensions with tens of observations that is as good as a local
+// optimizer and needs no dependencies, and determinism keeps coordinator
+// behavior reproducible across runs.
+#include "autotune.h"
+
+#include <cmath>
+
+namespace hvd {
+
+namespace {
+
+// Standard normal pdf / cdf for the EI formula.
+double Phi(double z) { return 0.5 * std::erfc(-z / std::sqrt(2.0)); }
+double phi(double z) {
+  return std::exp(-0.5 * z * z) / std::sqrt(2.0 * M_PI);
+}
+
+}  // namespace
+
+BayesianOptimizer::BayesianOptimizer(int dims, int n_init)
+    : dims_(dims), n_init_(n_init) {}
+
+double BayesianOptimizer::Rand01() {
+  // xorshift64* — deterministic, no <random> state to seed per-rank.
+  uint64_t x = rng_state_;
+  x ^= x >> 12;
+  x ^= x << 25;
+  x ^= x >> 27;
+  rng_state_ = x;
+  return static_cast<double>((x * 0x2545F4914F6CDD1Dull) >> 11) /
+         static_cast<double>(1ull << 53);
+}
+
+std::vector<double> BayesianOptimizer::NextSample() {
+  if (num_observations() < n_init_) {
+    // Space-filling initialization: jittered midpoints walk the box.
+    std::vector<double> x(dims_);
+    for (int d = 0; d < dims_; ++d) x[d] = Rand01();
+    return x;
+  }
+  gp_.Fit(xs_, ys_);
+  // EI(x) = (mu - best - xi) Phi(z) + sigma phi(z), z = (mu - best - xi)/sigma
+  const double xi = 0.01 * std::abs(best_score_);
+  std::vector<double> best_cand(dims_, 0.5);
+  double best_ei = -1.0;
+  for (int c = 0; c < 256; ++c) {
+    std::vector<double> x(dims_);
+    for (int d = 0; d < dims_; ++d) x[d] = Rand01();
+    double mu, sigma;
+    gp_.Predict(x, &mu, &sigma);
+    double imp = mu - best_score_ - xi;
+    double z = imp / sigma;
+    double ei = imp * Phi(z) + sigma * phi(z);
+    if (ei > best_ei) {
+      best_ei = ei;
+      best_cand = x;
+    }
+  }
+  return best_cand;
+}
+
+void BayesianOptimizer::Observe(const std::vector<double>& x, double score) {
+  xs_.push_back(x);
+  ys_.push_back(score);
+  if (score > best_score_) {
+    best_score_ = score;
+    best_x_ = x;
+  }
+}
+
+}  // namespace hvd

@@ -112,13 +112,14 @@ void Controller::Shutdown() {
   listener_.Close();
 }
 
-Status Controller::Cycle(RequestList& mine, ResponseList* out) {
+Status Controller::Cycle(RequestList& mine, ResponseList* out,
+                         const TunedParams* tuned) {
   if (size_ == 1) {
     // Degenerate single-rank job: everything is immediately ready.
     Ingest(mine, 0);
-    return MasterCycle(RequestList{}, out);
+    return MasterCycle(RequestList{}, out, tuned);
   }
-  if (rank_ == 0) return MasterCycle(mine, out);
+  if (rank_ == 0) return MasterCycle(mine, out, tuned);
   Status s = master_.SendFrame(mine.Serialize());
   if (!s.ok()) return s;
   std::string buf;
@@ -127,7 +128,8 @@ Status Controller::Cycle(RequestList& mine, ResponseList* out) {
   return ResponseList::Parse(buf, out);
 }
 
-Status Controller::MasterCycle(const RequestList& mine, ResponseList* out) {
+Status Controller::MasterCycle(const RequestList& mine, ResponseList* out,
+                               const TunedParams* tuned) {
   // Gather every worker's announcements (reference RecvReadyTensors /
   // MPI_Gather, mpi_controller.cc:107-150).  Lock-step: every rank sends
   // exactly one list per cycle.
@@ -144,6 +146,7 @@ Status Controller::MasterCycle(const RequestList& mine, ResponseList* out) {
 
   out->responses.clear();
   out->shutdown = false;
+  if (tuned != nullptr) out->params = *tuned;
 
   // Ready tensors -> validated responses, in the master-defined order.
   // Joins are ordered LAST within the cycle: executing a join resets the

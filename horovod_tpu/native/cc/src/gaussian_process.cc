@@ -1,0 +1,99 @@
+// Gaussian-process regressor for the autotuner's surrogate model.
+//
+// Reference equivalent: horovod/common/optim/gaussian_process.{h,cc}
+// (Eigen-based RBF GP).  Design-point counts here are tiny (<= a few tens),
+// so an own dense Cholesky factorization replaces Eigen.
+#include "autotune.h"
+
+#include <cmath>
+
+namespace hvd {
+
+double GaussianProcess::Kernel(const std::vector<double>& a,
+                               const std::vector<double>& b) const {
+  double d2 = 0.0;
+  for (size_t i = 0; i < a.size(); ++i) {
+    double d = a[i] - b[i];
+    d2 += d * d;
+  }
+  return std::exp(-0.5 * d2 / (length_ * length_));
+}
+
+void GaussianProcess::Fit(const std::vector<std::vector<double>>& xs,
+                          const std::vector<double>& ys, double length_scale,
+                          double noise) {
+  n_ = static_cast<int>(xs.size());
+  xs_ = xs;
+  length_ = length_scale;
+  if (n_ == 0) return;
+
+  // Standardize targets (zero-mean GP prior).
+  y_mean_ = 0.0;
+  for (double y : ys) y_mean_ += y;
+  y_mean_ /= n_;
+  y_std_ = 0.0;
+  for (double y : ys) y_std_ += (y - y_mean_) * (y - y_mean_);
+  y_std_ = std::sqrt(y_std_ / n_);
+  if (y_std_ < 1e-12) y_std_ = 1.0;
+
+  // K + noise*I, then lower Cholesky (in place, row-major).
+  chol_.assign(static_cast<size_t>(n_) * n_, 0.0);
+  for (int i = 0; i < n_; ++i)
+    for (int j = 0; j <= i; ++j)
+      chol_[i * n_ + j] = Kernel(xs_[i], xs_[j]) + (i == j ? noise : 0.0);
+  for (int j = 0; j < n_; ++j) {
+    double d = chol_[j * n_ + j];
+    for (int k = 0; k < j; ++k) d -= chol_[j * n_ + k] * chol_[j * n_ + k];
+    d = std::sqrt(d > 1e-12 ? d : 1e-12);
+    chol_[j * n_ + j] = d;
+    for (int i = j + 1; i < n_; ++i) {
+      double s = chol_[i * n_ + j];
+      for (int k = 0; k < j; ++k) s -= chol_[i * n_ + k] * chol_[j * n_ + k];
+      chol_[i * n_ + j] = s / d;
+    }
+  }
+
+  // alpha = K^-1 y_std via forward + back substitution.
+  std::vector<double> z(n_);
+  for (int i = 0; i < n_; ++i) {
+    double s = (ys[i] - y_mean_) / y_std_;
+    for (int k = 0; k < i; ++k) s -= chol_[i * n_ + k] * z[k];
+    z[i] = s / chol_[i * n_ + i];
+  }
+  alpha_.assign(n_, 0.0);
+  for (int i = n_ - 1; i >= 0; --i) {
+    double s = z[i];
+    for (int k = i + 1; k < n_; ++k) s -= chol_[k * n_ + i] * alpha_[k];
+    alpha_[i] = s / chol_[i * n_ + i];
+  }
+}
+
+void GaussianProcess::Predict(const std::vector<double>& x, double* mean,
+                              double* stddev) const {
+  if (n_ == 0) {
+    *mean = 0.0;
+    *stddev = 1.0;
+    return;
+  }
+  std::vector<double> k(n_);
+  for (int i = 0; i < n_; ++i) k[i] = Kernel(x, xs_[i]);
+
+  double mu = 0.0;
+  for (int i = 0; i < n_; ++i) mu += k[i] * alpha_[i];
+
+  // var = k(x,x) - v^T v with v = L^-1 k.
+  std::vector<double> v(n_);
+  for (int i = 0; i < n_; ++i) {
+    double s = k[i];
+    for (int j = 0; j < i; ++j) s -= chol_[i * n_ + j] * v[j];
+    v[i] = s / chol_[i * n_ + i];
+  }
+  double var = 1.0;  // k(x,x) = 1 for the RBF kernel
+  for (int i = 0; i < n_; ++i) var -= v[i] * v[i];
+  if (var < 1e-12) var = 1e-12;
+
+  *mean = y_mean_ + y_std_ * mu;
+  *stddev = y_std_ * std::sqrt(var);
+}
+
+}  // namespace hvd

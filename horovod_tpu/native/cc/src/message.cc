@@ -114,6 +114,13 @@ std::string ResponseList::Serialize() const {
     for (const auto& nm : r.names) PutStr(&buf, nm);
     PutVec(&buf, r.first_dims);
   }
+  PutPod<uint8_t>(&buf, params.present ? 1 : 0);
+  if (params.present) {
+    PutPod<uint8_t>(&buf, params.tuning ? 1 : 0);
+    PutPod<double>(&buf, params.cycle_time_ms);
+    PutPod<int64_t>(&buf, params.fusion_threshold);
+    PutPod<uint8_t>(&buf, params.cache_enabled ? 1 : 0);
+  }
   return buf;
 }
 
@@ -140,6 +147,17 @@ Status ResponseList::Parse(const std::string& buf, ResponseList* out) {
     for (auto& nm : r.names)
       if (!rd.GetStr(&nm)) return Malformed("name");
     if (!rd.GetVec(&r.first_dims)) return Malformed("first_dims");
+  }
+  uint8_t present;
+  if (!rd.GetPod(&present)) return Malformed("params");
+  out->params.present = present != 0;
+  if (out->params.present) {
+    uint8_t tuning, cache;
+    if (!rd.GetPod(&tuning) || !rd.GetPod(&out->params.cycle_time_ms) ||
+        !rd.GetPod(&out->params.fusion_threshold) || !rd.GetPod(&cache))
+      return Malformed("params body");
+    out->params.tuning = tuning != 0;
+    out->params.cache_enabled = cache != 0;
   }
   return Status::OK();
 }

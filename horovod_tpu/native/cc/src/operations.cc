@@ -14,6 +14,7 @@
 #include <memory>
 #include <thread>
 
+#include "autotune.h"
 #include "controller.h"
 #include "data_plane.h"
 #include "hvd_common.h"
@@ -52,6 +53,9 @@ struct GlobalState {
   DataPlane data_plane;
   Timeline timeline;
   ResponseCache cache;
+  ParameterManager param_manager;
+  bool autotune = false;       // attach TunedParams to every ResponseList
+  bool cache_enabled = true;   // autotune-gated (flips in lock-step)
   std::vector<char> fusion_buffer;
   double cycle_time_ms = 1.0;
 
@@ -138,18 +142,20 @@ void ParticipateJoined(const Response& resp) {
   }
 }
 
-void ExecuteResponse(const Response& resp) {
+// Returns the payload bytes this response moved (the autotuner's score
+// numerator; 0 for errors, barriers and zero-participation).
+int64_t ExecuteResponse(const Response& resp) {
   auto entries = g->queue.TakeEntries(resp);
   for (auto& e : entries) g->timeline.NegotiateEnd(e->name);
   if (entries.empty()) {
     if (g->joined.load() && !resp.error) ParticipateJoined(resp);
-    return;
+    return 0;
   }
 
   if (resp.error) {
     Status st = Status::Precondition(resp.error_message);
     for (auto& e : entries) g->queue.Complete(e, st);
-    return;
+    return 0;
   }
 
   // Refresh the response cache from this rank's own entry params — every
@@ -157,7 +163,8 @@ void ExecuteResponse(const Response& resp) {
   // name->slot assignment identical everywhere (see response_cache.h).
   // Allgather is excluded: its dim-0 differs per rank, so the coordinator
   // could not faithfully expand another rank's bit from its own params.
-  if (resp.op_type != OpType::kBarrier && resp.op_type != OpType::kJoin &&
+  if (g->cache_enabled &&
+      resp.op_type != OpType::kBarrier && resp.op_type != OpType::kJoin &&
       resp.op_type != OpType::kAllgather) {
     for (auto& e : entries) {
       Request params;
@@ -176,6 +183,7 @@ void ExecuteResponse(const Response& resp) {
   };
 
   const size_t esz = DataTypeSize(resp.dtype);
+  int64_t moved = 0;
   Status st;
   switch (resp.op_type) {
     case OpType::kAllreduce: {
@@ -321,6 +329,12 @@ void ExecuteResponse(const Response& resp) {
     }
   }
   complete_all(st);
+  if (!st.ok() || resp.op_type == OpType::kBarrier ||
+      resp.op_type == OpType::kJoin)
+    return 0;  // no useful payload moved — don't inflate autotune scores
+  for (auto& e : entries)
+    moved += static_cast<int64_t>(e->count) * static_cast<int64_t>(esz);
+  return moved;
 }
 
 // ---------------------------------------------------------------------------
@@ -347,6 +361,12 @@ void BackgroundThread() {
   }
   g->timeline.Initialize(EnvStr("HOROVOD_TIMELINE"), g->rank);
   g->cycle_time_ms = EnvDouble("HOROVOD_CYCLE_TIME", 1.0);
+  g->cache_enabled = g->cache.enabled();
+  g->autotune = EnvBool("HOROVOD_AUTOTUNE", false);
+  if (g->autotune)
+    g->param_manager.Initialize(g->rank, g->cycle_time_ms,
+                                g->controller.fusion_threshold(),
+                                g->cache_enabled);
 
   if (s.ok()) g->initialized.store(true);  // before the init_cv handshake:
   // the caller may enqueue the moment hvd_init returns.
@@ -373,7 +393,7 @@ void BackgroundThread() {
       // Steady state: a tensor whose params match the cache travels as one
       // bit instead of a serialized request (reference cached fast path,
       // controller.cc:165-179).
-      int64_t slot = g->cache.Lookup(r);
+      int64_t slot = g->cache_enabled ? g->cache.Lookup(r) : -1;
       if (slot >= 0 && r.op_type != OpType::kAllgather)
         ResponseCache::SetBit(&mine.cache_hits, slot);
       else
@@ -382,17 +402,37 @@ void BackgroundThread() {
     mine.shutdown = g->shutting_down.load();
 
     ResponseList responses;
-    s = g->controller.Cycle(mine, &responses);
+    TunedParams tuned;
+    if (g->autotune && g->rank == 0) tuned = g->param_manager.Current();
+    s = g->controller.Cycle(mine, &responses,
+                            tuned.present ? &tuned : nullptr);
     if (!s.ok()) {
       LOG(Error) << "controller cycle failed: " << s.reason;
       SetLastError(s.reason);
       g->queue.FailAll(Status::Aborted(s.reason));
       break;
     }
+    // Apply autotuned knobs delivered with THIS list before fusing it —
+    // the fusion walk and cache gating must flip at the same response-
+    // stream position on every rank or buckets would diverge.
+    if (responses.params.present) {
+      g->cycle_time_ms = responses.params.cycle_time_ms;
+      g->controller.set_fusion_threshold(responses.params.fusion_threshold);
+      g->cache_enabled = responses.params.cache_enabled;
+    }
     // The verdict list arrives unfused (per-name) so ExecuteResponse can
     // refresh the cache; fuse locally with the master's own walk.
     g->controller.Fuse(&responses.responses);
-    for (const auto& resp : responses.responses) ExecuteResponse(resp);
+    int64_t cycle_bytes = 0;
+    for (const auto& resp : responses.responses)
+      cycle_bytes += ExecuteResponse(resp);
+    if (g->autotune && g->rank == 0) {
+      g->param_manager.Update(cycle_bytes);
+      if (tuned.present && !tuned.tuning)
+        // The pinned-best params just rode this cycle's list ("once more
+        // to pin"); stop attaching from here on.
+        g->autotune = false;
+    }
     shutdown_seen = responses.shutdown;
 
     if (!shutdown_seen) {

@@ -1,0 +1,159 @@
+// Coordinator-side autotuner driving the Bayesian optimizer.
+//
+// Reference equivalent: horovod/common/parameter_manager.{h,cc} —
+// warmup discard, bytes/usec sample scoring with a median over SAMPLES
+// (parameter_manager.cc:142-176), tune on the coordinator only, broadcast
+// each change, converge and pin the best.  Search space here: cycle time
+// (log-scale 0.1–20 ms), fusion threshold (1–64 MB) and the response cache
+// on/off as a rounded third dimension.
+#include "autotune.h"
+
+#include <algorithm>
+#include <cmath>
+
+#include "hvd_common.h"
+
+namespace hvd {
+
+namespace {
+constexpr double kCycleMinMs = 0.1, kCycleMaxMs = 20.0;
+constexpr double kFusionMinMb = 1.0, kFusionMaxMb = 64.0;
+}  // namespace
+
+void ParameterManager::Initialize(int rank, double cycle_ms,
+                                  int64_t fusion_bytes, bool cache_enabled) {
+  rank_ = rank;
+  cycle_time_ms_ = cycle_ms;
+  fusion_threshold_ = fusion_bytes;
+  cache_enabled_ = cache_enabled;
+  cache_available_ = cache_enabled;  // capacity 0: never explore cache=on
+  active_ = EnvBool("HOROVOD_AUTOTUNE", false);
+  if (!active_) return;
+
+  warmup_remaining_ =
+      static_cast<int>(EnvInt("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 3));
+  steps_per_sample_ =
+      static_cast<int>(EnvInt("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", 10));
+  samples_per_trial_ = static_cast<int>(EnvInt("HOROVOD_AUTOTUNE_SAMPLES", 5));
+  max_trials_ = static_cast<int>(EnvInt("HOROVOD_AUTOTUNE_BAYES_TRIALS", 20));
+  sample_start_ = std::chrono::steady_clock::now();
+
+  if (rank_ == 0) {
+    std::string path = EnvStr("HOROVOD_AUTOTUNE_LOG");
+    if (!path.empty()) {
+      log_.open(path, std::ios::trunc);
+      log_ << "trial,cycle_time_ms,fusion_threshold_mb,cache_enabled,"
+              "score_bytes_per_usec,best_score,pinned\n";
+      log_.flush();
+    }
+    LOG(Info) << "Autotuner: enabled (warmup " << warmup_remaining_
+              << " samples, " << samples_per_trial_ << " samples/trial, "
+              << max_trials_ << " trials max)";
+  }
+}
+
+std::vector<double> ParameterManager::CurrentPoint() const {
+  // Unit-box encoding: x0 = log-cycle, x1 = fusion MB, x2 = cache.
+  double x0 = (std::log(cycle_time_ms_) - std::log(kCycleMinMs)) /
+              (std::log(kCycleMaxMs) - std::log(kCycleMinMs));
+  double x1 = (static_cast<double>(fusion_threshold_) / (1024 * 1024) -
+               kFusionMinMb) /
+              (kFusionMaxMb - kFusionMinMb);
+  return {std::min(std::max(x0, 0.0), 1.0), std::min(std::max(x1, 0.0), 1.0),
+          cache_enabled_ ? 1.0 : 0.0};
+}
+
+void ParameterManager::ApplyPoint(const std::vector<double>& x) {
+  cycle_time_ms_ = std::exp(std::log(kCycleMinMs) +
+                            x[0] * (std::log(kCycleMaxMs) -
+                                    std::log(kCycleMinMs)));
+  double mb = kFusionMinMb + x[1] * (kFusionMaxMb - kFusionMinMb);
+  fusion_threshold_ = static_cast<int64_t>(mb * 1024 * 1024);
+  cache_enabled_ = cache_available_ && x[2] >= 0.5;
+}
+
+bool ParameterManager::Update(int64_t bytes) {
+  if (!active_ || bytes <= 0) return false;  // idle cycles are not scored
+  auto now = std::chrono::steady_clock::now();
+  if (steps_in_sample_ == 0)
+    // A sample's clock starts at its first busy cycle: idle gaps BETWEEN
+    // samples (eval phases, checkpointing) must not poison the next
+    // sample's bytes/usec with pause time.
+    sample_start_ = now;
+  bytes_in_sample_ += bytes;
+  if (++steps_in_sample_ < steps_per_sample_) return false;
+
+  double usec = std::chrono::duration_cast<std::chrono::microseconds>(
+                    now - sample_start_).count();
+  if (usec < 1.0) usec = 1.0;
+  steps_in_sample_ = 0;
+  double sample_score = static_cast<double>(bytes_in_sample_) / usec;
+  bytes_in_sample_ = 0;
+  if (warmup_remaining_ > 0) {
+    // Warmup discards SAMPLES (as the env knob promises), covering JIT
+    // compilation / connection ramp-up.
+    --warmup_remaining_;
+    LOG(Info) << "Autotuner: warming up (" << warmup_remaining_
+              << " samples remaining)";
+    return false;
+  }
+  scores_.push_back(sample_score);
+
+  if (static_cast<int>(scores_.size()) < samples_per_trial_) return false;
+  // Median is robust to scheduler noise (reference uses the same).
+  std::sort(scores_.begin(), scores_.end());
+  double median = scores_[scores_.size() / 2];
+  scores_.clear();
+  return Tune(median);
+}
+
+bool ParameterManager::Tune(double median_score) {
+  optimizer_.Observe(CurrentPoint(), median_score);
+  ++trials_;
+  if (median_score > best_seen_) {
+    best_seen_ = median_score;
+    no_improve_streak_ = 0;
+  } else {
+    ++no_improve_streak_;
+  }
+
+  bool pin = trials_ >= max_trials_ ||
+             (trials_ >= 8 && no_improve_streak_ >= 5);
+  LogTrial(median_score, pin);
+
+  if (pin) {
+    ApplyPoint(optimizer_.best_x());
+    active_ = false;
+    LOG(Info) << "Autotuner: converged after " << trials_
+              << " trials; pinned cycle_time_ms=" << cycle_time_ms_
+              << " fusion_threshold=" << fusion_threshold_
+              << " cache=" << (cache_enabled_ ? 1 : 0)
+              << " (best " << optimizer_.best_score() << " bytes/usec)";
+    if (log_.is_open()) log_.flush();
+    return true;
+  }
+
+  ApplyPoint(optimizer_.NextSample());
+  return true;
+}
+
+void ParameterManager::LogTrial(double score, bool pinned) {
+  if (!log_.is_open()) return;
+  log_ << trials_ << "," << cycle_time_ms_ << ","
+       << (static_cast<double>(fusion_threshold_) / (1024 * 1024)) << ","
+       << (cache_enabled_ ? 1 : 0) << "," << score << ","
+       << optimizer_.best_score() << "," << (pinned ? 1 : 0) << "\n";
+  log_.flush();
+}
+
+TunedParams ParameterManager::Current() const {
+  TunedParams p;
+  p.present = true;
+  p.tuning = active_;
+  p.cycle_time_ms = cycle_time_ms_;
+  p.fusion_threshold = fusion_threshold_;
+  p.cache_enabled = cache_enabled_;
+  return p;
+}
+
+}  // namespace hvd
