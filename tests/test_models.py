@@ -61,6 +61,30 @@ def test_train_step_runs_and_learns(hvd, mesh8):
     assert losses[-1] < losses[0]
 
 
+def test_benchmark_reports_flops_and_efficiency(hvd, monkeypatch):
+    """run_synthetic_benchmark must report FLOPs (XLA cost analysis) and
+    run_scaling_efficiency must compute the 1-vs-N ratio — the metric
+    BASELINE.md anchors on (reference README.rst:75)."""
+    from horovod_tpu.benchmark import (run_scaling_efficiency,
+                                       run_synthetic_benchmark)
+
+    monkeypatch.delenv("BENCH_PEAK_TFLOPS", raising=False)
+    res = run_synthetic_benchmark(
+        "resnet18", batch_size=2, image_size=32, num_warmup_batches=1,
+        num_batches_per_iter=2, num_iters=2, verbose=False)
+    assert res["img_sec_per_chip"] > 0
+    assert res["flops_per_step"] and res["flops_per_step"] > 1e8
+    assert res["tflops_per_chip"] and res["tflops_per_chip"] > 0
+    assert res["mfu"] is None  # CPU mesh: no peak -> no MFU claim
+
+    eff = run_scaling_efficiency(
+        "resnet18", batch_size=2, image_size=32, n_devices=8,
+        num_warmup_batches=1, num_batches_per_iter=2, num_iters=2,
+        verbose=False)
+    assert eff["n_devices"] == 8
+    assert 0 < eff["scaling_efficiency"] <= 1.5  # plumbing, not perf, on CPU
+
+
 def test_graft_entry_single_chip(hvd):
     import __graft_entry__ as ge
 
