@@ -1,0 +1,110 @@
+"""Shared Keras integration impl (reference ``horovod/_keras/__init__.py``).
+
+The reference targets Keras 2, whose optimizers expose ``get_gradients``;
+it overrides that to allreduce (``_keras/__init__.py:20-80``).  Keras 3
+(this image) removed ``get_gradients`` — the single choke point every
+training path goes through is ``Optimizer.apply_gradients`` (both
+``model.fit``'s train_step and custom loops call it), so the distributed
+wrapper intercepts there: allreduce the gradients, then hand the averaged
+set to the wrapped class.
+
+Works with any Keras 3 backend: with the TensorFlow backend the allreduce
+rides ``horovod_tpu.tensorflow`` (py_function inside the traced train
+step); with the JAX backend Keras runs the step jitted and per-op
+collectives cannot be injected mid-graph, so wrapping raises with a
+pointer at the native JAX API (``horovod_tpu.DistributedOptimizer`` /
+``make_training_step``), which is the TPU-idiomatic path anyway.
+"""
+
+from __future__ import annotations
+
+
+def make_distributed_optimizer_class(keras, base_cls, name=None,
+                                     compression=None,
+                                     sparse_as_dense=False):
+    """Build a distributed subclass of ``base_cls`` with the same class
+    name, so saved models restore without horovod installed (reference
+    trick, ``_keras/__init__.py:75-82``) — and, being a real class with
+    ``from_config``, it can be registered as a Keras 3 custom object for
+    ``load_model``."""
+    backend = keras.backend.backend()
+    if backend != "tensorflow":
+        raise ValueError(
+            f"horovod_tpu.keras.DistributedOptimizer supports the "
+            f"TensorFlow Keras backend (got {backend!r}). For the JAX "
+            f"backend use the native API: horovod_tpu.DistributedOptimizer "
+            f"/ horovod_tpu.make_training_step, which jits collectives "
+            f"into the step instead of injecting them per-op.")
+
+    import tensorflow as tf
+    import horovod_tpu.tensorflow as hvd
+
+    if compression is None:
+        compression = hvd.Compression.none
+
+    class _DistributedOptimizer(keras.optimizers.Optimizer):
+        _hvd_wrapped = True
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            grads_and_vars = list(grads_and_vars)
+            if hvd.size() > 1 and grads_and_vars:
+                grads, variables = zip(*grads_and_vars)
+                scope = name or "Distributed%s" % self.__class__.__name__
+                with tf.name_scope(scope + "_Allreduce"):
+                    avg = []
+                    for i, g in enumerate(grads):
+                        if g is None:
+                            avg.append(None)
+                            continue
+                        if sparse_as_dense and isinstance(g, tf.IndexedSlices):
+                            g = tf.convert_to_tensor(g)
+                        avg.append(hvd.allreduce(
+                            g, compression=compression,
+                            name=f"{scope}.grad.{i}"))
+                grads_and_vars = list(zip(avg, variables))
+            return super(self.__class__, self).apply_gradients(
+                grads_and_vars, *args, **kwargs)
+
+    return type(base_cls.__name__, (base_cls,),
+                dict(_DistributedOptimizer.__dict__))
+
+
+def create_distributed_optimizer(keras, optimizer, name=None,
+                                 compression=None, sparse_as_dense=False):
+    """Wrap an optimizer *instance*: subclass its class, rebuild from its
+    config (reference ``_keras/__init__.py:75-82``)."""
+    cls = make_distributed_optimizer_class(
+        keras, optimizer.__class__, name=name, compression=compression,
+        sparse_as_dense=sparse_as_dense)
+    return cls.from_config(optimizer.get_config())
+
+
+def load_model(keras, wrap_optimizer, filepath, custom_optimizers=None,
+               custom_objects=None, **kwargs):
+    """Load a model saved with a wrapped optimizer (reference
+    ``_keras/__init__.py:107-123``): register distributed wrappers for all
+    built-in (and user-supplied) optimizer classes as custom objects so the
+    deserialized optimizer comes back wrapped."""
+    def _all_subclasses(cls):
+        # AdamW subclasses Adam, not Optimizer directly — walk transitively.
+        out = set()
+        for sub in cls.__subclasses__():
+            out.add(sub)
+            out |= _all_subclasses(sub)
+        return out
+
+    horovod_objects = {}
+    for subclass in _all_subclasses(keras.optimizers.Optimizer):
+        if subclass.__module__.startswith("keras"):
+            wrapped = wrap_optimizer(subclass)
+            # Keras 3 deserializes by class name; the reference era used
+            # lowercase registrations — accept both.
+            horovod_objects[subclass.__name__] = wrapped
+            horovod_objects[subclass.__name__.lower()] = wrapped
+    if custom_optimizers is not None:
+        horovod_objects.update({
+            cls.__name__: wrap_optimizer(cls) for cls in custom_optimizers})
+    if custom_objects is not None:
+        horovod_objects.update(custom_objects)
+    return keras.models.load_model(filepath,
+                                   custom_objects=horovod_objects, **kwargs)

@@ -1,0 +1,178 @@
+"""Keras callback implementations (reference ``horovod/_keras/callbacks.py``).
+
+Backend-agnostic redesign: the reference impls drive TF session/eager ops;
+these operate on the numpy plane (``model.get_weights`` / variable
+``assign``) and call the eager runtime directly, so they work with the
+TensorFlow *and* JAX Keras 3 backends — weight broadcast and metric
+averaging happen between steps, outside any traced graph, which is exactly
+where Horovod's callbacks run anyway (``on_batch_end`` / ``on_epoch_end``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from horovod_tpu import basics
+from horovod_tpu.ops import collective as _c
+
+
+def _bcast_np(arr, root_rank, name):
+    return _c._eager_broadcast(np.asarray(arr), root_rank, name)
+
+
+class BroadcastGlobalVariablesCallbackImpl:
+    """Broadcast model + optimizer state from root after the first batch
+    (reference ``_keras/callbacks.py:20-43``): run once, after any
+    deferred variable creation, so restored/random init is consistent."""
+
+    def __init__(self, root_rank=0, device='', *args):
+        super().__init__(*args)
+        self.root_rank = root_rank
+        self.device = device   # parity-only; placement is XLA's job on TPU
+        self.broadcast_done = False
+
+    def on_batch_end(self, batch, logs=None):
+        if self.broadcast_done:
+            return
+        weights = self.model.get_weights()
+        self.model.set_weights([
+            _bcast_np(w, self.root_rank, f"keras.bcast.model.{i}")
+            for i, w in enumerate(weights)])
+        opt = getattr(self.model, "optimizer", None)
+        variables = getattr(opt, "variables", None)
+        if callable(variables):   # Keras 2 style method
+            variables = variables()
+        if variables:
+            for i, v in enumerate(variables):
+                v.assign(_bcast_np(np.asarray(v), self.root_rank,
+                                   f"keras.bcast.opt.{i}"))
+        self.broadcast_done = True
+
+
+class MetricAverageCallbackImpl:
+    """Average epoch-end metric logs across ranks in place (reference
+    ``_keras/callbacks.py:45-82``), sorted by name for deterministic
+    cross-rank wire order."""
+
+    def __init__(self, device='', *args):
+        super().__init__(*args)
+        self.device = device
+
+    def _average_metrics_in_place(self, logs):
+        logs = logs or {}
+        for metric, value in sorted(logs.items()):
+            if not np.isscalar(value) and not isinstance(value, np.ndarray):
+                continue
+            out = _c._eager_allreduce(
+                np.asarray(value, dtype=np.float64), _c.Average,
+                f"keras.metric.{metric}", 1.0, 1.0)
+            logs[metric] = float(np.asarray(out))
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._average_metrics_in_place(logs)
+
+
+class LearningRateScheduleCallbackImpl:
+    """Scale the optimizer LR by ``multiplier(epoch)`` inside
+    [start_epoch, end_epoch), with momentum correction (reference
+    ``_keras/callbacks.py:85-160``)."""
+
+    def __init__(self, multiplier, start_epoch=0, end_epoch=None,
+                 staircase=True, momentum_correction=True,
+                 steps_per_epoch=None, *args):
+        super().__init__(*args)
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.initial_lr = None
+        self.restore_momentum = None
+        self.steps_per_epoch = steps_per_epoch
+        self.current_epoch = 0
+        if not callable(multiplier):
+            self.staircase = True
+            self.multiplier = lambda epoch: multiplier
+        else:
+            self.multiplier = multiplier
+
+    # -- LR plumbing (Keras 3 exposes learning_rate as a Variable) --------
+    def _get_lr(self):
+        return float(np.asarray(self.model.optimizer.learning_rate))
+
+    def _set_lr(self, value):
+        self.model.optimizer.learning_rate = value
+
+    def _autodetect_steps_per_epoch(self):
+        if self.params.get("steps"):
+            return self.params["steps"]
+        if self.params.get("samples") and self.params.get("batch_size"):
+            return self.params["samples"] // self.params["batch_size"]
+        raise ValueError(
+            "Could not autodetect the number of steps per epoch. Please "
+            "specify the steps_per_epoch parameter to %s()"
+            % self.__class__.__name__)
+
+    def _adjust_learning_rate(self, epoch):
+        old_lr = self._get_lr()
+        new_lr = self.initial_lr * self.multiplier(epoch)
+        self._set_lr(new_lr)
+        opt = self.model.optimizer
+        if self.momentum_correction and hasattr(opt, "momentum"):
+            # Momentum correction (Goyal et al. 2017, as in the reference):
+            # rescale accumulated momentum when LR changes mid-run.
+            self.restore_momentum = float(np.asarray(opt.momentum))
+            opt.momentum = self.restore_momentum * new_lr / old_lr
+
+    def _restore_momentum_if_needed(self):
+        if self.restore_momentum:
+            self.model.optimizer.momentum = self.restore_momentum
+            self.restore_momentum = None
+
+    def on_train_begin(self, logs=None):
+        self.initial_lr = self._get_lr()
+        if not self.staircase and not self.steps_per_epoch:
+            self.steps_per_epoch = self._autodetect_steps_per_epoch()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+
+    def on_batch_begin(self, batch, logs=None):
+        if (self.current_epoch < self.start_epoch or
+                (self.end_epoch is not None and
+                 self.current_epoch >= self.end_epoch)):
+            return
+        if self.staircase and batch == 0:
+            self._adjust_learning_rate(self.current_epoch)
+        elif not self.staircase:
+            epoch = self.current_epoch + float(batch) / self.steps_per_epoch
+            self._adjust_learning_rate(epoch)
+
+    def on_batch_end(self, batch, logs=None):
+        self._restore_momentum_if_needed()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            logs["lr"] = self._get_lr()
+
+
+class LearningRateWarmupCallbackImpl(LearningRateScheduleCallbackImpl):
+    """Gradual warmup from lr/size to lr over ``warmup_epochs`` (reference
+    ``_keras/callbacks.py:163-185``, Goyal et al. 2017)."""
+
+    def __init__(self, warmup_epochs=5, momentum_correction=True,
+                 steps_per_epoch=None, verbose=0, *args):
+        def multiplier(epoch):
+            epoch += 1.0 / self.steps_per_epoch
+            return 1.0 / basics.size() * (
+                epoch * (basics.size() - 1) / warmup_epochs + 1)
+        super().__init__(multiplier, start_epoch=0, end_epoch=warmup_epochs,
+                         staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch, *args)
+        self.verbose = verbose
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if epoch == self.end_epoch - 1 and self.verbose > 0:
+            print("\nEpoch %d: finished gradual learning rate warmup to %g."
+                  % (epoch + 1, self._get_lr()))

@@ -72,16 +72,18 @@ def _step_flops(compiled, model_name: str, global_bs: int,
 
     XLA's cost analysis reports the PER-DEVICE SPMD module (verified: an
     8-way-sharded program reports 1/8 of the single-device figure), so the
-    count is scaled by n_chips; the analytic fallback is global already."""
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        flops = float(ca.get("flops", 0.0))
-        if flops > 0:
-            return flops * n_chips
-    except Exception:
-        pass
+    count is scaled by n_chips; the analytic fallback is global already.
+    ``compiled=None`` requests the analytic estimate directly."""
+    if compiled is not None:
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            flops = float(ca.get("flops", 0.0))
+            if flops > 0:
+                return flops * n_chips
+        except Exception:
+            pass
     fwd = _FWD_GFLOPS_224.get(model_name)
     if fwd is None:
         return None
@@ -276,7 +278,25 @@ def run_scaling_efficiency(model_name: str = "resnet50",
     if n < 2:
         raise ValueError(f"scaling efficiency needs >= 2 devices, have {n}")
 
-    mesh_1 = build_mesh(axes=("data",), shape=(1,), devices=devices[:1])
+    # Baseline mesh: the FIRST device of every process.  On a single host
+    # that is one device; on a multi-host pod every process keeps an
+    # addressable device in the baseline mesh (a devices[:1] mesh would
+    # strand the other hosts — jax.device_put rejects shardings with no
+    # local device).  Efficiency is then img_sec_n / (growth * img_sec_base)
+    # where growth = n / len(baseline): weak scaling from one chip per host
+    # to all chips per host.
+    by_process: dict = {}
+    for d in devices[:n]:
+        by_process.setdefault(getattr(d, "process_index", 0), d)
+    base_devices = [by_process[k] for k in sorted(by_process)]
+    n_base = len(base_devices)
+    if n_base >= n:
+        raise ValueError(
+            f"scaling efficiency needs more total devices ({n}) than "
+            f"baseline devices ({n_base}; one per process)")
+
+    mesh_1 = build_mesh(axes=("data",), shape=(n_base,),
+                        devices=base_devices)
     mesh_n = build_mesh(axes=("data",), shape=(n,), devices=devices[:n])
 
     res_1 = run_synthetic_benchmark(model_name, batch_size, mesh=mesh_1,
@@ -284,15 +304,18 @@ def run_scaling_efficiency(model_name: str = "resnet50",
     res_n = run_synthetic_benchmark(model_name, batch_size, mesh=mesh_n,
                                     verbose=False, **bench_kwargs)
 
-    efficiency = res_n["img_sec_total"] / (n * res_1["img_sec_total"])
+    growth = n / n_base
+    efficiency = res_n["img_sec_total"] / (growth * res_1["img_sec_total"])
     if verbose:
-        print(f"1 device:  {res_1['img_sec_total']:.1f} img/sec", flush=True)
+        print(f"{n_base} device(s): {res_1['img_sec_total']:.1f} img/sec",
+              flush=True)
         print(f"{n} devices: {res_n['img_sec_total']:.1f} img/sec "
-              f"(perfect: {n * res_1['img_sec_total']:.1f})", flush=True)
+              f"(perfect: {growth * res_1['img_sec_total']:.1f})", flush=True)
         print(f"Scaling efficiency: {efficiency * 100:.1f}%", flush=True)
     return {
         "model": model_name,
         "n_devices": n,
+        "n_baseline_devices": n_base,
         "img_sec_1": res_1["img_sec_total"],
         "img_sec_n": res_n["img_sec_total"],
         "scaling_efficiency": efficiency,

@@ -1,0 +1,70 @@
+"""Keras binding (reference ``horovod/keras/__init__.py``).
+
+Public surface matches the reference: ``init/rank/size/...``,
+``DistributedOptimizer``, ``Compression``, ``load_model``, eager helpers
+``allreduce/allgather/broadcast`` on plain values, and the callbacks
+submodule.  Built for Keras 3 (see ``horovod_tpu/_keras/__init__.py`` for
+the apply_gradients-interception rationale).
+"""
+
+from __future__ import annotations
+
+import keras
+
+from horovod_tpu import _keras as _impl
+from horovod_tpu.basics import (  # noqa: F401
+    init, shutdown, is_initialized, rank, size, local_rank, local_size,
+    cross_rank, cross_size, mpi_threads_supported, mpi_built, mpi_enabled,
+    gloo_built, gloo_enabled, nccl_built, ddl_built, mlsl_built,
+    tpu_built, tpu_enabled,
+)
+from horovod_tpu.ops import collective as _c
+from horovod_tpu.keras import callbacks  # noqa: F401
+
+try:
+    from horovod_tpu.tensorflow import Compression
+except ImportError:  # JAX-backend Keras without TF installed
+    from horovod_tpu.ops.compression import Compression
+
+
+def DistributedOptimizer(optimizer, name=None, device_dense='',
+                         device_sparse='', compression=Compression.none,
+                         sparse_as_dense=False):
+    """Wrap a keras optimizer so ``apply_gradients`` averages gradients
+    across ranks first (reference ``keras/__init__.py:34-114``)."""
+    return _impl.create_distributed_optimizer(
+        keras, optimizer, name=name, compression=compression,
+        sparse_as_dense=sparse_as_dense)
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=Compression.none, **kwargs):
+    """Load a model saved with a DistributedOptimizer, re-wrapping the
+    deserialized optimizer (reference ``keras/__init__.py:117-150``)."""
+    def wrap_optimizer(cls):
+        return _impl.make_distributed_optimizer_class(
+            keras, cls, compression=compression)
+    return _impl.load_model(keras, wrap_optimizer, filepath,
+                            custom_optimizers, custom_objects, **kwargs)
+
+
+def allreduce(value, name=None, average=True):
+    """Average a plain value (np array / scalar) across ranks (reference
+    ``keras/__init__.py:153-163``)."""
+    import numpy as np
+    op = _c.Average if average else _c.Sum
+    return _c._eager_allreduce(np.asarray(value), op,
+                               _c._auto_name("keras.allreduce", name),
+                               1.0, 1.0)
+
+
+def allgather(value, name=None):
+    import numpy as np
+    return _c._eager_allgather(np.asarray(value),
+                               _c._auto_name("keras.allgather", name))
+
+
+def broadcast(value, root_rank=0, name=None):
+    import numpy as np
+    return _c._eager_broadcast(np.asarray(value), root_rank,
+                               _c._auto_name("keras.broadcast", name))

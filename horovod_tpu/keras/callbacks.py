@@ -1,0 +1,51 @@
+"""Keras callbacks (reference ``horovod/keras/callbacks.py``): thin
+keras.callbacks.Callback shells over the shared impls in
+``horovod_tpu/_keras/callbacks.py``."""
+
+from __future__ import annotations
+
+import keras
+
+from horovod_tpu._keras import callbacks as _impl
+
+
+class BroadcastGlobalVariablesCallback(
+        _impl.BroadcastGlobalVariablesCallbackImpl, keras.callbacks.Callback):
+    """Broadcast initial model/optimizer state from ``root_rank`` on the
+    first batch (reference ``keras/callbacks.py:28-48``)."""
+
+    def __init__(self, root_rank=0, device=''):
+        super().__init__(root_rank, device)
+
+
+class MetricAverageCallback(_impl.MetricAverageCallbackImpl,
+                            keras.callbacks.Callback):
+    """Average epoch metrics across ranks before other callbacks (e.g.
+    checkpointing/early stopping) see them (reference
+    ``keras/callbacks.py:51-65``)."""
+
+    def __init__(self, device=''):
+        super().__init__(device)
+
+
+class LearningRateScheduleCallback(_impl.LearningRateScheduleCallbackImpl,
+                                   keras.callbacks.Callback):
+    """Epoch/step LR schedule with momentum correction (reference
+    ``keras/callbacks.py:68-107``)."""
+
+    def __init__(self, multiplier, start_epoch=0, end_epoch=None,
+                 staircase=True, momentum_correction=True,
+                 steps_per_epoch=None):
+        super().__init__(multiplier, start_epoch, end_epoch, staircase,
+                         momentum_correction, steps_per_epoch)
+
+
+class LearningRateWarmupCallback(_impl.LearningRateWarmupCallbackImpl,
+                                 keras.callbacks.Callback):
+    """Linear LR warmup from lr/size to lr (reference
+    ``keras/callbacks.py:110-159``)."""
+
+    def __init__(self, warmup_epochs=5, momentum_correction=True,
+                 steps_per_epoch=None, verbose=0):
+        super().__init__(warmup_epochs, momentum_correction,
+                         steps_per_epoch, verbose)

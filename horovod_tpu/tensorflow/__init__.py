@@ -1,0 +1,397 @@
+"""TensorFlow 2 binding: Horovod's TF API over the TPU-native eager runtime.
+
+Reference equivalents: ``horovod/tensorflow/mpi_ops.cc`` (async kernels
+:276-463), ``horovod/tensorflow/mpi_ops.py`` (op wrappers + registered
+gradients :85-180), ``horovod/tensorflow/__init__.py`` (``allreduce`` with
+IndexedSlices path :38-83, ``broadcast_variables`` :104-117,
+``BroadcastGlobalVariablesHook`` :159-192, ``_DistributedOptimizer``
+:230-320, ``DistributedGradientTape`` :323-376).
+
+TPU-native redesign: the reference registers custom TF kernels that enqueue
+into the MPI background thread.  Here TF tensors ride the eager plane (the
+native TCP runtime) through ``tf.py_function`` — which executes eagerly even
+inside a ``tf.function`` graph, giving one code path for both eager and
+graph mode — and gradients are attached with ``tf.custom_gradient`` rather
+than ``ops.RegisterGradient``.  The TPU compute path proper is JAX/XLA
+(``horovod_tpu`` SPMD API); this binding exists so TF user code keeps
+working unchanged, same contract as the torch binding.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import tensorflow as tf
+
+from horovod_tpu import basics
+from horovod_tpu.basics import (  # noqa: F401  (API parity re-exports)
+    init, shutdown, is_initialized, rank, size, local_rank, local_size,
+    cross_rank, cross_size, mpi_threads_supported, mpi_built, mpi_enabled,
+    gloo_built, gloo_enabled, nccl_built, ddl_built, mlsl_built,
+    tpu_built, tpu_enabled,
+)
+from horovod_tpu.ops import collective as _c
+from horovod_tpu.ops.collective import (  # noqa: F401
+    Average, Sum, Adasum, Min, Max, join,
+)
+
+
+class Compression:
+    """Gradient wire compression (reference ``tensorflow/compression.py``)."""
+
+    class none:
+        @staticmethod
+        def compress(tensor):
+            return tensor, None
+
+        @staticmethod
+        def decompress(tensor, ctx):
+            return tensor
+
+    class fp16:
+        @staticmethod
+        def compress(tensor):
+            if tensor.dtype in (tf.float32, tf.float64):
+                return tf.cast(tensor, tf.float16), tensor.dtype
+            return tensor, None
+
+        @staticmethod
+        def decompress(tensor, ctx):
+            return tensor if ctx is None else tf.cast(tensor, ctx)
+
+
+def _py_collective(fn, inputs, out_dtype, name):
+    """Run a numpy-plane collective as a TF op.
+
+    ``tf.py_function`` executes its body eagerly at step-run time even when
+    traced into a ``tf.function`` graph — the moral equivalent of the
+    reference's AsyncOpKernel enqueue (``tensorflow/mpi_ops.cc:276-433``):
+    the graph node is a placeholder, the real work happens against live
+    data.  ``name`` is fixed at trace time, so every rank's graph issues the
+    same wire name in the same order (SPMD discipline, enforced by the
+    controller's cross-rank validation).
+    """
+    return tf.py_function(fn, inputs, Tout=out_dtype, name=name.replace(".", "_"))
+
+
+def _allreduce(tensor, name=None, op=None, prescale_factor=1.0,
+               postscale_factor=1.0):
+    """Low-level allreduce on a dense tf.Tensor (reference
+    ``tensorflow/mpi_ops.py:62-100``).  Gradient of a sum-allreduce is a
+    sum-allreduce of the upstream gradient (``mpi_ops.py:89-100``)."""
+    basics._check_initialized()
+    rop = _c._resolve_op(op, None) if op is not None else Sum
+    nm = _c._auto_name("allreduce", name)
+
+    @tf.custom_gradient
+    def fn(x):
+        def run(v):
+            return tf.convert_to_tensor(_c._eager_allreduce(
+                v.numpy(), rop, nm, prescale_factor, postscale_factor))
+
+        out = _py_collective(run, [x], x.dtype, nm)
+        out.set_shape(x.shape)
+
+        def grad(dy):
+            return _allreduce(dy, name=nm + ".grad", op=Sum)
+
+        return out, grad
+
+    return fn(tf.convert_to_tensor(tensor))
+
+
+def allreduce(tensor, average=True, device_dense='', device_sparse='',
+              compression=Compression.none, name=None, op=None,
+              prescale_factor=1.0, postscale_factor=1.0):
+    """Allreduce a tf.Tensor or tf.IndexedSlices (reference
+    ``tensorflow/__init__.py:38-83``): IndexedSlices becomes an allgather of
+    values+indices; dense rides compression → allreduce → decompress, with
+    the average applied after the sum.  ``device_*`` args are accepted for
+    API parity and ignored (placement is XLA's job on TPU)."""
+    if isinstance(tensor, tf.IndexedSlices):
+        values = allgather(tensor.values)
+        indices = allgather(tensor.indices)
+        if average:
+            values = values / tf.cast(size(), values.dtype)
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=tensor.dense_shape)
+    tensor = tf.convert_to_tensor(tensor)
+    if op is None and average:
+        op = Average
+    if op is Average:
+        # Sum on the wire, divide locally — same math as the reference
+        # (divide after _allreduce, tensorflow/__init__.py:82).
+        summed = allreduce(tensor, average=False, compression=compression,
+                           name=name, op=Sum,
+                           prescale_factor=prescale_factor,
+                           postscale_factor=postscale_factor)
+        return summed / tf.cast(size(), tensor.dtype)
+    compressed, ctx = compression.compress(tensor)
+    out = _allreduce(compressed, name=name, op=op or Sum,
+                     prescale_factor=prescale_factor,
+                     postscale_factor=postscale_factor)
+    return compression.decompress(out, ctx)
+
+
+def allgather(tensor, name=None):
+    """Concatenate tensors from all ranks on dim 0; dim 0 may differ per
+    rank (reference ``tensorflow/mpi_ops.py:103-145``).  Gradient:
+    allreduce the upstream gradient, then slice out this rank's rows."""
+    basics._check_initialized()
+    nm = _c._auto_name("allgather", name)
+
+    @tf.custom_gradient
+    def fn(x):
+        def run(v):
+            return tf.convert_to_tensor(_c._eager_allgather(v.numpy(), nm))
+
+        out = _py_collective(run, [x], x.dtype, nm)
+        out.set_shape(tf.TensorShape([None]).concatenate(x.shape[1:]))
+
+        def grad(dy):
+            summed = _allreduce(dy, name=nm + ".grad", op=Sum)
+            # Per-rank dim-0 sizes, exchanged over the wire (reference
+            # mpi_ops.py:122-145 gathers d0 and splits).
+            d0 = tf.shape(x)[0:1]
+            sizes = allgather(tf.cast(d0, tf.int32), name=nm + ".grad.sizes")
+            sizes = tf.reshape(sizes, [size()])
+            splits = tf.split(summed, num_or_size_splits=sizes, axis=0)
+            return splits[rank()]
+
+        return out, grad
+
+    return fn(tf.convert_to_tensor(tensor))
+
+
+def broadcast(tensor, root_rank, name=None):
+    """Broadcast from ``root_rank`` (reference
+    ``tensorflow/mpi_ops.py:148-180``).  Gradient: allreduce to the root;
+    zero elsewhere."""
+    basics._check_initialized()
+    nm = _c._auto_name("broadcast", name)
+
+    @tf.custom_gradient
+    def fn(x):
+        def run(v):
+            return tf.convert_to_tensor(
+                _c._eager_broadcast(v.numpy(), root_rank, nm))
+
+        out = _py_collective(run, [x], x.dtype, nm)
+        out.set_shape(x.shape)
+
+        def grad(dy):
+            reduced = _allreduce(dy, name=nm + ".grad", op=Sum)
+            if rank() != root_rank:
+                return reduced * 0
+            return reduced
+
+        return out, grad
+
+    return fn(tf.convert_to_tensor(tensor))
+
+
+def alltoall(tensor, splits=None, name=None):
+    """Scatter slices of ``tensor`` to every rank and gather theirs
+    (beyond-reference op; the reference era had no alltoall)."""
+    basics._check_initialized()
+    nm = _c._auto_name("alltoall", name)
+    tensor = tf.convert_to_tensor(tensor)
+
+    def run(v):
+        return tf.convert_to_tensor(
+            _c._eager_alltoall(v.numpy(), splits, nm))
+
+    out = _py_collective(run, [tensor], tensor.dtype, nm)
+    out.set_shape(tf.TensorShape([None]).concatenate(tensor.shape[1:]))
+    return out
+
+
+def reducescatter(tensor, op=None, name=None):
+    basics._check_initialized()
+    rop = _c._resolve_op(op, None)
+    nm = _c._auto_name("reducescatter", name)
+    tensor = tf.convert_to_tensor(tensor)
+
+    def run(v):
+        return tf.convert_to_tensor(_c._eager_reducescatter(v.numpy(), rop, nm))
+
+    out = _py_collective(run, [tensor], tensor.dtype, nm)
+    out.set_shape(tf.TensorShape([None]).concatenate(tensor.shape[1:]))
+    return out
+
+
+def broadcast_object(obj, root_rank=0, name=None):
+    return _c.broadcast_object(obj, root_rank=root_rank, name=name)
+
+
+def allgather_object(obj, name=None):
+    return _c.allgather_object(obj, name=name)
+
+
+# ---------------------------------------------------------------------------
+# Variable broadcast (reference tensorflow/__init__.py:88-192)
+# ---------------------------------------------------------------------------
+
+def broadcast_variables(variables, root_rank=0):
+    """Assign every variable the root rank's value (reference
+    ``broadcast_variables``, ``tensorflow/__init__.py:104-117``).  Used for
+    consistent init and checkpoint-restore fan-out (§5.4 of the survey)."""
+    for i, var in enumerate(variables):
+        vname = getattr(var, "name", None) or f"var.{i}"
+        var.assign(broadcast(var, root_rank,
+                             name=f"broadcast_variables.{vname}"))
+
+
+def broadcast_global_variables(root_rank=0):
+    """TF1-compat: broadcast the default graph's global variables
+    (reference ``tensorflow/__init__.py:125-140``)."""
+    if tf.executing_eagerly():
+        raise RuntimeError(
+            "hvd.broadcast_global_variables() does not support eager "
+            "execution. Please use `hvd.broadcast_variables(<model/optimizer "
+            "variables>)` instead.")
+    return broadcast_variables(tf.compat.v1.global_variables(), root_rank)
+
+
+try:
+    _SessionRunHook = tf.compat.v1.train.SessionRunHook
+except AttributeError:  # estimator surface removed in a future TF
+    _SessionRunHook = None
+
+if _SessionRunHook is not None:
+    class BroadcastGlobalVariablesHook(_SessionRunHook):
+        """SessionRunHook broadcasting global variables once after session
+        creation (reference ``tensorflow/__init__.py:159-192``)."""
+
+        def __init__(self, root_rank=0, device=''):
+            super().__init__()
+            self.root_rank = root_rank
+            self.device = device  # parity-only; placement is XLA's job
+            self.bcast_op = None
+
+        def begin(self):
+            if (not self.bcast_op or
+                    self.bcast_op.graph != tf.compat.v1.get_default_graph()):
+                self.bcast_op = broadcast_global_variables(self.root_rank)
+
+        def after_create_session(self, session, coord):
+            session.run(self.bcast_op)
+
+
+# ---------------------------------------------------------------------------
+# Gradient aggregation wrappers (reference tensorflow/__init__.py:195-376)
+# ---------------------------------------------------------------------------
+
+def _make_allreduce_grads_fn(name, compression, sparse_as_dense):
+    """Shared grads→averaged-grads transform (reference
+    ``_make_allreduce_grads_fn``, ``tensorflow/__init__.py:195-216``)."""
+    def allreduce_grads(grads):
+        with tf.name_scope(name + "_Allreduce"):
+            if sparse_as_dense:
+                grads = [tf.convert_to_tensor(g)
+                         if g is not None and isinstance(g, tf.IndexedSlices)
+                         else g for g in grads]
+            return [allreduce(g, compression=compression,
+                              name=f"{name}.grad.{i}")
+                    if g is not None else g
+                    for i, g in enumerate(grads)]
+    return allreduce_grads
+
+
+class _DistributedGradientTape(tf.GradientTape):
+    def __init__(self, tape, compression, sparse_as_dense,
+                 persistent=False, watch_accessed_variables=True):
+        super(self.__class__, self).__init__(persistent,
+                                             watch_accessed_variables)
+        self._tape = tape
+        self._allreduce_grads = _make_allreduce_grads_fn(
+            "DistributedGradientTape", compression, sparse_as_dense)
+
+    def gradient(self, target, sources, output_gradients=None):
+        gradients = super(self.__class__, self).gradient(
+            target, sources, output_gradients)
+        if size() > 1:
+            return self._allreduce_grads(gradients)
+        return gradients
+
+
+def DistributedGradientTape(gradtape, device_dense='', device_sparse='',
+                            compression=Compression.none,
+                            sparse_as_dense=False):
+    """Wrap a ``tf.GradientTape`` so ``gradient()`` returns cross-rank
+    averages (reference ``tensorflow/__init__.py:323-376``; same dynamic
+    subclassing trick so user ``isinstance`` checks keep working)."""
+    cls = type(gradtape.__class__.__name__, (gradtape.__class__,),
+               dict(_DistributedGradientTape.__dict__))
+    if hasattr(gradtape, '_watch_accessed_variables'):
+        return cls(gradtape._tape, compression, sparse_as_dense,
+                   gradtape._persistent, gradtape._watch_accessed_variables)
+    return cls(gradtape._tape, compression, sparse_as_dense,
+               gradtape._persistent)
+
+
+try:
+    _LegacyOptimizer = tf.compat.v1.train.Optimizer
+except AttributeError:
+    _LegacyOptimizer = None
+
+if _LegacyOptimizer is not None:
+    class _DistributedOptimizer(_LegacyOptimizer):
+        """TF1-style optimizer wrapper: ``compute_gradients`` also
+        allreduces (reference ``tensorflow/__init__.py:230-320``)."""
+
+        def __init__(self, optimizer, name=None, use_locking=False,
+                     device_dense='', device_sparse='',
+                     compression=Compression.none, sparse_as_dense=False):
+            if name is None:
+                name = "Distributed{}".format(type(optimizer).__name__)
+            super(_DistributedOptimizer, self).__init__(
+                name=name, use_locking=use_locking)
+            self._optimizer = optimizer
+            self._allreduce_grads = _make_allreduce_grads_fn(
+                name, compression, sparse_as_dense)
+
+        def compute_gradients(self, *args, **kwargs):
+            gradients = self._optimizer.compute_gradients(*args, **kwargs)
+            if size() > 1:
+                grads, variables = zip(*gradients)
+                avg_grads = self._allreduce_grads(grads)
+                return list(zip(avg_grads, variables))
+            return gradients
+
+        def apply_gradients(self, *args, **kwargs):
+            return self._optimizer.apply_gradients(*args, **kwargs)
+
+        def get_slot(self, *args, **kwargs):
+            return self._optimizer.get_slot(*args, **kwargs)
+
+        def get_slot_names(self, *args, **kwargs):
+            return self._optimizer.get_slot_names(*args, **kwargs)
+
+        def variables(self, *args, **kwargs):
+            return self._optimizer.variables(*args, **kwargs)
+
+
+def DistributedOptimizer(optimizer, name=None, use_locking=False,
+                         device_dense='', device_sparse='',
+                         compression=Compression.none,
+                         sparse_as_dense=False):
+    """Wrap a TF1 legacy or Keras optimizer (reference
+    ``tensorflow/__init__.py:278-320`` dispatch)."""
+    if _LegacyOptimizer is not None and isinstance(optimizer,
+                                                   _LegacyOptimizer):
+        return _DistributedOptimizer(optimizer, name, use_locking,
+                                     device_dense, device_sparse,
+                                     compression, sparse_as_dense)
+    try:
+        import keras
+        is_keras = isinstance(optimizer, keras.optimizers.Optimizer)
+    except ImportError:
+        is_keras = False
+    if is_keras:
+        from horovod_tpu import keras as hvd_keras
+        return hvd_keras.DistributedOptimizer(
+            optimizer, name=name, compression=compression,
+            sparse_as_dense=sparse_as_dense)
+    raise ValueError(
+        "Provided optimizer doesn't inherit from either legacy TensorFlow "
+        "or Keras optimizer: %s" % optimizer)
