@@ -1,0 +1,169 @@
+"""PyTorch synthetic benchmark over the eager plane (BASELINE config #3;
+reference ``examples/pytorch_synthetic_benchmark.py``).
+
+Same shape as the reference: fixed fake ImageNet batch, DistributedOptimizer
+with per-parameter hooks, broadcast of params + optimizer state, img/sec
+over timed iterations.  torchvision is not required — a self-contained
+ResNet lives below (standard He-style residual architecture).
+
+Run: ``hvdrun -np 2 python examples/pytorch_synthetic_benchmark.py
+--model resnet18 --batch-size 8``
+"""
+
+import argparse
+import timeit
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+from torch import nn
+
+import horovod_tpu.torch as hvd
+
+
+# ---------------------------------------------------------------------------
+# Minimal ResNet family (torchvision is absent in this image)
+# ---------------------------------------------------------------------------
+
+class Bottleneck(nn.Module):
+    expansion = 4
+
+    def __init__(self, cin, width, stride=1):
+        super().__init__()
+        cout = width * self.expansion
+        self.conv1 = nn.Conv2d(cin, width, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(width)
+        self.conv2 = nn.Conv2d(width, width, 3, stride, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(width)
+        self.conv3 = nn.Conv2d(width, cout, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(cout)
+        self.down = None
+        if stride != 1 or cin != cout:
+            self.down = nn.Sequential(
+                nn.Conv2d(cin, cout, 1, stride, bias=False),
+                nn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        idt = x if self.down is None else self.down(x)
+        x = F.relu(self.bn1(self.conv1(x)))
+        x = F.relu(self.bn2(self.conv2(x)))
+        x = self.bn3(self.conv3(x))
+        return F.relu(x + idt)
+
+
+class BasicBlock(nn.Module):
+    expansion = 1
+
+    def __init__(self, cin, width, stride=1):
+        super().__init__()
+        cout = width * self.expansion
+        self.conv1 = nn.Conv2d(cin, width, 3, stride, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(width)
+        self.conv2 = nn.Conv2d(width, cout, 3, 1, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(cout)
+        self.down = None
+        if stride != 1 or cin != cout:
+            self.down = nn.Sequential(
+                nn.Conv2d(cin, cout, 1, stride, bias=False),
+                nn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        idt = x if self.down is None else self.down(x)
+        x = F.relu(self.bn1(self.conv1(x)))
+        x = self.bn2(self.conv2(x))
+        return F.relu(x + idt)
+
+
+class ResNet(nn.Module):
+    def __init__(self, block, layers, num_classes=1000):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2d(3, 64, 7, 2, 3, bias=False), nn.BatchNorm2d(64),
+            nn.ReLU(), nn.MaxPool2d(3, 2, 1))
+        cin, stages = 64, []
+        for i, (width, n) in enumerate(zip((64, 128, 256, 512), layers)):
+            for j in range(n):
+                stages.append(block(cin, width, 2 if (i and not j) else 1))
+                cin = width * block.expansion
+        self.stages = nn.Sequential(*stages)
+        self.head = nn.Linear(cin, num_classes)
+
+    def forward(self, x):
+        x = self.stages(self.stem(x))
+        x = x.mean((2, 3))
+        return self.head(x)
+
+
+MODELS = {
+    "resnet18": lambda: ResNet(BasicBlock, (2, 2, 2, 2)),
+    "resnet34": lambda: ResNet(BasicBlock, (3, 4, 6, 3)),
+    "resnet50": lambda: ResNet(Bottleneck, (3, 4, 6, 3)),
+    "resnet101": lambda: ResNet(Bottleneck, (3, 4, 23, 3)),
+    "resnet152": lambda: ResNet(Bottleneck, (3, 8, 36, 3)),
+}
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description="PyTorch Synthetic Benchmark",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    p.add_argument("--fp16-allreduce", action="store_true", default=False)
+    p.add_argument("--model", default="resnet50", choices=sorted(MODELS))
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--num-warmup-batches", type=int, default=10)
+    p.add_argument("--num-batches-per-iter", type=int, default=10)
+    p.add_argument("--num-iters", type=int, default=10)
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42)
+    torch.set_num_threads(max(1, torch.get_num_threads() // hvd.local_size()))
+
+    model = MODELS[args.model]()
+    optimizer = torch.optim.SGD(model.parameters(), lr=0.01)
+    compression = (hvd.Compression.fp16 if args.fp16_allreduce
+                   else hvd.Compression.none)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+        compression=compression)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    data = torch.randn(args.batch_size, 3, args.image_size, args.image_size)
+    target = torch.LongTensor(args.batch_size).random_() % 1000
+
+    def benchmark_step():
+        optimizer.zero_grad()
+        loss = F.cross_entropy(model(data), target)
+        loss.backward()
+        optimizer.step()
+
+    def log(s):
+        if hvd.rank() == 0:
+            print(s, flush=True)
+
+    log(f"Model: {args.model}")
+    log(f"Batch size: {args.batch_size}")
+    log(f"Number of CPUs: {hvd.size()}")
+
+    log("Running warmup...")
+    timeit.timeit(benchmark_step, number=args.num_warmup_batches)
+
+    log("Running benchmark...")
+    img_secs = []
+    for x in range(args.num_iters):
+        t = timeit.timeit(benchmark_step, number=args.num_batches_per_iter)
+        img_sec = args.batch_size * args.num_batches_per_iter / t
+        log("Iter #%d: %.1f img/sec per CPU" % (x, img_sec))
+        img_secs.append(img_sec)
+
+    img_sec_mean = np.mean(img_secs)
+    img_sec_conf = 1.96 * np.std(img_secs)
+    log("Img/sec per CPU: %.1f +-%.1f" % (img_sec_mean, img_sec_conf))
+    log("Total img/sec on %d CPU(s): %.1f +-%.1f" %
+        (hvd.size(), hvd.size() * img_sec_mean, hvd.size() * img_sec_conf))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,95 @@
+"""TF2 MNIST (BASELINE config #1's TF2 face; reference
+``examples/tensorflow2_mnist.py``).
+
+DistributedGradientTape training loop with rank-0 checkpointing.  Uses a
+deterministic synthetic MNIST-shaped dataset so the example is hermetic
+(no downloads) — swap in ``tf.keras.datasets.mnist`` when network access
+exists.
+
+Run: ``hvdrun -np 2 python examples/tensorflow2_mnist.py``
+"""
+
+import argparse
+import os
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+
+def synthetic_mnist(n, seed=0):
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, n).astype(np.int64)
+    images = rng.normal(0.0, 0.1, (n, 28, 28, 1)).astype(np.float32)
+    for i, d in enumerate(labels):
+        r, c = 4 + (d % 5) * 4, 4 + (d // 5) * 10
+        images[i, r:r + 6, c:c + 6, 0] += 1.0
+    return images, labels
+
+
+def main():
+    p = argparse.ArgumentParser(description="TF2 MNIST")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--lr", type=float, default=0.001)
+    p.add_argument("--checkpoint-dir", default="./checkpoints")
+    args = p.parse_args()
+
+    hvd.init()
+
+    # Different shards per rank (the reference shards by shuffle seed).
+    images, labels = synthetic_mnist(args.batch_size * 64, seed=hvd.rank())
+    dataset = (tf.data.Dataset.from_tensor_slices((images, labels))
+               .repeat().shuffle(4096, seed=hvd.rank())
+               .batch(args.batch_size))
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Conv2D(32, [3, 3], activation="relu"),
+        tf.keras.layers.MaxPooling2D(pool_size=(2, 2)),
+        tf.keras.layers.Conv2D(64, [3, 3], activation="relu"),
+        tf.keras.layers.MaxPooling2D(pool_size=(2, 2)),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(128, activation="relu"),
+        tf.keras.layers.Dense(10, activation="softmax"),
+    ])
+    loss_obj = tf.losses.SparseCategoricalCrossentropy()
+    # Horovod: scale LR by world size (reference tensorflow2_mnist.py:49).
+    opt = tf.optimizers.Adam(args.lr * hvd.size())
+    checkpoint = tf.train.Checkpoint(model=model, optimizer=opt)
+
+    @tf.function
+    def training_step(batch, batch_labels, first_batch):
+        with tf.GradientTape() as tape:
+            probs = model(batch, training=True)
+            loss = loss_obj(batch_labels, probs)
+        tape = hvd.DistributedGradientTape(tape)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        if first_batch:
+            hvd.broadcast_variables(model.variables, root_rank=0)
+            hvd.broadcast_variables(opt.variables, root_rank=0)
+        return loss
+
+    for step, (batch, batch_labels) in enumerate(
+            dataset.take(args.steps)):
+        loss = training_step(batch, batch_labels, step == 0)
+        if step % 50 == 0 and hvd.rank() == 0:
+            print(f"Step #{step}\tLoss: {float(loss):.6f}", flush=True)
+
+    # Horovod: checkpoint only on rank 0 to prevent clobbering (reference
+    # tensorflow2_mnist.py:83-86).
+    if hvd.rank() == 0:
+        os.makedirs(args.checkpoint_dir, exist_ok=True)
+        checkpoint.save(os.path.join(args.checkpoint_dir, "ckpt"))
+
+    logits = model(tf.constant(images[:512]), training=False)
+    acc = float(tf.reduce_mean(tf.cast(
+        tf.argmax(logits, -1) == tf.constant(labels[:512]), tf.float32)))
+    if hvd.rank() == 0:
+        print(f"train accuracy: {acc:.3f}", flush=True)
+    assert acc > 0.5, f"model failed to learn (acc={acc})"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,95 @@
+"""TF2 synthetic benchmark (BASELINE config #2's TF face; reference
+``examples/tensorflow2_synthetic_benchmark.py:86-132``).
+
+DistributedGradientTape over the eager plane with fixed fake data.  The
+TPU-native flagship is ``jax_synthetic_benchmark.py`` (SPMD, compiled
+end-to-end); this exists so a TF2 Horovod user's benchmark script ports
+verbatim.
+
+Run: ``hvdrun -np 2 python examples/tensorflow2_synthetic_benchmark.py
+--model resnet50 --batch-size 8``
+"""
+
+import argparse
+import timeit
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description="TensorFlow2 Synthetic Benchmark",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    p.add_argument("--fp16-allreduce", action="store_true", default=False)
+    p.add_argument("--model", default="ResNet50",
+                   help="any tf.keras.applications model name")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-warmup-batches", type=int, default=10)
+    p.add_argument("--num-batches-per-iter", type=int, default=10)
+    p.add_argument("--num-iters", type=int, default=10)
+    args = p.parse_args()
+
+    hvd.init()
+    tf.random.set_seed(42)
+
+    model = getattr(tf.keras.applications, args.model)(weights=None)
+    opt = tf.keras.optimizers.SGD(0.01)
+    compression = (hvd.Compression.fp16 if args.fp16_allreduce
+                   else hvd.Compression.none)
+
+    data = tf.random.uniform([args.batch_size, 224, 224, 3])
+    target = tf.random.uniform([args.batch_size, 1], minval=0, maxval=999,
+                               dtype=tf.int64)
+    loss_obj = tf.losses.SparseCategoricalCrossentropy()
+
+    @tf.function
+    def benchmark_step(first_batch):
+        with tf.GradientTape() as tape:
+            probs = model(data, training=True)
+            loss = loss_obj(target, probs)
+        # Horovod: wrap the tape so gradients are cross-rank averages
+        # (reference :99-101).
+        tape = hvd.DistributedGradientTape(tape, compression=compression)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        # Horovod: broadcast initial state after the first step, when all
+        # variables exist (reference :103-108).
+        if first_batch:
+            hvd.broadcast_variables(model.variables, root_rank=0)
+            hvd.broadcast_variables(opt.variables, root_rank=0)
+        return loss
+
+    def log(s):
+        if hvd.rank() == 0:
+            print(s, flush=True)
+
+    log(f"Model: {args.model}")
+    log(f"Batch size: {args.batch_size}")
+    log(f"Number of CPUs: {hvd.size()}")
+
+    log("Running warmup...")
+    benchmark_step(first_batch=True)
+    timeit.timeit(lambda: benchmark_step(first_batch=False),
+                  number=args.num_warmup_batches)
+
+    log("Running benchmark...")
+    img_secs = []
+    for x in range(args.num_iters):
+        t = timeit.timeit(lambda: benchmark_step(first_batch=False),
+                          number=args.num_batches_per_iter)
+        img_sec = args.batch_size * args.num_batches_per_iter / t
+        log("Iter #%d: %.1f img/sec per CPU" % (x, img_sec))
+        img_secs.append(img_sec)
+
+    img_sec_mean = np.mean(img_secs)
+    img_sec_conf = 1.96 * np.std(img_secs)
+    log("Img/sec per CPU: %.1f +-%.1f" % (img_sec_mean, img_sec_conf))
+    log("Total img/sec on %d CPU(s): %.1f +-%.1f" %
+        (hvd.size(), hvd.size() * img_sec_mean, hvd.size() * img_sec_conf))
+
+
+if __name__ == "__main__":
+    main()

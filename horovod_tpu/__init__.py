@@ -79,6 +79,7 @@ from horovod_tpu.ops.collective import (
     join,
 )
 from horovod_tpu.ops.compression import Compression
+from horovod_tpu import checkpoint  # noqa: F401  (hvd.checkpoint.save/restore)
 from horovod_tpu.parallel.data import (
     DistributedOptimizer,
     DistributedGradientTape,
@@ -108,7 +109,7 @@ __all__ = [
     "reducescatter", "alltoall",
     "synchronize", "poll", "join",
     # training
-    "Compression",
+    "Compression", "checkpoint",
     "DistributedOptimizer", "DistributedGradientTape", "make_training_step",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_variables",
 ]

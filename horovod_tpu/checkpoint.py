@@ -1,0 +1,117 @@
+"""Checkpoint save/restore with rank-0-writes + broadcast consistency.
+
+The reference has no checkpoint subsystem; its convention (SURVEY §5.4)
+is "rank 0 writes framework checkpoints; on start, restore on rank 0 and
+broadcast state to all ranks" — ``BroadcastGlobalVariablesHook``
+(reference ``tensorflow/__init__.py:159-192``), torch
+``broadcast_parameters``/``broadcast_optimizer_state``
+(``torch/__init__.py:255-403``), and every example gates ``checkpoint_dir``
+on ``hvd.rank() == 0`` (``examples/tensorflow_mnist.py:144``).
+
+This module makes that convention a first-class API for JAX/flax/optax
+training state, backed by orbax (the TPU-ecosystem checkpointer):
+
+    state = {"params": params, "opt_state": opt_state, "step": step}
+    hvd.checkpoint.save(ckpt_dir, state, step=step)       # rank 0 only
+    state = hvd.checkpoint.restore(ckpt_dir, state)       # restore+broadcast
+
+``restore`` reads on rank 0 and broadcasts every leaf over the eager
+plane, so all ranks resume bit-identical even if their local filesystems
+diverge — the same consistency guarantee the reference gets from
+``BroadcastGlobalVariablesCallback``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from horovod_tpu import basics
+from horovod_tpu.ops import collective as _c
+from horovod_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def _tree_broadcast(tree: Any, root_rank: int, name_prefix: str) -> Any:
+    """Broadcast every array leaf of a pytree from ``root_rank``, keyed by
+    its tree path so wire names agree across ranks."""
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    treedef = jax.tree_util.tree_structure(tree)
+    out_leaves = []
+    for path, leaf in leaves_with_paths:
+        key = name_prefix + jax.tree_util.keystr(path)
+        arr = np.asarray(leaf)
+        out = _c._eager_broadcast(arr, root_rank, key)
+        # preserve jax vs numpy leaf type and dtype
+        if isinstance(leaf, jax.Array):
+            import jax.numpy as jnp
+            out = jnp.asarray(out, dtype=leaf.dtype)
+        else:
+            out = np.asarray(out, dtype=arr.dtype)
+        out_leaves.append(out)
+    return jax.tree_util.tree_unflatten(treedef, out_leaves)
+
+
+def save(ckpt_dir: str, state: Any, step: int = 0,
+         max_to_keep: Optional[int] = None) -> Optional[str]:
+    """Write ``state`` (a pytree) to ``ckpt_dir/<step>``; rank 0 only, all
+    ranks barrier afterwards so no rank races ahead and reads a
+    half-written checkpoint.  Returns the checkpoint path on rank 0,
+    None elsewhere."""
+    path = None
+    if basics.rank() == 0:
+        import orbax.checkpoint as ocp
+        ckpt_dir = os.path.abspath(ckpt_dir)
+        with ocp.CheckpointManager(
+                ckpt_dir,
+                options=ocp.CheckpointManagerOptions(
+                    max_to_keep=max_to_keep)) as mgr:
+            mgr.save(step, args=ocp.args.StandardSave(state))
+        path = os.path.join(ckpt_dir, str(step))
+        log.info("checkpoint step %d written to %s", step, path)
+    if basics.size() > 1:
+        rt = basics.runtime()
+        if rt is not None:
+            rt.barrier(f"hvd.checkpoint.save.{step}")
+    return path
+
+
+def restore(ckpt_dir: str, state_template: Any,
+            step: Optional[int] = None, root_rank: int = 0) -> Any:
+    """Restore the latest (or ``step``-th) checkpoint on ``root_rank`` and
+    broadcast it to every rank.  ``state_template`` supplies the pytree
+    structure/shapes/dtypes (pass the freshly-initialized state)."""
+    state = state_template
+    found = np.zeros(1, np.int32)
+    if basics.rank() == root_rank:
+        import orbax.checkpoint as ocp
+        ckpt_dir = os.path.abspath(ckpt_dir)
+        with ocp.CheckpointManager(ckpt_dir) as mgr:
+            use_step = step if step is not None else mgr.latest_step()
+            if use_step is not None:
+                state = mgr.restore(
+                    use_step, args=ocp.args.StandardRestore(state_template))
+                found[0] = 1
+                log.info("restored checkpoint step %s from %s",
+                         use_step, ckpt_dir)
+    if basics.size() > 1:
+        found = _c._eager_broadcast(found, root_rank,
+                                    "hvd.checkpoint.restore.found")
+        if int(found[0]):
+            state = _tree_broadcast(state, root_rank,
+                                    "hvd.checkpoint.restore")
+    return state
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    """Highest checkpoint step present in ``ckpt_dir`` (local read; no
+    collective)."""
+    import orbax.checkpoint as ocp
+    if not os.path.isdir(ckpt_dir):
+        return None
+    with ocp.CheckpointManager(os.path.abspath(ckpt_dir)) as mgr:
+        return mgr.latest_step()

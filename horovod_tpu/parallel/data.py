@@ -223,4 +223,15 @@ def make_training_step(loss_fn: Callable,
         in_specs=(replicated, replicated, sharded_batch),
         out_specs=(replicated, replicated, replicated),
         check_vma=False)
-    return jax.jit(smapped, donate_argnums=(0, 1) if donate else ())
+    jitted = jax.jit(smapped, donate_argnums=(0, 1) if donate else ())
+
+    # Expose the wrapped chain's init so callers don't have to rebuild the
+    # distributed_gradients∘optimizer chain themselves:
+    #   step = hvd.make_training_step(loss_fn, opt, mesh)
+    #   opt_state = step.init(params)
+    def step(params, opt_state, batch):
+        return jitted(params, opt_state, batch)
+
+    step.init = dist_opt.init
+    step.jitted = jitted   # AOT access (.lower/.compile) when needed
+    return step

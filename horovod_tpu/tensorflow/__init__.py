@@ -59,6 +59,23 @@ class Compression:
             return tensor if ctx is None else tf.cast(tensor, ctx)
 
 
+import re as _re
+
+# Grappler's dependency optimizer prunes the control-dependency chain that
+# _py_collective builds between collectives (verified: with it enabled the
+# chain exists in the traced FuncGraph but runtime execution interleaves;
+# with only this pass off, ordering holds).  Without the chain, two ranks
+# can block inside *different* collectives and deadlock — see
+# _py_collective's docstring.  Scoped to the process, set at import like
+# the reference sets graph-level options in its op library load.
+tf.config.optimizer.set_experimental_options(
+    {"dependency_optimization": False})
+
+def _tf_node_name(name):
+    """Wire names (dots, ':0' variable suffixes) → valid TF op names."""
+    return _re.sub(r"[^A-Za-z0-9_.\-/>]", "_", name.replace(".", "_"))
+
+
 def _py_collective(fn, inputs, out_dtype, name):
     """Run a numpy-plane collective as a TF op.
 
@@ -66,11 +83,35 @@ def _py_collective(fn, inputs, out_dtype, name):
     traced into a ``tf.function`` graph — the moral equivalent of the
     reference's AsyncOpKernel enqueue (``tensorflow/mpi_ops.cc:276-433``):
     the graph node is a placeholder, the real work happens against live
-    data.  ``name`` is fixed at trace time, so every rank's graph issues the
-    same wire name in the same order (SPMD discipline, enforced by the
-    controller's cross-rank validation).
-    """
-    return tf.py_function(fn, inputs, Tout=out_dtype, name=name.replace(".", "_"))
+    data.  ``name`` is fixed at trace time, so every rank issues the same
+    wire name (SPMD discipline, enforced by the controller's cross-rank
+    validation).
+
+    In graph mode every collective is chained to the previous one with a
+    control dependency.  Without this, TF's executor is free to start
+    independent py_functions in different orders on different ranks; a
+    blocking collective then occupies the python executor while the rank
+    the controller is waiting on is blocked inside a *different*
+    collective — a cross-rank scheduling deadlock (the reference avoids it
+    with truly async kernels, ``mpi_ops.cc:276-281``; our py_function body
+    is synchronous, so we pin a deterministic trace order instead)."""
+    if tf.executing_eagerly():
+        return tf.py_function(fn, inputs, Tout=out_dtype,
+                              name=_tf_node_name(name))
+    # The chain head lives on the FuncGraph itself: a side dict keyed by
+    # graph would pin every retraced graph forever (the stored output
+    # tensor strongly references its graph).
+    graph = tf.compat.v1.get_default_graph()
+    prev = getattr(graph, "_hvd_collective_chain", None)
+    if prev is not None:
+        with tf.control_dependencies([prev]):
+            out = tf.py_function(fn, inputs, Tout=out_dtype,
+                                 name=_tf_node_name(name))
+    else:
+        out = tf.py_function(fn, inputs, Tout=out_dtype,
+                             name=_tf_node_name(name))
+    graph._hvd_collective_chain = out
+    return out
 
 
 def _allreduce(tensor, name=None, op=None, prescale_factor=1.0,

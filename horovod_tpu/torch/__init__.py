@@ -63,7 +63,11 @@ def _to_numpy(tensor: torch.Tensor) -> np.ndarray:
 
 
 def _from_numpy(arr: np.ndarray, like: torch.Tensor) -> torch.Tensor:
-    return torch.from_numpy(np.ascontiguousarray(arr)).to(like.dtype)
+    # np.ascontiguousarray promotes 0-dim to 1-d; reshape restores it so
+    # scalar tensors (e.g. BatchNorm num_batches_tracked) round-trip.
+    shape = np.shape(arr)
+    return torch.from_numpy(
+        np.ascontiguousarray(arr).reshape(shape)).to(like.dtype)
 
 
 def synchronize(handle) -> torch.Tensor:
@@ -319,6 +323,7 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     the optimizer's own class)."""
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                dict(_DistributedOptimizer.__dict__))
+    cls._hvd_wrapped = True   # lets state-fill paths reach the base step
     return cls(optimizer.param_groups, named_parameters, compression,
                backward_passes_per_step, op)
 
@@ -350,14 +355,21 @@ def broadcast_optimizer_state(optimizer, root_rank=0):
         raise ValueError("cannot broadcast torch.optim.LBFGS state")
     state_dict = optimizer.state_dict()
 
-    # Fill missing per-param state on non-root ranks by running a zero-grad
-    # step, so state_dicts line up (reference torch/__init__.py:300-317).
-    if basics.rank() != root_rank and not state_dict.get("state"):
+    # Fill missing per-param state by running a zero-grad step, so
+    # state_dicts line up (reference torch/__init__.py:300-317).  The
+    # empty-state check is per-rank (on checkpoint resume only the root has
+    # state), so the dummy step must be purely LOCAL: for a wrapped
+    # DistributedOptimizer, step() would allreduce on the subset of ranks
+    # with empty state and deadlock — call the base class's step instead.
+    if not state_dict.get("state"):
         for group in optimizer.param_groups:
             for p in group["params"]:
                 if p.requires_grad and p.grad is None:
                     p.grad = torch.zeros_like(p)
-        optimizer.step()
+        if getattr(type(optimizer), "_hvd_wrapped", False):
+            type(optimizer).__mro__[1].step(optimizer)
+        else:
+            optimizer.step()
         state_dict = optimizer.state_dict()
 
     tensors = {}

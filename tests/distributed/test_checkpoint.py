@@ -1,0 +1,69 @@
+"""Checkpoint round-trip tests (train → save → restore → broadcast),
+rank-aware — run standalone (size 1) or under ``hvdrun -np N``.
+
+The reference's checkpoint *convention* is rank-0-writes + broadcast
+(SURVEY §5.4); these tests assert our first-class API keeps exactly that
+contract: only rank 0 touches disk, every rank resumes bit-identical.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+
+@pytest.fixture()
+def shared_dir(hvd, rank, tmp_path):
+    """All ranks must agree on the directory; rank 0 picks, broadcasts."""
+    import horovod_tpu as h
+    path = h.broadcast_object(str(tmp_path), root_rank=0,
+                              name="ckpt.dir")
+    return path
+
+
+def test_save_restore_roundtrip(hvd, rank, size, shared_dir):
+    state = {
+        "params": {"w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3) * 2.0,
+                   "b": jnp.ones(3, jnp.float32)},
+        "step": np.asarray(7, np.int32),
+    }
+    hvd.checkpoint.save(shared_dir, state, step=7)
+
+    if rank == 0:
+        assert os.path.isdir(os.path.join(shared_dir, "7"))
+
+    # fresh template with WRONG values: restore must overwrite everywhere
+    template = {
+        "params": {"w": jnp.zeros((2, 3), jnp.float32),
+                   "b": jnp.zeros(3, jnp.float32)},
+        "step": np.asarray(0, np.int32),
+    }
+    restored = hvd.checkpoint.restore(shared_dir, template)
+    assert np.allclose(np.asarray(restored["params"]["w"]),
+                       np.arange(6, dtype=np.float32).reshape(2, 3) * 2.0)
+    assert np.allclose(np.asarray(restored["params"]["b"]), 1.0)
+    assert int(restored["step"]) == 7
+    assert hvd.checkpoint.latest_step(shared_dir) == 7
+
+
+def test_restore_missing_returns_template(hvd, rank, size, shared_dir):
+    template = {"w": jnp.full((2,), float(rank))}
+    out = hvd.checkpoint.restore(os.path.join(shared_dir, "nothing_here"),
+                                 template)
+    assert np.allclose(np.asarray(out["w"]), float(rank))
+
+
+def test_rank0_only_writes(hvd, rank, size, tmp_path):
+    """Non-root ranks never write into their own directory."""
+    import horovod_tpu as h
+    private_dir = str(tmp_path / f"private_{rank}")
+    os.makedirs(private_dir, exist_ok=True)
+    shared = h.broadcast_object(private_dir, root_rank=0,
+                                name="ckpt.dir.private")
+    hvd.checkpoint.save(shared, {"x": jnp.ones(2)}, step=1)
+    if rank != 0:
+        assert os.listdir(private_dir) == [], \
+            "non-root rank wrote checkpoint files"

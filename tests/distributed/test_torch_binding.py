@@ -129,3 +129,29 @@ def test_broadcast_optimizer_state(thvd, rank, size):
         {k: v for k, v in state["param_groups"][0].items()
          if k != "params"})
     assert all(g == gathered[0] for g in gathered)
+
+
+def test_broadcast_optimizer_state_resume(thvd, rank, size):
+    """Checkpoint-resume shape: only the ROOT has optimizer state; workers
+    must fill theirs locally (no collective) and then receive the root's.
+    Regression: a wrapped optimizer's dummy fill step used to allreduce on
+    the worker subset only and deadlock."""
+    torch.manual_seed(3)
+    model = torch.nn.Linear(3, 2)
+    opt = torch.optim.Adam(model.parameters(), lr=0.01)
+    opt = thvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters())
+    if rank == 0:
+        # simulate restored state: a purely local base-class step
+        for p in model.parameters():
+            p.grad = torch.full_like(p, 0.5)
+        type(opt).__mro__[1].step(opt)
+        for p in model.parameters():
+            p.grad = None
+    thvd.broadcast_optimizer_state(opt, root_rank=0)
+    sd = opt.state_dict()
+    assert sd["state"], "optimizer state missing after broadcast"
+    # every rank carries the root's step counter
+    steps = [int(v["step"]) for v in sd["state"].values()]
+    gathered = thvd.allgather_object(steps, name="opt.steps")
+    assert all(g == gathered[0] for g in gathered)

@@ -1,0 +1,93 @@
+"""Examples double as smoke tests, the reference's CI strategy
+(.buildkite/gen-pipeline.sh runs example scripts under the launcher on
+every image).  Tiny shapes: these verify the wiring end-to-end, not
+performance."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+def _run_example(script, args, np_=2, timeout=420, extra_env=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    if extra_env:
+        env.update(extra_env)
+    cmd = [sys.executable, "-m", "horovod_tpu.runner", "-np", str(np_),
+           sys.executable, os.path.join(EXAMPLES, script)] + args
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout, env=env, cwd=REPO)
+
+
+def test_jax_mnist_single_process(tmp_path):
+    """BASELINE config #1: the 1-process allreduce baseline."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, "jax_mnist.py"),
+         "--steps", "80", "--batch-size", "32",
+         "--checkpoint-dir", str(tmp_path / "ck")],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "train accuracy" in res.stdout
+
+
+def test_jax_mnist_two_ranks(tmp_path):
+    res = _run_example("jax_mnist.py", ["--steps", "60", "--batch-size",
+                                        "32"])
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_pytorch_synthetic_benchmark():
+    res = _run_example("pytorch_synthetic_benchmark.py",
+                       ["--model", "resnet18", "--batch-size", "2",
+                        "--image-size", "32", "--num-warmup-batches", "1",
+                        "--num-batches-per-iter", "1", "--num-iters", "2"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "Total img/sec" in res.stdout
+
+
+def test_tensorflow2_mnist(tmp_path):
+    pytest.importorskip("tensorflow")
+    res = _run_example("tensorflow2_mnist.py",
+                       ["--steps", "80", "--batch-size", "32",
+                        "--checkpoint-dir", str(tmp_path / "ck")])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "train accuracy" in res.stdout
+
+
+def test_keras_mnist(tmp_path):
+    pytest.importorskip("keras")
+    res = _run_example("keras_mnist.py",
+                       ["--epochs", "2", "--batch-size", "64",
+                        "--checkpoint-dir", str(tmp_path)])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "final train accuracy" in res.stdout
+
+
+def test_jax_synthetic_benchmark_json():
+    """The flagship bench CLI emits a parseable result."""
+    import json
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, "jax_synthetic_benchmark.py"),
+         "--model", "resnet18", "--batch-size", "2", "--image-size", "32",
+         "--num-warmup-batches", "1", "--num-batches-per-iter", "2",
+         "--num-iters", "2", "--json"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    out = json.loads(res.stdout.strip().splitlines()[-1])
+    assert out["n_chips"] == 4
+    assert out["img_sec_total"] > 0
