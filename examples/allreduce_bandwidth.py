@@ -1,0 +1,160 @@
+"""Eager-plane allreduce bandwidth microbenchmark.
+
+The reference's reputation is allreduce throughput; this measures ours.
+Reports, per payload size:
+
+* algorithmic bandwidth  algbw = payload_bytes / time
+* bus bandwidth          busbw = algbw * 2*(size-1)/size  (ring transfer
+  volume — the number comparable across world sizes, same convention as
+  nccl-tests)
+
+plus a fused-vs-unfused comparison (64 small tensors submitted together
+ride one fusion buffer — reference fusion_buffer_manager — vs submitted
+one-by-one), and a raw loopback socket baseline measured in-process so
+the TCP ceiling is printed next to the achieved numbers.
+
+Run: ``hvdrun -np 2 python examples/allreduce_bandwidth.py``
+"""
+
+import argparse
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import basics
+
+
+def loopback_baseline(nbytes=64 << 20):
+    """Raw TCP loopback throughput (one direction, one connection) — the
+    wire ceiling the ring rides on this host."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    out = {}
+
+    def sink():
+        conn, _ = srv.accept()
+        buf = bytearray(1 << 20)
+        got = 0
+        t0 = time.perf_counter()
+        while got < nbytes:
+            n = conn.recv_into(buf)
+            if not n:
+                break
+            got += n
+        out["secs"] = time.perf_counter() - t0
+        conn.close()
+
+    th = threading.Thread(target=sink)
+    th.start()
+    cli = socket.create_connection(("127.0.0.1", port))
+    cli.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    chunk = b"\x00" * (1 << 20)
+    sent = 0
+    while sent < nbytes:
+        cli.sendall(chunk)
+        sent += len(chunk)
+    cli.close()
+    th.join()
+    srv.close()
+    return nbytes / out["secs"] / 1e9
+
+
+def bench_payload(nbytes, iters, warmup=3):
+    rt = basics.runtime()
+    arr = np.ones(nbytes // 4, np.float32)
+    for _ in range(warmup):
+        rt.allreduce("bw.sweep", arr, 0)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        rt.allreduce("bw.sweep", arr, 0)
+    dt = (time.perf_counter() - t0) / iters
+    algbw = nbytes / dt / 1e9
+    busbw = algbw * 2 * (hvd.size() - 1) / hvd.size()
+    return {"bytes": nbytes, "secs_per_op": dt, "algbw_GBs": algbw,
+            "busbw_GBs": busbw}
+
+
+def bench_fusion(n_tensors=64, tensor_bytes=64 << 10, iters=10):
+    """Submit N small tensors at once (they land in one cycle and fuse)
+    vs one-at-a-time (each pays its own negotiation + ring)."""
+    rt = basics.runtime()
+    arrs = [np.ones(tensor_bytes // 4, np.float32) for _ in range(n_tensors)]
+
+    def fused_round(tag):
+        hs = [rt._submit(0, f"fu.{tag}.{i}", a, 0)
+              for i, a in enumerate(arrs)]
+        for h, a in zip(hs, arrs):
+            rt._wait_read(h, a.dtype, ())
+
+    def unfused_round(tag):
+        for i, a in enumerate(arrs):
+            rt.allreduce(f"un.{tag}.{i}", a, 0)
+
+    fused_round("w")            # warmup (also seeds the response cache)
+    t0 = time.perf_counter()
+    for it in range(iters):
+        fused_round("w")        # same names → cached negotiation
+    fused = (time.perf_counter() - t0) / iters
+
+    unfused_round("w")
+    t0 = time.perf_counter()
+    for it in range(iters):
+        unfused_round("w")
+    unfused = (time.perf_counter() - t0) / iters
+
+    total = n_tensors * tensor_bytes
+    return {"n_tensors": n_tensors, "tensor_bytes": tensor_bytes,
+            "fused_secs": fused, "unfused_secs": unfused,
+            "fused_GBs": total / fused / 1e9,
+            "unfused_GBs": total / unfused / 1e9,
+            "speedup": unfused / fused}
+
+
+def main():
+    p = argparse.ArgumentParser(description="Eager allreduce bandwidth")
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--max-mb", type=int, default=64)
+    args = p.parse_args()
+
+    hvd.init()
+    if hvd.size() < 2:
+        raise SystemExit("run under the launcher: hvdrun -np 2 ...")
+
+    results = {"size": hvd.size()}
+    if hvd.rank() == 0:
+        results["loopback_GBs"] = loopback_baseline()
+
+    sweep = []
+    nbytes = 16 << 10
+    while nbytes <= args.max_mb << 20:
+        r = bench_payload(nbytes, args.iters if nbytes < (16 << 20) else 5)
+        sweep.append(r)
+        if hvd.rank() == 0:
+            print(f"{r['bytes']:>12d} B  algbw {r['algbw_GBs']:.3f} GB/s  "
+                  f"busbw {r['busbw_GBs']:.3f} GB/s", flush=True)
+        nbytes *= 4
+    results["sweep"] = sweep
+
+    fu = bench_fusion()
+    results["fusion"] = fu
+    if hvd.rank() == 0:
+        print(f"fused {fu['fused_GBs']:.3f} GB/s vs unfused "
+              f"{fu['unfused_GBs']:.3f} GB/s  (speedup "
+              f"{fu['speedup']:.2f}x)", flush=True)
+        peak = max(r["busbw_GBs"] for r in sweep)
+        results["peak_busbw_GBs"] = peak
+        results["pct_of_loopback"] = 100 * peak / results["loopback_GBs"]
+        print(f"peak busbw {peak:.3f} GB/s = "
+              f"{results['pct_of_loopback']:.1f}% of raw loopback "
+              f"({results['loopback_GBs']:.3f} GB/s)", flush=True)
+        print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

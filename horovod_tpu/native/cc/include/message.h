@@ -44,6 +44,12 @@ struct Response {
   DataType dtype = DataType::kFloat32;
   int32_t arg = 0;
   bool error = false;
+  // Coordinator-decided: false when any rank was a joined zero-contributor
+  // for this tensor.  Ranks only refresh their response cache from
+  // cacheable responses — a joined rank has no local entry to Put, and a
+  // partial Put would diverge the deterministic cache replicas (slot
+  // numbering), corrupting later bit-announced negotiation.
+  bool cacheable = true;
   std::string error_message;
   std::vector<std::string> names;
   // Allgather/alltoall: first-dim sizes of every rank (reference

@@ -276,6 +276,10 @@ Response Controller::ConstructResponse(const std::string& name) {
   resp.op_type = first.op_type;
   resp.dtype = first.dtype;
   resp.arg = first.arg;
+  // Cache refresh is only safe when every rank actually submitted: a
+  // joined zero-contributor has no entry (and no shape) to Put, and a
+  // partial Put diverges the deterministic cache replicas' slot numbering.
+  resp.cacheable = (p.count == size_);
   resp.names.push_back(name);
 
   auto fail = [&](const std::string& msg) {
@@ -444,6 +448,7 @@ void Controller::Fuse(std::vector<Response>* responses) {
               fusion_threshold_) {
         prev.names.push_back(r.names[0]);
         prev.first_dims.push_back(r.first_dims[0]);
+        prev.cacheable = prev.cacheable && r.cacheable;
         continue;
       }
     }

@@ -267,23 +267,37 @@ Status DataPlane::SendRecv(int send_peer, const void* sbuf, size_t sbytes,
       if (errno == EINTR) continue;
       return Status::Unknown(std::string("poll: ") + std::strerror(errno));
     }
+    // Drain each direction until EAGAIN, not one syscall per poll wakeup —
+    // with 8 MB kernel buffers a single wakeup can move megabytes, and the
+    // poll/send ping-pong otherwise caps throughput well under the wire.
     if (si >= 0 && (fds[si].revents & (POLLOUT | POLLERR | POLLHUP))) {
-      ssize_t w = ::send(ssock->fd(), sp, sleft, MSG_NOSIGNAL | MSG_DONTWAIT);
-      if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
-        return Status::Unknown(std::string("send: ") + std::strerror(errno));
-      if (w > 0) {
-        sp += w;
-        sleft -= static_cast<size_t>(w);
+      while (sleft > 0) {
+        ssize_t w =
+            ::send(ssock->fd(), sp, sleft, MSG_NOSIGNAL | MSG_DONTWAIT);
+        if (w > 0) {
+          sp += w;
+          sleft -= static_cast<size_t>(w);
+          continue;
+        }
+        if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+        if (w < 0 && errno == EINTR) continue;
+        if (w < 0)
+          return Status::Unknown(std::string("send: ") +
+                                 std::strerror(errno));
       }
     }
     if (ri >= 0 && (fds[ri].revents & (POLLIN | POLLERR | POLLHUP))) {
-      ssize_t r = ::recv(rsock->fd(), rp, rleft, MSG_DONTWAIT);
-      if (r == 0) return Status::Aborted("peer closed during exchange");
-      if (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+      while (rleft > 0) {
+        ssize_t r = ::recv(rsock->fd(), rp, rleft, MSG_DONTWAIT);
+        if (r > 0) {
+          rp += r;
+          rleft -= static_cast<size_t>(r);
+          continue;
+        }
+        if (r == 0) return Status::Aborted("peer closed during exchange");
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        if (errno == EINTR) continue;
         return Status::Unknown(std::string("recv: ") + std::strerror(errno));
-      if (r > 0) {
-        rp += r;
-        rleft -= static_cast<size_t>(r);
       }
     }
   }
@@ -327,7 +341,10 @@ Status DataPlane::Allreduce(void* buf, int64_t count, DataType dtype,
   std::vector<char> scratch(static_cast<size_t>(max_chunk) * esz);
 
   // Phase 1: ring reduce-scatter.  After size-1 steps, chunk (rank+1)%size
-  // holds the full reduction on this rank.
+  // holds the full reduction on this rank.  The reduce stays OUTSIDE the
+  // exchange: folding it into the recv drain was measured slower here —
+  // the single-threaded drain stops feeding the send direction while it
+  // reduces, stalling the stream for longer than the saved memory pass.
   for (int s = 0; s < size_ - 1; ++s) {
     int send_c = (rank_ - s + size_) % size_;
     int recv_c = (rank_ - s - 1 + size_) % size_;

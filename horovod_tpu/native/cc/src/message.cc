@@ -109,6 +109,7 @@ std::string ResponseList::Serialize() const {
     PutPod<int32_t>(&buf, static_cast<int32_t>(r.dtype));
     PutPod<int32_t>(&buf, r.arg);
     PutPod<uint8_t>(&buf, r.error ? 1 : 0);
+    PutPod<uint8_t>(&buf, r.cacheable ? 1 : 0);
     PutStr(&buf, r.error_message);
     PutPod<uint32_t>(&buf, static_cast<uint32_t>(r.names.size()));
     for (const auto& nm : r.names) PutStr(&buf, nm);
@@ -135,14 +136,16 @@ Status ResponseList::Parse(const std::string& buf, ResponseList* out) {
   out->responses.resize(n);
   for (auto& r : out->responses) {
     int32_t op, dt;
-    uint8_t err;
+    uint8_t err, cacheable;
     uint32_t nn;
     if (!rd.GetPod(&op) || !rd.GetPod(&dt) || !rd.GetPod(&r.arg) ||
-        !rd.GetPod(&err) || !rd.GetStr(&r.error_message) || !rd.GetPod(&nn))
+        !rd.GetPod(&err) || !rd.GetPod(&cacheable) ||
+        !rd.GetStr(&r.error_message) || !rd.GetPod(&nn))
       return Malformed("response");
     r.op_type = static_cast<OpType>(op);
     r.dtype = static_cast<DataType>(dt);
     r.error = err != 0;
+    r.cacheable = cacheable != 0;
     r.names.resize(nn);
     for (auto& nm : r.names)
       if (!rd.GetStr(&nm)) return Malformed("name");

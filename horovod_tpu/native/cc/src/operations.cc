@@ -59,6 +59,13 @@ struct GlobalState {
   std::vector<char> fusion_buffer;
   double cycle_time_ms = 1.0;
 
+  // Wakes the background loop the moment work arrives, instead of letting
+  // a fresh enqueue wait out the remainder of the cycle sleep — cuts
+  // small-op latency from ~cycle_time to ~negotiation time (the reference
+  // simply eats this, operations.cc:500-510 sleeps unconditionally).
+  std::mutex wake_mu;
+  std::condition_variable wake_cv;
+
   std::mutex err_mu;
   std::string last_error;
 };
@@ -163,7 +170,7 @@ int64_t ExecuteResponse(const Response& resp) {
   // name->slot assignment identical everywhere (see response_cache.h).
   // Allgather is excluded: its dim-0 differs per rank, so the coordinator
   // could not faithfully expand another rank's bit from its own params.
-  if (g->cache_enabled &&
+  if (g->cache_enabled && resp.cacheable &&
       resp.op_type != OpType::kBarrier && resp.op_type != OpType::kJoin &&
       resp.op_type != OpType::kAllgather) {
     for (auto& e : entries) {
@@ -191,7 +198,7 @@ int64_t ExecuteResponse(const Response& resp) {
       if (entries.size() == 1 && resp.names.size() == 1) {
         auto& e = entries[0];
         g->timeline.Start(e->name, "ALLREDUCE");
-        e->output.resize(static_cast<size_t>(e->count) * esz);
+        e->output.resize_uninit(static_cast<size_t>(e->count) * esz);
         std::memcpy(e->output.data(), e->input, e->output.size());
         e->output_count = e->count;
         g->timeline.ActivityStart(e->name, "TCP_ALLREDUCE");
@@ -263,7 +270,7 @@ int64_t ExecuteResponse(const Response& resp) {
         counts[r] = resp.first_dims[r] * static_cast<int64_t>(esz);  // bytes
         total_elems += resp.first_dims[r];
       }
-      e->output.resize(static_cast<size_t>(total_elems) * esz);
+      e->output.resize_uninit(static_cast<size_t>(total_elems) * esz);
       e->output_count = total_elems;
       g->timeline.ActivityStart(e->name, "TCP_ALLGATHER");
       st = g->data_plane.Allgather(e->input, e->output.data(), counts);
@@ -274,7 +281,7 @@ int64_t ExecuteResponse(const Response& resp) {
     case OpType::kBroadcast: {
       auto& e = entries[0];
       g->timeline.Start(e->name, "BROADCAST");
-      e->output.resize(static_cast<size_t>(e->count) * esz);
+      e->output.resize_uninit(static_cast<size_t>(e->count) * esz);
       std::memcpy(e->output.data(), e->input, e->output.size());
       e->output_count = e->count;
       g->timeline.ActivityStart(e->name, "TCP_BROADCAST");
@@ -287,7 +294,7 @@ int64_t ExecuteResponse(const Response& resp) {
     case OpType::kAlltoall: {
       auto& e = entries[0];
       g->timeline.Start(e->name, "ALLTOALL");
-      e->output.resize(static_cast<size_t>(e->count) * esz);
+      e->output.resize_uninit(static_cast<size_t>(e->count) * esz);
       e->output_count = e->count;
       g->timeline.ActivityStart(e->name, "TCP_ALLTOALL");
       st = g->data_plane.Alltoall(e->input, e->output.data(), e->count,
@@ -300,7 +307,7 @@ int64_t ExecuteResponse(const Response& resp) {
       auto& e = entries[0];
       g->timeline.Start(e->name, "REDUCESCATTER");
       int64_t out_count = e->count / g->size;
-      e->output.resize(static_cast<size_t>(out_count) * esz);
+      e->output.resize_uninit(static_cast<size_t>(out_count) * esz);
       e->output_count = out_count;
       g->timeline.ActivityStart(e->name, "TCP_REDUCESCATTER");
       st = g->data_plane.Reducescatter(e->input, e->output.data(), e->count,
@@ -321,7 +328,7 @@ int64_t ExecuteResponse(const Response& resp) {
       // so the next epoch's collectives take the normal path.
       g->joined.store(false);
       auto& e = entries[0];
-      e->output.resize(sizeof(int32_t));
+      e->output.resize_uninit(sizeof(int32_t));
       int32_t last = resp.arg;
       std::memcpy(e->output.data(), &last, sizeof(last));
       e->output_count = 1;
@@ -440,8 +447,12 @@ void BackgroundThread() {
       auto budget = std::chrono::duration<double, std::milli>(
           g->cycle_time_ms);
       if (elapsed < budget &&
-          g->queue.NumPending() == 0)  // hot when work is in flight
-        std::this_thread::sleep_for(budget - elapsed);
+          g->queue.NumPending() == 0) {  // hot when work is in flight
+        std::unique_lock<std::mutex> lk(g->wake_mu);
+        g->wake_cv.wait_for(lk, budget - elapsed, [] {
+          return g->queue.NumPending() > 0 || g->shutting_down.load();
+        });
+      }
     }
   }
 
@@ -506,6 +517,12 @@ void hvd_shutdown() {
   std::lock_guard<std::mutex> lk(g_mu);
   if (g == nullptr) return;
   g->shutting_down.store(true);
+  {
+    // Under wake_mu so the store+notify can't slip into the loop's
+    // check-then-block window and be lost (lost-wakeup race).
+    std::lock_guard<std::mutex> wl(g->wake_mu);
+    g->wake_cv.notify_all();   // don't let the loop sleep out its cycle
+  }
   if (g->background.joinable()) g->background.join();
   // Keep `g` allocated: concurrent hvd_wait callers woken by FailAll are
   // still inside g->queue; freeing here would be a use-after-free.  The
@@ -538,6 +555,13 @@ int64_t hvd_enqueue(int op_type, const char* name, const void* data,
   if (!s.ok()) {
     SetLastError(s.reason);
     return -1;
+  }
+  {
+    // Lock-then-notify: without holding wake_mu the notify can land in the
+    // window between the background loop's predicate check and its block,
+    // get lost, and the enqueue waits out the full cycle sleep anyway.
+    std::lock_guard<std::mutex> wl(g->wake_mu);
+    g->wake_cv.notify_one();
   }
   return e->handle;
 }
@@ -576,6 +600,14 @@ int hvd_read_output(int64_t handle, void* dst, int64_t count) {
   if (!e || !e->done) {
     SetLastError("output not ready");
     return 1;
+  }
+  if (!e->status.ok()) {
+    // The output buffer of a failed collective is unwritten (and, being
+    // resize_uninit'd, holds stale heap bytes) — surface the failure to
+    // poll+read callers instead of leaking it.
+    SetLastError(e->status.reason);
+    g->queue.Release(handle);
+    return static_cast<int>(e->status.code);
   }
   size_t nbytes = static_cast<size_t>(count) * DataTypeSize(e->dtype);
   if (nbytes > e->output.size()) {

@@ -10,6 +10,7 @@
 #include <unistd.h>
 
 #include <chrono>
+#include <cstdio>
 #include <thread>
 
 namespace hvd {
@@ -23,6 +24,55 @@ std::string Errno(const char* what) {
 void SetCommonOpts(int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+// Large kernel buffers: the data plane moves multi-MB fused payloads and
+// the poll loop in DataPlane::SendRecv can only hand the kernel SO_SNDBUF
+// bytes per wakeup — small buffers cap large-payload throughput under the
+// wire.  Caveats this respects:
+//   * Explicitly setting SO_RCVBUF opts the socket OUT of Linux receive
+//     auto-tuning (tcp_moderate_rcvbuf, which can grow past rmem_max), so
+//     only apply when it actually enlarges the kernel's current value —
+//     on hosts where rmem_max clamps 8 MB below the default, leave the
+//     default (and auto-tuning) alone.
+//   * Must run BEFORE connect()/listen() to influence the negotiated TCP
+//     window scale; accepted sockets inherit the listener's sizes.
+// HOROVOD_SOCKET_BUFFER (bytes) overrides; 0 keeps kernel defaults.
+long ReadSysctl(const char* path, long fallback) {
+  FILE* f = std::fopen(path, "r");
+  if (f == nullptr) return fallback;
+  long v = fallback;
+  if (std::fscanf(f, "%ld", &v) != 1) v = fallback;
+  std::fclose(f);
+  return v;
+}
+
+void SetBufferSizes(int fd) {
+  // Re-read per call (not statics): sockets are only created during init,
+  // and a shutdown/re-init cycle must honor a changed env value like every
+  // other HOROVOD_* knob does.
+  const long want_env = EnvInt("HOROVOD_SOCKET_BUFFER", -1);
+  const long want = want_env >= 0 ? want_env : (1 << 23);  // 8 MB
+  if (want <= 0) return;
+  const long rmax = ReadSysctl("/proc/sys/net/core/rmem_max", 1 << 23);
+  const long wmax = ReadSysctl("/proc/sys/net/core/wmem_max", 1 << 23);
+  for (int opt : {SO_SNDBUF, SO_RCVBUF}) {
+    long cap = opt == SO_SNDBUF ? wmax : rmax;
+    // The kernel clamps the request to the cap; when the cap can't fit
+    // the request, forcing it would trade the auto-tuner (which may grow
+    // beyond the cap) for a small fixed buffer — only an explicit env
+    // override takes that deal.
+    if (cap < want && want_env < 0) continue;
+    int cur = 0;
+    socklen_t len = sizeof(cur);
+    // getsockopt reports the doubled (bookkeeping-inclusive) value; halve
+    // for an apples-to-apples compare with what we would request.
+    if (getsockopt(fd, SOL_SOCKET, opt, &cur, &len) == 0 &&
+        cur / 2 >= want)
+      continue;
+    int buf = static_cast<int>(want);
+    setsockopt(fd, SOL_SOCKET, opt, &buf, sizeof(buf));
+  }
 }
 
 }  // namespace
@@ -51,6 +101,7 @@ Status TcpSocket::Listen(const std::string& addr, int port) {
   if (fd_ < 0) return Status::Unknown(Errno("socket"));
   int one = 1;
   setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  SetBufferSizes(fd_);  // pre-listen: accepted sockets inherit
   sockaddr_in sa{};
   sa.sin_family = AF_INET;
   sa.sin_port = htons(static_cast<uint16_t>(port));
@@ -101,6 +152,7 @@ Status TcpSocket::Connect(const std::string& addr, int port, int timeout_ms) {
   while (true) {
     fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     if (fd_ < 0) return Status::Unknown(Errno("socket"));
+    SetBufferSizes(fd_);  // pre-connect: influences the window scale
     if (::connect(fd_, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) == 0) {
       SetCommonOpts(fd_);
       return Status::OK();
