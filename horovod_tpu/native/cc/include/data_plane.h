@@ -50,6 +50,11 @@ class DataPlane {
   Status Broadcast(void* buf, int64_t count, DataType dtype, int root);
   // Equal splits: count divisible by size; block i goes to rank i.
   Status Alltoall(const void* in, void* out, int64_t count, DataType dtype);
+  // Uneven splits: per-peer byte counts (send_bytes[r] to rank r,
+  // recv_bytes[r] from rank r); dtype-agnostic.
+  Status Alltoallv(const void* in, void* out,
+                   const std::vector<int64_t>& send_bytes,
+                   const std::vector<int64_t>& recv_bytes);
 
   void Shutdown();
 

@@ -24,6 +24,10 @@ struct Request {
   int32_t arg = 0;          // reduce-op code or broadcast root
   std::string name;
   std::vector<int64_t> shape;
+  // Alltoall only: dim-0 rows this rank sends to each destination
+  // (uneven alltoallv, parity with later-Horovod `splits`).  Empty =
+  // equal splits (shape[0] / size rows each).
+  std::vector<int64_t> splits;
 };
 
 // Everything a worker tells the coordinator each cycle

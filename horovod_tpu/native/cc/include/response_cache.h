@@ -41,13 +41,18 @@ class ResponseCache {
   int64_t Lookup(const Request& r) const;
 
   // Rebuild synthetic requests (attributed to `rank`) from a hit bitvector.
+  // For ops whose per-rank dims differ (allgather dim-0, alltoall splits)
+  // the stored Response — identical on every rank, it rode the broadcast —
+  // supplies rank's dims: a hit bit proves the announcer's OWN params are
+  // unchanged since that response, so its recorded first_dims entry is
+  // still exact.
   std::vector<Request> Expand(const std::vector<uint64_t>& bits,
                               int rank) const;
 
-  // Record params after a response executed for this tensor; replaces an
+  // Record params + the executed response for this tensor; replaces an
   // existing same-name entry in place, else takes a free/evicted slot
   // (FIFO eviction — deterministic across ranks).
-  void Put(const Request& params);
+  void Put(const Request& params, const Response& resp);
 
   static void SetBit(std::vector<uint64_t>* bits, int64_t slot);
 
@@ -56,6 +61,7 @@ class ResponseCache {
  private:
   struct Slot {
     Request params;
+    Response resp;   // per-rank dims source for allgather/alltoall Expand
     bool used = false;
   };
 

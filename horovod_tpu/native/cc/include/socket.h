@@ -36,6 +36,12 @@ class TcpSocket {
   Status SendAll(const void* data, size_t n) const;
   Status RecvAll(void* data, size_t n) const;
 
+  // Kernel-level receive timeout (0 = blocking).  Set on freshly accepted
+  // connections for the duration of the auth handshake + hello so a rogue
+  // peer that connects and goes silent cannot stall the serial accept
+  // loop; cleared once the peer is registered.
+  void SetRecvTimeout(int ms) const;
+
   // Length-prefixed frames.
   Status SendFrame(const void* data, size_t n) const;
   Status SendFrame(const std::string& s) const {

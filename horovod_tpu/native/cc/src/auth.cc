@@ -1,0 +1,228 @@
+#include "auth.h"
+
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <random>
+
+namespace hvd {
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// SHA-256, FIPS 180-4.  Self-contained: the image ships no crypto library
+// and the native runtime links nothing external by design.
+// ---------------------------------------------------------------------------
+
+constexpr uint32_t kK[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+inline uint32_t Rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+struct Sha256Ctx {
+  uint32_t h[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                   0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+  uint8_t block[64];
+  size_t block_len = 0;
+  uint64_t total = 0;
+
+  void Compress(const uint8_t* p) {
+    uint32_t w[64];
+    for (int i = 0; i < 16; ++i)
+      w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+             (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+    for (int i = 16; i < 64; ++i) {
+      uint32_t s0 = Rotr(w[i - 15], 7) ^ Rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      uint32_t s1 = Rotr(w[i - 2], 17) ^ Rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int i = 0; i < 64; ++i) {
+      uint32_t s1 = Rotr(e, 6) ^ Rotr(e, 11) ^ Rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + s1 + ch + kK[i] + w[i];
+      uint32_t s0 = Rotr(a, 2) ^ Rotr(a, 13) ^ Rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = s0 + maj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void Update(const void* data, size_t n) {
+    const uint8_t* p = static_cast<const uint8_t*>(data);
+    total += n;
+    while (n > 0) {
+      size_t take = std::min(n, sizeof(block) - block_len);
+      std::memcpy(block + block_len, p, take);
+      block_len += take;
+      p += take;
+      n -= take;
+      if (block_len == sizeof(block)) {
+        Compress(block);
+        block_len = 0;
+      }
+    }
+  }
+
+  std::string Final() {
+    uint64_t bits = total * 8;
+    uint8_t pad = 0x80;
+    Update(&pad, 1);
+    uint8_t zero = 0;
+    while (block_len != 56) Update(&zero, 1);
+    uint8_t len[8];
+    for (int i = 0; i < 8; ++i)
+      len[i] = static_cast<uint8_t>(bits >> (56 - 8 * i));
+    Update(len, 8);
+    std::string out(32, '\0');
+    for (int i = 0; i < 8; ++i)
+      for (int j = 0; j < 4; ++j)
+        out[4 * i + j] = static_cast<char>(h[i] >> (24 - 8 * j));
+    return out;
+  }
+};
+
+}  // namespace
+
+std::string Sha256(const void* data, size_t n) {
+  Sha256Ctx ctx;
+  ctx.Update(data, n);
+  return ctx.Final();
+}
+
+std::string HmacSha256(const std::string& key, const std::string& msg) {
+  std::string k = key;
+  if (k.size() > 64) k = Sha256(k.data(), k.size());
+  k.resize(64, '\0');
+  std::string ipad(64, '\x36'), opad(64, '\x5c');
+  for (int i = 0; i < 64; ++i) {
+    ipad[i] ^= k[i];
+    opad[i] ^= k[i];
+  }
+  std::string inner = Sha256((ipad + msg).data(), ipad.size() + msg.size());
+  std::string outer_msg = opad + inner;
+  return Sha256(outer_msg.data(), outer_msg.size());
+}
+
+bool ConstantTimeEq(const std::string& a, const std::string& b) {
+  if (a.size() != b.size()) return false;
+  unsigned char diff = 0;
+  for (size_t i = 0; i < a.size(); ++i)
+    diff |= static_cast<unsigned char>(a[i]) ^
+            static_cast<unsigned char>(b[i]);
+  return diff == 0;
+}
+
+std::string RandomNonce() {
+  std::string out(32, '\0');
+  int fd = ::open("/dev/urandom", O_RDONLY);
+  if (fd >= 0) {
+    size_t got = 0;
+    while (got < out.size()) {
+      ssize_t r = ::read(fd, &out[got], out.size() - got);
+      if (r <= 0) break;
+      got += static_cast<size_t>(r);
+    }
+    ::close(fd);
+    if (got == out.size()) return out;
+  }
+  std::random_device rd;  // fallback; still non-deterministic
+  for (auto& c : out) c = static_cast<char>(rd());
+  return out;
+}
+
+std::string JobKey() {
+  std::string b64 = EnvStr("HOROVOD_SECRET_KEY", "");
+  if (b64.empty()) return "";
+  // urlsafe base64 decode; on malformed input fall back to the raw string
+  // (both sides see the same env var, so they still agree).
+  static const char* kAlpha =
+      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789-_";
+  int8_t rev[256];
+  std::memset(rev, -1, sizeof(rev));
+  for (int i = 0; i < 64; ++i)
+    rev[static_cast<uint8_t>(kAlpha[i])] = static_cast<int8_t>(i);
+  rev[static_cast<uint8_t>('+')] = 62;  // accept standard alphabet too
+  rev[static_cast<uint8_t>('/')] = 63;
+  std::string out;
+  uint32_t acc = 0;
+  int nbits = 0;
+  for (char c : b64) {
+    if (c == '=' || c == '\n') continue;
+    int8_t v = rev[static_cast<uint8_t>(c)];
+    if (v < 0) return b64;  // not base64: use raw
+    acc = (acc << 6) | static_cast<uint32_t>(v);
+    nbits += 6;
+    if (nbits >= 8) {
+      nbits -= 8;
+      out.push_back(static_cast<char>((acc >> nbits) & 0xff));
+    }
+  }
+  return out.empty() ? b64 : out;
+}
+
+namespace {
+constexpr const char kClientRole[] = "hvd-client";
+constexpr const char kServerRole[] = "hvd-server";
+}  // namespace
+
+Status AuthAccept(const TcpSocket& sock, const std::string& key) {
+  if (key.empty()) return Status::OK();
+  std::string nonce_a = RandomNonce();
+  Status s = sock.SendFrame(nonce_a);
+  if (!s.ok()) return s;
+  std::string reply;
+  s = sock.RecvFrame(&reply);
+  if (!s.ok()) return s;
+  if (reply.size() != 64)
+    return Status::Unknown("auth: malformed client response");
+  std::string nonce_c = reply.substr(0, 32);
+  std::string mac_c = reply.substr(32);
+  std::string want = HmacSha256(key, kClientRole + nonce_a + nonce_c);
+  if (!ConstantTimeEq(mac_c, want))
+    return Status::Unknown(
+        "auth: connection rejected — peer does not hold this job's "
+        "HOROVOD_SECRET_KEY");
+  return sock.SendFrame(HmacSha256(key, kServerRole + nonce_c + nonce_a));
+}
+
+Status AuthConnect(const TcpSocket& sock, const std::string& key) {
+  if (key.empty()) return Status::OK();
+  std::string nonce_a;
+  Status s = sock.RecvFrame(&nonce_a);
+  if (!s.ok()) return s;
+  if (nonce_a.size() != 32)
+    return Status::Unknown("auth: malformed server challenge");
+  std::string nonce_c = RandomNonce();
+  s = sock.SendFrame(nonce_c + HmacSha256(key, kClientRole + nonce_a +
+                                          nonce_c));
+  if (!s.ok()) return s;
+  std::string mac_a;
+  s = sock.RecvFrame(&mac_a);
+  if (!s.ok())
+    return Status::Unknown(
+        "auth: server closed during handshake — HOROVOD_SECRET_KEY "
+        "mismatch? (" + s.reason + ")");
+  if (!ConstantTimeEq(mac_a, HmacSha256(key, kServerRole + nonce_c +
+                                        nonce_a)))
+    return Status::Unknown(
+        "auth: server failed to prove knowledge of HOROVOD_SECRET_KEY");
+  return Status::OK();
+}
+
+}  // namespace hvd

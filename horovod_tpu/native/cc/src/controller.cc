@@ -1,7 +1,10 @@
 #include "controller.h"
 
 #include <algorithm>
+#include <chrono>
 #include <sstream>
+
+#include "auth.h"
 
 namespace hvd {
 
@@ -37,6 +40,7 @@ Status Controller::Init(int rank, int size, const std::string& master_addr,
   joined_.assign(size, false);
   peers_out->assign(size, PeerAddr{});
 
+  const std::string key = JobKey();
   if (rank == 0) {
     Status s = listener_.Listen("", master_port);
     if (!s.ok()) return s;
@@ -46,10 +50,28 @@ Status Controller::Init(int rank, int size, const std::string& master_addr,
     (*peers_out)[0] = PeerAddr{
         my_data_host.empty() ? std::string("-") : my_data_host,
         my_data_port};
-    for (int n = 0; n < size - 1; ++n) {
+    // Rogue-connection resilience: an unauthenticated or malformed
+    // connection is dropped and accepting continues (a port scanner must
+    // not kill the job); only the overall rendezvous deadline is fatal.
+    auto deadline =
+        std::chrono::steady_clock::now() + std::chrono::seconds(120);
+    for (int registered = 0; registered < size - 1;) {
+      auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+          deadline - std::chrono::steady_clock::now()).count();
+      if (left <= 0)
+        return Status::Unknown("controller rendezvous timed out waiting "
+                               "for workers");
       TcpSocket conn;
-      s = listener_.Accept(&conn, 60000);
+      s = listener_.Accept(&conn, static_cast<int>(left));
       if (!s.ok()) return s;
+      // A silent rogue must not stall the serial accept loop.
+      conn.SetRecvTimeout(10000);
+      s = AuthAccept(conn, key);
+      if (!s.ok()) {
+        LOG(Warning) << "controller: dropped unauthenticated connection ("
+                     << s.reason << ")";
+        continue;
+      }
       // hello frame: "rank data_port host".  The self-reported host (the
       // worker's HOROVOD_HOSTNAME) is preferred over the observed peer
       // address: on multi-host jobs a worker co-located with rank 0 — or
@@ -58,19 +80,32 @@ Status Controller::Init(int rank, int size, const std::string& master_addr,
       // would make remote ranks dial loopback and hang.
       std::string hello;
       s = conn.RecvFrame(&hello);
-      if (!s.ok()) return s;
+      if (!s.ok()) {
+        LOG(Warning) << "controller: dropped connection before hello ("
+                     << s.reason << ")";
+        continue;
+      }
       int r = -1, dport = 0;
       char hostbuf[256] = {0};
       int n_parsed =
           std::sscanf(hello.c_str(), "%d %d %255s", &r, &dport, hostbuf);
-      if (n_parsed < 2 || r <= 0 || r >= size || workers_[r].valid())
+      if (n_parsed < 2 || r <= 0 || r >= size || workers_[r].valid()) {
+        // An AUTHENTICATED peer speaking garbage (or a duplicate rank) is
+        // a real job misconfiguration, not scanner noise — fail loudly.
+        if (key.empty()) {
+          LOG(Warning) << "controller: dropped bad hello: " << hello;
+          continue;  // unauthenticated mode: treat as noise
+        }
         return Status::Unknown("bad controller hello: " + hello);
+      }
       std::string host = (n_parsed >= 3) ? std::string(hostbuf) : "";
       if (host == "-") host.clear();  // worker had no HOROVOD_HOSTNAME
       if (host.empty()) host = conn.peer_addr();
       if (host.empty() || host == "0.0.0.0") host = "127.0.0.1";
       (*peers_out)[r] = PeerAddr{host, dport};
+      conn.SetRecvTimeout(0);  // registered: back to blocking reads
       workers_[r] = std::move(conn);
+      ++registered;
     }
     // Broadcast the peer table: "host port\n" per rank.
     std::ostringstream table;
@@ -84,6 +119,8 @@ Status Controller::Init(int rank, int size, const std::string& master_addr,
   }
 
   Status s = master_.Connect(master_addr, master_port);
+  if (!s.ok()) return s;
+  s = AuthConnect(master_, key);
   if (!s.ok()) return s;
   std::ostringstream hello;
   hello << rank << " " << my_data_port << " "
@@ -403,8 +440,56 @@ Response Controller::ConstructResponse(const std::string& name) {
           first.op_type == OpType::kReducescatter)
         return fail("Reducescatter with joined ranks supports only the Sum "
                     "reduction (tensor " + name + ").");
+      if (first.op_type == OpType::kAlltoall &&
+          std::any_of(p.requests.begin(), p.requests.end(),
+                      [](const Request& r) { return !r.splits.empty(); })) {
+        // Uneven alltoallv: every rank must supply a full splits vector;
+        // dim-0 may differ per rank (it is sum(splits)); trailing dims
+        // must agree.  Response carries the size x size element-count
+        // matrix (src-major) so every executor can lay out its exchange.
+        for (const auto& r : p.requests) {
+          if (r.splits.size() != static_cast<size_t>(size_))
+            return fail("Mismatched alltoall splits: rank " +
+                        std::to_string(r.rank) + " supplied " +
+                        std::to_string(r.splits.size()) + " splits for job "
+                        "size " + std::to_string(size_) + " (tensor " +
+                        name + "; all ranks must pass splits, or none).");
+          if (r.shape.empty() || r.shape.size() != first.shape.size() ||
+              !std::equal(r.shape.begin() + 1, r.shape.end(),
+                          first.shape.begin() + 1))
+            return fail("Mismatched alltoall trailing dimensions: rank " +
+                        std::to_string(first.rank) + " has " +
+                        ShapeStr(first.shape) + " but rank " +
+                        std::to_string(r.rank) + " has " + ShapeStr(r.shape) +
+                        " for tensor " + name + ".");
+          int64_t total = 0;
+          for (auto v : r.splits) {
+            if (v < 0)
+              return fail("Negative alltoall split on rank " +
+                          std::to_string(r.rank) + " (tensor " + name +
+                          ").");
+            total += v;
+          }
+          if (total != r.shape[0])
+            return fail("Alltoall splits of rank " + std::to_string(r.rank) +
+                        " sum to " + std::to_string(total) +
+                        " but its first dimension is " +
+                        std::to_string(r.shape[0]) + " (tensor " + name +
+                        ").");
+        }
+        int64_t trailing = 1;
+        for (size_t i = 1; i < first.shape.size(); ++i)
+          trailing *= first.shape[i];
+        resp.first_dims.assign(
+            static_cast<size_t>(size_) * static_cast<size_t>(size_), 0);
+        for (const auto& r : p.requests)
+          for (int dst = 0; dst < size_; ++dst)
+            resp.first_dims[static_cast<size_t>(r.rank) * size_ + dst] =
+                r.splits[dst] * trailing;
+        break;
+      }
       for (const auto& r : p.requests)
-        if (r.shape != first.shape)
+        if (r.shape != first.shape || !r.splits.empty())
           return fail("Mismatched " + std::string(OpTypeName(first.op_type)) +
                       " tensor shapes for tensor " + name + ".");
       if (first.shape.empty() || first.shape[0] % size_ != 0)

@@ -5,7 +5,10 @@
 #include <sys/socket.h>
 
 #include <algorithm>
+#include <chrono>
 #include <cstring>
+
+#include "auth.h"
 
 namespace hvd {
 
@@ -200,28 +203,59 @@ Status DataPlane::Connect(int rank, int size,
   size_ = size;
   peers_.clear();
   peers_.resize(size);
+  const std::string key = JobKey();
   // Connect to lower ranks; accept from higher ranks.  The rank id travels
   // first so accepts can be matched to slots.
   for (int r = 0; r < rank; ++r) {
     auto sock = std::unique_ptr<TcpSocket>(new TcpSocket());
     Status s = sock->Connect(peers[r].host, peers[r].port);
     if (!s.ok()) return s;
+    s = AuthConnect(*sock, key);
+    if (!s.ok()) return s;
     int32_t me = rank;
     s = sock->SendAll(&me, sizeof(me));
     if (!s.ok()) return s;
     peers_[r] = std::move(sock);
   }
-  for (int n = 0; n < size - rank - 1; ++n) {
+  // Unauthenticated/malformed connections are dropped and accepting
+  // continues (scanner resilience, same policy as the controller); only
+  // the overall deadline is fatal.
+  auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::seconds(120);
+  for (int registered = 0; registered < size - rank - 1;) {
+    auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+        deadline - std::chrono::steady_clock::now()).count();
+    if (left <= 0)
+      return Status::Unknown("data-plane mesh timed out waiting for peers");
     TcpSocket conn;
-    Status s = listener_.Accept(&conn, 60000);
+    Status s = listener_.Accept(&conn, static_cast<int>(left));
     if (!s.ok()) return s;
+    // A silent rogue must not stall the serial accept loop.
+    conn.SetRecvTimeout(10000);
+    s = AuthAccept(conn, key);
+    if (!s.ok()) {
+      LOG(Warning) << "data plane: dropped unauthenticated connection ("
+                   << s.reason << ")";
+      continue;
+    }
     int32_t who = -1;
     s = conn.RecvAll(&who, sizeof(who));
-    if (!s.ok()) return s;
-    if (who <= rank || who >= size || peers_[who])
+    if (!s.ok()) {
+      LOG(Warning) << "data plane: dropped connection before hello ("
+                   << s.reason << ")";
+      continue;
+    }
+    if (who <= rank || who >= size || peers_[who]) {
+      if (key.empty()) {
+        LOG(Warning) << "data plane: dropped bad hello from rank " << who;
+        continue;
+      }
       return Status::Unknown("bad data-plane hello from rank " +
                              std::to_string(who));
+    }
+    conn.SetRecvTimeout(0);  // registered: back to blocking reads
     peers_[who] = std::unique_ptr<TcpSocket>(new TcpSocket(std::move(conn)));
+    ++registered;
   }
   return Status::OK();
 }
@@ -450,6 +484,38 @@ Status DataPlane::Alltoall(const void* in, void* out, int64_t count,
     int from = (rank_ - k + size_) % size_;
     Status st = SendRecv(to, i + block * to, block,
                          from, o + block * from, block);
+    if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
+Status DataPlane::Alltoallv(const void* in, void* out,
+                            const std::vector<int64_t>& send_bytes,
+                            const std::vector<int64_t>& recv_bytes) {
+  // Uneven pairwise rotation: same schedule as Alltoall, per-peer sizes
+  // from the coordinator's splits matrix (later-Horovod alltoallv; the
+  // v0.18 reference has no alltoall at all, message.h:47-49).
+  if (send_bytes.size() != static_cast<size_t>(size_) ||
+      recv_bytes.size() != static_cast<size_t>(size_))
+    return Status::InvalidArgument("alltoallv counts length != size");
+  std::vector<int64_t> soff(size_ + 1, 0), roff(size_ + 1, 0);
+  for (int r = 0; r < size_; ++r) {
+    soff[r + 1] = soff[r] + send_bytes[r];
+    roff[r + 1] = roff[r] + recv_bytes[r];
+  }
+  const char* i = static_cast<const char*>(in);
+  char* o = static_cast<char*>(out);
+  if (send_bytes[rank_] != recv_bytes[rank_])
+    return Status::InvalidArgument("alltoallv self block mismatch");
+  std::memcpy(o + roff[rank_], i + soff[rank_],
+              static_cast<size_t>(send_bytes[rank_]));
+  for (int k = 1; k < size_; ++k) {
+    int to = (rank_ + k) % size_;
+    int from = (rank_ - k + size_) % size_;
+    Status st = SendRecv(to, i + soff[to],
+                         static_cast<size_t>(send_bytes[to]),
+                         from, o + roff[from],
+                         static_cast<size_t>(recv_bytes[from]));
     if (!st.ok()) return st;
   }
   return Status::OK();

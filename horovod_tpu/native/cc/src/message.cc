@@ -75,6 +75,7 @@ std::string RequestList::Serialize() const {
     PutPod<int32_t>(&buf, r.arg);
     PutStr(&buf, r.name);
     PutVec(&buf, r.shape);
+    PutVec(&buf, r.splits);
   }
   return buf;
 }
@@ -91,7 +92,8 @@ Status RequestList::Parse(const std::string& buf, RequestList* out) {
   for (auto& r : out->requests) {
     int32_t op, dt;
     if (!rd.GetPod(&r.rank) || !rd.GetPod(&op) || !rd.GetPod(&dt) ||
-        !rd.GetPod(&r.arg) || !rd.GetStr(&r.name) || !rd.GetVec(&r.shape))
+        !rd.GetPod(&r.arg) || !rd.GetStr(&r.name) || !rd.GetVec(&r.shape) ||
+        !rd.GetVec(&r.splits))
       return Malformed("request");
     r.op_type = static_cast<OpType>(op);
     r.dtype = static_cast<DataType>(dt);

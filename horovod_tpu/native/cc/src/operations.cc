@@ -168,11 +168,12 @@ int64_t ExecuteResponse(const Response& resp) {
   // Refresh the response cache from this rank's own entry params — every
   // rank sees the same response stream in the same order, which keeps
   // name->slot assignment identical everywhere (see response_cache.h).
-  // Allgather is excluded: its dim-0 differs per rank, so the coordinator
-  // could not faithfully expand another rank's bit from its own params.
+  // The response rides along so per-rank-dim ops (allgather dim-0,
+  // alltoall splits) can be bit-announced too: the coordinator expands
+  // another rank's bit using the response's recorded first_dims rather
+  // than its own (different) local dims.
   if (g->cache_enabled && resp.cacheable &&
-      resp.op_type != OpType::kBarrier && resp.op_type != OpType::kJoin &&
-      resp.op_type != OpType::kAllgather) {
+      resp.op_type != OpType::kBarrier && resp.op_type != OpType::kJoin) {
     for (auto& e : entries) {
       Request params;
       params.rank = g->rank;
@@ -181,7 +182,8 @@ int64_t ExecuteResponse(const Response& resp) {
       params.arg = e->arg;
       params.name = e->name;
       params.shape = e->shape;
-      g->cache.Put(params);
+      params.splits = e->splits;
+      g->cache.Put(params, resp);
     }
   }
 
@@ -294,11 +296,40 @@ int64_t ExecuteResponse(const Response& resp) {
     case OpType::kAlltoall: {
       auto& e = entries[0];
       g->timeline.Start(e->name, "ALLTOALL");
-      e->output.resize_uninit(static_cast<size_t>(e->count) * esz);
-      e->output_count = e->count;
-      g->timeline.ActivityStart(e->name, "TCP_ALLTOALL");
-      st = g->data_plane.Alltoall(e->input, e->output.data(), e->count,
-                                  resp.dtype);
+      const size_t sz = static_cast<size_t>(g->size);
+      if (resp.first_dims.size() == sz * sz) {
+        // Uneven alltoallv: first_dims is the src-major element-count
+        // matrix the coordinator built from every rank's splits.
+        int64_t trailing = 1;
+        for (size_t i = 1; i < e->shape.size(); ++i) trailing *= e->shape[i];
+        std::vector<int64_t> send_b(g->size), recv_b(g->size);
+        int64_t out_elems = 0;
+        e->recv_splits.assign(g->size, 0);
+        for (int r = 0; r < g->size; ++r) {
+          send_b[r] = resp.first_dims[static_cast<size_t>(g->rank) * sz + r] *
+                      static_cast<int64_t>(esz);
+          int64_t rc = resp.first_dims[static_cast<size_t>(r) * sz + g->rank];
+          recv_b[r] = rc * static_cast<int64_t>(esz);
+          out_elems += rc;
+          e->recv_splits[r] = trailing > 0 ? rc / trailing : 0;
+        }
+        e->output.resize_uninit(static_cast<size_t>(out_elems) * esz);
+        e->output_count = out_elems;
+        g->timeline.ActivityStart(e->name, "TCP_ALLTOALLV");
+        st = g->data_plane.Alltoallv(e->input, e->output.data(), send_b,
+                                     recv_b);
+      } else {
+        e->output.resize_uninit(static_cast<size_t>(e->count) * esz);
+        e->output_count = e->count;
+        int64_t trailing = 1;
+        for (size_t i = 1; i < e->shape.size(); ++i) trailing *= e->shape[i];
+        int64_t rows =
+            trailing > 0 ? e->count / trailing / g->size : 0;
+        e->recv_splits.assign(g->size, rows);
+        g->timeline.ActivityStart(e->name, "TCP_ALLTOALL");
+        st = g->data_plane.Alltoall(e->input, e->output.data(), e->count,
+                                    resp.dtype);
+      }
       g->timeline.ActivityEnd(e->name);
       g->timeline.End(e->name);
       break;
@@ -399,9 +430,11 @@ void BackgroundThread() {
       g->timeline.NegotiateStart(r.name, r.op_type);
       // Steady state: a tensor whose params match the cache travels as one
       // bit instead of a serialized request (reference cached fast path,
-      // controller.cc:165-179).
+      // controller.cc:165-179).  Allgather/alltoall included: the hit bit
+      // proves OUR dims are unchanged, and the coordinator recovers them
+      // from the cached response's first_dims (see ResponseCache::Expand).
       int64_t slot = g->cache_enabled ? g->cache.Lookup(r) : -1;
-      if (slot >= 0 && r.op_type != OpType::kAllgather)
+      if (slot >= 0)
         ResponseCache::SetBit(&mine.cache_hits, slot);
       else
         mine.requests.push_back(std::move(r));
@@ -537,7 +570,8 @@ int hvd_local_size() { return g ? g->local_size : -1; }
 int hvd_is_initialized() { return g && g->initialized.load() ? 1 : 0; }
 
 int64_t hvd_enqueue(int op_type, const char* name, const void* data,
-                    const int64_t* shape, int32_t ndim, int dtype, int arg) {
+                    const int64_t* shape, int32_t ndim, int dtype, int arg,
+                    const int64_t* splits, int32_t nsplits) {
   if (g == nullptr || !g->initialized.load()) {
     SetLastError("runtime not initialized");
     return -1;
@@ -548,6 +582,8 @@ int64_t hvd_enqueue(int op_type, const char* name, const void* data,
   e->dtype = static_cast<DataType>(dtype);
   e->arg = arg;
   e->shape.assign(shape, shape + ndim);
+  if (splits != nullptr && nsplits > 0)
+    e->splits.assign(splits, splits + nsplits);
   e->input = data;
   e->count = 1;
   for (int i = 0; i < ndim; ++i) e->count *= shape[i];
@@ -589,6 +625,25 @@ int64_t hvd_output_size(int64_t handle) {
   if (g == nullptr) return -1;
   auto e = g->queue.Get(handle);
   return e ? e->output_count : -1;
+}
+
+int hvd_read_splits(int64_t handle, int64_t* dst, int32_t n) {
+  if (g == nullptr) {
+    SetLastError("runtime not initialized");
+    return 1;
+  }
+  auto e = g->queue.Get(handle);
+  if (!e || !e->done || !e->status.ok()) {
+    SetLastError("splits not available");
+    return 1;
+  }
+  if (static_cast<size_t>(n) < e->recv_splits.size()) {
+    SetLastError("splits buffer too small");
+    return 1;
+  }
+  for (size_t i = 0; i < e->recv_splits.size(); ++i)
+    dst[i] = e->recv_splits[i];
+  return 0;
 }
 
 int hvd_read_output(int64_t handle, void* dst, int64_t count) {

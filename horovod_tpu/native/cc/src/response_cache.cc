@@ -13,7 +13,7 @@ void ResponseCache::Initialize(int64_t capacity) {
 
 static bool SameParams(const Request& a, const Request& b) {
   return a.op_type == b.op_type && a.dtype == b.dtype && a.arg == b.arg &&
-         a.shape == b.shape;
+         a.shape == b.shape && a.splits == b.splits;
 }
 
 int64_t ResponseCache::Lookup(const Request& r) const {
@@ -34,8 +34,37 @@ std::vector<Request> ResponseCache::Expand(const std::vector<uint64_t>& bits,
       word &= word - 1;
       size_t slot = w * 64 + static_cast<size_t>(b);
       if (slot < slots_.size() && slots_[slot].used) {
-        Request r = slots_[slot].params;
+        const Slot& s = slots_[slot];
+        Request r = s.params;
         r.rank = rank;
+        // This replica's params carry THIS rank's dims; for per-rank-dim
+        // ops, substitute the announcer's dims from the stored response
+        // (identical on every rank).  Trailing dims agree by validation,
+        // so they come from our own params.
+        int64_t trailing = 1;
+        for (size_t i = 1; i < s.params.shape.size(); ++i)
+          trailing *= s.params.shape[i];
+        const size_t n = s.resp.first_dims.size();
+        if (s.params.op_type == OpType::kAllgather && !r.shape.empty() &&
+            trailing > 0 && static_cast<size_t>(rank) < n) {
+          // first_dims[r] = rank r's TOTAL element count.
+          r.shape[0] = s.resp.first_dims[rank] / trailing;
+        } else if (s.params.op_type == OpType::kAlltoall &&
+                   !s.params.splits.empty() && !r.shape.empty() &&
+                   trailing > 0) {
+          // first_dims is the size x size src-major element-count matrix.
+          const size_t size = s.params.splits.size();
+          if (n == size * size && static_cast<size_t>(rank) < size) {
+            int64_t total = 0;
+            for (size_t dst = 0; dst < size; ++dst) {
+              r.splits[dst] =
+                  s.resp.first_dims[static_cast<size_t>(rank) * size + dst] /
+                  trailing;
+              total += r.splits[dst];
+            }
+            r.shape[0] = total;
+          }
+        }
         out.push_back(std::move(r));
       }
     }
@@ -43,13 +72,15 @@ std::vector<Request> ResponseCache::Expand(const std::vector<uint64_t>& bits,
   return out;
 }
 
-void ResponseCache::Put(const Request& params) {
+void ResponseCache::Put(const Request& params, const Response& resp) {
   if (!enabled()) return;
   auto it = by_name_.find(params.name);
   if (it != by_name_.end()) {
     // Same tensor, possibly new params (e.g. changed batch dim): refresh in
     // place, keeping the slot stable.
-    slots_[static_cast<size_t>(it->second)].params = params;
+    Slot& s = slots_[static_cast<size_t>(it->second)];
+    s.params = params;
+    s.resp = resp;
     return;
   }
   int64_t slot;
@@ -68,6 +99,7 @@ void ResponseCache::Put(const Request& params) {
   }
   Slot& s = slots_[static_cast<size_t>(slot)];
   s.params = params;
+  s.resp = resp;
   s.used = true;
   by_name_[params.name] = slot;
   fifo_.push_back(slot);

@@ -165,6 +165,13 @@ Status TcpSocket::Connect(const std::string& addr, int port, int timeout_ms) {
   }
 }
 
+void TcpSocket::SetRecvTimeout(int ms) const {
+  timeval tv{};
+  tv.tv_sec = ms / 1000;
+  tv.tv_usec = (ms % 1000) * 1000;
+  setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+}
+
 Status TcpSocket::SendAll(const void* data, size_t n) const {
   const char* p = static_cast<const char*>(data);
   while (n > 0) {
@@ -205,6 +212,13 @@ Status TcpSocket::RecvFrame(std::string* out) const {
   uint64_t len = 0;
   Status s = RecvAll(&len, sizeof(len));
   if (!s.ok()) return s;
+  // Sanity cap: a garbage length prefix (e.g. random bytes from a port
+  // scanner) must become a clean error, not a std::length_error from an
+  // absurd resize that takes the process down.  1 GB is far above any
+  // real control-plane frame.
+  if (len > (1ull << 30))
+    return Status::Unknown("frame length " + std::to_string(len) +
+                           " exceeds sanity cap");
   out->resize(len);
   return len ? RecvAll(&(*out)[0], len) : Status::OK();
 }

@@ -35,6 +35,7 @@ std::vector<Request> TensorQueue::PopAnnouncements(int32_t rank) {
     r.arg = e->arg;
     r.name = e->name;
     r.shape = e->shape;
+    r.splits = e->splits;
     out.push_back(std::move(r));
   }
   to_announce_.clear();

@@ -106,6 +106,8 @@ class Runtime:
             ctypes.c_int,            # ndim
             ctypes.c_int,            # dtype code
             ctypes.c_int,            # reduce-op code / root rank
+            ctypes.POINTER(ctypes.c_longlong),  # alltoall splits (or None)
+            ctypes.c_int,            # number of splits
         ]
         lib.hvd_enqueue.restype = ctypes.c_longlong   # handle, <0 on error
         lib.hvd_poll.argtypes = [ctypes.c_longlong]
@@ -117,6 +119,10 @@ class Runtime:
         lib.hvd_read_output.argtypes = [ctypes.c_longlong, ctypes.c_void_p,
                                         ctypes.c_longlong]
         lib.hvd_read_output.restype = ctypes.c_int
+        lib.hvd_read_splits.argtypes = [ctypes.c_longlong,
+                                        ctypes.POINTER(ctypes.c_longlong),
+                                        ctypes.c_int]
+        lib.hvd_read_splits.restype = ctypes.c_int
         lib.hvd_release.argtypes = [ctypes.c_longlong]
         lib.hvd_release.restype = None
         lib.hvd_last_error.argtypes = []
@@ -138,22 +144,35 @@ class Runtime:
 
     # -- collectives -------------------------------------------------------
 
-    def _submit(self, op: int, name: str, arr: np.ndarray, arg: int = 0) -> int:
+    def _submit(self, op: int, name: str, arr: np.ndarray, arg: int = 0,
+                splits=None) -> int:
         arr = np.ascontiguousarray(arr)
         code = _DTYPE_CODES.get(arr.dtype)
         if code is None:
             raise ValueError(f"unsupported dtype for eager collective: {arr.dtype}")
         shape = (ctypes.c_longlong * max(arr.ndim, 1))(*arr.shape)
+        if splits is not None:
+            sp = np.ascontiguousarray(splits, dtype=np.int64).ravel()
+            csplits = (ctypes.c_longlong * sp.size)(*sp)
+            nsplits = sp.size
+        else:
+            csplits, nsplits = None, 0
         h = self._lib.hvd_enqueue(
             op, name.encode(), arr.ctypes.data_as(ctypes.c_void_p),
-            shape, arr.ndim, code, arg)
+            shape, arr.ndim, code, arg, csplits, nsplits)
         if h < 0:
             raise RuntimeError(self._lib.hvd_last_error().decode())
         with self._inflight_lock:
             self._inflight[h] = arr
         return h
 
-    def _wait_read(self, h: int, dtype, trailing_shape) -> np.ndarray:
+    def _wait_read(self, h: int, dtype, trailing_shape,
+                   read_splits: bool = False):
+        """Wait, (optionally) read received splits, read output, release.
+
+        With ``read_splits`` returns ``(output, received_splits)`` —
+        splits must be read BEFORE hvd_read_output, which releases the
+        native table entry (c_api.h contract)."""
         rc = self._lib.hvd_wait(h)
         with self._inflight_lock:
             self._inflight.pop(h, None)
@@ -161,6 +180,14 @@ class Runtime:
             err = self._lib.hvd_last_error().decode()
             self._lib.hvd_release(h)   # drop the native table entry
             raise RuntimeError(err)
+        received = None
+        if read_splits:
+            recv = (ctypes.c_longlong * self.size)()
+            if self._lib.hvd_read_splits(h, recv, self.size) != 0:
+                err = self._lib.hvd_last_error().decode()
+                self._lib.hvd_release(h)
+                raise RuntimeError(err)
+            received = np.array(recv[:], dtype=np.int64)
         n = self._lib.hvd_output_size(h)
         out = np.empty(int(n), dtype=dtype)
         rc = self._lib.hvd_read_output(
@@ -172,7 +199,7 @@ class Runtime:
         if trailing_shape:
             inner = int(np.prod(trailing_shape)) or 1
             out = out.reshape((int(n) // inner,) + tuple(trailing_shape))
-        return out
+        return (out, received) if read_splits else out
 
     def allreduce(self, name: str, arr: np.ndarray, op_code: int) -> np.ndarray:
         arr = np.asarray(arr)
@@ -192,12 +219,16 @@ class Runtime:
         return self._wait_read(h, arr.dtype, arr.shape[1:]).reshape(arr.shape)
 
     def alltoall(self, name: str, arr: np.ndarray,
-                 splits: Optional[np.ndarray] = None) -> np.ndarray:
+                 splits: Optional[np.ndarray] = None):
+        """Returns ``(output, received_splits)`` — the concatenated blocks
+        and the dim-0 row count received from each source rank (parity
+        with later-Horovod alltoall's received_splits)."""
         arr = np.asarray(arr)
-        if splits is not None:
-            raise NotImplementedError("uneven alltoall splits: TODO native")
-        h = self._submit(3, name, arr, 0)
-        return self._wait_read(h, arr.dtype, arr.shape[1:])
+        if arr.ndim == 0:
+            arr = arr.reshape(1)
+        h = self._submit(3, name, arr, 0, splits=splits)
+        return self._wait_read(h, arr.dtype, arr.shape[1:],
+                               read_splits=True)
 
     def reducescatter(self, name: str, arr: np.ndarray, op_code: int) -> np.ndarray:
         arr = np.asarray(arr)

@@ -245,10 +245,21 @@ def _eager_broadcast(x, root_rank: int, name: str):
 
 
 def _eager_alltoall(x, splits, name: str):
+    """Returns ``(output, received_splits)``; received_splits[r] = dim-0
+    rows that came from rank r (later-Horovod alltoall contract)."""
     rt = basics.runtime()
     arr = np.asarray(x)
     if rt is None:
-        return arr.copy()
+        if arr.ndim == 0:
+            arr = arr.reshape(1)
+        rows = arr.shape[0] if arr.ndim else 1
+        if splits is not None:
+            sp = np.asarray(splits, np.int64).ravel()
+            if sp.size != 1 or sp.sum() != rows:
+                raise ValueError(
+                    f"alltoall splits {sp.tolist()} do not match first "
+                    f"dimension {rows} for size-1 job")
+        return arr.copy(), np.array([rows], np.int64)
     return rt.alltoall(name, arr, splits)
 
 
@@ -522,10 +533,20 @@ def alltoall(tensor, splits=None, name=None, axis_name=None):
         return lax.all_to_all(tensor, ax, split_axis=0, concat_axis=0,
                               tiled=True)
     if _is_traced(tensor):
-        return _plain_jit_fallback(tensor, "alltoall")
+        out = _plain_jit_fallback(tensor, "alltoall")
+        if splits is not None:
+            # Keep the tuple contract under a plain-jit trace too (size-1
+            # identity: everything came from self).
+            return out, jnp.asarray(np.asarray([out.shape[0]], np.int64))
+        return out
     basics._check_initialized()
     nm = _auto_name("alltoall", name)
-    return jnp.asarray(_eager_alltoall(tensor, splits, nm))
+    out, received = _eager_alltoall(tensor, splits, nm)
+    if splits is not None:
+        # Later-Horovod contract: with explicit splits the caller gets the
+        # received row counts back (needed to slice the uneven output).
+        return jnp.asarray(out), jnp.asarray(received)
+    return jnp.asarray(out)
 
 
 def join() -> int:

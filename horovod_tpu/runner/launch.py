@@ -47,22 +47,38 @@ class RankProcess:
         self._pump: Optional[threading.Thread] = None
 
     def start(self) -> None:
+        self._stdin_secret = None   # set only on the ssh path
         if is_local(self.info.hostname):
             cmd = self.command
             env = self.env
         else:
             # Remote spawn over ssh with env inlined (reference
-            # gloo_run.py:211-254 builds the same kind of command line).
+            # gloo_run.py:211-254 builds the same kind of command line) —
+            # EXCEPT the job secret: anything on the command line is
+            # world-readable via ps on both ends, which would defeat the
+            # auth handshake exactly in the multi-host case it exists
+            # for.  The secret travels over ssh stdin instead.
             exports = " ".join(
                 f"{k}={shlex.quote(v)}" for k, v in sorted(self.env.items())
-                if k.startswith(("HOROVOD_", "PYTHONPATH", "PATH", "XLA_",
-                                 "JAX_")))
-            remote = f"cd {shlex.quote(os.getcwd())} && env {exports} " + \
+                if k != "HOROVOD_SECRET_KEY" and
+                k.startswith(("HOROVOD_", "PYTHONPATH", "PATH", "XLA_",
+                              "JAX_")))
+            self._stdin_secret = self.env.get("HOROVOD_SECRET_KEY")
+            read_key = ("IFS= read -r HOROVOD_SECRET_KEY; "
+                        "export HOROVOD_SECRET_KEY; "
+                        if self._stdin_secret else "")
+            remote = read_key + \
+                f"cd {shlex.quote(os.getcwd())} && env {exports} " + \
                 " ".join(shlex.quote(c) for c in self.command)
-            cmd = ["ssh", "-o", "StrictHostKeyChecking=no",
+            # HOROVOD_SSH_CMD: override for tests and exotic transports
+            # (reference horovodrun has no override; its ssh path is
+            # untested for the same reason ours would otherwise be).
+            ssh = os.environ.get("HOROVOD_SSH_CMD", "ssh")
+            cmd = [ssh, "-o", "StrictHostKeyChecking=no",
                    self.info.hostname, remote]
             env = dict(os.environ)
 
+        stdin_target = subprocess.PIPE if self._stdin_secret else None
         stdout_target = subprocess.PIPE
         if self.output_dir:
             rank_dir = os.path.join(self.output_dir,
@@ -71,14 +87,26 @@ class RankProcess:
             self._stdout_f = open(os.path.join(rank_dir, "stdout"), "wb")
             self._stderr_f = open(os.path.join(rank_dir, "stderr"), "wb")
             self.proc = subprocess.Popen(
-                cmd, env=env, stdout=self._stdout_f, stderr=self._stderr_f,
-                start_new_session=True)
+                cmd, env=env, stdin=stdin_target, stdout=self._stdout_f,
+                stderr=self._stderr_f, start_new_session=True)
+            self._feed_secret()
             return
         self.proc = subprocess.Popen(
-            cmd, env=env, stdout=stdout_target, stderr=subprocess.STDOUT,
-            start_new_session=True)
+            cmd, env=env, stdin=stdin_target, stdout=stdout_target,
+            stderr=subprocess.STDOUT, start_new_session=True)
+        self._feed_secret()
         self._pump = threading.Thread(target=self._pump_output, daemon=True)
         self._pump.start()
+
+    def _feed_secret(self) -> None:
+        if self._stdin_secret and self.proc.stdin is not None:
+            try:
+                self.proc.stdin.write(self._stdin_secret.encode() + b"\n")
+                self.proc.stdin.flush()
+            except (BrokenPipeError, OSError):
+                pass  # rank died at spawn; the supervisor will notice
+            finally:
+                self.proc.stdin.close()
 
     def _pump_output(self) -> None:
         prefix = f"[{self.info.rank}]<stdout>:" if self.prefix_output else ""

@@ -120,11 +120,23 @@ def run_command(args) -> int:
 
     infos = hosts.allocate(host_list, np_)
     extra_env = config_parser.env_from_args(args)
+    # One shared secret per job unless the caller pinned one (e.g. to join
+    # an externally coordinated job).
+    extra_env.setdefault(
+        "HOROVOD_SECRET_KEY",
+        os.environ.get("HOROVOD_SECRET_KEY") or config_parser.job_secret())
 
     # The coordinator lives on rank 0's host.  Only an all-local job may use
     # loopback: with remote ranks in the mix they must reach rank 0 by its
     # real hostname.
     all_local = all(launch.is_local(i.hostname) for i in infos)
+    if not all_local:
+        # Fail fast on dead hosts before any rank spawns (reference
+        # run.py:59-112 cached ssh reachability check).
+        from horovod_tpu.runner import network
+        remote = sorted({i.hostname for i in infos
+                         if not launch.is_local(i.hostname)})
+        network.check_hosts_reachable(remote)
     addr = "127.0.0.1" if all_local else infos[0].hostname
     port = args.rendezvous_port or launch.find_free_port()
     env_per_rank = [

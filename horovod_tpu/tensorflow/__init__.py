@@ -110,7 +110,9 @@ def _py_collective(fn, inputs, out_dtype, name):
     else:
         out = tf.py_function(fn, inputs, Tout=out_dtype,
                              name=_tf_node_name(name))
-    graph._hvd_collective_chain = out
+    # Multi-output collectives (alltoall with splits) chain on their first
+    # output; any one output suffices as the ordering anchor.
+    graph._hvd_collective_chain = out[0] if isinstance(out, list) else out
     return out
 
 
@@ -237,9 +239,22 @@ def alltoall(tensor, splits=None, name=None):
     nm = _c._auto_name("alltoall", name)
     tensor = tf.convert_to_tensor(tensor)
 
+    if splits is not None:
+        # Later-Horovod contract: (output, received_splits) with splits —
+        # a two-output py_function so graph mode threads both through.
+        def run2(v):
+            out, received = _c._eager_alltoall(v.numpy(), splits, nm)
+            return tf.convert_to_tensor(out), tf.convert_to_tensor(received)
+
+        out, received = _py_collective(run2, [tensor],
+                                       [tensor.dtype, tf.int64], nm)
+        out.set_shape(tf.TensorShape([None]).concatenate(tensor.shape[1:]))
+        received.set_shape([basics.size()])
+        return out, received
+
     def run(v):
-        return tf.convert_to_tensor(
-            _c._eager_alltoall(v.numpy(), splits, nm))
+        out, _ = _c._eager_alltoall(v.numpy(), splits, nm)
+        return tf.convert_to_tensor(out)
 
     out = _py_collective(run, [tensor], tensor.dtype, nm)
     out.set_shape(tf.TensorShape([None]).concatenate(tensor.shape[1:]))

@@ -185,7 +185,12 @@ def broadcast_(tensor, root_rank, name=None):
 def alltoall(tensor, splits=None, name=None):
     basics._check_initialized()
     nm = _c._auto_name("alltoall", name)
-    out = _c._eager_alltoall(_to_numpy(tensor), splits, nm)
+    if splits is not None and torch.is_tensor(splits):
+        splits = splits.detach().cpu().numpy()
+    out, received = _c._eager_alltoall(_to_numpy(tensor), splits, nm)
+    if splits is not None:
+        # Later-Horovod contract: (output, received_splits) with splits.
+        return _from_numpy(out, tensor), torch.as_tensor(received)
     return _from_numpy(out, tensor)
 
 

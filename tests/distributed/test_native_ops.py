@@ -243,3 +243,79 @@ def test_poll_and_synchronize(hvd, rank, size):
     out = hvd.synchronize(h)
     assert hvd.poll(h)  # completed handles poll true
     np.testing.assert_allclose(np.asarray(out), np.full(2, float(size)))
+
+
+def test_alltoall_uneven_splits(hvd, rank, size):
+    """Uneven alltoallv (later-Horovod `splits` contract): rank r sends
+    (dst+1) rows to each destination dst; returns (output,
+    received_splits)."""
+    splits = np.arange(1, size + 1, dtype=np.int64)          # 1,2,...,size
+    rows = int(splits.sum())
+    # Row value encodes (src, dst) so placement is fully checkable.
+    x = np.zeros((rows, 2), np.float32)
+    off = 0
+    for dst in range(size):
+        for k in range(int(splits[dst])):
+            x[off] = [100 * rank + dst, k]
+            off += 1
+    out, received = hvd.alltoall(x, splits=splits, name="t.a2av")
+    out = np.asarray(out)
+    received = np.asarray(received)
+    # Every source sent me (rank+1) rows.
+    np.testing.assert_array_equal(received, np.full(size, rank + 1))
+    assert out.shape == (int(received.sum()), 2)
+    off = 0
+    for src in range(size):
+        for k in range(rank + 1):
+            np.testing.assert_allclose(out[off], [100 * src + rank, k])
+            off += 1
+
+
+def test_alltoall_uneven_splits_mismatch_error(hvd, rank, size):
+    """Some ranks passing splits and others not must produce a clean
+    coordinated error on every rank."""
+    if size < 2:
+        pytest.skip("needs >= 2 ranks")
+    x = np.ones((size, 1), np.float32)
+    splits = np.ones(size, np.int64) if rank == 0 else None
+    with pytest.raises(RuntimeError, match="splits"):
+        hvd.alltoall(x, splits=splits, name="t.a2av.bad")
+
+
+def test_allgather_steady_state_cached(hvd, rank, size):
+    """Variable-dim allgather with STABLE per-rank shapes must ride the
+    response cache (bit announcements) and stay exact across steps, and a
+    dim-0 change on one rank must cleanly renegotiate."""
+    for step in range(5):
+        me = np.full((rank + 1, 2), float(rank + step), np.float32)
+        out = np.asarray(hvd.allgather(me, name="t.ag.cache"))
+        assert out.shape == (sum(range(1, size + 1)), 2)
+        off = 0
+        for r in range(size):
+            np.testing.assert_allclose(out[off:off + r + 1],
+                                       float(r + step))
+            off += r + 1
+    # Dim-0 change: rank 0 grows; everyone must agree on the new layout.
+    n0 = 3 if rank == 0 else rank + 1
+    me = np.full((n0, 2), float(rank), np.float32)
+    out = np.asarray(hvd.allgather(me, name="t.ag.cache"))
+    total = 3 + sum(r + 1 for r in range(1, size))
+    assert out.shape == (total, 2)
+
+
+def test_alltoall_uneven_steady_state_cached(hvd, rank, size):
+    """Uneven alltoall with stable splits must survive the cached
+    (bit-announced) path."""
+    splits = np.arange(1, size + 1, dtype=np.int64)
+    rows = int(splits.sum())
+    for step in range(4):
+        x = np.full((rows, 1), float(rank + step), np.float32)
+        out, received = hvd.alltoall(x, splits=splits, name="t.a2av.cache")
+        out = np.asarray(out)
+        np.testing.assert_array_equal(np.asarray(received),
+                                      np.full(size, rank + 1))
+        off = 0
+        for src in range(size):
+            np.testing.assert_allclose(
+                np.asarray(out)[off:off + rank + 1], float(src + step))
+            off += rank + 1

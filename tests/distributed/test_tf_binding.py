@@ -136,3 +136,26 @@ def test_tf_shape_mismatch_error(tfhvd, rank, size):
     x = tf.ones((rank + 1,))   # different shape per rank
     with pytest.raises(Exception, match="[Mm]ismatch|shape"):
         tfhvd.allreduce(x, average=False, name="tf.err.shape")
+
+
+def test_tf_alltoall_uneven_splits(tfhvd, rank, size):
+    """alltoall with explicit splits returns (output, received_splits),
+    both in eager and traced-graph mode (two-output py_function)."""
+    splits = tf.constant(np.arange(1, size + 1, dtype=np.int64))
+    rows = int(np.arange(1, size + 1).sum())
+    x = tf.ones((rows, 2)) * rank
+    out, received = tfhvd.alltoall(x, splits=np.arange(1, size + 1,
+                                                      dtype=np.int64),
+                                   name="tf.a2av")
+    assert np.array_equal(received.numpy(), np.full(size, rank + 1))
+    assert out.shape[0] == (rank + 1) * size
+
+    @tf.function
+    def step(v):
+        return tfhvd.alltoall(v, splits=np.arange(1, size + 1,
+                                                  dtype=np.int64),
+                              name="tf.a2av.graph")
+    out2, received2 = step(x)
+    assert np.array_equal(received2.numpy(), np.full(size, rank + 1))
+    assert out2.shape[0] == (rank + 1) * size
+    del splits

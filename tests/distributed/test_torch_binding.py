@@ -155,3 +155,18 @@ def test_broadcast_optimizer_state_resume(thvd, rank, size):
     steps = [int(v["step"]) for v in sd["state"].values()]
     gathered = thvd.allgather_object(steps, name="opt.steps")
     assert all(g == gathered[0] for g in gathered)
+
+
+def test_torch_alltoall_uneven_splits(thvd, rank, size):
+    """alltoall with splits returns (output, received_splits) as torch
+    tensors (later-Horovod contract)."""
+    import torch
+    splits = torch.arange(1, size + 1, dtype=torch.int64)
+    rows = int(splits.sum())
+    x = torch.full((rows, 2), float(rank))
+    out, received = thvd.alltoall(x, splits=splits, name="th.a2av")
+    assert torch.equal(received, torch.full((size,), rank + 1,
+                                            dtype=received.dtype))
+    assert out.shape == ((rank + 1) * size, 2)
+    assert not torch.isnan(out).any()
+    assert (out[:rank + 1] == 0).all()  # block from rank 0

@@ -145,3 +145,180 @@ def test_three_process_job(tmp_path):
     """)
     res = _hvdrun([], script=script, np_=3, timeout=120, tmp_path=tmp_path)
     assert res.returncode == 0, res.stdout + res.stderr
+
+
+# ---------------------------------------------------------------------------
+# Connection authentication (reference run/common/network.py:50-84: HMAC-
+# signed launcher RPC; here a mutual HMAC-SHA256 handshake on controller and
+# data-plane connects, keyed by the launcher-generated HOROVOD_SECRET_KEY).
+# ---------------------------------------------------------------------------
+
+def _rank_env(rank, size, port, key):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        "HOROVOD_RANK": str(rank),
+        "HOROVOD_SIZE": str(size),
+        "HOROVOD_LOCAL_RANK": str(rank),
+        "HOROVOD_LOCAL_SIZE": str(size),
+        "HOROVOD_RENDEZVOUS_ADDR": "127.0.0.1",
+        "HOROVOD_RENDEZVOUS_PORT": str(port),
+        "HOROVOD_SECRET_KEY": key,
+    })
+    env.pop("XLA_FLAGS", None)
+    return env
+
+
+_AUTH_SCRIPT = textwrap.dedent("""\
+    import numpy as np
+    import horovod_tpu as hvd
+    hvd.init()
+    out = np.asarray(hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
+                                   name="auth.ok"))
+    np.testing.assert_allclose(out, np.full(4, float(hvd.size())))
+    print("AUTH_JOB_OK", flush=True)
+    hvd.shutdown()
+""")
+
+
+def test_wrong_key_connect_rejected(tmp_path):
+    """A rank holding a different HOROVOD_SECRET_KEY must be refused at the
+    rendezvous with an auth error, not admitted or hung."""
+    import base64
+    import socket as pysocket
+
+    script = tmp_path / "auth_job.py"
+    script.write_text(_AUTH_SCRIPT)
+    with pysocket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    key = base64.urlsafe_b64encode(b"k" * 32).decode()
+    wrong = base64.urlsafe_b64encode(b"x" * 32).decode()
+
+    rank0 = subprocess.Popen(
+        [sys.executable, str(script)], env=_rank_env(0, 2, port, key),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=REPO)
+    try:
+        rank1 = subprocess.run(
+            [sys.executable, str(script)], env=_rank_env(1, 2, port, wrong),
+            capture_output=True, text=True, timeout=60, cwd=REPO)
+        assert rank1.returncode != 0
+        assert "auth" in (rank1.stdout + rank1.stderr).lower(), (
+            rank1.stdout + rank1.stderr)
+    finally:
+        rank0.kill()
+        rank0.wait()
+
+
+def test_rogue_connection_ignored(tmp_path):
+    """Garbage/unauthenticated connects to the rendezvous port must be
+    dropped while the real job completes (scanner resilience)."""
+    import base64
+    import socket as pysocket
+    import threading
+    import time
+
+    script = tmp_path / "auth_job.py"
+    script.write_text(_AUTH_SCRIPT)
+    with pysocket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    key = base64.urlsafe_b64encode(b"k" * 32).decode()
+
+    rank0 = subprocess.Popen(
+        [sys.executable, str(script)], env=_rank_env(0, 2, port, key),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=REPO)
+
+    def rogue():
+        # Let rank 0 start listening, then poke it with garbage and with a
+        # connect-and-say-nothing probe (must not stall the accept loop).
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                c = pysocket.create_connection(("127.0.0.1", port),
+                                               timeout=2)
+                break
+            except OSError:
+                time.sleep(0.2)
+        else:
+            return
+        with c:
+            c.sendall(b"\xff" * 64)  # malformed handshake reply
+            time.sleep(0.5)
+        with pysocket.create_connection(("127.0.0.1", port), timeout=2):
+            time.sleep(0.5)  # silent probe; server times it out
+
+    th = threading.Thread(target=rogue)
+    th.start()
+    time.sleep(2)  # give the rogue the first connects
+    try:
+        rank1 = subprocess.run(
+            [sys.executable, str(script)], env=_rank_env(1, 2, port, key),
+            capture_output=True, text=True, timeout=120, cwd=REPO)
+        th.join()
+        out0, _ = rank0.communicate(timeout=60)
+        assert rank1.returncode == 0, rank1.stdout + rank1.stderr
+        assert "AUTH_JOB_OK" in rank1.stdout
+        assert "AUTH_JOB_OK" in out0, out0
+    finally:
+        th.join(timeout=5)
+        rank0.kill()
+        rank0.wait()
+
+
+def test_launcher_sets_secret_key(tmp_path):
+    """hvdrun injects a per-job HOROVOD_SECRET_KEY so jobs authenticate by
+    default."""
+    script = textwrap.dedent("""\
+        import os
+        import horovod_tpu as hvd
+        hvd.init()
+        assert os.environ.get("HOROVOD_SECRET_KEY"), "no job secret set"
+        print("KEY_PRESENT", flush=True)
+        hvd.shutdown()
+    """)
+    res = _hvdrun([], script=script, np_=2, timeout=120, tmp_path=tmp_path)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "KEY_PRESENT" in res.stdout
+
+
+def test_remote_spawn_secret_not_on_command_line(tmp_path):
+    """The ssh spawn path must deliver HOROVOD_SECRET_KEY over stdin, not
+    argv (argv is world-readable via ps).  A fake ssh executes the remote
+    command locally and logs its argv; 127.0.1.1 routes to loopback but is
+    not classified local, so both ranks take the ssh path for real."""
+    argv_log = tmp_path / "ssh_argv.log"
+    fake_ssh = tmp_path / "fake_ssh"
+    fake_ssh.write_text(textwrap.dedent(f"""\
+        #!/bin/bash
+        printf '%s\\n' "$@" >> {argv_log}
+        # args: -o StrictHostKeyChecking=no <host> <remote-command>
+        exec bash -c "$4"
+    """))
+    fake_ssh.chmod(0o755)
+
+    script = tmp_path / "job.py"
+    script.write_text(textwrap.dedent("""\
+        import os
+        import numpy as np
+        import horovod_tpu as hvd
+        hvd.init()
+        assert os.environ.get("HOROVOD_SECRET_KEY"), "secret missing"
+        out = np.asarray(hvd.allreduce(np.ones(4, np.float32),
+                                       op=hvd.Sum, name="ssh.ok"))
+        np.testing.assert_allclose(out, np.full(4, float(hvd.size())))
+        print("SSH_JOB_OK", flush=True)
+        hvd.shutdown()
+    """))
+    res = _hvdrun(["-H", "127.0.1.1:2", sys.executable, str(script)],
+                  np_=2, timeout=120,
+                  env={"HOROVOD_SSH_CMD": str(fake_ssh)})
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert res.stdout.count("SSH_JOB_OK") == 2, res.stdout + res.stderr
+    argv = argv_log.read_text()
+    assert "HOROVOD_SECRET_KEY" not in argv.replace(
+        "read -r HOROVOD_SECRET_KEY; export HOROVOD_SECRET_KEY", "")
+    assert "HOROVOD_RANK" in argv  # env inlining still present for the rest
