@@ -1,0 +1,35 @@
+"""Build hook: compile the native runtime during pip install.
+
+Reference equivalent: the extension builds of setup.py:44-48 (one shared
+lib per framework, feature-probing MPI/CUDA/NCCL).  Here there is exactly
+one dependency-free shared library (`libhorovod_tpu.so`) built by make;
+everything else (metadata, console script, package data) lives in
+pyproject.toml.  If no C++ toolchain is available at install time the
+install still succeeds — the runtime falls back to an on-demand build at
+first use (`horovod_tpu/native/build.py`), and the SPMD plane needs no
+native code at all.
+"""
+
+import os
+import subprocess
+import sys
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+class BuildNative(build_py):
+    def run(self):
+        try:
+            subprocess.run([sys.executable, "-m", "horovod_tpu.native.build"],
+                           check=True, cwd=REPO)
+        except Exception as e:  # noqa: BLE001 — degrade, don't block
+            print(f"warning: native runtime build skipped ({e}); "
+                  "it will be built on demand at first multi-process use",
+                  file=sys.stderr)
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildNative})

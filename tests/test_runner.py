@@ -94,3 +94,47 @@ def test_runtime_env():
     assert env["HOROVOD_RENDEZVOUS_PORT"] == "1234"
     assert env["FOO"] == "bar"
     assert os.environ.get("PATH", "") == env.get("PATH", "")
+
+
+def test_packaging_metadata():
+    """pyproject must declare the hvdrun console script and ship the
+    native sources + library (reference setup.py installs bin/horovodrun,
+    setup.py:1449)."""
+    import tomllib
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "pyproject.toml"), "rb") as f:
+        meta = tomllib.load(f)
+    assert meta["project"]["scripts"]["hvdrun"] == \
+        "horovod_tpu.runner.run:main"
+    pkg_data = meta["tool"]["setuptools"]["package-data"]
+    assert "cc/src/*.cc" in pkg_data["horovod_tpu.native"]
+    assert any("libhorovod_tpu.so" in p
+               for p in pkg_data["horovod_tpu.native"])
+    # The console-script target must be importable and callable.
+    from horovod_tpu.runner.run import main
+    assert callable(main)
+
+
+def test_reachability_check(tmp_path):
+    """Unreachable hosts fail fast with names; successful probes cache
+    (reference run.py:59-112 + run/util/cache.py)."""
+    from horovod_tpu.runner import network
+    calls = []
+
+    def fake_ssh(host):
+        calls.append(host)
+        return ["true"] if host.startswith("good") else ["false"]
+
+    cache = str(tmp_path / "cache.json")
+    network.check_hosts_reachable(["good1", "good2"], ssh_builder=fake_ssh,
+                                  cache_path=cache)
+    assert sorted(calls) == ["good1", "good2"]
+    # Cached: no new probes.
+    calls.clear()
+    network.check_hosts_reachable(["good1", "good2"], ssh_builder=fake_ssh,
+                                  cache_path=cache)
+    assert calls == []
+    with pytest.raises(RuntimeError, match="bad1"):
+        network.check_hosts_reachable(["good1", "bad1"],
+                                      ssh_builder=fake_ssh,
+                                      cache_path=cache)
