@@ -1,0 +1,184 @@
+"""HMAC-authenticated pickle-RPC for launcher/driver services.
+
+Reference equivalent: ``horovod/run/common/network.py:50-84`` — the
+``Wire`` class wraps every message in an HMAC digest keyed by the job
+secret so arbitrary processes cannot inject commands into the driver/task
+services, plus ``service/{driver,task}_service.py`` request dispatch.
+
+Used by ``horovod_tpu.spark`` (task registration / rank assignment) and
+available to any future driver-side discovery service.  The eager
+runtime's own connections authenticate in C++ (``native/cc/src/auth.cc``)
+with the same per-job secret.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Callable, Optional
+
+
+class AuthError(RuntimeError):
+    pass
+
+
+def _send_msg(sock: socket.socket, payload: bytes, key: bytes) -> None:
+    digest = hmac.new(key, payload, hashlib.sha256).digest()
+    sock.sendall(struct.pack("!Q", len(payload)) + digest + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock: socket.socket, key: bytes,
+              max_len: int = 64 << 20) -> bytes:
+    (length,) = struct.unpack("!Q", _recv_exact(sock, 8))
+    if length > max_len:
+        raise AuthError(f"message length {length} exceeds sanity cap")
+    digest = _recv_exact(sock, 32)
+    payload = _recv_exact(sock, length)
+    want = hmac.new(key, payload, hashlib.sha256).digest()
+    if not hmac.compare_digest(digest, want):
+        raise AuthError("message digest mismatch — wrong or missing "
+                        "HOROVOD_SECRET_KEY")
+    return payload
+
+
+class RpcServer:
+    """Threaded TCP server dispatching authenticated pickled requests.
+
+    ``handler(request) -> response`` runs under a lock (launcher services
+    mutate shared registration state).  Unauthenticated or malformed
+    requests are dropped without a reply; the connection is one-shot
+    (request → response → close), matching the reference's usage pattern.
+    """
+
+    def __init__(self, key: bytes, handler: Callable[[Any], Any],
+                 bind: str = "0.0.0.0"):
+        self._key = key
+        self._handler = handler
+        self._lock = threading.Lock()
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    req = pickle.loads(_recv_msg(self.request, outer._key))
+                except (AuthError, ConnectionError, pickle.PickleError,
+                        struct.error):
+                    return  # drop silently: scanner resilience
+                with outer._lock:
+                    resp = outer._handler(req)
+                _send_msg(self.request, pickle.dumps(resp), outer._key)
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((bind, 0), _Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def rpc_call(addr: str, port: int, request: Any, key: bytes,
+             timeout: float = 30.0) -> Any:
+    """One authenticated request/response round trip."""
+    with socket.create_connection((addr, port), timeout=timeout) as sock:
+        _send_msg(sock, pickle.dumps(request), key)
+        return pickle.loads(_recv_msg(sock, key))
+
+
+def probe_reachable(host: str, port: int, timeout: float = 3.0) -> bool:
+    """TCP reachability probe (the role of the reference's cached ssh
+    check, ``run/run.py:59-112``, minus the shell)."""
+    try:
+        with socket.create_connection((host, port), timeout=timeout):
+            return True
+    except OSError:
+        return False
+
+
+def local_addresses() -> list:
+    """Routable local interface addresses (reference NIC discovery probes
+    each host's interfaces ring-wise, ``run.py:195-265``; here the task
+    side reports its addresses and the driver intersects)."""
+    addrs = set()
+    hostname = socket.gethostname()
+    try:
+        for info in socket.getaddrinfo(hostname, None,
+                                       family=socket.AF_INET):
+            addrs.add(info[4][0])
+    except socket.gaierror:
+        pass
+    # The address used to reach an external network (no traffic is sent).
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("10.255.255.255", 1))
+        addrs.add(s.getsockname()[0])
+        s.close()
+    except OSError:
+        pass
+    addrs.discard("127.0.0.1")
+    return sorted(addrs) or ["127.0.0.1"]
+
+
+class KeepaliveMonitor:
+    """Driver-side liveness bookkeeping: tasks ping periodically; a task
+    silent past ``timeout`` is reported dead (the failure-detection half
+    of the reference's task services)."""
+
+    def __init__(self, timeout: float = 60.0):
+        import time
+        self._time = time
+        self._timeout = timeout
+        self._last: dict = {}
+        self._lock = threading.Lock()
+
+    def ping(self, task_id) -> None:
+        with self._lock:
+            self._last[task_id] = self._time.monotonic()
+
+    def dead_tasks(self) -> list:
+        now = self._time.monotonic()
+        with self._lock:
+            return [t for t, ts in self._last.items()
+                    if now - ts > self._timeout]
+
+
+def find_free_port(bind: str = "") -> int:
+    with socket.socket() as s:
+        s.bind((bind, 0))
+        return s.getsockname()[1]
+
+
+def job_key_bytes(env_value: Optional[str]) -> bytes:
+    """Normalize HOROVOD_SECRET_KEY to raw bytes (urlsafe base64 with raw
+    fallback, mirroring the native runtime's JobKey)."""
+    if not env_value:
+        return b""
+    import base64
+    try:
+        return base64.urlsafe_b64decode(env_value.encode())
+    except Exception:  # noqa: BLE001
+        return env_value.encode()

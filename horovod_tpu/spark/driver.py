@@ -1,0 +1,197 @@
+"""Driver-side job coordination for Spark (and any task-based cluster).
+
+Reference equivalent: ``horovod/spark/driver/driver_service.py`` +
+the rank-assignment logic of ``spark/__init__.py:171-188`` (host-hash
+grouping with rank 0's host first) — minus the mpirun_rsh tunneling,
+which the TPU rebuild does not need: the native runtime rendezvouses
+over TCP by env contract alone, so the driver only has to assign ranks
+and hand every task its environment.
+
+Pyspark-independent by design: the protocol is exercised in unit tests
+with plain threads standing in for Spark tasks.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from horovod_tpu.runner import rpc
+
+
+class JobDriver:
+    """Collects task registrations, assigns ranks, distributes env maps,
+    and gathers per-rank results."""
+
+    def __init__(self, num_proc: int, key: bytes,
+                 base_env: Optional[Dict[str, str]] = None):
+        self.num_proc = num_proc
+        self.key = key
+        self.base_env = dict(base_env or {})
+        self._registrations: Dict[int, Dict[str, Any]] = {}
+        self._results: Dict[int, Any] = {}
+        self._failures: Dict[int, str] = {}
+        self._env_maps: Optional[Dict[int, Dict[str, str]]] = None
+        self._cv = threading.Condition()
+        self._monitor = rpc.KeepaliveMonitor()
+        self._server = rpc.RpcServer(key, self._handle)
+
+    # -- wire ----------------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    def addresses(self) -> List[str]:
+        return rpc.local_addresses()
+
+    def _handle(self, req):
+        kind = req.get("kind")
+        if kind == "register":
+            idx = int(req["index"])
+            self._registrations[idx] = {
+                "host": req["host"], "port": int(req["port"])}
+            self._monitor.ping(idx)
+            with self._cv:
+                if (len(self._registrations) == self.num_proc and
+                        self._env_maps is None):
+                    self._assign()
+                self._cv.notify_all()
+            return {"ok": True}
+        if kind == "env":
+            self._monitor.ping(int(req["index"]))
+            if self._env_maps is None:
+                return {"ready": False}
+            return {"ready": True,
+                    "env": self._env_maps[int(req["index"])]}
+        if kind == "result":
+            idx = int(req["index"])
+            if req.get("error"):
+                self._failures[idx] = str(req["error"])
+            else:
+                self._results[idx] = req.get("value")
+            with self._cv:
+                self._cv.notify_all()
+            return {"ok": True}
+        if kind == "ping":
+            self._monitor.ping(int(req["index"]))
+            return {"ok": True}
+        return {"error": f"unknown request {kind!r}"}
+
+    # -- rank assignment (reference spark/__init__.py:171-188) ---------------
+
+    def _assign(self):
+        # Group task indices by host; hosts ordered by first appearance of
+        # their lowest task index (deterministic), tasks within a host by
+        # index → contiguous local ranks, rank 0 on the first host.
+        by_host: Dict[str, List[int]] = {}
+        for idx in sorted(self._registrations):
+            by_host.setdefault(self._registrations[idx]["host"],
+                               []).append(idx)
+        hosts = sorted(by_host, key=lambda h: by_host[h][0])
+        rank = 0
+        order: List[int] = []          # task index per rank
+        locals_: Dict[int, int] = {}   # task index -> local rank
+        cross: Dict[int, int] = {}     # task index -> cross rank
+        for hi, h in enumerate(hosts):
+            for li, idx in enumerate(by_host[h]):
+                order.append(idx)
+                locals_[idx] = li
+                cross[idx] = hi
+                rank += 1
+        rank0 = self._registrations[order[0]]
+        self._env_maps = {}
+        for r, idx in enumerate(order):
+            reg = self._registrations[idx]
+            env = dict(self.base_env)
+            env.update({
+                "HOROVOD_RANK": str(r),
+                "HOROVOD_SIZE": str(self.num_proc),
+                "HOROVOD_LOCAL_RANK": str(locals_[idx]),
+                "HOROVOD_LOCAL_SIZE": str(
+                    len(by_host[reg["host"]])),
+                "HOROVOD_CROSS_RANK": str(cross[idx]),
+                "HOROVOD_CROSS_SIZE": str(len(hosts)),
+                "HOROVOD_HOSTNAME": reg["host"],
+                "HOROVOD_RENDEZVOUS_ADDR": rank0["host"],
+                "HOROVOD_RENDEZVOUS_PORT": str(rank0["port"]),
+                "HOROVOD_CONTROLLER": "tcp",
+                "HOROVOD_CPU_OPERATIONS": "tcp",
+            })
+            self._env_maps[idx] = env
+
+    # -- driver-side waiting -------------------------------------------------
+
+    def wait_for_results(self, timeout: float = 600.0) -> List[Any]:
+        """Block until every task reported; returns results in RANK order.
+        Raises on task failure or timeout (reference gloo_run kills the
+        job when any rank fails, gloo_run.py:256-262)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while True:
+                if self._failures:
+                    idx, err = sorted(self._failures.items())[0]
+                    raise RuntimeError(
+                        f"task {idx} failed: {err}")
+                if len(self._results) == self.num_proc:
+                    break
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    missing = sorted(set(range(self.num_proc)) -
+                                     set(self._results))
+                    raise TimeoutError(
+                        f"tasks {missing} did not report within "
+                        f"{timeout}s")
+                self._cv.wait(min(left, 1.0))
+        # Results keyed by task index; map to rank order via env maps.
+        rank_of = {idx: int(env["HOROVOD_RANK"])
+                   for idx, env in (self._env_maps or {}).items()}
+        out: List[Any] = [None] * self.num_proc
+        for idx, value in self._results.items():
+            out[rank_of.get(idx, idx)] = value
+        return out
+
+    def shutdown(self):
+        self._server.shutdown()
+
+
+def run_task(index: int, driver_addr: str, driver_port: int, key: bytes,
+             fn, args=(), kwargs=None, poll_interval: float = 0.3,
+             start_timeout: float = 600.0):
+    """Task-side protocol: register → await env → run ``fn`` → report.
+
+    Runs inside a Spark executor (or a test thread).  Returns fn's result
+    so map-style callers can also collect through their own channel."""
+    import os
+    import socket
+
+    kwargs = kwargs or {}
+    host = rpc.local_addresses()[0]
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]   # rendezvous port candidate (rank 0)
+    rpc.rpc_call(driver_addr, driver_port,
+                 {"kind": "register", "index": index, "host": host,
+                  "port": port}, key)
+    deadline = time.monotonic() + start_timeout
+    while True:
+        resp = rpc.rpc_call(driver_addr, driver_port,
+                            {"kind": "env", "index": index}, key)
+        if resp.get("ready"):
+            env = resp["env"]
+            break
+        if time.monotonic() > deadline:
+            raise TimeoutError("timed out waiting for rank assignment")
+        time.sleep(poll_interval)
+    os.environ.update(env)
+    try:
+        value = fn(*args, **kwargs)
+    except BaseException as e:  # noqa: BLE001 — reported, then re-raised
+        rpc.rpc_call(driver_addr, driver_port,
+                     {"kind": "result", "index": index,
+                      "error": f"{type(e).__name__}: {e}"}, key)
+        raise
+    rpc.rpc_call(driver_addr, driver_port,
+                 {"kind": "result", "index": index, "value": value}, key)
+    return value

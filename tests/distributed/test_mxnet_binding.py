@@ -1,0 +1,51 @@
+"""MXNet binding tests (reference test/test_mxnet.py op matrix).
+
+MXNet is not shipped in this image, so the whole module skips unless
+mxnet is importable; the binding's numpy-plane collectives underneath are
+exercised by the torch/TF binding suites either way.
+"""
+
+import numpy as np
+import pytest
+
+mx = pytest.importorskip("mxnet")
+
+import horovod_tpu.mxnet as mxhvd  # noqa: E402
+
+
+def test_mx_allreduce(hvd, rank, size):
+    x = mx.nd.ones((3, 4)) * (rank + 1)
+    out = mxhvd.allreduce(x, op=mxhvd.Sum, name="mx.sum")
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.full((3, 4), sum(range(1, size + 1))))
+
+
+def test_mx_allreduce_inplace_average(hvd, rank, size):
+    x = mx.nd.ones((4,)) * (rank + 1)
+    mxhvd.allreduce_(x, name="mx.avg")
+    np.testing.assert_allclose(x.asnumpy(), np.full((4,), (size + 1) / 2))
+
+
+def test_mx_broadcast(hvd, rank, size):
+    x = mx.nd.ones((2, 2)) * rank
+    out = mxhvd.broadcast(x, root_rank=0, name="mx.bcast")
+    np.testing.assert_allclose(out.asnumpy(), 0.0)
+
+
+def test_mx_allgather(hvd, rank, size):
+    x = mx.nd.ones((rank + 1, 2)) * rank
+    out = mxhvd.allgather(x, name="mx.ag")
+    assert out.shape == (sum(range(1, size + 1)), 2)
+
+
+def test_mx_distributed_optimizer(hvd, rank, size):
+    opt = mxhvd.DistributedOptimizer(mx.optimizer.SGD(learning_rate=0.1))
+    assert opt.rescale_grad == pytest.approx(1.0 / size)
+    w = mx.nd.ones((4,))
+    g = mx.nd.ones((4,)) * (rank + 1)
+    state = opt.create_state(0, w)
+    opt.update(0, w, g, state)
+    # After sum-allreduce + rescale, every rank applied the same mean grad.
+    expect = 1.0 - 0.1 * (sum(range(1, size + 1)) / size)
+    np.testing.assert_allclose(w.asnumpy(), np.full((4,), expect),
+                               rtol=1e-5)
