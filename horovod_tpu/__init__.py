@@ -77,6 +77,10 @@ from horovod_tpu.ops.collective import (
     synchronize,
     poll,
     join,
+    barrier,
+    ProcessSet,
+    add_process_set,
+    global_process_set,
 )
 from horovod_tpu.ops.compression import Compression
 from horovod_tpu import checkpoint  # noqa: F401  (hvd.checkpoint.save/restore)

@@ -31,9 +31,11 @@ int hvd_is_initialized();
 // `splits`/`nsplits`: alltoall only — dim-0 rows sent to each destination
 // (uneven alltoallv); NULL/0 = equal splits.
 // Returns a handle >= 0, or -1 (error text via hvd_last_error).
+// `set_id`: process set to run over (0 = global; ids come from an
+// op-7 kProcessSet registration, whose output is the new id).
 int64_t hvd_enqueue(int op_type, const char* name, const void* data,
                     const int64_t* shape, int32_t ndim, int dtype, int arg,
-                    const int64_t* splits, int32_t nsplits);
+                    const int64_t* splits, int32_t nsplits, int set_id);
 
 // 1 when the op has completed (successfully or not).
 int hvd_poll(int64_t handle);
@@ -44,9 +46,10 @@ int hvd_wait(int64_t handle);
 // Element count of the output (valid after successful wait).
 int64_t hvd_output_size(int64_t handle);
 
-// Alltoall: copy the dim-0 row counts received from each source rank into
-// `dst` (length `n` >= job size).  Valid after successful wait, BEFORE
-// hvd_read_output (which releases the handle).  Returns 0 on success.
+// Alltoall: copy the dim-0 row counts received from each source into
+// `dst` (length `n` >= the group size; job size always suffices).  Valid
+// after successful wait, BEFORE hvd_read_output (which releases the
+// handle).  Returns the number of entries written, or -1 on error.
 int hvd_read_splits(int64_t handle, int64_t* dst, int32_t n);
 
 // Copy `count` output elements into `dst` and release the handle.

@@ -12,6 +12,7 @@
 #define HVD_CONTROLLER_H
 
 #include <chrono>
+#include <map>
 #include <deque>
 #include <unordered_map>
 #include <vector>
@@ -23,6 +24,19 @@
 #include "stall_inspector.h"
 
 namespace hvd {
+
+// Group view for response construction: member list (null = the global
+// set), group size, and rank -> group-position mapping.
+struct GroupInfo {
+  const std::vector<int32_t>* members;   // null for the global set
+  int gsize;
+  int pos_of(int32_t rank) const {
+    if (members == nullptr) return static_cast<int>(rank);
+    for (size_t i = 0; i < members->size(); ++i)
+      if ((*members)[i] == rank) return static_cast<int>(i);
+    return -1;
+  }
+};
 
 class Controller {
  public:
@@ -53,12 +67,25 @@ class Controller {
   void Fuse(std::vector<Response>* responses);
 
   int64_t fusion_threshold() const { return fusion_threshold_; }
+  // Coordinator-side process-set registry (id -> sorted member ranks),
+  // populated when a kProcessSet registration response is constructed.
+  // Set 0 (global) is implicit.
+  const std::vector<int32_t>* FindSet(int32_t id) const {
+    auto it = process_sets_.find(id);
+    return it == process_sets_.end() ? nullptr : &it->second;
+  }
+  GroupInfo ResolveGroup(int32_t set_id) const {
+    const std::vector<int32_t>* m = set_id != 0 ? FindSet(set_id) : nullptr;
+    return GroupInfo{m, m ? static_cast<int>(m->size()) : size_};
+  }
   // Autotune applies the threshold delivered in each ResponseList before
   // fusing that list, keeping the fusion walk identical across ranks.
   void set_fusion_threshold(int64_t t) { fusion_threshold_ = t; }
   StallInspector& stall_inspector() { return stall_; }
 
  private:
+  std::map<int32_t, std::vector<int32_t>> process_sets_;
+  int32_t next_set_id_ = 1;
   struct PendingTensor {
     std::vector<Request> requests;           // one per submitting rank
     std::vector<bool> submitted;             // [size]
@@ -79,7 +106,7 @@ class Controller {
   // controller.cc:700-723); names becoming ready join ready_ in arrival
   // order (identical on all ranks because only the master defines it).
   void Ingest(const RequestList& list, int from_rank);
-  Response ConstructResponse(const std::string& name);
+  Response ConstructResponse(const std::string& key);
 
   int rank_ = 0;
   int size_ = 1;

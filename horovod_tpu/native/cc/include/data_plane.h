@@ -37,24 +37,40 @@ class DataPlane {
   // ranks (deadlock-free order).
   Status Connect(int rank, int size, const std::vector<PeerAddr>& peers);
 
+  // Every collective takes an optional ``group``: a sorted list of GLOBAL
+  // ranks forming a sub-communicator (later-Horovod process sets;
+  // reference v0.18 had only the single global group, basics.py:29-61).
+  // Empty = all ranks.  The caller must be a member; algorithms run over
+  // logical positions within the group, mapped back to the global mesh
+  // sockets.  Position-indexed arguments (counts, splits) are indexed by
+  // group POSITION, which equals global rank for the default group.
+
   // In-place ring allreduce over buf (count elements).
-  Status Allreduce(void* buf, int64_t count, DataType dtype, ReduceOp op);
+  Status Allreduce(void* buf, int64_t count, DataType dtype, ReduceOp op,
+                   const std::vector<int32_t>& group = {});
   // Reduce across ranks, keep my dim-0 chunk: in has count elems,
-  // out has count/size.
+  // out has count/group_size.
   Status Reducescatter(const void* in, void* out, int64_t count,
-                       DataType dtype, ReduceOp op);
-  // out = concat of every rank's block; counts[r] = rank r's BYTE count
-  // (dtype-agnostic; callers multiply by element size).
+                       DataType dtype, ReduceOp op,
+                       const std::vector<int32_t>& group = {});
+  // out = concat of every member's block; counts[p] = position p's BYTE
+  // count (dtype-agnostic; callers multiply by element size).
   Status Allgather(const void* in, void* out,
-                   const std::vector<int64_t>& counts);
-  Status Broadcast(void* buf, int64_t count, DataType dtype, int root);
-  // Equal splits: count divisible by size; block i goes to rank i.
-  Status Alltoall(const void* in, void* out, int64_t count, DataType dtype);
-  // Uneven splits: per-peer byte counts (send_bytes[r] to rank r,
-  // recv_bytes[r] from rank r); dtype-agnostic.
+                   const std::vector<int64_t>& counts,
+                   const std::vector<int32_t>& group = {});
+  // root is a GLOBAL rank (must be a member when group is given).
+  Status Broadcast(void* buf, int64_t count, DataType dtype, int root,
+                   const std::vector<int32_t>& group = {});
+  // Equal splits: count divisible by group size; block p goes to the
+  // member at position p.
+  Status Alltoall(const void* in, void* out, int64_t count, DataType dtype,
+                  const std::vector<int32_t>& group = {});
+  // Uneven splits: per-position byte counts (send_bytes[p] to position p,
+  // recv_bytes[p] from position p); dtype-agnostic.
   Status Alltoallv(const void* in, void* out,
                    const std::vector<int64_t>& send_bytes,
-                   const std::vector<int64_t>& recv_bytes);
+                   const std::vector<int64_t>& recv_bytes,
+                   const std::vector<int32_t>& group = {});
 
   void Shutdown();
 

@@ -82,6 +82,9 @@ enum class OpType : int32_t {
   kReducescatter = 4,
   kBarrier = 5,
   kJoin = 6,
+  // Collective registration of a rank-subset group (later-Horovod
+  // process sets; reference v0.18 had only the global group).
+  kProcessSet = 7,
 };
 
 inline const char* OpTypeName(OpType t) {
@@ -93,6 +96,7 @@ inline const char* OpTypeName(OpType t) {
     case OpType::kReducescatter: return "reducescatter";
     case OpType::kBarrier: return "barrier";
     case OpType::kJoin: return "join";
+    case OpType::kProcessSet: return "process_set";
   }
   return "unknown";
 }

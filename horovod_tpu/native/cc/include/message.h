@@ -23,6 +23,9 @@ struct Request {
   DataType dtype = DataType::kFloat32;
   int32_t arg = 0;          // reduce-op code or broadcast root
   std::string name;
+  // Process set this collective runs over (0 = the global set).  For
+  // op kProcessSet, `splits` carries the proposed member ranks instead.
+  int32_t set_id = 0;
   std::vector<int64_t> shape;
   // Alltoall only: dim-0 rows this rank sends to each destination
   // (uneven alltoallv, parity with later-Horovod `splits`).  Empty =
@@ -47,6 +50,7 @@ struct Response {
   OpType op_type = OpType::kAllreduce;
   DataType dtype = DataType::kFloat32;
   int32_t arg = 0;
+  int32_t set_id = 0;   // process set (0 = global); kProcessSet: new id in arg
   bool error = false;
   // Coordinator-decided: false when any rank was a joined zero-contributor
   // for this tensor.  Ranks only refresh their response cache from

@@ -56,6 +56,7 @@ struct TensorTableEntry {
   std::vector<int64_t> shape;
   const void* input = nullptr;   // caller keeps alive until done
   int64_t count = 0;             // input element count
+  int32_t set_id = 0;            // process set (0 = global)
   std::vector<int64_t> splits;   // alltoall: dim-0 rows per destination
   // Alltoall: dim-0 rows received from each source (set at execution so
   // callers can slice the concatenated output; hvd_read_splits).

@@ -25,6 +25,14 @@ int64_t NumElements(const std::vector<int64_t>& shape) {
   return n;
 }
 
+// Pending-table key: tensor names are scoped PER PROCESS SET (two sets
+// may negotiate a same-named tensor concurrently — later-Horovod scopes
+// its tensor tables per process set the same way).  Responses still carry
+// the bare name; executors' local tables are per-rank unique by name.
+std::string TableKey(int32_t set_id, const std::string& name) {
+  return std::to_string(set_id) + "\x01" + name;
+}
+
 }  // namespace
 
 Status Controller::Init(int rank, int size, const std::string& master_addr,
@@ -191,10 +199,10 @@ Status Controller::MasterCycle(const RequestList& mine, ResponseList* out,
   // on joined ranks' zero-participation must run first.
   std::vector<Response> joins;
   while (!ready_.empty()) {
-    std::string name = ready_.front();
+    std::string key = ready_.front();
     ready_.pop_front();
-    Response r = ConstructResponse(name);
-    table_.erase(name);
+    Response r = ConstructResponse(key);
+    table_.erase(key);
     if (!r.error && r.op_type == OpType::kJoin)
       joins.push_back(std::move(r));
     else
@@ -209,19 +217,43 @@ Status Controller::MasterCycle(const RequestList& mine, ResponseList* out,
   // Stall inspection over still-pending tensors (reference
   // CheckForStalledTensors, stall_inspector.cc:26).
   std::vector<std::string> stalled;
-  for (auto& kv : table_)
-    if (stall_.Check(kv.first, kv.second.submitted, kv.second.first_seen))
+  for (auto& kv : table_) {
+    // Report/respond with the REAL tensor name (the table key is
+    // set-scoped); executors match entries by name.  For subset
+    // collectives, non-members are marked submitted so the "missing
+    // ranks" warning names only members actually being waited on.
+    const std::string& name = kv.second.requests.empty()
+        ? kv.first : kv.second.requests.front().name;
+    std::vector<bool> expected = kv.second.submitted;
+    if (!kv.second.requests.empty() &&
+        kv.second.requests.front().set_id != 0) {
+      GroupInfo gi = ResolveGroup(kv.second.requests.front().set_id);
+      if (gi.members != nullptr) {
+        std::vector<bool> member_mask(size_, false);
+        for (int32_t m : *gi.members) member_mask[m] = true;
+        for (int r = 0; r < size_; ++r)
+          if (!member_mask[r]) expected[r] = true;
+      }
+    }
+    if (stall_.Check(name, expected, kv.second.first_seen))
       stalled.push_back(kv.first);
-  for (auto& name : stalled) {
+  }
+  for (auto& key : stalled) {
+    auto it = table_.find(key);
+    if (it == table_.end()) continue;
+    const std::string name = it->second.requests.empty()
+        ? key : it->second.requests.front().name;
     Response r;
     r.error = true;
+    if (!it->second.requests.empty())
+      r.set_id = it->second.requests.front().set_id;
     r.names.push_back(name);
     r.error_message =
         "Stalled collective: tensor " + name +
         " exceeded HOROVOD_STALL_SHUTDOWN_TIME_SECONDS without being "
         "submitted on all ranks.";
     out->responses.push_back(std::move(r));
-    table_.erase(name);
+    table_.erase(key);
   }
 
   // Shutdown agreement: once every rank has signaled, the whole job stops
@@ -248,8 +280,19 @@ bool Controller::IsReady(const PendingTensor& p, OpType op) const {
   // Join itself needs every rank to actually call join; everything else is
   // ready once each rank has either submitted or joined (joined ranks
   // contribute zero payloads at execution — reference Join semantics).
-  if (op == OpType::kJoin) return p.count == size_;
+  if (op == OpType::kJoin || op == OpType::kProcessSet)
+    return p.count == size_;   // both are collective over ALL ranks
   if (p.count == 0) return false;
+  // Subset collectives are ready when every MEMBER has submitted (join is
+  // global-set-only; joined ranks do not stand in for subset members).
+  const int32_t set_id = p.requests.front().set_id;
+  if (set_id != 0) {
+    const std::vector<int32_t>* members = FindSet(set_id);
+    if (members == nullptr) return p.count > 0;  // -> error response
+    for (int32_t r : *members)
+      if (!p.submitted[r]) return false;
+    return true;
+  }
   for (int r = 0; r < size_; ++r)
     if (!p.submitted[r] && !joined_[r]) return false;
   return true;
@@ -270,7 +313,8 @@ void Controller::Ingest(const RequestList& list, int from_rank) {
       joined_[from_rank] = true;
       join_arrived = true;
     }
-    auto& p = table_[req.name];
+    const std::string key = TableKey(req.set_id, req.name);
+    auto& p = table_[key];
     if (p.submitted.empty()) {
       p.submitted.assign(size_, false);
       p.first_seen = std::chrono::steady_clock::now();
@@ -281,7 +325,7 @@ void Controller::Ingest(const RequestList& list, int from_rank) {
     ++p.count;
     if (!p.queued && IsReady(p, req.op_type)) {
       p.queued = true;
-      ready_.push_back(req.name);
+      ready_.push_back(key);
     }
   }
   if (join_arrived) {
@@ -303,19 +347,25 @@ void Controller::Ingest(const RequestList& list, int from_rank) {
   }
 }
 
-Response Controller::ConstructResponse(const std::string& name) {
+Response Controller::ConstructResponse(const std::string& key) {
   // Cross-rank agreement validation (reference ConstructResponse,
   // controller.cc:320-522: op/dtype/shape/root mismatches become a clean
   // coordinated ERROR response instead of a hang or corruption).
-  auto& p = table_[name];
+  // `key` is the set-scoped table key; `name` below is the real tensor
+  // name (what executors and error messages use).
+  auto& p = table_[key];
   const Request& first = p.requests.front();
+  const std::string& name = first.name;
   Response resp;
   resp.op_type = first.op_type;
   resp.dtype = first.dtype;
   resp.arg = first.arg;
-  // Cache refresh is only safe when every rank actually submitted: a
-  // joined zero-contributor has no entry (and no shape) to Put, and a
-  // partial Put diverges the deterministic cache replicas' slot numbering.
+  resp.set_id = first.set_id;
+  // Cache refresh is only safe when every expected rank actually
+  // submitted: a joined zero-contributor has no entry (and no shape) to
+  // Put, and a partial Put diverges the deterministic cache replicas'
+  // slot numbering.  For subset collectives "expected" is the member
+  // count.
   resp.cacheable = (p.count == size_);
   resp.names.push_back(name);
 
@@ -324,6 +374,74 @@ Response Controller::ConstructResponse(const std::string& name) {
     resp.error_message = msg;
     return resp;
   };
+
+  // Process-set registration: all ranks must propose identical member
+  // lists; the coordinator assigns (or re-finds) the id and broadcasts
+  // the membership in first_dims so every rank installs the same
+  // registry entry (reference: later-Horovod add_process_set is a
+  // collective over the global set).
+  if (first.op_type == OpType::kProcessSet) {
+    for (const auto& r : p.requests)
+      if (r.splits != first.splits)
+        return fail("Mismatched process-set registration: rank " +
+                    std::to_string(r.rank) + " proposed a different "
+                    "member list than rank " +
+                    std::to_string(first.rank) + " (" + name + ").");
+    if (first.splits.empty())
+      return fail("Process set must have at least one member (" + name +
+                  ").");
+    std::vector<int32_t> members;
+    int64_t prev = -1;
+    for (int64_t v : first.splits) {
+      if (v < 0 || v >= size_)
+        return fail("Process-set member rank " + std::to_string(v) +
+                    " out of range for job size " + std::to_string(size_) +
+                    " (" + name + ").");
+      if (v <= prev)
+        return fail("Process-set member ranks must be strictly "
+                    "increasing (" + name + ").");
+      prev = v;
+      members.push_back(static_cast<int32_t>(v));
+    }
+    // Idempotent: re-registering an existing member list returns its id.
+    for (const auto& kv : process_sets_)
+      if (kv.second == members) {
+        resp.arg = kv.first;
+        resp.first_dims = first.splits;
+        return resp;
+      }
+    int32_t id = next_set_id_++;
+    process_sets_[id] = members;
+    resp.arg = id;
+    resp.first_dims = first.splits;
+    return resp;
+  }
+
+  if (first.set_id != 0) {
+    const std::vector<int32_t>* members = FindSet(first.set_id);
+    if (members == nullptr)
+      return fail("Unknown process set id " +
+                  std::to_string(first.set_id) + " for tensor " + name +
+                  " (register it with add_process_set on every rank "
+                  "first).");
+    // Subset responses are NEVER cacheable: only member ranks hold
+    // entries to Put, so a cacheable subset response would advance the
+    // members' deterministic cache replicas while non-members' stand
+    // still — the slot numbering diverges and every later bit
+    // announcement is misread (observed as a cross-suite hang).
+    resp.cacheable = false;
+    for (const auto& r : p.requests) {
+      bool member = false;
+      for (int32_t m : *members) member = member || (m == r.rank);
+      if (!member)
+        return fail("Rank " + std::to_string(r.rank) + " submitted tensor " +
+                    name + " for process set " +
+                    std::to_string(first.set_id) +
+                    " but is not a member of it.");
+      // NOTE: r.set_id == first.set_id is guaranteed by the pending
+      // table's (set, name) key; no per-request check needed.
+    }
+  }
 
   for (const auto& r : p.requests) {
     if (r.op_type != first.op_type)
@@ -387,6 +505,17 @@ Response Controller::ConstructResponse(const std::string& name) {
         return fail("Broadcast root rank " + std::to_string(first.arg) +
                     " out of range for job size " + std::to_string(size_) +
                     " (tensor " + name + ").");
+      if (first.op_type == OpType::kBroadcast && first.set_id != 0) {
+        const std::vector<int32_t>* members = FindSet(first.set_id);
+        bool member = false;
+        if (members)
+          for (int32_t m : *members) member = member || (m == first.arg);
+        if (!member)
+          return fail("Broadcast root rank " + std::to_string(first.arg) +
+                      " is not a member of process set " +
+                      std::to_string(first.set_id) + " (tensor " + name +
+                      ").");
+      }
       if (first.op_type == OpType::kBroadcast && joined_[first.arg])
         return fail("Broadcast root rank " + std::to_string(first.arg) +
                     " has already joined and holds no data for tensor " +
@@ -414,15 +543,20 @@ Response Controller::ConstructResponse(const std::string& name) {
                       std::to_string(r.rank) + " has " + ShapeStr(r.shape) +
                       " for tensor " + name + ".");
       }
-      // first_dims[r] = rank r's TOTAL element count (dim-0 x trailing),
-      // not just dim-0: executors — including joined ranks that have no
-      // local entry to read trailing dims from — size buffers directly
-      // from it.  Joined ranks contribute 0 elements.
-      resp.first_dims.assign(size_, 0);
-      for (const auto& r : p.requests) {
-        int64_t trailing = 1;
-        for (size_t i = 1; i < r.shape.size(); ++i) trailing *= r.shape[i];
-        resp.first_dims[r.rank] = r.shape[0] * trailing;
+      // first_dims[p] = TOTAL element count (dim-0 x trailing) of the
+      // member at group position p, not just dim-0: executors —
+      // including joined ranks that have no local entry to read trailing
+      // dims from — size buffers directly from it.  Joined ranks
+      // contribute 0 elements.  Position == rank for the global set.
+      {
+        GroupInfo gi = ResolveGroup(first.set_id);
+        resp.first_dims.assign(gi.gsize, 0);
+        for (const auto& r : p.requests) {
+          int64_t trailing = 1;
+          for (size_t i = 1; i < r.shape.size(); ++i) trailing *= r.shape[i];
+          int pos = gi.pos_of(r.rank);
+          if (pos >= 0) resp.first_dims[pos] = r.shape[0] * trailing;
+        }
       }
       break;
     }
@@ -448,12 +582,15 @@ Response Controller::ConstructResponse(const std::string& name) {
         // must agree.  Response carries the size x size element-count
         // matrix (src-major) so every executor can lay out its exchange.
         for (const auto& r : p.requests) {
-          if (r.splits.size() != static_cast<size_t>(size_))
+          size_t expect =
+              static_cast<size_t>(ResolveGroup(first.set_id).gsize);
+          if (r.splits.size() != expect)
             return fail("Mismatched alltoall splits: rank " +
                         std::to_string(r.rank) + " supplied " +
-                        std::to_string(r.splits.size()) + " splits for job "
-                        "size " + std::to_string(size_) + " (tensor " +
-                        name + "; all ranks must pass splits, or none).");
+                        std::to_string(r.splits.size()) + " splits for "
+                        "group size " + std::to_string(expect) +
+                        " (tensor " + name +
+                        "; all ranks must pass splits, or none).");
           if (r.shape.empty() || r.shape.size() != first.shape.size() ||
               !std::equal(r.shape.begin() + 1, r.shape.end(),
                           first.shape.begin() + 1))
@@ -480,25 +617,35 @@ Response Controller::ConstructResponse(const std::string& name) {
         int64_t trailing = 1;
         for (size_t i = 1; i < first.shape.size(); ++i)
           trailing *= first.shape[i];
+        // Matrix is group-position-indexed (position == rank for the
+        // global set): gsize x gsize, src-major.
+        GroupInfo gi = ResolveGroup(first.set_id);
         resp.first_dims.assign(
-            static_cast<size_t>(size_) * static_cast<size_t>(size_), 0);
-        for (const auto& r : p.requests)
-          for (int dst = 0; dst < size_; ++dst)
-            resp.first_dims[static_cast<size_t>(r.rank) * size_ + dst] =
+            static_cast<size_t>(gi.gsize) * static_cast<size_t>(gi.gsize),
+            0);
+        for (const auto& r : p.requests) {
+          int pos = gi.pos_of(r.rank);
+          if (pos < 0) continue;  // unreachable: membership checked above
+          for (int dst = 0; dst < gi.gsize; ++dst)
+            resp.first_dims[static_cast<size_t>(pos) * gi.gsize + dst] =
                 r.splits[dst] * trailing;
+        }
         break;
       }
       for (const auto& r : p.requests)
         if (r.shape != first.shape || !r.splits.empty())
           return fail("Mismatched " + std::string(OpTypeName(first.op_type)) +
                       " tensor shapes for tensor " + name + ".");
-      if (first.shape.empty() || first.shape[0] % size_ != 0)
-        return fail(std::string(OpTypeName(first.op_type)) +
-                    " requires the first dimension (" +
-                    (first.shape.empty() ? std::string("scalar")
-                                         : std::to_string(first.shape[0])) +
-                    ") to be divisible by the job size " +
-                    std::to_string(size_) + " (tensor " + name + ").");
+      {
+        int gsize = ResolveGroup(first.set_id).gsize;
+        if (first.shape.empty() || first.shape[0] % gsize != 0)
+          return fail(std::string(OpTypeName(first.op_type)) +
+                      " requires the first dimension (" +
+                      (first.shape.empty() ? std::string("scalar")
+                                           : std::to_string(first.shape[0])) +
+                      ") to be divisible by the group size " +
+                      std::to_string(gsize) + " (tensor " + name + ").");
+      }
       // Payload size for joined ranks' zero-participation buffers.
       resp.first_dims.assign(1, NumElements(first.shape));
       break;
@@ -525,6 +672,7 @@ void Controller::Fuse(std::vector<Response>* responses) {
       int64_t prev_elems = 0;
       for (auto d : prev.first_dims) prev_elems += d;
       if (!prev.error && prev.op_type == OpType::kAllreduce &&
+          prev.set_id == r.set_id &&
           prev.dtype == r.dtype && prev.arg == r.arg &&
           prev.first_dims.size() == prev.names.size() &&
           r.first_dims.size() == 1 &&

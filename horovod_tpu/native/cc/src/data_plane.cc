@@ -354,34 +354,68 @@ std::vector<int64_t> ChunkOffsets(int64_t count, int size) {
   return off;
 }
 
+// Sub-communicator view: logical position + size within `group` (empty =
+// the full mesh), mapping positions back to global ranks for SendRecv.
+struct GroupView {
+  const std::vector<int32_t>* group;
+  int me;      // my logical position
+  int size;    // group size
+  int global_of(int pos) const {
+    return group->empty() ? pos : (*group)[pos];
+  }
+};
+
+Status MakeView(const std::vector<int32_t>& group, int my_rank,
+                int world_size, GroupView* out) {
+  out->group = &group;
+  if (group.empty()) {
+    out->me = my_rank;
+    out->size = world_size;
+    return Status::OK();
+  }
+  out->size = static_cast<int>(group.size());
+  out->me = -1;
+  for (size_t i = 0; i < group.size(); ++i)
+    if (group[i] == my_rank) out->me = static_cast<int>(i);
+  if (out->me < 0)
+    return Status::InvalidArgument(
+        "rank " + std::to_string(my_rank) +
+        " is not a member of the process set");
+  return Status::OK();
+}
+
 }  // namespace
 
 Status DataPlane::Allreduce(void* buf, int64_t count, DataType dtype,
-                            ReduceOp op) {
-  if (size_ == 1) return Status::OK();
+                            ReduceOp op,
+                            const std::vector<int32_t>& group) {
+  GroupView v;
+  Status gs = MakeView(group, rank_, size_, &v);
+  if (!gs.ok()) return gs;
+  if (v.size == 1) return Status::OK();
   const size_t esz = DataTypeSize(dtype);
-  auto off = ChunkOffsets(count, size_);
+  auto off = ChunkOffsets(count, v.size);
   auto bytes_of = [&](int c) {
     return static_cast<size_t>(off[c + 1] - off[c]) * esz;
   };
   auto ptr_of = [&](int c) {
     return static_cast<char*>(buf) + static_cast<size_t>(off[c]) * esz;
   };
-  const int right = (rank_ + 1) % size_;
-  const int left = (rank_ - 1 + size_) % size_;
+  const int right = v.global_of((v.me + 1) % v.size);
+  const int left = v.global_of((v.me - 1 + v.size) % v.size);
   int64_t max_chunk = 0;
-  for (int c = 0; c < size_; ++c)
+  for (int c = 0; c < v.size; ++c)
     max_chunk = std::max(max_chunk, off[c + 1] - off[c]);
   std::vector<char> scratch(static_cast<size_t>(max_chunk) * esz);
 
-  // Phase 1: ring reduce-scatter.  After size-1 steps, chunk (rank+1)%size
-  // holds the full reduction on this rank.  The reduce stays OUTSIDE the
-  // exchange: folding it into the recv drain was measured slower here —
+  // Phase 1: ring reduce-scatter.  After size-1 steps, chunk (pos+1)%size
+  // holds the full reduction on this member.  The reduce stays OUTSIDE
+  // the exchange: folding it into the recv drain was measured slower —
   // the single-threaded drain stops feeding the send direction while it
   // reduces, stalling the stream for longer than the saved memory pass.
-  for (int s = 0; s < size_ - 1; ++s) {
-    int send_c = (rank_ - s + size_) % size_;
-    int recv_c = (rank_ - s - 1 + size_) % size_;
+  for (int s = 0; s < v.size - 1; ++s) {
+    int send_c = (v.me - s + v.size) % v.size;
+    int recv_c = (v.me - s - 1 + v.size) % v.size;
     Status st = SendRecv(right, ptr_of(send_c), bytes_of(send_c),
                          left, scratch.data(), bytes_of(recv_c));
     if (!st.ok()) return st;
@@ -389,9 +423,9 @@ Status DataPlane::Allreduce(void* buf, int64_t count, DataType dtype,
                dtype, op);
   }
   // Phase 2: ring allgather of the reduced chunks.
-  for (int s = 0; s < size_ - 1; ++s) {
-    int send_c = (rank_ + 1 - s + size_) % size_;
-    int recv_c = (rank_ - s + size_) % size_;
+  for (int s = 0; s < v.size - 1; ++s) {
+    int send_c = (v.me + 1 - s + v.size) % v.size;
+    int recv_c = (v.me - s + v.size) % v.size;
     Status st = SendRecv(right, ptr_of(send_c), bytes_of(send_c),
                          left, ptr_of(recv_c), bytes_of(recv_c));
     if (!st.ok()) return st;
@@ -400,55 +434,66 @@ Status DataPlane::Allreduce(void* buf, int64_t count, DataType dtype,
 }
 
 Status DataPlane::Reducescatter(const void* in, void* out, int64_t count,
-                                DataType dtype, ReduceOp op) {
+                                DataType dtype, ReduceOp op,
+                                const std::vector<int32_t>& group) {
+  GroupView v;
+  Status gs = MakeView(group, rank_, size_, &v);
+  if (!gs.ok()) return gs;
   const size_t esz = DataTypeSize(dtype);
-  if (size_ == 1) {
+  if (v.size == 1) {
     std::memcpy(out, in, static_cast<size_t>(count) * esz);
     return Status::OK();
   }
-  if (count % size_ != 0)
+  if (count % v.size != 0)
     return Status::InvalidArgument("reducescatter count not divisible");
   // Work on a copy so the caller's input stays intact, then run the
   // reduce-scatter half of the ring and keep our chunk.
   std::vector<char> work(static_cast<size_t>(count) * esz);
   std::memcpy(work.data(), in, work.size());
-  auto off = ChunkOffsets(count, size_);
-  const size_t chunk_bytes = static_cast<size_t>(count / size_) * esz;
+  auto off = ChunkOffsets(count, v.size);
+  const size_t chunk_bytes = static_cast<size_t>(count / v.size) * esz;
   auto ptr_of = [&](int c) {
     return work.data() + static_cast<size_t>(off[c]) * esz;
   };
-  const int right = (rank_ + 1) % size_;
-  const int left = (rank_ - 1 + size_) % size_;
+  const int right = v.global_of((v.me + 1) % v.size);
+  const int left = v.global_of((v.me - 1 + v.size) % v.size);
   std::vector<char> scratch(chunk_bytes);
-  for (int s = 0; s < size_ - 1; ++s) {
-    int send_c = (rank_ - s + size_) % size_;
-    int recv_c = (rank_ - s - 1 + size_) % size_;
+  for (int s = 0; s < v.size - 1; ++s) {
+    int send_c = (v.me - s + v.size) % v.size;
+    int recv_c = (v.me - s - 1 + v.size) % v.size;
     Status st = SendRecv(right, ptr_of(send_c), chunk_bytes,
                          left, scratch.data(), chunk_bytes);
     if (!st.ok()) return st;
-    ReduceInto(ptr_of(recv_c), scratch.data(), count / size_, dtype, op);
+    ReduceInto(ptr_of(recv_c), scratch.data(), count / v.size, dtype, op);
   }
-  // After size-1 steps this rank holds the complete reduction of chunk
-  // (rank+1)%size; chunk `rank` is complete on the left neighbor.  One more
-  // rotation hands every rank its own chunk.
-  int done_c = (rank_ + 1) % size_;
+  // After size-1 steps this member holds the complete reduction of chunk
+  // (pos+1)%size; chunk `pos` is complete on the left neighbor.  One more
+  // rotation hands every member its own chunk.
+  int done_c = (v.me + 1) % v.size;
   return SendRecv(right, ptr_of(done_c), chunk_bytes,
                   left, out, chunk_bytes);
 }
 
 Status DataPlane::Allgather(const void* in, void* out,
-                            const std::vector<int64_t>& counts) {
-  // counts[r] is rank r's byte count (dtype-agnostic).
-  std::vector<int64_t> displ(size_ + 1, 0);
-  for (int r = 0; r < size_; ++r) displ[r + 1] = displ[r] + counts[r];
+                            const std::vector<int64_t>& counts,
+                            const std::vector<int32_t>& group) {
+  GroupView v;
+  Status gs = MakeView(group, rank_, size_, &v);
+  if (!gs.ok()) return gs;
+  // counts[p] is position p's byte count (dtype-agnostic).
+  if (counts.size() != static_cast<size_t>(v.size))
+    return Status::InvalidArgument("allgather counts length != group size");
+  std::vector<int64_t> displ(v.size + 1, 0);
+  for (int p = 0; p < v.size; ++p) displ[p + 1] = displ[p] + counts[p];
   char* o = static_cast<char*>(out);
-  if (counts[rank_] > 0)  // joined ranks contribute 0 bytes with in=null
-    std::memcpy(o + displ[rank_], in, static_cast<size_t>(counts[rank_]));
-  for (int k = 1; k < size_; ++k) {
-    int to = (rank_ + k) % size_;
-    int from = (rank_ - k + size_) % size_;
-    Status st = SendRecv(to, in, static_cast<size_t>(counts[rank_]),
-                         from, o + displ[from],
+  if (counts[v.me] > 0)  // joined ranks contribute 0 bytes with in=null
+    std::memcpy(o + displ[v.me], in, static_cast<size_t>(counts[v.me]));
+  for (int k = 1; k < v.size; ++k) {
+    int to = (v.me + k) % v.size;
+    int from = (v.me - k + v.size) % v.size;
+    Status st = SendRecv(v.global_of(to), in,
+                         static_cast<size_t>(counts[v.me]),
+                         v.global_of(from), o + displ[from],
                          static_cast<size_t>(counts[from]));
     if (!st.ok()) return st;
   }
@@ -456,11 +501,16 @@ Status DataPlane::Allgather(const void* in, void* out,
 }
 
 Status DataPlane::Broadcast(void* buf, int64_t count, DataType dtype,
-                            int root) {
-  if (size_ == 1) return Status::OK();
+                            int root,
+                            const std::vector<int32_t>& group) {
+  GroupView v;
+  Status gs = MakeView(group, rank_, size_, &v);
+  if (!gs.ok()) return gs;
+  if (v.size == 1) return Status::OK();
   const size_t nbytes = static_cast<size_t>(count) * DataTypeSize(dtype);
   if (rank_ == root) {
-    for (int r = 0; r < size_; ++r) {
+    for (int p = 0; p < v.size; ++p) {
+      int r = v.global_of(p);
       if (r == rank_) continue;
       Status st = peers_[r]->SendAll(buf, nbytes);
       if (!st.ok()) return st;
@@ -471,19 +521,23 @@ Status DataPlane::Broadcast(void* buf, int64_t count, DataType dtype,
 }
 
 Status DataPlane::Alltoall(const void* in, void* out, int64_t count,
-                           DataType dtype) {
+                           DataType dtype,
+                           const std::vector<int32_t>& group) {
+  GroupView v;
+  Status gs = MakeView(group, rank_, size_, &v);
+  if (!gs.ok()) return gs;
   const size_t esz = DataTypeSize(dtype);
-  if (count % size_ != 0)
+  if (count % v.size != 0)
     return Status::InvalidArgument("alltoall count not divisible by size");
-  const size_t block = static_cast<size_t>(count / size_) * esz;
+  const size_t block = static_cast<size_t>(count / v.size) * esz;
   const char* i = static_cast<const char*>(in);
   char* o = static_cast<char*>(out);
-  std::memcpy(o + block * rank_, i + block * rank_, block);
-  for (int k = 1; k < size_; ++k) {
-    int to = (rank_ + k) % size_;
-    int from = (rank_ - k + size_) % size_;
-    Status st = SendRecv(to, i + block * to, block,
-                         from, o + block * from, block);
+  std::memcpy(o + block * v.me, i + block * v.me, block);
+  for (int k = 1; k < v.size; ++k) {
+    int to = (v.me + k) % v.size;
+    int from = (v.me - k + v.size) % v.size;
+    Status st = SendRecv(v.global_of(to), i + block * to, block,
+                         v.global_of(from), o + block * from, block);
     if (!st.ok()) return st;
   }
   return Status::OK();
@@ -491,30 +545,34 @@ Status DataPlane::Alltoall(const void* in, void* out, int64_t count,
 
 Status DataPlane::Alltoallv(const void* in, void* out,
                             const std::vector<int64_t>& send_bytes,
-                            const std::vector<int64_t>& recv_bytes) {
-  // Uneven pairwise rotation: same schedule as Alltoall, per-peer sizes
-  // from the coordinator's splits matrix (later-Horovod alltoallv; the
-  // v0.18 reference has no alltoall at all, message.h:47-49).
-  if (send_bytes.size() != static_cast<size_t>(size_) ||
-      recv_bytes.size() != static_cast<size_t>(size_))
-    return Status::InvalidArgument("alltoallv counts length != size");
-  std::vector<int64_t> soff(size_ + 1, 0), roff(size_ + 1, 0);
-  for (int r = 0; r < size_; ++r) {
-    soff[r + 1] = soff[r] + send_bytes[r];
-    roff[r + 1] = roff[r] + recv_bytes[r];
+                            const std::vector<int64_t>& recv_bytes,
+                            const std::vector<int32_t>& group) {
+  // Uneven pairwise rotation: same schedule as Alltoall, per-position
+  // sizes from the coordinator's splits matrix (later-Horovod alltoallv;
+  // the v0.18 reference has no alltoall at all, message.h:47-49).
+  GroupView v;
+  Status gs = MakeView(group, rank_, size_, &v);
+  if (!gs.ok()) return gs;
+  if (send_bytes.size() != static_cast<size_t>(v.size) ||
+      recv_bytes.size() != static_cast<size_t>(v.size))
+    return Status::InvalidArgument("alltoallv counts length != group size");
+  std::vector<int64_t> soff(v.size + 1, 0), roff(v.size + 1, 0);
+  for (int p = 0; p < v.size; ++p) {
+    soff[p + 1] = soff[p] + send_bytes[p];
+    roff[p + 1] = roff[p] + recv_bytes[p];
   }
   const char* i = static_cast<const char*>(in);
   char* o = static_cast<char*>(out);
-  if (send_bytes[rank_] != recv_bytes[rank_])
+  if (send_bytes[v.me] != recv_bytes[v.me])
     return Status::InvalidArgument("alltoallv self block mismatch");
-  std::memcpy(o + roff[rank_], i + soff[rank_],
-              static_cast<size_t>(send_bytes[rank_]));
-  for (int k = 1; k < size_; ++k) {
-    int to = (rank_ + k) % size_;
-    int from = (rank_ - k + size_) % size_;
-    Status st = SendRecv(to, i + soff[to],
+  std::memcpy(o + roff[v.me], i + soff[v.me],
+              static_cast<size_t>(send_bytes[v.me]));
+  for (int k = 1; k < v.size; ++k) {
+    int to = (v.me + k) % v.size;
+    int from = (v.me - k + v.size) % v.size;
+    Status st = SendRecv(v.global_of(to), i + soff[to],
                          static_cast<size_t>(send_bytes[to]),
-                         from, o + roff[from],
+                         v.global_of(from), o + roff[from],
                          static_cast<size_t>(recv_bytes[from]));
     if (!st.ok()) return st;
   }

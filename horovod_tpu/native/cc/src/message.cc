@@ -73,6 +73,7 @@ std::string RequestList::Serialize() const {
     PutPod<int32_t>(&buf, static_cast<int32_t>(r.op_type));
     PutPod<int32_t>(&buf, static_cast<int32_t>(r.dtype));
     PutPod<int32_t>(&buf, r.arg);
+    PutPod<int32_t>(&buf, r.set_id);
     PutStr(&buf, r.name);
     PutVec(&buf, r.shape);
     PutVec(&buf, r.splits);
@@ -92,7 +93,8 @@ Status RequestList::Parse(const std::string& buf, RequestList* out) {
   for (auto& r : out->requests) {
     int32_t op, dt;
     if (!rd.GetPod(&r.rank) || !rd.GetPod(&op) || !rd.GetPod(&dt) ||
-        !rd.GetPod(&r.arg) || !rd.GetStr(&r.name) || !rd.GetVec(&r.shape) ||
+        !rd.GetPod(&r.arg) || !rd.GetPod(&r.set_id) ||
+        !rd.GetStr(&r.name) || !rd.GetVec(&r.shape) ||
         !rd.GetVec(&r.splits))
       return Malformed("request");
     r.op_type = static_cast<OpType>(op);
@@ -110,6 +112,7 @@ std::string ResponseList::Serialize() const {
     PutPod<int32_t>(&buf, static_cast<int32_t>(r.op_type));
     PutPod<int32_t>(&buf, static_cast<int32_t>(r.dtype));
     PutPod<int32_t>(&buf, r.arg);
+    PutPod<int32_t>(&buf, r.set_id);
     PutPod<uint8_t>(&buf, r.error ? 1 : 0);
     PutPod<uint8_t>(&buf, r.cacheable ? 1 : 0);
     PutStr(&buf, r.error_message);
@@ -141,6 +144,7 @@ Status ResponseList::Parse(const std::string& buf, ResponseList* out) {
     uint8_t err, cacheable;
     uint32_t nn;
     if (!rd.GetPod(&op) || !rd.GetPod(&dt) || !rd.GetPod(&r.arg) ||
+        !rd.GetPod(&r.set_id) ||
         !rd.GetPod(&err) || !rd.GetPod(&cacheable) ||
         !rd.GetStr(&r.error_message) || !rd.GetPod(&nn))
       return Malformed("response");

@@ -9,6 +9,7 @@
 #include "c_api.h"
 
 #include <atomic>
+#include <map>
 #include <chrono>
 #include <cstring>
 #include <memory>
@@ -48,6 +49,10 @@ struct GlobalState {
 
   std::thread background;
   std::atomic<bool> joined{false};
+  // Executor-side process-set registry (id -> sorted member ranks),
+  // installed lock-step by kProcessSet responses; only the background
+  // thread touches it.  Set 0 (global) is implicit (empty group).
+  std::map<int32_t, std::vector<int32_t>> process_sets;
   TensorQueue queue;
   Controller controller;
   DataPlane data_plane;
@@ -141,6 +146,7 @@ void ParticipateJoined(const Response& resp) {
     }
     case OpType::kBarrier:
     case OpType::kJoin:
+    case OpType::kProcessSet:
       return;  // negotiation-only; no data movement
   }
   if (!st.ok()) {
@@ -155,15 +161,38 @@ int64_t ExecuteResponse(const Response& resp) {
   auto entries = g->queue.TakeEntries(resp);
   for (auto& e : entries) g->timeline.NegotiateEnd(e->name);
   if (entries.empty()) {
-    if (g->joined.load() && !resp.error) ParticipateJoined(resp);
+    // Joined zero-participation applies only to the GLOBAL set; a
+    // non-member of a subset collective simply skips it (it holds no
+    // sockets in that exchange).
+    if (g->joined.load() && !resp.error && resp.set_id == 0)
+      ParticipateJoined(resp);
     return 0;
   }
-
   if (resp.error) {
+    // Before group resolution: a coordinator error (e.g. "unknown
+    // process set") must reach the caller verbatim, not be masked by a
+    // local lookup failure for the same unknown set.
     Status st = Status::Precondition(resp.error_message);
     for (auto& e : entries) g->queue.Complete(e, st);
     return 0;
   }
+
+  // Group for subset collectives; empty = the global set.
+  static const std::vector<int32_t> kGlobalGroup;
+  const std::vector<int32_t>* group = &kGlobalGroup;
+  if (resp.set_id != 0) {
+    auto it = g->process_sets.find(resp.set_id);
+    if (it == g->process_sets.end()) {
+      Status st = Status::Precondition(
+          "process set " + std::to_string(resp.set_id) +
+          " is not registered on rank " + std::to_string(g->rank));
+      for (auto& e : entries) g->queue.Complete(e, st);
+      return 0;
+    }
+    group = &it->second;
+  }
+  const int group_size =
+      group->empty() ? g->size : static_cast<int>(group->size());
 
   // Refresh the response cache from this rank's own entry params — every
   // rank sees the same response stream in the same order, which keeps
@@ -173,7 +202,8 @@ int64_t ExecuteResponse(const Response& resp) {
   // another rank's bit using the response's recorded first_dims rather
   // than its own (different) local dims.
   if (g->cache_enabled && resp.cacheable &&
-      resp.op_type != OpType::kBarrier && resp.op_type != OpType::kJoin) {
+      resp.op_type != OpType::kBarrier && resp.op_type != OpType::kJoin &&
+      resp.op_type != OpType::kProcessSet) {
     for (auto& e : entries) {
       Request params;
       params.rank = g->rank;
@@ -181,6 +211,7 @@ int64_t ExecuteResponse(const Response& resp) {
       params.dtype = e->dtype;
       params.arg = e->arg;
       params.name = e->name;
+      params.set_id = e->set_id;
       params.shape = e->shape;
       params.splits = e->splits;
       g->cache.Put(params, resp);
@@ -205,7 +236,7 @@ int64_t ExecuteResponse(const Response& resp) {
         e->output_count = e->count;
         g->timeline.ActivityStart(e->name, "TCP_ALLREDUCE");
         st = g->data_plane.Allreduce(e->output.data(), e->count, resp.dtype,
-                                     rop);
+                                     rop, *group);
         g->timeline.ActivityEnd(e->name);
         g->timeline.End(e->name);
       } else {
@@ -242,7 +273,7 @@ int64_t ExecuteResponse(const Response& resp) {
         if (!entries.empty())
           g->timeline.ActivityStart(entries[0]->name, "TCP_ALLREDUCE");
         st = g->data_plane.Allreduce(buf, static_cast<int64_t>(total / esz),
-                                     resp.dtype, rop);
+                                     resp.dtype, rop, *group);
         if (!entries.empty()) g->timeline.ActivityEnd(entries[0]->name);
         off = 0;
         for (size_t i = 0; i < resp.names.size(); ++i) {
@@ -264,18 +295,20 @@ int64_t ExecuteResponse(const Response& resp) {
     case OpType::kAllgather: {
       auto& e = entries[0];
       g->timeline.Start(e->name, "ALLGATHER");
-      // first_dims[r] is rank r's TOTAL element count (coordinator folds
-      // trailing dims in so joined ranks can size buffers shape-free).
-      std::vector<int64_t> counts(g->size);
+      // first_dims[p] is group position p's TOTAL element count
+      // (coordinator folds trailing dims in so joined ranks can size
+      // buffers shape-free); position == rank for the global set.
+      std::vector<int64_t> counts(group_size);
       int64_t total_elems = 0;
-      for (int r = 0; r < g->size; ++r) {
+      for (int r = 0; r < group_size; ++r) {
         counts[r] = resp.first_dims[r] * static_cast<int64_t>(esz);  // bytes
         total_elems += resp.first_dims[r];
       }
       e->output.resize_uninit(static_cast<size_t>(total_elems) * esz);
       e->output_count = total_elems;
       g->timeline.ActivityStart(e->name, "TCP_ALLGATHER");
-      st = g->data_plane.Allgather(e->input, e->output.data(), counts);
+      st = g->data_plane.Allgather(e->input, e->output.data(), counts,
+                                   *group);
       g->timeline.ActivityEnd(e->name);
       g->timeline.End(e->name);
       break;
@@ -288,7 +321,7 @@ int64_t ExecuteResponse(const Response& resp) {
       e->output_count = e->count;
       g->timeline.ActivityStart(e->name, "TCP_BROADCAST");
       st = g->data_plane.Broadcast(e->output.data(), e->count, resp.dtype,
-                                   resp.arg);
+                                   resp.arg, *group);
       g->timeline.ActivityEnd(e->name);
       g->timeline.End(e->name);
       break;
@@ -296,19 +329,26 @@ int64_t ExecuteResponse(const Response& resp) {
     case OpType::kAlltoall: {
       auto& e = entries[0];
       g->timeline.Start(e->name, "ALLTOALL");
-      const size_t sz = static_cast<size_t>(g->size);
-      if (resp.first_dims.size() == sz * sz) {
+      const size_t sz = static_cast<size_t>(group_size);
+      int my_pos = g->rank;
+      if (!group->empty()) {
+        my_pos = -1;
+        for (size_t i = 0; i < group->size(); ++i)
+          if ((*group)[i] == g->rank) my_pos = static_cast<int>(i);
+      }
+      if (resp.first_dims.size() == sz * sz && my_pos >= 0) {
         // Uneven alltoallv: first_dims is the src-major element-count
-        // matrix the coordinator built from every rank's splits.
+        // matrix (group-position-indexed) the coordinator built from
+        // every member's splits.
         int64_t trailing = 1;
         for (size_t i = 1; i < e->shape.size(); ++i) trailing *= e->shape[i];
-        std::vector<int64_t> send_b(g->size), recv_b(g->size);
+        std::vector<int64_t> send_b(group_size), recv_b(group_size);
         int64_t out_elems = 0;
-        e->recv_splits.assign(g->size, 0);
-        for (int r = 0; r < g->size; ++r) {
-          send_b[r] = resp.first_dims[static_cast<size_t>(g->rank) * sz + r] *
+        e->recv_splits.assign(group_size, 0);
+        for (int r = 0; r < group_size; ++r) {
+          send_b[r] = resp.first_dims[static_cast<size_t>(my_pos) * sz + r] *
                       static_cast<int64_t>(esz);
-          int64_t rc = resp.first_dims[static_cast<size_t>(r) * sz + g->rank];
+          int64_t rc = resp.first_dims[static_cast<size_t>(r) * sz + my_pos];
           recv_b[r] = rc * static_cast<int64_t>(esz);
           out_elems += rc;
           e->recv_splits[r] = trailing > 0 ? rc / trailing : 0;
@@ -317,18 +357,18 @@ int64_t ExecuteResponse(const Response& resp) {
         e->output_count = out_elems;
         g->timeline.ActivityStart(e->name, "TCP_ALLTOALLV");
         st = g->data_plane.Alltoallv(e->input, e->output.data(), send_b,
-                                     recv_b);
+                                     recv_b, *group);
       } else {
         e->output.resize_uninit(static_cast<size_t>(e->count) * esz);
         e->output_count = e->count;
         int64_t trailing = 1;
         for (size_t i = 1; i < e->shape.size(); ++i) trailing *= e->shape[i];
         int64_t rows =
-            trailing > 0 ? e->count / trailing / g->size : 0;
-        e->recv_splits.assign(g->size, rows);
+            trailing > 0 ? e->count / trailing / group_size : 0;
+        e->recv_splits.assign(group_size, rows);
         g->timeline.ActivityStart(e->name, "TCP_ALLTOALL");
         st = g->data_plane.Alltoall(e->input, e->output.data(), e->count,
-                                    resp.dtype);
+                                    resp.dtype, *group);
       }
       g->timeline.ActivityEnd(e->name);
       g->timeline.End(e->name);
@@ -337,7 +377,7 @@ int64_t ExecuteResponse(const Response& resp) {
     case OpType::kReducescatter: {
       auto& e = entries[0];
       g->timeline.Start(e->name, "REDUCESCATTER");
-      int64_t out_count = e->count / g->size;
+      int64_t out_count = e->count / group_size;
       e->output.resize_uninit(static_cast<size_t>(out_count) * esz);
       e->output_count = out_count;
       g->timeline.ActivityStart(e->name, "TCP_REDUCESCATTER");
@@ -349,8 +389,22 @@ int64_t ExecuteResponse(const Response& resp) {
       break;
     }
     case OpType::kBarrier: {
-      // Negotiation itself proved every rank arrived; nothing to move.
+      // Negotiation itself proved every member arrived; nothing to move.
       entries[0]->output_count = 0;
+      break;
+    }
+    case OpType::kProcessSet: {
+      // Install the registry entry lock-step (same response stream
+      // position on every rank) and hand the id back as an int32.
+      auto& e = entries[0];
+      std::vector<int32_t> members;
+      for (auto v : resp.first_dims)
+        members.push_back(static_cast<int32_t>(v));
+      g->process_sets[resp.arg] = std::move(members);
+      e->output.resize_uninit(sizeof(int32_t));
+      int32_t id = resp.arg;
+      std::memcpy(e->output.data(), &id, sizeof(id));
+      e->output_count = 1;
       break;
     }
     case OpType::kJoin: {
@@ -571,7 +625,7 @@ int hvd_is_initialized() { return g && g->initialized.load() ? 1 : 0; }
 
 int64_t hvd_enqueue(int op_type, const char* name, const void* data,
                     const int64_t* shape, int32_t ndim, int dtype, int arg,
-                    const int64_t* splits, int32_t nsplits) {
+                    const int64_t* splits, int32_t nsplits, int set_id) {
   if (g == nullptr || !g->initialized.load()) {
     SetLastError("runtime not initialized");
     return -1;
@@ -581,6 +635,7 @@ int64_t hvd_enqueue(int op_type, const char* name, const void* data,
   e->op_type = static_cast<OpType>(op_type);
   e->dtype = static_cast<DataType>(dtype);
   e->arg = arg;
+  e->set_id = set_id;
   e->shape.assign(shape, shape + ndim);
   if (splits != nullptr && nsplits > 0)
     e->splits.assign(splits, splits + nsplits);
@@ -628,22 +683,24 @@ int64_t hvd_output_size(int64_t handle) {
 }
 
 int hvd_read_splits(int64_t handle, int64_t* dst, int32_t n) {
+  // Returns the number of entries written (the SOURCE COUNT — the
+  // process-set size for subset alltoalls), or -1 on error.
   if (g == nullptr) {
     SetLastError("runtime not initialized");
-    return 1;
+    return -1;
   }
   auto e = g->queue.Get(handle);
   if (!e || !e->done || !e->status.ok()) {
     SetLastError("splits not available");
-    return 1;
+    return -1;
   }
   if (static_cast<size_t>(n) < e->recv_splits.size()) {
     SetLastError("splits buffer too small");
-    return 1;
+    return -1;
   }
   for (size_t i = 0; i < e->recv_splits.size(); ++i)
     dst[i] = e->recv_splits[i];
-  return 0;
+  return static_cast<int>(e->recv_splits.size());
 }
 
 int hvd_read_output(int64_t handle, void* dst, int64_t count) {

@@ -13,7 +13,8 @@ void ResponseCache::Initialize(int64_t capacity) {
 
 static bool SameParams(const Request& a, const Request& b) {
   return a.op_type == b.op_type && a.dtype == b.dtype && a.arg == b.arg &&
-         a.shape == b.shape && a.splits == b.splits;
+         a.set_id == b.set_id && a.shape == b.shape &&
+         a.splits == b.splits;
 }
 
 int64_t ResponseCache::Lookup(const Request& r) const {

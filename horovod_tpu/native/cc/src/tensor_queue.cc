@@ -34,6 +34,7 @@ std::vector<Request> TensorQueue::PopAnnouncements(int32_t rank) {
     r.dtype = e->dtype;
     r.arg = e->arg;
     r.name = e->name;
+    r.set_id = e->set_id;
     r.shape = e->shape;
     r.splits = e->splits;
     out.push_back(std::move(r));
@@ -48,7 +49,10 @@ std::vector<EntryPtr> TensorQueue::TakeEntries(const Response& response) {
   out.reserve(response.names.size());
   for (const auto& name : response.names) {
     auto it = by_name_.find(name);
-    if (it != by_name_.end()) {
+    // Names are scoped per process set: another set's same-named
+    // collective must not steal this rank's entry (e.g. rank in set B
+    // holding "grad.0" while set A's "grad.0" response arrives).
+    if (it != by_name_.end() && it->second->set_id == response.set_id) {
       out.push_back(it->second);
       by_name_.erase(it);
     }

@@ -108,6 +108,7 @@ class Runtime:
             ctypes.c_int,            # reduce-op code / root rank
             ctypes.POINTER(ctypes.c_longlong),  # alltoall splits (or None)
             ctypes.c_int,            # number of splits
+            ctypes.c_int,            # process set id (0 = global)
         ]
         lib.hvd_enqueue.restype = ctypes.c_longlong   # handle, <0 on error
         lib.hvd_poll.argtypes = [ctypes.c_longlong]
@@ -145,7 +146,7 @@ class Runtime:
     # -- collectives -------------------------------------------------------
 
     def _submit(self, op: int, name: str, arr: np.ndarray, arg: int = 0,
-                splits=None) -> int:
+                splits=None, set_id: int = 0) -> int:
         arr = np.ascontiguousarray(arr)
         code = _DTYPE_CODES.get(arr.dtype)
         if code is None:
@@ -159,7 +160,7 @@ class Runtime:
             csplits, nsplits = None, 0
         h = self._lib.hvd_enqueue(
             op, name.encode(), arr.ctypes.data_as(ctypes.c_void_p),
-            shape, arr.ndim, code, arg, csplits, nsplits)
+            shape, arr.ndim, code, arg, csplits, nsplits, set_id)
         if h < 0:
             raise RuntimeError(self._lib.hvd_last_error().decode())
         with self._inflight_lock:
@@ -183,11 +184,13 @@ class Runtime:
         received = None
         if read_splits:
             recv = (ctypes.c_longlong * self.size)()
-            if self._lib.hvd_read_splits(h, recv, self.size) != 0:
+            n_src = self._lib.hvd_read_splits(h, recv, self.size)
+            if n_src < 0:
                 err = self._lib.hvd_last_error().decode()
                 self._lib.hvd_release(h)
                 raise RuntimeError(err)
-            received = np.array(recv[:], dtype=np.int64)
+            # n_src = the source count (process-set size for subset ops).
+            received = np.array(recv[:n_src], dtype=np.int64)
         n = self._lib.hvd_output_size(h)
         out = np.empty(int(n), dtype=dtype)
         rc = self._lib.hvd_read_output(
@@ -201,46 +204,72 @@ class Runtime:
             out = out.reshape((int(n) // inner,) + tuple(trailing_shape))
         return (out, received) if read_splits else out
 
-    def allreduce(self, name: str, arr: np.ndarray, op_code: int) -> np.ndarray:
+    def allreduce(self, name: str, arr: np.ndarray, op_code: int,
+                  set_id: int = 0) -> np.ndarray:
         arr = np.asarray(arr)
-        h = self._submit(0, name, arr, op_code)
+        h = self._submit(0, name, arr, op_code, set_id=set_id)
         return self._wait_read(h, arr.dtype, arr.shape[1:]).reshape(arr.shape)
 
-    def allgather(self, name: str, arr: np.ndarray) -> np.ndarray:
+    def allgather(self, name: str, arr: np.ndarray,
+                  set_id: int = 0) -> np.ndarray:
         arr = np.asarray(arr)
         if arr.ndim == 0:
             arr = arr.reshape(1)
-        h = self._submit(1, name, arr)
+        h = self._submit(1, name, arr, set_id=set_id)
         return self._wait_read(h, arr.dtype, arr.shape[1:])
 
-    def broadcast(self, name: str, arr: np.ndarray, root: int) -> np.ndarray:
+    def broadcast(self, name: str, arr: np.ndarray, root: int,
+                  set_id: int = 0) -> np.ndarray:
         arr = np.asarray(arr)
-        h = self._submit(2, name, arr, root)
+        h = self._submit(2, name, arr, root, set_id=set_id)
         return self._wait_read(h, arr.dtype, arr.shape[1:]).reshape(arr.shape)
 
     def alltoall(self, name: str, arr: np.ndarray,
-                 splits: Optional[np.ndarray] = None):
+                 splits: Optional[np.ndarray] = None, set_id: int = 0):
         """Returns ``(output, received_splits)`` — the concatenated blocks
-        and the dim-0 row count received from each source rank (parity
-        with later-Horovod alltoall's received_splits)."""
+        and the dim-0 row count received from each source (position within
+        the process set; parity with later-Horovod received_splits)."""
         arr = np.asarray(arr)
         if arr.ndim == 0:
             arr = arr.reshape(1)
-        h = self._submit(3, name, arr, 0, splits=splits)
+        h = self._submit(3, name, arr, 0, splits=splits, set_id=set_id)
         return self._wait_read(h, arr.dtype, arr.shape[1:],
                                read_splits=True)
 
-    def reducescatter(self, name: str, arr: np.ndarray, op_code: int) -> np.ndarray:
+    def reducescatter(self, name: str, arr: np.ndarray, op_code: int,
+                      set_id: int = 0) -> np.ndarray:
         arr = np.asarray(arr)
-        h = self._submit(4, name, arr, op_code)
+        h = self._submit(4, name, arr, op_code, set_id=set_id)
         return self._wait_read(h, arr.dtype, arr.shape[1:])
 
-    def barrier(self, name: str = "hvd.barrier") -> None:
-        """Native barrier: the negotiation round IS the barrier (all ranks
-        must announce before the coordinator responds)."""
+    def barrier(self, name: str = "hvd.barrier", set_id: int = 0) -> None:
+        """Native barrier: the negotiation round IS the barrier (all
+        members must announce before the coordinator responds)."""
         arr = np.zeros(1, np.int32)
-        h = self._submit(5, name, arr)
+        h = self._submit(5, name, arr, set_id=set_id)
         self._wait_read(h, arr.dtype, ())
+
+    def add_process_set(self, ranks) -> int:
+        """Collectively register a rank-subset group; returns its id.
+
+        Every rank of the job must call this with the SAME sorted ranks
+        list (later-Horovod ``add_process_set`` is likewise a collective
+        over the global set); registering an existing list returns its
+        existing id."""
+        ranks = sorted(int(r) for r in ranks)
+        # The wire name is a per-rank REGISTRATION SEQUENCE NUMBER, not
+        # the member list: every rank must call add_process_set in the
+        # same order (the collective contract), and a common name is what
+        # lets the coordinator DETECT a mismatched proposal as a clean
+        # error — member-list-derived names would just stall, each rank
+        # waiting on a name the others never submit.
+        self._ps_seq = getattr(self, "_ps_seq", 0) + 1
+        name = f"hvd.process_set.{self._ps_seq}"
+        arr = np.zeros(1, np.int32)
+        h = self._submit(7, name, arr,
+                         splits=np.asarray(ranks, np.int64))
+        out = self._wait_read(h, np.dtype(np.int32), ())
+        return int(np.asarray(out).ravel()[0])
 
     def join(self) -> int:
         """Signal that this rank has no more work (uneven final batches).

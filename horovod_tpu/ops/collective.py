@@ -65,6 +65,111 @@ Adasum = ReduceOp("Adasum", 2)   # accepted; falls back to Average semantics
 Min = ReduceOp("Min", 3)
 Max = ReduceOp("Max", 4)
 
+# ---------------------------------------------------------------------------
+# Process sets (later-Horovod; the v0.18 reference had only the single
+# global group, basics.py:29-61 "rank subset" init).  A ProcessSet is a
+# simultaneous sub-communicator: collectives with `process_set=ps` involve
+# only its member ranks, negotiated and executed concurrently with global
+# (and other sets') traffic on the eager plane.  SPMD-plane code should
+# build a sub-mesh instead (jax.sharding.Mesh over a device subset).
+# ---------------------------------------------------------------------------
+
+class ProcessSet:
+    """A registered subset of ranks (reference: later-Horovod
+    ``hvd.ProcessSet``).  Create via :func:`add_process_set`."""
+
+    def __init__(self, ranks, set_id=None):
+        self.ranks = sorted(int(r) for r in ranks)
+        self.id = set_id   # None until registered
+
+    def included(self) -> bool:
+        return basics.rank() in self.ranks
+
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def rank(self) -> int:
+        """This process's position within the set (its "set rank")."""
+        try:
+            return self.ranks.index(basics.rank())
+        except ValueError:
+            raise RuntimeError(
+                f"rank {basics.rank()} is not a member of process set "
+                f"{self.ranks}")
+
+    def __repr__(self):
+        return f"ProcessSet(ranks={self.ranks}, id={self.id})"
+
+
+class _GlobalProcessSet(ProcessSet):
+    """The implicit set of all ranks (id 0); size tracks hvd.size()."""
+
+    def __init__(self):
+        self.id = 0
+
+    @property
+    def ranks(self):
+        return list(range(basics.size()))
+
+    def included(self) -> bool:
+        return True
+
+    def size(self) -> int:
+        return basics.size()
+
+    def rank(self) -> int:
+        return basics.rank()
+
+
+global_process_set = _GlobalProcessSet()
+
+
+def add_process_set(ranks) -> ProcessSet:
+    """Collectively register a new process set; EVERY rank of the job must
+    call this with the same ranks (later-Horovod ``add_process_set``
+    contract — registration is a collective over the global set).
+    Registering an already-registered member list returns a set with its
+    existing id."""
+    basics._check_initialized()
+    ps = ranks if isinstance(ranks, ProcessSet) else ProcessSet(ranks)
+    if ps.id == 0:
+        return global_process_set
+    rt = basics.runtime()
+    if rt is None:
+        if ps.ranks != [0]:
+            raise ValueError(
+                f"process set {ps.ranks} is invalid for a 1-process job")
+        ps.id = 0
+        return ps
+    ps.id = rt.add_process_set(ps.ranks)
+    return ps
+
+
+def _reject_spmd_process_set(process_set, ax):
+    """SPMD plane has no process sets — a subset request under a bound
+    mesh axis must fail loudly, never silently involve the whole axis."""
+    if process_set is not None and process_set.id != 0 and _axis_bound(ax):
+        raise ValueError(
+            "process_set is an eager-plane concept; under shard_map build "
+            "a sub-mesh (jax.sharding.Mesh over the member devices) "
+            "instead")
+
+
+def _set_args(process_set):
+    """(set_id, set_size) for the eager plane; validates membership."""
+    if process_set is None or process_set.id == 0:
+        return 0, basics.size()
+    if process_set.id is None:
+        raise ValueError(
+            f"process set {process_set.ranks} is not registered; call "
+            "hvd.add_process_set(...) on every rank first")
+    if not process_set.included():
+        raise RuntimeError(
+            f"rank {basics.rank()} is not a member of process set "
+            f"{process_set.ranks} and cannot submit collectives on it")
+    return process_set.id, process_set.size()
+
+
 # Error-message contract (reference horovod/common/common.h:155-158).
 DUPLICATE_NAME_ERROR_FMT = (
     "Requested to %s a tensor with the same name as another tensor that is "
@@ -209,7 +314,8 @@ def synchronize(handle):
 # Eager execution (concrete arrays)
 # ---------------------------------------------------------------------------
 
-def _eager_allreduce(x, op: ReduceOp, name: str, prescale_factor, postscale_factor):
+def _eager_allreduce(x, op: ReduceOp, name: str, prescale_factor,
+                     postscale_factor, set_id=0, set_size=None):
     rt = basics.runtime()
     arr = np.asarray(x)
     if prescale_factor != 1.0:
@@ -217,23 +323,23 @@ def _eager_allreduce(x, op: ReduceOp, name: str, prescale_factor, postscale_fact
     if rt is None:
         out = arr.copy()
     else:
-        out = rt.allreduce(name, arr, op.code)
+        out = rt.allreduce(name, arr, op.code, set_id=set_id)
     if op is Average or op is Adasum:
-        out = out / basics.size()
+        out = out / (set_size if set_size else basics.size())
     if postscale_factor != 1.0:
         out = out * postscale_factor
     return out
 
 
-def _eager_allgather(x, name: str):
+def _eager_allgather(x, name: str, set_id=0):
     rt = basics.runtime()
     arr = np.asarray(x)
     if rt is None:
         return arr.copy()
-    return rt.allgather(name, arr)
+    return rt.allgather(name, arr, set_id=set_id)
 
 
-def _eager_broadcast(x, root_rank: int, name: str):
+def _eager_broadcast(x, root_rank: int, name: str, set_id=0):
     rt = basics.runtime()
     arr = np.asarray(x)
     if rt is None:
@@ -241,10 +347,10 @@ def _eager_broadcast(x, root_rank: int, name: str):
             raise ValueError(
                 f"broadcast root_rank {root_rank} out of range for size 1")
         return arr.copy()
-    return rt.broadcast(name, arr, root_rank)
+    return rt.broadcast(name, arr, root_rank, set_id=set_id)
 
 
-def _eager_alltoall(x, splits, name: str):
+def _eager_alltoall(x, splits, name: str, set_id=0):
     """Returns ``(output, received_splits)``; received_splits[r] = dim-0
     rows that came from rank r (later-Horovod alltoall contract)."""
     rt = basics.runtime()
@@ -260,17 +366,19 @@ def _eager_alltoall(x, splits, name: str):
                     f"alltoall splits {sp.tolist()} do not match first "
                     f"dimension {rows} for size-1 job")
         return arr.copy(), np.array([rows], np.int64)
-    return rt.alltoall(name, arr, splits)
+    return rt.alltoall(name, arr, splits, set_id=set_id)
 
 
-def _eager_reducescatter(x, op: ReduceOp, name: str):
+def _eager_reducescatter(x, op: ReduceOp, name: str, set_id=0,
+                         set_size=None):
     rt = basics.runtime()
     arr = np.asarray(x)
     if rt is None:
-        return arr / basics.size() if op is Average else arr.copy()
-    out = rt.reducescatter(name, arr, op.code)
+        return (arr / (set_size or basics.size()) if op is Average
+                else arr.copy())
+    out = rt.reducescatter(name, arr, op.code, set_id=set_id)
     if op is Average:
-        out = out / basics.size()
+        out = out / (set_size or basics.size())
     return out
 
 
@@ -316,7 +424,7 @@ def _async_dispatch(fn, kind: str, name: str, to_jnp=True):
 
 def allreduce(tensor, average=None, name=None, op=None,
               prescale_factor=1.0, postscale_factor=1.0,
-              compression=None, axis_name=None):
+              compression=None, axis_name=None, process_set=None):
     """Allreduce across all workers/devices.
 
     SPMD plane: ``lax.psum``/``pmean`` over ``axis_name`` (default ``'data'``).
@@ -333,6 +441,7 @@ def allreduce(tensor, average=None, name=None, op=None,
     else:
         ctx = None
     ax = _default_axis(axis_name)
+    _reject_spmd_process_set(process_set, ax)
     if _axis_bound(ax):
         t = tensor * prescale_factor if prescale_factor != 1.0 else tensor
         if rop is Average or rop is Adasum:
@@ -354,9 +463,11 @@ def allreduce(tensor, average=None, name=None, op=None,
             out = out * scale
     else:
         basics._check_initialized()
+        set_id, set_size = _set_args(process_set)
         nm = _auto_name("allreduce", name)
         out = jnp.asarray(_eager_allreduce(
-            tensor, rop, nm, prescale_factor, postscale_factor))
+            tensor, rop, nm, prescale_factor, postscale_factor,
+            set_id=set_id, set_size=set_size))
     if ctx is not None:
         out = compression.decompress(out, ctx)
     return out
@@ -403,18 +514,20 @@ def grouped_allreduce(tensors, average=None, name=None, op=None, axis_name=None)
             for i, t in enumerate(tensors)]
 
 
-def allgather(tensor, name=None, axis_name=None):
+def allgather(tensor, name=None, axis_name=None, process_set=None):
     """Concatenate each worker's tensor along dim 0 (reference TF op shape fn
     ``tensorflow/mpi_ops.cc:369-391``: first dims may differ, others must
     match).  SPMD plane: ``lax.all_gather(..., tiled=True)``."""
     ax = _default_axis(axis_name)
+    _reject_spmd_process_set(process_set, ax)
     if _axis_bound(ax):
         return lax.all_gather(tensor, ax, axis=0, tiled=True)
     if _is_traced(tensor):
         return _plain_jit_fallback(tensor, "allgather")
     basics._check_initialized()
+    set_id, _ = _set_args(process_set)
     nm = _auto_name("allgather", name)
-    return jnp.asarray(_eager_allgather(tensor, nm))
+    return jnp.asarray(_eager_allgather(tensor, nm, set_id=set_id))
 
 
 def allgather_async(tensor, name=None):
@@ -440,14 +553,22 @@ def allgather_object(obj, name=None):
     return out
 
 
-def broadcast(tensor, root_rank=0, name=None, axis_name=None):
+def broadcast(tensor, root_rank=0, name=None, axis_name=None,
+              process_set=None):
     """Broadcast from ``root_rank`` to all (reference
     ``EnqueueTensorBroadcast``, ``operations.cc:806-843``).
 
-    SPMD plane: implemented as a masked ``psum`` — XLA turns the
-    select+all-reduce into an efficient broadcast on ICI; there is no explicit
-    collective-broadcast primitive in ``lax``."""
+    SPMD plane: implemented as a masked ``psum`` (``lax`` has no explicit
+    collective-broadcast primitive).  Cost note: a ring all-reduce moves
+    ~2N bytes per link where an optimal broadcast moves ~N, so this is at
+    most 2x the optimal wire cost; in SPMD training broadcast appears
+    only at initialization/restore (params are replicated thereafter), so
+    the one-time factor is irrelevant in practice, and inside ``jit``
+    XLA may simplify the select further.  Steady-state broadcast traffic
+    belongs on the eager plane, whose native fan-out broadcast is
+    wire-optimal (``data_plane.cc``)."""
     ax = _default_axis(axis_name)
+    _reject_spmd_process_set(process_set, ax)
     if _axis_bound(ax):
         idx = lax.axis_index(ax)
         masked = jnp.where(idx == root_rank, tensor,
@@ -458,8 +579,10 @@ def broadcast(tensor, root_rank=0, name=None, axis_name=None):
     if _is_traced(tensor):
         return _plain_jit_fallback(tensor, "broadcast")
     basics._check_initialized()
+    set_id, _ = _set_args(process_set)
     nm = _auto_name("broadcast", name)
-    return jnp.asarray(_eager_broadcast(tensor, root_rank, nm))
+    return jnp.asarray(_eager_broadcast(tensor, root_rank, nm,
+                                        set_id=set_id))
 
 
 def broadcast_(tensor, root_rank=0, name=None, **kw):
@@ -499,7 +622,8 @@ def broadcast_object(obj, root_rank=0, name=None):
     return pickle.loads(np.asarray(data).tobytes())
 
 
-def reducescatter(tensor, op=None, name=None, axis_name=None):
+def reducescatter(tensor, op=None, name=None, axis_name=None,
+                  process_set=None):
     """Reduce then scatter along dim 0.  SPMD plane: ``lax.psum_scatter``.
     Not in the v0.18 reference (its collectives are only
     allreduce/allgather/broadcast, ``message.h:47-49``) but the clean
@@ -508,6 +632,7 @@ def reducescatter(tensor, op=None, name=None, axis_name=None):
     if rop not in (Average, Sum):
         raise ValueError(f"reducescatter supports Average/Sum, got {rop}")
     ax = _default_axis(axis_name)
+    _reject_spmd_process_set(process_set, ax)
     if _axis_bound(ax):
         out = lax.psum_scatter(tensor, ax, scatter_dimension=0, tiled=True)
         if rop is Average:
@@ -516,15 +641,19 @@ def reducescatter(tensor, op=None, name=None, axis_name=None):
     if _is_traced(tensor):
         return _plain_jit_fallback(tensor, "reducescatter")
     basics._check_initialized()
+    set_id, set_size = _set_args(process_set)
     nm = _auto_name("reducescatter", name)
-    return jnp.asarray(_eager_reducescatter(tensor, rop, nm))
+    return jnp.asarray(_eager_reducescatter(tensor, rop, nm, set_id=set_id,
+                                            set_size=set_size))
 
 
-def alltoall(tensor, splits=None, name=None, axis_name=None):
+def alltoall(tensor, splits=None, name=None, axis_name=None,
+             process_set=None):
     """Exchange dim-0 chunks between workers (the EP/MoE primitive; absent
     from the v0.18 reference, present in later Horovod).  SPMD plane:
     ``lax.all_to_all(tiled=True)`` with equal splits."""
     ax = _default_axis(axis_name)
+    _reject_spmd_process_set(process_set, ax)
     if _axis_bound(ax):
         if splits is not None:
             raise NotImplementedError(
@@ -540,13 +669,25 @@ def alltoall(tensor, splits=None, name=None, axis_name=None):
             return out, jnp.asarray(np.asarray([out.shape[0]], np.int64))
         return out
     basics._check_initialized()
+    set_id, _ = _set_args(process_set)
     nm = _auto_name("alltoall", name)
-    out, received = _eager_alltoall(tensor, splits, nm)
+    out, received = _eager_alltoall(tensor, splits, nm, set_id=set_id)
     if splits is not None:
         # Later-Horovod contract: with explicit splits the caller gets the
         # received row counts back (needed to slice the uneven output).
         return jnp.asarray(out), jnp.asarray(received)
     return jnp.asarray(out)
+
+
+def barrier(name=None, process_set=None) -> None:
+    """Block until every member has arrived (later-Horovod ``hvd.barrier``;
+    the negotiation round itself is the barrier on the eager plane)."""
+    basics._check_initialized()
+    rt = basics.runtime()
+    if rt is None:
+        return
+    set_id, _ = _set_args(process_set)
+    rt.barrier(_auto_name("barrier", name), set_id=set_id)
 
 
 def join() -> int:

@@ -30,6 +30,7 @@ from horovod_tpu.basics import (  # noqa: F401  (API parity re-exports)
 from horovod_tpu.ops import collective as _c
 from horovod_tpu.ops.collective import (  # noqa: F401
     Average, Sum, Adasum, Min, Max, poll, synchronize as _synchronize,
+    ProcessSet, add_process_set, global_process_set,
 )
 
 
@@ -88,27 +89,31 @@ def join() -> int:
 # ---------------------------------------------------------------------------
 
 def allreduce_async(tensor, average=None, name=None, op=None,
-                    prescale_factor=1.0, postscale_factor=1.0):
+                    prescale_factor=1.0, postscale_factor=1.0,
+                    process_set=None):
     basics._check_initialized()
     rop = _c._resolve_op(op, average)
+    set_id, set_size = _c._set_args(process_set)
     nm = _c._auto_name("allreduce", name)
     arr = _to_numpy(tensor)
 
     def work():
         out = _c._eager_allreduce(arr, rop, nm, prescale_factor,
-                                  postscale_factor)
+                                  postscale_factor, set_id=set_id,
+                                  set_size=set_size)
         return _from_numpy(out, tensor)
 
     return _c._async_dispatch(work, "allreduce", nm, to_jnp=False)
 
 
 def allreduce(tensor, average=None, name=None, op=None, compression=None,
-              prescale_factor=1.0, postscale_factor=1.0):
+              prescale_factor=1.0, postscale_factor=1.0, process_set=None):
     compression = compression or Compression.none
     wire, ctx = compression.compress(tensor)
     h = allreduce_async(wire, average=average, name=name, op=op,
                         prescale_factor=prescale_factor,
-                        postscale_factor=postscale_factor)
+                        postscale_factor=postscale_factor,
+                        process_set=process_set)
     return compression.decompress(synchronize(h), ctx)
 
 
@@ -145,7 +150,14 @@ def allgather_async(tensor, name=None):
     return _c._async_dispatch(work, "allgather", nm, to_jnp=False)
 
 
-def allgather(tensor, name=None):
+def allgather(tensor, name=None, process_set=None):
+    if process_set is not None:
+        basics._check_initialized()
+        set_id, _ = _c._set_args(process_set)
+        nm = _c._auto_name("allgather", name)
+        return _from_numpy(
+            _c._eager_allgather(_to_numpy(tensor), nm, set_id=set_id),
+            tensor)
     return synchronize(allgather_async(tensor, name=name))
 
 
@@ -160,7 +172,14 @@ def broadcast_async(tensor, root_rank, name=None):
     return _c._async_dispatch(work, "broadcast", nm, to_jnp=False)
 
 
-def broadcast(tensor, root_rank, name=None):
+def broadcast(tensor, root_rank, name=None, process_set=None):
+    if process_set is not None:
+        basics._check_initialized()
+        set_id, _ = _c._set_args(process_set)
+        nm = _c._auto_name("broadcast", name)
+        return _from_numpy(
+            _c._eager_broadcast(_to_numpy(tensor), root_rank, nm,
+                                set_id=set_id), tensor)
     return synchronize(broadcast_async(tensor, root_rank, name=name))
 
 
@@ -182,12 +201,14 @@ def broadcast_(tensor, root_rank, name=None):
     return synchronize(broadcast_async_(tensor, root_rank, name=name))
 
 
-def alltoall(tensor, splits=None, name=None):
+def alltoall(tensor, splits=None, name=None, process_set=None):
     basics._check_initialized()
+    set_id, _ = _c._set_args(process_set)
     nm = _c._auto_name("alltoall", name)
     if splits is not None and torch.is_tensor(splits):
         splits = splits.detach().cpu().numpy()
-    out, received = _c._eager_alltoall(_to_numpy(tensor), splits, nm)
+    out, received = _c._eager_alltoall(_to_numpy(tensor), splits, nm,
+                                       set_id=set_id)
     if splits is not None:
         # Later-Horovod contract: (output, received_splits) with splits.
         return _from_numpy(out, tensor), torch.as_tensor(received)

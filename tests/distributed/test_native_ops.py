@@ -319,3 +319,116 @@ def test_alltoall_uneven_steady_state_cached(hvd, rank, size):
             np.testing.assert_allclose(
                 np.asarray(out)[off:off + rank + 1], float(src + step))
             off += rank + 1
+
+
+# ---------------------------------------------------------------------------
+# Process sets (later-Horovod; reference v0.18 had only the global group —
+# SURVEY §2.5 "rank-subset communicators: partial").
+# ---------------------------------------------------------------------------
+
+def test_process_set_allreduce(hvd, rank, size):
+    """A subset allreduce involves only members; averages divide by SET
+    size; global traffic interleaves with it untouched."""
+    if size < 2:
+        pytest.skip("needs >= 2 ranks")
+    evens = list(range(0, size, 2))
+    odds = list(range(1, size, 2))
+    ps_even = hvd.add_process_set(evens)
+    ps_odd = hvd.add_process_set(odds) if odds else None
+    assert ps_even.id != 0
+    mine = ps_even if rank % 2 == 0 else ps_odd
+    members = evens if rank % 2 == 0 else odds
+    assert mine.included() and mine.size() == len(members)
+    assert mine.rank() == members.index(rank)
+
+    out = np.asarray(hvd.allreduce(np.full(4, float(rank + 1), np.float32),
+                                   op=hvd.Sum, name="ps.sum",
+                                   process_set=mine))
+    np.testing.assert_allclose(out, sum(r + 1 for r in members))
+    # Average divides by the SET size, not the world size.
+    out = np.asarray(hvd.allreduce(np.full(4, float(rank + 1), np.float32),
+                                   name="ps.avg", process_set=mine))
+    np.testing.assert_allclose(
+        out, sum(r + 1 for r in members) / len(members))
+    # Global collective still works in between.
+    out = np.asarray(hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                                   name="ps.global"))
+    np.testing.assert_allclose(out, float(size))
+
+
+def test_process_set_allgather_broadcast_barrier(hvd, rank, size):
+    if size < 2:
+        pytest.skip("needs >= 2 ranks")
+    ps = hvd.add_process_set(list(range(size - 1)))  # all but the last rank
+    if rank < size - 1:
+        out = np.asarray(hvd.allgather(
+            np.full((rank + 1, 2), float(rank), np.float32),
+            name="ps.ag", process_set=ps))
+        assert out.shape == (sum(r + 1 for r in range(size - 1)), 2)
+        root = ps.ranks[0]
+        out = np.asarray(hvd.broadcast(np.full(3, float(rank), np.float32),
+                                       root_rank=root, name="ps.bc",
+                                       process_set=ps))
+        np.testing.assert_allclose(out, float(root))
+        hvd.barrier(name="ps.barrier", process_set=ps)
+    # Everyone (members and the excluded rank): a closing global barrier —
+    # proving the excluded rank was never blocked by the subset traffic.
+    hvd.barrier(name="ps.final")
+
+
+def test_process_set_registration_validation(hvd, rank, size):
+    if size < 2:
+        pytest.skip("needs >= 2 ranks")
+    # Non-member submission is refused locally.
+    ps = hvd.add_process_set([0])
+    if rank != 0:
+        with pytest.raises(RuntimeError, match="not a member"):
+            hvd.allreduce(np.ones(1, np.float32), name="ps.nonmember",
+                          process_set=ps)
+    # Mismatched registration -> clean coordinated error on every rank.
+    bad = [0] if rank == 0 else [0, 1]
+    with pytest.raises(RuntimeError, match="[Mm]ismatched process-set"):
+        hvd.add_process_set(bad)
+    # Re-registering the same list returns the same id (idempotent).
+    again = hvd.add_process_set([0])
+    assert again.id == ps.id
+
+
+def test_process_set_alltoall_uneven(hvd, rank, size):
+    """Uneven alltoallv over a subset: splits are indexed by SET position."""
+    if size < 3:
+        pytest.skip("needs >= 3 ranks")
+    members = [0, size - 1]
+    ps = hvd.add_process_set(members)
+    if rank in members:
+        pos = members.index(rank)
+        splits = np.array([1, 2], np.int64)     # to position 0 and 1
+        x = np.full((3, 1), float(100 + pos), np.float32)
+        out, received = hvd.alltoall(x, splits=splits, name="ps.a2av",
+                                     process_set=ps)
+        received = np.asarray(received)
+        # position p receives p+1 rows from each of the 2 members
+        np.testing.assert_array_equal(received, np.full(2, pos + 1))
+        assert np.asarray(out).shape == (2 * (pos + 1), 1)
+    hvd.barrier(name="ps.a2av.done")
+
+
+def test_process_set_then_cached_global_steady_state(hvd, rank, size):
+    """Regression: subset responses must not advance the deterministic
+    response-cache replicas (only members hold entries to Put) — after
+    subset traffic, bit-announced global steady state must stay exact on
+    EVERY rank, member or not."""
+    if size < 2:
+        pytest.skip("needs >= 2 ranks")
+    ps = hvd.add_process_set([0])
+    for step in range(4):
+        if rank == 0:
+            hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                          name="ps.cachemix.sub", process_set=ps)
+        # Same names every step -> cached bit announcements after step 1.
+        for i in range(3):
+            out = np.asarray(hvd.allreduce(
+                np.full(4, float(step + i + rank), np.float32),
+                op=hvd.Sum, name=f"ps.cachemix.{i}"))
+            expect = size * (step + i) + sum(range(size))
+            np.testing.assert_allclose(out, expect)
