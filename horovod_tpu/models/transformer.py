@@ -26,6 +26,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from horovod_tpu.ops.flash_attention import flash_attention
 from horovod_tpu.parallel import sequence as seq_mod
 from horovod_tpu.parallel import tensor as tp
 
@@ -131,8 +132,20 @@ def forward(params, tokens, cfg: TransformerConfig,
         if seq_axis is not None:
             if attention == "ring":
                 o = seq_mod.ring_attention(q, k, v, seq_axis, causal=True)
-            else:
+            elif attention == "ulysses":
                 o = seq_mod.ulysses_attention(q, k, v, seq_axis, causal=True)
+            else:
+                # The flash kernel is single-device attention; under
+                # sequence parallelism K/V blocks arrive over ICI and the
+                # blockwise math lives in ring_attention.  Never silently
+                # substitute a different algorithm than the user selected.
+                raise ValueError(
+                    f"attention={attention!r} is not available with a "
+                    f"sequence axis; choose 'ring' or 'ulysses'")
+        elif attention == "flash":
+            # Pallas flash kernel (ops/flash_attention.py): same exact
+            # math blockwise in VMEM; requires T divisible by its blocks.
+            o = flash_attention(q, k, v, True)
         else:
             o = seq_mod.local_attention(q, k, v, causal=True)
         o = o.reshape(b, t, dh) @ layer["wo"].astype(dt)

@@ -1,0 +1,139 @@
+"""Pallas flash attention vs the lax oracle (interpret mode on CPU).
+
+The kernel (`ops/flash_attention.py`) runs here through the Pallas
+interpreter — same kernel code, CPU-executable — against
+`parallel/sequence.local_attention`, the straightforward lax softmax
+attention the SP tests already use as their numerical oracle.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.ops.flash_attention import flash_attention
+from horovod_tpu.parallel.sequence import local_attention
+
+
+def _make_qkv(rs, b=2, t=256, h=3, d=32, dtype=jnp.float32):
+    q = jnp.asarray(rs.standard_normal((b, t, h, d)), dtype)
+    k = jnp.asarray(rs.standard_normal((b, t, h, d)), dtype)
+    v = jnp.asarray(rs.standard_normal((b, t, h, d)), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_forward_matches_oracle(causal):
+    rs = np.random.default_rng(0)
+    q, k, v = _make_qkv(rs)
+    out = flash_attention(q, k, v, causal, None, 64, 64, True)
+    ref = local_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_gradients_match_oracle(causal):
+    rs = np.random.default_rng(1)
+    q, k, v = _make_qkv(rs, t=128, d=16)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal, None, 32, 32, True)
+        return jnp.sum(o * (o + 1.0))
+
+    def loss_ref(q, k, v):
+        o = local_attention(q, k, v, causal=causal)
+        return jnp.sum(o * (o + 1.0))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, nm in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg=f"d{nm} mismatch")
+
+
+def test_uneven_blocks():
+    """block_q != block_k and blocks not dividing each other's multiples."""
+    rs = np.random.default_rng(2)
+    q, k, v = _make_qkv(rs, t=192, d=16)
+    out = flash_attention(q, k, v, True, None, 64, 32, True)
+    ref = local_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_bf16_inputs():
+    rs = np.random.default_rng(3)
+    q, k, v = _make_qkv(rs, t=128, d=32, dtype=jnp.bfloat16)
+    out = flash_attention(q, k, v, True, None, 64, 64, True)
+    ref = local_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                          v.astype(jnp.float32), causal=True)
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
+                               np.asarray(ref), rtol=3e-2, atol=3e-2)
+
+
+def test_custom_scale():
+    rs = np.random.default_rng(4)
+    q, k, v = _make_qkv(rs, t=128, d=16)
+    out = flash_attention(q, k, v, False, 0.5, 64, 64, True)
+    ref = local_attention(q, k, v, causal=False, scale=0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_rejects_ragged_sequence():
+    rs = np.random.default_rng(5)
+    q, k, v = _make_qkv(rs, t=100, d=16)
+    with pytest.raises(ValueError, match="divisible"):
+        flash_attention(q, k, v, True, None, 64, 64, True)
+
+
+def test_short_sequence_block_clamp():
+    """T smaller than the default blocks clamps instead of failing."""
+    rs = np.random.default_rng(6)
+    q, k, v = _make_qkv(rs, t=64, d=16)
+    out = flash_attention(q, k, v, True, None, 128, 128, True)
+    ref = local_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_transformer_flash_path():
+    """The transformer's attention="flash" route matches the lax route."""
+    from horovod_tpu.models import transformer as tfm
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                d_ff=64, n_layers=1, max_seq=64,
+                                dtype=jnp.float32)
+    rs = np.random.default_rng(7)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.asarray(rs.integers(0, 64, (2, 64)), jnp.int32)
+    a = tfm.forward(params, tokens, cfg, attention="flash")
+    b = tfm.forward(params, tokens, cfg, attention="local")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-2,
+                               atol=2e-2)
+
+
+def test_flash_rejected_under_sequence_axis():
+    """flash + seq_axis must error, never silently run a different
+    algorithm."""
+    from horovod_tpu.models import transformer as tfm
+    import jax.numpy as jnp
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                d_ff=64, n_layers=1, max_seq=64,
+                                dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.zeros((1, 64), jnp.int32)
+    import horovod_tpu as hvd
+    from horovod_tpu.topology import build_mesh
+    import jax as _jax
+    mesh = build_mesh(axes=("seq",), shape=(2,))
+    with pytest.raises(ValueError, match="ring.*ulysses|not available"):
+        _jax.shard_map(
+            lambda p, t: tfm.forward(p, t, cfg, seq_axis="seq",
+                                     attention="flash"),
+            mesh=mesh,
+            in_specs=(_jax.sharding.PartitionSpec(),
+                      _jax.sharding.PartitionSpec(None, "seq")),
+            out_specs=_jax.sharding.PartitionSpec(None, "seq"),
+            check_vma=False)(params, tokens)
