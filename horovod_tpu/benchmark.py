@@ -48,6 +48,11 @@ PEAK_TFLOPS_BY_KIND = {
 _FWD_GFLOPS_224 = {
     "resnet18": 1.82, "resnet34": 3.67, "resnet50": 4.09,
     "resnet101": 7.80, "resnet152": 11.52,
+    # VGG-BN conv stacks (GAP head; the convs are >99% of FLOPs).
+    "vgg11": 7.6, "vgg13": 11.3, "vgg16": 15.5, "vgg19": 19.6,
+    # Inception V3 is 5.7 GFLOPs at its canonical 299x299 => ~3.2 at 224
+    # under the quadratic spatial scaling the fallback applies.
+    "inception3": 3.2, "inceptionv3": 3.2,
 }
 
 

@@ -8,6 +8,7 @@ framework cannot lean on those, so the models live here, written
 TPU-first (NHWC, bfloat16 matmuls/convs on the MXU, fp32 accumulation).
 """
 
+from horovod_tpu.models.inception import InceptionV3
 from horovod_tpu.models.resnet import (
     ResNet,
     ResNet18,
@@ -17,3 +18,4 @@ from horovod_tpu.models.resnet import (
     ResNet152,
 )
 from horovod_tpu.models.registry import get_model, list_models
+from horovod_tpu.models.vgg import VGG, VGG11, VGG13, VGG16, VGG19

@@ -1,0 +1,196 @@
+"""Inception V3 in flax, TPU-first.
+
+Inception V3 is one of the reference's three headline scaling models (90%
+scaling efficiency on 512 GPUs, reference ``README.rst:75``,
+``docs/benchmarks.rst:13-14``; selectable in the synthetic benchmark like
+every ``tf.keras.applications`` model,
+``examples/tensorflow2_synthetic_benchmark.py:24-30``).
+
+Architecture follows Szegedy et al. 2015 ("Rethinking the Inception
+Architecture"): stem -> 3x InceptionA -> reduction B -> 4x InceptionC
+(factorized 7x7) -> reduction D -> 2x InceptionE -> global pool -> dense.
+The auxiliary classifier is omitted (inference-irrelevant and typically
+disabled in benchmark harnesses).
+
+TPU design notes (same conventions as :mod:`horovod_tpu.models.resnet`):
+
+* NHWC, bfloat16 compute / float32 params+stats — every conv is
+  conv+BN+relu, which XLA fuses into single MXU-feeding kernels.
+* All branches of a block are independent convs over the same input; XLA
+  schedules them back-to-back on the MXU and fuses each one's BN/relu —
+  no manual branch fusion needed.
+* Shape-polymorphic in image size (canonical 299x299; any size that
+  survives the stem's three stride-2 reductions works, e.g. 224).
+* 1x1 convs dominate the op count: they are pure matmuls on the MXU, the
+  best-case op for TPUs — which is why Inception's scaling efficiency tops
+  the reference's table (tiny activations, compute-dense).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class ConvBN(nn.Module):
+    """conv -> BN -> relu, the universal Inception building unit."""
+
+    features: int
+    kernel: Tuple[int, int] = (1, 1)
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "SAME"
+    dtype: Any = jnp.bfloat16
+    axis_name: Optional[str] = None
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.features, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False, dtype=self.dtype,
+                    param_dtype=jnp.float32)(x)
+        x = nn.BatchNorm(use_running_average=not self.train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype,
+                         param_dtype=jnp.float32,
+                         axis_name=self.axis_name if self.train else None)(x)
+        return nn.relu(x)
+
+
+def _avg_pool_same(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    """35x35 block: 1x1 / 5x5 / double-3x3 / pool branches."""
+
+    pool_features: int
+    conv: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b1 = self.conv(64)(x)
+        b2 = self.conv(48)(x)
+        b2 = self.conv(64, kernel=(5, 5))(b2)
+        b3 = self.conv(64)(x)
+        b3 = self.conv(96, kernel=(3, 3))(b3)
+        b3 = self.conv(96, kernel=(3, 3))(b3)
+        b4 = self.conv(self.pool_features)(_avg_pool_same(x))
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """Grid reduction 35x35 -> 17x17."""
+
+    conv: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b1 = self.conv(384, kernel=(3, 3), strides=(2, 2),
+                       padding="VALID")(x)
+        b2 = self.conv(64)(x)
+        b2 = self.conv(96, kernel=(3, 3))(b2)
+        b2 = self.conv(96, kernel=(3, 3), strides=(2, 2),
+                       padding="VALID")(b2)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """17x17 block with factorized 7x7 (1x7 + 7x1) branches."""
+
+    channels_7x7: int
+    conv: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        c7 = self.channels_7x7
+        b1 = self.conv(192)(x)
+        b2 = self.conv(c7)(x)
+        b2 = self.conv(c7, kernel=(1, 7))(b2)
+        b2 = self.conv(192, kernel=(7, 1))(b2)
+        b3 = self.conv(c7)(x)
+        b3 = self.conv(c7, kernel=(7, 1))(b3)
+        b3 = self.conv(c7, kernel=(1, 7))(b3)
+        b3 = self.conv(c7, kernel=(7, 1))(b3)
+        b3 = self.conv(192, kernel=(1, 7))(b3)
+        b4 = self.conv(192)(_avg_pool_same(x))
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """Grid reduction 17x17 -> 8x8."""
+
+    conv: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b1 = self.conv(192)(x)
+        b1 = self.conv(320, kernel=(3, 3), strides=(2, 2),
+                       padding="VALID")(b1)
+        b2 = self.conv(192)(x)
+        b2 = self.conv(192, kernel=(1, 7))(b2)
+        b2 = self.conv(192, kernel=(7, 1))(b2)
+        b2 = self.conv(192, kernel=(3, 3), strides=(2, 2),
+                       padding="VALID")(b2)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """8x8 block with split 1x3/3x1 branch expansions."""
+
+    conv: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b1 = self.conv(320)(x)
+        b2 = self.conv(384)(x)
+        b2 = jnp.concatenate([self.conv(384, kernel=(1, 3))(b2),
+                              self.conv(384, kernel=(3, 1))(b2)], axis=-1)
+        b3 = self.conv(448)(x)
+        b3 = self.conv(384, kernel=(3, 3))(b3)
+        b3 = jnp.concatenate([self.conv(384, kernel=(1, 3))(b3),
+                              self.conv(384, kernel=(3, 1))(b3)], axis=-1)
+        b4 = self.conv(192)(_avg_pool_same(x))
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    """Inception V3 over NHWC inputs."""
+
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    axis_name: Optional[str] = None   # sync-BN across replicas if set
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(ConvBN, dtype=self.dtype,
+                                 axis_name=self.axis_name, train=train)
+        x = x.astype(self.dtype)
+        # Stem: 299 -> 35 spatial (three stride-2 reductions).
+        x = conv(32, kernel=(3, 3), strides=(2, 2), padding="VALID")(x)
+        x = conv(32, kernel=(3, 3), padding="VALID")(x)
+        x = conv(64, kernel=(3, 3))(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = conv(80)(x)
+        x = conv(192, kernel=(3, 3), padding="VALID")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+
+        x = InceptionA(pool_features=32, conv=conv)(x)
+        x = InceptionA(pool_features=64, conv=conv)(x)
+        x = InceptionA(pool_features=64, conv=conv)(x)
+        x = InceptionB(conv=conv)(x)
+        for c7 in (128, 160, 160, 192):
+            x = InceptionC(channels_7x7=c7, conv=conv)(x)
+        x = InceptionD(conv=conv)(x)
+        x = InceptionE(conv=conv)(x)
+        x = InceptionE(conv=conv)(x)
+
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)

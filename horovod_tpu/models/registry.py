@@ -28,12 +28,18 @@ def list_models():
 
 
 def _register_defaults():
-    from horovod_tpu.models import resnet
+    from horovod_tpu.models import inception, resnet, vgg
     register("resnet18", resnet.ResNet18)
     register("resnet34", resnet.ResNet34)
     register("resnet50", resnet.ResNet50)
     register("resnet101", resnet.ResNet101)
     register("resnet152", resnet.ResNet152)
+    register("vgg11", vgg.VGG11)
+    register("vgg13", vgg.VGG13)
+    register("vgg16", vgg.VGG16)
+    register("vgg19", vgg.VGG19)
+    register("inception3", inception.InceptionV3)
+    register("inceptionv3", inception.InceptionV3)
 
 
 _register_defaults()

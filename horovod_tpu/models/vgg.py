@@ -1,0 +1,85 @@
+"""VGG in flax, TPU-first.
+
+VGG-16 is one of the reference's three headline scaling models (68%
+scaling efficiency on 512 GPUs, reference ``README.rst:75``,
+``docs/benchmarks.rst:14``; benchmarked via ``tf_cnn_benchmarks`` and
+selectable by ``--model`` in ``examples/tensorflow2_synthetic_benchmark.py:24-30``).
+
+Design notes (same conventions as :mod:`horovod_tpu.models.resnet`):
+
+* NHWC, bfloat16 compute / float32 params — conv stacks feed the MXU.
+* The batch-normalized variant (VGG-BN, as in ``torchvision.models.vgg16_bn``):
+  the plain 1989-style network needs careful init to train at all, BN makes
+  it robust and gives the harness its ``batch_stats`` collection like every
+  other model here.
+* The classifier head follows modern practice (global average pool + one
+  dense layer) instead of the original 224-locked 25088->4096->4096 FC
+  stack: it keeps the network shape-polymorphic in image size the way the
+  rest of the zoo is, and the conv stack — where >99% of the FLOPs live —
+  is exactly VGG.  Set ``classic_head=True`` for the original FC head
+  (fp32-heavy, 224x224 only).
+* VGG is intentionally kept *conv-dominated*: it is the memory-bandwidth
+  stress model of the trio (large activations, no residual reuse), which is
+  why the reference's scaling efficiency drops to 68% on it — gradient
+  volume is ~550 MB/step.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# Stage configs: number of 3x3 convs per stage x output channels.
+_CFGS = {
+    "vgg11": ((1, 64), (1, 128), (2, 256), (2, 512), (2, 512)),
+    "vgg13": ((2, 64), (2, 128), (2, 256), (2, 512), (2, 512)),
+    "vgg16": ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512)),
+    "vgg19": ((2, 64), (2, 128), (4, 256), (4, 512), (4, 512)),
+}
+
+
+class VGG(nn.Module):
+    """VGG-BN over NHWC inputs."""
+
+    stage_sizes: Sequence          # ((n_convs, channels), ...)
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    axis_name: Optional[str] = None   # sync-BN across replicas if set
+    classic_head: bool = False        # original 4096-4096 FC classifier
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, kernel_size=(3, 3), use_bias=False,
+                                 dtype=self.dtype, param_dtype=jnp.float32)
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype, param_dtype=jnp.float32,
+            axis_name=self.axis_name if train else None)
+
+        x = x.astype(self.dtype)
+        for n_convs, channels in self.stage_sizes:
+            for _ in range(n_convs):
+                x = conv(channels)(x)
+                x = norm()(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        if self.classic_head:
+            x = x.reshape((x.shape[0], -1))
+            for _ in range(2):
+                x = nn.Dense(4096, dtype=self.dtype,
+                             param_dtype=jnp.float32)(x)
+                x = nn.relu(x)
+        else:
+            x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+VGG11 = functools.partial(VGG, stage_sizes=_CFGS["vgg11"])
+VGG13 = functools.partial(VGG, stage_sizes=_CFGS["vgg13"])
+VGG16 = functools.partial(VGG, stage_sizes=_CFGS["vgg16"])
+VGG19 = functools.partial(VGG, stage_sizes=_CFGS["vgg19"])

@@ -118,22 +118,19 @@ def test_flash_rejected_under_sequence_axis():
     """flash + seq_axis must error, never silently run a different
     algorithm."""
     from horovod_tpu.models import transformer as tfm
-    import jax.numpy as jnp
+    from horovod_tpu.topology import build_mesh
     cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
                                 d_ff=64, n_layers=1, max_seq=64,
                                 dtype=jnp.float32)
     params = tfm.init_params(jax.random.PRNGKey(0), cfg)
     tokens = jnp.zeros((1, 64), jnp.int32)
-    import horovod_tpu as hvd
-    from horovod_tpu.topology import build_mesh
-    import jax as _jax
     mesh = build_mesh(axes=("seq",), shape=(2,))
     with pytest.raises(ValueError, match="ring.*ulysses|not available"):
-        _jax.shard_map(
+        jax.shard_map(
             lambda p, t: tfm.forward(p, t, cfg, seq_axis="seq",
                                      attention="flash"),
             mesh=mesh,
-            in_specs=(_jax.sharding.PartitionSpec(),
-                      _jax.sharding.PartitionSpec(None, "seq")),
-            out_specs=_jax.sharding.PartitionSpec(None, "seq"),
+            in_specs=(jax.sharding.PartitionSpec(),
+                      jax.sharding.PartitionSpec(None, "seq")),
+            out_specs=jax.sharding.PartitionSpec(None, "seq"),
             check_vma=False)(params, tokens)

@@ -18,6 +18,40 @@ def test_resnet18_forward_shapes(hvd):
     assert out.dtype == jnp.float32
 
 
+@pytest.mark.parametrize("name,size", [("vgg16", 32), ("inception3", 96)])
+def test_headline_model_forward(hvd, name, size):
+    """VGG-16 and Inception V3 — the reference's other two headline scaling
+    models (README.rst:75) — forward with BN state at reduced resolution."""
+    from horovod_tpu.models import get_model
+
+    model = get_model(name, num_classes=10)
+    x = jnp.zeros((2, size, size, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 10)
+    assert out.dtype == jnp.float32
+    assert "batch_stats" in variables
+
+    # train=True mutates batch_stats (the harness contract).
+    out, mutated = model.apply(variables, x, train=True,
+                               mutable=["batch_stats"])
+    assert "batch_stats" in mutated
+
+
+def test_headline_models_train_step(hvd, mesh8):
+    """The synthetic benchmark harness must drive the new families end-to-end
+    (registry -> make_train_step -> finite loss)."""
+    from horovod_tpu.benchmark import run_synthetic_benchmark
+
+    for name, size in (("vgg11", 32), ("inception3", 96)):
+        res = run_synthetic_benchmark(
+            name, batch_size=1, image_size=size, num_classes=4,
+            num_warmup_batches=0, num_batches_per_iter=1, num_iters=1,
+            verbose=False)
+        assert np.isfinite(res["loss"])
+        assert res["img_sec_per_chip"] > 0
+
+
 def test_registry(hvd):
     from horovod_tpu.models import get_model, list_models
 
