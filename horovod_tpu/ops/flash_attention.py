@@ -7,15 +7,20 @@ blowup live.  The lax implementation (``parallel/sequence.py
 local_attention``) materializes the [B, H, T, T] score matrix in HBM —
 O(T^2) memory and two full HBM round trips.  This kernel computes the
 same exact attention blockwise in VMEM with online softmax (Dao et al.
-2022, FlashAttention), never materializing scores: memory is O(T·D) and
-score traffic stays on-chip.
+2022, FlashAttention), never materializing scores: memory is O(T·D) in
+HBM and O(block·D) in VMEM, so sequence length is bounded by HBM, not by
+the ~16 MB VMEM.
 
 Layout: ``[B, T, H, D]`` (the repo convention) is folded to
-``[B·H, T, D]``; the grid walks (batch·head, query-block), each step
-streaming key/value blocks from VMEM with fp32 accumulation.  Causal
-masking skips key blocks strictly above the diagonal.  The backward pass
-is the standard flash recomputation: per key-block kernels for dK/dV and
-per query-block kernels for dQ, using the saved row max/denominator.
+``[B·H, T, D]``; the grid walks (batch·head, query-block, key-block) —
+the innermost grid dimension streams one K/V tile at a time through
+VMEM (Mosaic double-buffers the fetches), while fp32 accumulators and
+the online-softmax m/l state persist across the inner dimension in VMEM
+scratch.  Causal masking skips the compute of key blocks strictly above
+the diagonal (``pl.when``).  The backward pass is the standard flash
+recomputation: a per key-block kernel for dK/dV streaming query tiles,
+and a per query-block kernel for dQ streaming key tiles, using the saved
+row max/denominator.
 
 ``interpret=True`` (or ``HOROVOD_FLASH_INTERPRET=1``) runs the kernels
 in the Pallas interpreter — exact same code path, CPU-executable — which
@@ -32,6 +37,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = float("-inf")
 
@@ -49,38 +55,33 @@ def _interpret_default() -> bool:
 # Forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *,
-                block_q: int, block_k: int, seq_len: int, causal: bool,
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                block_q: int, block_k: int, num_k: int, causal: bool,
                 scale: float):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)                    # [bq, D]
-    d = q.shape[-1]
+    kj = pl.program_id(2)
+    rows = pl.dslice(qi * block_q, block_q)
 
-    m = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
-    acc = jnp.zeros((block_q, d), jnp.float32)
+    @pl.when(kj == 0)
+    def _init():
+        m_ref[0, 0, rows] = jnp.full((block_q,), NEG_INF, jnp.float32)
+        l_ref[0, 0, rows] = jnp.zeros((block_q,), jnp.float32)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    num_k = seq_len // block_k
-    if causal:
-        # Key blocks strictly above the diagonal contribute nothing.
-        num_k_live = lax.div((qi + 1) * block_q + block_k - 1, block_k)
-        num_k_live = jnp.minimum(num_k_live, num_k)
-    else:
-        num_k_live = num_k
-
-    def body(j, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, pl.dslice(j * block_k, block_k), :].astype(
-            jnp.float32)                                # [bk, D]
-        v_blk = v_ref[0, pl.dslice(j * block_k, block_k), :].astype(
-            jnp.float32)
+    def compute():
+        q = q_ref[0].astype(jnp.float32)                 # [bq, D]
+        k_blk = k_ref[0].astype(jnp.float32)             # [bk, D]
+        v_blk = v_ref[0].astype(jnp.float32)
+        m = m_ref[0, 0, rows]
+        l = l_ref[0, 0, rows]
+        acc = acc_ref[...]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
         if causal:
             qpos = qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            kpos = j * block_k + lax.broadcasted_iota(
+            kpos = kj * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(qpos >= kpos, s, NEG_INF)
         m_blk = jnp.max(s, axis=-1)
@@ -89,20 +90,23 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *,
         p = jnp.exp(s - safe_m[:, None])
         p = jnp.where(s == NEG_INF, 0.0, p)
         corr = jnp.where(m == NEG_INF, 0.0, jnp.exp(m - safe_m))
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+        m_ref[0, 0, rows] = m_new
+        l_ref[0, 0, rows] = l * corr + jnp.sum(p, axis=-1)
+        acc_ref[...] = acc * corr[:, None] + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
 
-    m, l, acc = lax.fori_loop(0, num_k_live, body, (m, l, acc))
-    denom = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / denom[:, None]).astype(o_ref.dtype)
-    # m/l rows live in a full-length [1, T] block revisited across the
-    # q-block grid dimension (TPU tiling forbids (1, block_q) blocks);
-    # each program writes only its slice.
-    m_ref[0, 0, pl.dslice(qi * block_q, block_q)] = m
-    l_ref[0, 0, pl.dslice(qi * block_q, block_q)] = l
+    if causal:
+        # Key blocks strictly above the diagonal contribute nothing.
+        pl.when(kj * block_k < (qi + 1) * block_q)(compute)
+    else:
+        compute()
+
+    @pl.when(kj == num_k - 1)
+    def _finalize():
+        l = l_ref[0, 0, rows]
+        denom = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / denom[:, None]).astype(o_ref.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -116,37 +120,34 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *,
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, m_ref, l_ref,
-                   dq_ref, *, block_q: int, block_k: int, seq_len: int,
-                   causal: bool, scale: float):
+                   dq_ref, acc_ref, *, block_q: int, block_k: int,
+                   num_k: int, causal: bool, scale: float):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    o = o_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    m = m_ref[0, 0, pl.dslice(qi * block_q, block_q)]
-    l = l_ref[0, 0, pl.dslice(qi * block_q, block_q)]
-    safe_m = jnp.where(m == NEG_INF, 0.0, m)
-    denom = jnp.where(l == 0.0, 1.0, l)
-    di = jnp.sum(do * o, axis=-1)                       # [bq]
+    kj = pl.program_id(2)
+    rows = pl.dslice(qi * block_q, block_q)
 
-    num_k = seq_len // block_k
-    if causal:
-        num_k_live = jnp.minimum(
-            lax.div((qi + 1) * block_q + block_k - 1, block_k), num_k)
-    else:
-        num_k_live = num_k
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    def body(j, dq):
-        k_blk = k_ref[0, pl.dslice(j * block_k, block_k), :].astype(
-            jnp.float32)
-        v_blk = v_ref[0, pl.dslice(j * block_k, block_k), :].astype(
-            jnp.float32)
+    def compute():
+        q = q_ref[0].astype(jnp.float32)
+        o = o_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        m = m_ref[0, 0, rows]
+        l = l_ref[0, 0, rows]
+        safe_m = jnp.where(m == NEG_INF, 0.0, m)
+        denom = jnp.where(l == 0.0, 1.0, l)
+        di = jnp.sum(do * o, axis=-1)                    # [bq]
+        k_blk = k_ref[0].astype(jnp.float32)             # [bk, D]
+        v_blk = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
             qpos = qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            kpos = j * block_k + lax.broadcasted_iota(
+            kpos = kj * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(qpos >= kpos, s, NEG_INF)
         p = jnp.where(s == NEG_INF, 0.0,
@@ -155,39 +156,41 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, m_ref, l_ref,
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)          # [bq, bk]
         ds = p * (dp - di[:, None])
-        return dq + jax.lax.dot_general(
+        acc_ref[...] += jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
 
-    dq = lax.fori_loop(0, num_k_live,
-                       body, jnp.zeros_like(q, jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    if causal:
+        pl.when(kj * block_k < (qi + 1) * block_q)(compute)
+    else:
+        compute()
+
+    @pl.when(kj == num_k - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, m_ref, l_ref,
-                    dk_ref, dv_ref, *, block_q: int, block_k: int,
-                    seq_len: int, causal: bool, scale: float):
+                    dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *,
+                    block_q: int, block_k: int, num_q: int, causal: bool,
+                    scale: float):
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)                    # [bk, D]
-    v = v_ref[0].astype(jnp.float32)
+    qi = pl.program_id(2)
+    rows = pl.dslice(qi * block_q, block_q)
 
-    num_q = seq_len // block_q
-    if causal:
-        # Query blocks strictly left of this key block see none of it.
-        first_q = lax.div(ki * block_k, block_q)
-    else:
-        first_q = 0
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
 
-    def body(i, carry):
-        dk, dv = carry
-        q_blk = q_ref[0, pl.dslice(i * block_q, block_q), :].astype(
-            jnp.float32)
-        o_blk = o_ref[0, pl.dslice(i * block_q, block_q), :].astype(
-            jnp.float32)
-        do_blk = do_ref[0, pl.dslice(i * block_q, block_q), :].astype(
-            jnp.float32)
-        m_blk = m_ref[0, 0, pl.dslice(i * block_q, block_q)]
-        l_blk = l_ref[0, 0, pl.dslice(i * block_q, block_q)]
+    def compute():
+        k = k_ref[0].astype(jnp.float32)                 # [bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        q_blk = q_ref[0].astype(jnp.float32)             # [bq, D]
+        o_blk = o_ref[0].astype(jnp.float32)
+        do_blk = do_ref[0].astype(jnp.float32)
+        m_blk = m_ref[0, 0, rows]
+        l_blk = l_ref[0, 0, rows]
         safe_m = jnp.where(m_blk == NEG_INF, 0.0, m_blk)
         denom = jnp.where(l_blk == 0.0, 1.0, l_blk)
         di = jnp.sum(do_blk * o_blk, axis=-1)
@@ -195,35 +198,51 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, m_ref, l_ref,
             q_blk, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
         if causal:
-            qpos = i * block_q + lax.broadcasted_iota(
+            qpos = qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             kpos = ki * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(qpos >= kpos, s, NEG_INF)
         p = jnp.where(s == NEG_INF, 0.0,
                       jnp.exp(s - safe_m[:, None])) / denom[:, None]
-        dv = dv + jax.lax.dot_general(
+        dv_acc_ref[...] += jax.lax.dot_general(
             p, do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # [bk, D]
         dp = jax.lax.dot_general(
             do_blk, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - di[:, None])
-        dk = dk + jax.lax.dot_general(
+        dk_acc_ref[...] += jax.lax.dot_general(
             ds, q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        return dk, dv
 
-    dk, dv = lax.fori_loop(
-        first_q, num_q, body,
-        (jnp.zeros_like(k, jnp.float32), jnp.zeros_like(v, jnp.float32)))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    if causal:
+        # Query blocks strictly left of this key block see none of it.
+        pl.when((qi + 1) * block_q > ki * block_k)(compute)
+    else:
+        compute()
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
 # ---------------------------------------------------------------------------
 # pallas_call plumbing
 # ---------------------------------------------------------------------------
+
+def _causal_kv_map(block_q, block_k):
+    # Last key block with any unmasked entry for query block i.
+    return lambda bh_, i, j: (
+        bh_, jnp.minimum(j, ((i + 1) * block_q - 1) // block_k), 0)
+
+
+def _causal_q_map(block_q, block_k):
+    # First query block that sees key block j.
+    return lambda bh_, j, i: (
+        bh_, jnp.maximum(i, (j * block_k) // block_q), 0)
+
 
 def _check_shapes(q, k, v, block_q, block_k):
     if q.shape != k.shape or q.shape != v.shape:
@@ -252,31 +271,41 @@ def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     b, t, h, d = _check_shapes(q, k, v, block_q, block_k)
     qf, kf, vf = _fold(q), _fold(k), _fold(v)
     bh = b * h
-    grid = (bh, t // block_q)
+    num_k = t // block_k
+    grid = (bh, t // block_q, num_k)
     kernel = functools.partial(_fwd_kernel, block_q=block_q,
-                               block_k=block_k, seq_len=t, causal=causal,
+                               block_k=block_k, num_k=num_k, causal=causal,
                                scale=scale)
+    # Causal: masked steps (above the diagonal) clamp the K/V block index
+    # to the last live block — same index as the preceding step, so Mosaic
+    # elides the DMA instead of fetching a tile whose work pl.when skips.
+    kv_map = (_causal_kv_map(block_q, block_k) if causal
+              else (lambda bh_, i, j: (bh_, j, 0)))
     o, m, l = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh_, i: (bh_, i, 0)),
-            pl.BlockSpec((1, t, d), lambda bh_, i: (bh_, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda bh_, i: (bh_, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh_, i, j: (bh_, i, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh_, i: (bh_, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh_, i, j: (bh_, i, 0)),
             # TPU tiling: the last two block dims must be (8k, 128k) or
             # equal the array dims — a [bh, 1, T] layout with full
-            # (1, 1, T) blocks satisfies that for any block_q.
-            pl.BlockSpec((1, 1, t), lambda bh_, i: (bh_, 0, 0)),
-            pl.BlockSpec((1, 1, t), lambda bh_, i: (bh_, 0, 0)),
+            # (1, 1, T) blocks satisfies that for any block_q.  The m/l
+            # rows double as the online-softmax running state across the
+            # key-block grid dimension (the block is revisited, so it
+            # stays resident in VMEM).
+            pl.BlockSpec((1, 1, t), lambda bh_, i, j: (bh_, 0, 0)),
+            pl.BlockSpec((1, 1, t), lambda bh_, i, j: (bh_, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
             jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
             jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
         ],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(qf, kf, vf)
     return _unfold(o, b, h), (qf, kf, vf, o, m, l, b, h)
@@ -286,49 +315,59 @@ def _bwd(causal, scale, block_q, block_k, interpret, res, do):
     qf, kf, vf, of, m, l, b, h = res
     bh, t, d = qf.shape
     dof = _fold(do)
+    num_k = t // block_k
+    num_q = t // block_q
     kernel_dq = functools.partial(_bwd_dq_kernel, block_q=block_q,
-                                  block_k=block_k, seq_len=t,
+                                  block_k=block_k, num_k=num_k,
                                   causal=causal, scale=scale)
+    kv_map = (_causal_kv_map(block_q, block_k) if causal
+              else (lambda bh_, i, j: (bh_, j, 0)))
     dq = pl.pallas_call(
         kernel_dq,
-        grid=(bh, t // block_q),
+        grid=(bh, num_q, num_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh_, i: (bh_, i, 0)),
-            pl.BlockSpec((1, t, d), lambda bh_, i: (bh_, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda bh_, i: (bh_, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda bh_, i: (bh_, i, 0)),
-            pl.BlockSpec((1, block_q, d), lambda bh_, i: (bh_, i, 0)),
-            pl.BlockSpec((1, 1, t), lambda bh_, i: (bh_, 0, 0)),
-            pl.BlockSpec((1, 1, t), lambda bh_, i: (bh_, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh_, i, j: (bh_, i, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_q, d), lambda bh_, i, j: (bh_, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh_, i, j: (bh_, i, 0)),
+            pl.BlockSpec((1, 1, t), lambda bh_, i, j: (bh_, 0, 0)),
+            pl.BlockSpec((1, 1, t), lambda bh_, i, j: (bh_, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh_, i: (bh_, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh_, i, j: (bh_, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), qf.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(qf, kf, vf, of, dof, m, l)
 
     kernel_dkv = functools.partial(_bwd_dkv_kernel, block_q=block_q,
-                                   block_k=block_k, seq_len=t,
+                                   block_k=block_k, num_q=num_q,
                                    causal=causal, scale=scale)
+    q_map = (_causal_q_map(block_q, block_k) if causal
+             else (lambda bh_, j, i: (bh_, i, 0)))
     dk, dv = pl.pallas_call(
         kernel_dkv,
-        grid=(bh, t // block_k),
+        grid=(bh, num_k, num_q),
         in_specs=[
-            pl.BlockSpec((1, t, d), lambda bh_, j: (bh_, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh_, j: (bh_, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh_, j: (bh_, j, 0)),
-            pl.BlockSpec((1, t, d), lambda bh_, j: (bh_, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda bh_, j: (bh_, 0, 0)),
-            pl.BlockSpec((1, 1, t), lambda bh_, j: (bh_, 0, 0)),
-            pl.BlockSpec((1, 1, t), lambda bh_, j: (bh_, 0, 0)),
+            pl.BlockSpec((1, block_q, d), q_map),
+            pl.BlockSpec((1, block_k, d), lambda bh_, j, i: (bh_, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, j, i: (bh_, j, 0)),
+            pl.BlockSpec((1, block_q, d), q_map),
+            pl.BlockSpec((1, block_q, d), q_map),
+            pl.BlockSpec((1, 1, t), lambda bh_, j, i: (bh_, 0, 0)),
+            pl.BlockSpec((1, 1, t), lambda bh_, j, i: (bh_, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda bh_, j: (bh_, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh_, j: (bh_, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, j, i: (bh_, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, j, i: (bh_, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), qf.dtype),
             jax.ShapeDtypeStruct((bh, t, d), qf.dtype),
         ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
     )(qf, kf, vf, of, dof, m, l)
     return _unfold(dq, b, h), _unfold(dk, b, h), _unfold(dv, b, h)
