@@ -38,6 +38,7 @@ def main():
         num_iters=int(os.environ.get("BENCH_ITERS", "5")),
         per_step_dispatch=os.environ.get("BENCH_PER_STEP_DISPATCH",
                                          "0") == "1",
+        input_dtype=os.environ.get("BENCH_INPUT_DTYPE", "float32"),
         verbose=os.environ.get("BENCH_VERBOSE", "0") == "1",
     )
     value = res["img_sec_per_chip"]

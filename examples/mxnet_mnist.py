@@ -1,0 +1,104 @@
+"""MXNet MNIST end-to-end over the eager plane (reference
+``examples/mxnet_mnist.py``).
+
+The Horovod MXNet recipe: ``hvd.init()`` → rank-partitioned data →
+gluon net → ``DistributedTrainer`` (gradient allreduce in ``step``) →
+``broadcast_parameters`` from rank 0 → metrics averaged across ranks.
+Hermetic synthetic MNIST (no downloads).
+
+Run: ``hvdrun -np 2 python examples/mxnet_mnist.py --epochs 2``
+(requires mxnet, which is optional in this image).
+"""
+
+import argparse
+
+import numpy as np
+
+try:
+    import mxnet as mx
+    from mxnet import autograd, gluon
+except ImportError:  # pragma: no cover - mxnet optional
+    raise SystemExit("mxnet is not installed; this example requires it")
+
+import horovod_tpu.mxnet as hvd
+
+
+def synthetic_mnist(n, seed=0):
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, n).astype(np.float32)
+    images = rng.normal(0.0, 0.1, (n, 1, 28, 28)).astype(np.float32)
+    for i, d in enumerate(labels.astype(np.int64)):
+        r, c = 4 + (d % 5) * 4, 4 + (d // 5) * 10
+        images[i, 0, r:r + 6, c:c + 6] += 1.0
+    return images, labels
+
+
+def build_net():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(10, kernel_size=5, activation="relu"),
+            gluon.nn.MaxPool2D(pool_size=2),
+            gluon.nn.Conv2D(20, kernel_size=5, activation="relu"),
+            gluon.nn.MaxPool2D(pool_size=2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(50, activation="relu"),
+            gluon.nn.Dense(10))
+    return net
+
+
+def main():
+    parser = argparse.ArgumentParser(description="MXNet MNIST example")
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--train-size", type=int, default=4096)
+    parser.add_argument("--test-size", type=int, default=1024)
+    args = parser.parse_args()
+
+    hvd.init()
+    mx.np.random.seed(42)
+    ctx = mx.cpu()
+
+    images, labels = synthetic_mnist(args.train_size)
+    images = images[hvd.rank()::hvd.size()]
+    labels = labels[hvd.rank()::hvd.size()]
+    test_images, test_labels = synthetic_mnist(args.test_size, seed=1)
+    test_images = test_images[hvd.rank()::hvd.size()]
+    test_labels = test_labels[hvd.rank()::hvd.size()]
+
+    net = build_net()
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    net(mx.nd.zeros((1, 1, 28, 28), ctx=ctx))  # materialize params
+
+    params = net.collect_params()
+    hvd.broadcast_parameters(params, root_rank=0)
+    trainer = hvd.DistributedTrainer(
+        params, "sgd", {"learning_rate": args.lr * hvd.size()})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    n_local = images.shape[0]
+    for epoch in range(args.epochs):
+        order = np.random.default_rng(epoch).permutation(n_local)
+        for i in range(0, n_local - args.batch_size + 1, args.batch_size):
+            idx = order[i:i + args.batch_size]
+            x = mx.nd.array(images[idx], ctx=ctx)
+            y = mx.nd.array(labels[idx], ctx=ctx)
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(args.batch_size)
+
+        out = net(mx.nd.array(test_images, ctx=ctx))
+        pred = out.argmax(axis=1).asnumpy()
+        acc = float((pred == test_labels).mean())
+        acc = float(hvd.allreduce(mx.nd.array([acc]),
+                                  name=f"acc.{epoch}").asscalar())
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: accuracy {acc * 100:.1f}%", flush=True)
+
+    if hvd.rank() == 0:
+        assert acc > 0.5, f"model failed to learn: {acc}"
+        print("OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

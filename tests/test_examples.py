@@ -91,3 +91,51 @@ def test_jax_synthetic_benchmark_json():
     out = json.loads(res.stdout.strip().splitlines()[-1])
     assert out["n_chips"] == 4
     assert out["img_sec_total"] > 0
+
+
+def test_pytorch_mnist_two_ranks():
+    """Full torch MNIST recipe under the launcher (reference
+    examples/pytorch_mnist.py run by CI under horovodrun)."""
+    pytest.importorskip("torch")
+    res = _run_example("pytorch_mnist.py",
+                       ["--epochs", "3", "--batch-size", "64", "--lr",
+                        "0.1", "--train-size", "2048", "--test-size",
+                        "512"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "OK" in res.stdout
+    assert "accuracy" in res.stdout
+
+
+def test_mxnet_mnist_two_ranks():
+    pytest.importorskip("mxnet")
+    res = _run_example("mxnet_mnist.py",
+                       ["--epochs", "2", "--train-size", "1024",
+                        "--test-size", "512"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "OK" in res.stdout
+
+
+def test_jax_imagenet_resnet50_resume(tmp_path):
+    """The ImageNet recipe trains, checkpoints, and resumes (reference
+    keras_imagenet_resnet50.py's resume-from-checkpoint contract)."""
+    ck = str(tmp_path / "ck")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    args = [sys.executable,
+            os.path.join(EXAMPLES, "jax_imagenet_resnet50.py"),
+            "--epochs", "2", "--steps-per-epoch", "2", "--batch-size", "2",
+            "--image-size", "32", "--num-classes", "8", "--warmup-epochs",
+            "1", "--checkpoint-dir", ck]
+    res = subprocess.run(args, capture_output=True, text=True, timeout=420,
+                         env=env, cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "epoch 1" in res.stdout
+    # Second run resumes past the checkpointed epochs and trains 2 more.
+    args[args.index("--epochs") + 1] = "4"
+    res = subprocess.run(args, capture_output=True, text=True, timeout=420,
+                         env=env, cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "resumed from epoch 1" in res.stdout
+    assert "epoch 3" in res.stdout
