@@ -87,10 +87,13 @@ def main():
     def set_lr(lr):
         lr_box["lr"] = lr
 
-    size = hvd.size() if hvd.size() > 1 else n_chips
+    # The global batch scales with the MESH (all chips across all
+    # processes), so the linear-scaling rule and the warmup target both
+    # use n_chips — not the process count.
+    size = n_chips
     warmup = LearningRateWarmupCallback(
         args.base_lr, warmup_epochs=args.warmup_epochs, set_lr=set_lr,
-        steps_per_epoch=args.steps_per_epoch)
+        steps_per_epoch=args.steps_per_epoch, size=size)
 
     def decay_mult(epoch):
         m = size

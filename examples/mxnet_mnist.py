@@ -55,7 +55,7 @@ def main():
     args = parser.parse_args()
 
     hvd.init()
-    mx.np.random.seed(42)
+    mx.random.seed(42)
     ctx = mx.cpu()
 
     images, labels = synthetic_mnist(args.train_size)

@@ -142,10 +142,15 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
                  momentum_correction: bool = True,
                  steps_per_epoch: Optional[int] = None,
                  set_lr: Optional[Callable[[float], None]] = None,
-                 verbose: bool = False):
+                 verbose: bool = False, size: Optional[int] = None):
         self.warmup_epochs = warmup_epochs
         self.verbose = verbose
-        size = hvd.size() if hvd.is_initialized() else 1
+        # ``size`` is the factor the global batch grew by.  Default is the
+        # process count (the reference's world), but a single-process SPMD
+        # job scales its batch by the MESH size — pass size=mesh_size(mesh)
+        # there, or the warmup target won't match the linear-scaling rule.
+        if size is None:
+            size = hvd.size() if hvd.is_initialized() else 1
 
         def multiplier(epoch):
             if warmup_epochs <= 0:
