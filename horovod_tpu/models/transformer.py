@@ -203,18 +203,15 @@ def make_train_step(cfg: TransformerConfig, optimizer, mesh,
                                             updates)
         return new_params, new_opt, lax.pmean(loss, grad_axes)
 
-    # opt_state leaves mirror param shapes; map shape -> spec (identical
-    # shapes always carry identical specs in this scheme).
-    shape_to_spec = {}
-    jax.tree_util.tree_map(
-        lambda p, s: shape_to_spec.setdefault(tuple(p.shape), s),
-        init_abstract(cfg), specs)
-
-    def opt_spec_of(leaf):
-        return shape_to_spec.get(tuple(leaf.shape), P())
-
+    # Param-like opt-state leaves (momenta etc.) inherit the matching
+    # param's spec; everything else (step counters, empty states) is
+    # replicated.  tree_map_params aligns by optimizer structure, so
+    # distinct params that happen to share a shape cannot be confused.
+    import optax
     opt_state_shapes = jax.eval_shape(optimizer.init, init_abstract(cfg))
-    opt_specs = jax.tree_util.tree_map(opt_spec_of, opt_state_shapes)
+    opt_specs = optax.tree_map_params(
+        optimizer, lambda _leaf, spec: spec, opt_state_shapes, specs,
+        transform_non_params=lambda _leaf: P())
 
     data_spec = P(data_axis, seq_axis) if seq_axis else P(data_axis)
     step = jax.shard_map(

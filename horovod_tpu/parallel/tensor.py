@@ -65,3 +65,65 @@ def shard_dim(shape, axis_size: int, dim: int):
     out = list(shape)
     out[dim] //= axis_size
     return tuple(out)
+
+
+def clip_by_global_norm(max_norm: float, specs, mesh_axes=("model",)):
+    """Sharding-aware global-norm gradient clipping (optax transform).
+
+    ``optax.clip_by_global_norm`` inside a TP ``shard_map`` computes the
+    norm of the LOCAL weight shards — a value that varies over the model
+    axis, silently desynchronizing replicas (and tripping vma checks).
+    This variant consults each leaf's ``PartitionSpec``: leaves sharded
+    over any axis in ``mesh_axes`` contribute ``psum`` of their local
+    square-sums (shards are disjoint), replicated leaves contribute once.
+    The result is the true global norm, invariant over the mesh, so every
+    shard scales identically.
+
+    Use inside shard_map-jitted steps (the axes must be bound); pair with
+    the same ``specs`` tree passed to the step's ``in_specs``.
+    No reference equivalent (Horovod is DP-only; its torch binding defers
+    clipping to the user after ``synchronize()``, reference
+    ``test_torch.py:1266``).
+    """
+    import optax
+
+    def spec_axes(spec):
+        if spec is None:
+            return ()
+        out = []
+        for entry in spec:
+            if entry is None:
+                continue
+            for ax in (entry if isinstance(entry, tuple) else (entry,)):
+                if ax in mesh_axes:
+                    out.append(ax)
+        return tuple(out)
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        del params
+        leaves, treedef = jax.tree_util.tree_flatten(updates)
+        spec_leaves = treedef.flatten_up_to(specs)
+        # Accumulate local square-sums per axes-group, then ONE psum per
+        # group (not one per leaf): a deep TP model has many sharded
+        # leaves and per-leaf scalar collectives would dominate.
+        by_axes = {}
+        for g, spec in zip(leaves, spec_leaves):
+            sq = jnp.sum(jnp.square(g.astype(jnp.float32)))
+            axes = spec_axes(spec)
+            by_axes[axes] = by_axes.get(axes, jnp.float32(0.0)) + sq
+        total = jnp.float32(0.0)
+        for axes, sq in by_axes.items():
+            total = total + (lax.psum(sq, axes) if axes else sq)
+        gnorm = jnp.sqrt(total)
+        scale = jnp.minimum(1.0, max_norm / (gnorm + 1e-16)).astype(
+            jnp.float32)
+        clipped = jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype),
+            updates)
+        return clipped, state
+
+    return optax.GradientTransformation(init_fn, update_fn)

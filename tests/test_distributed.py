@@ -22,7 +22,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def _hvdrun(args, script=None, np_=2, timeout=180, env=None, tmp_path=None):
     full_env = dict(os.environ)
     full_env["JAX_PLATFORMS"] = "cpu"
-    full_env["PYTHONPATH"] = REPO + os.pathsep + full_env.get("PYTHONPATH", "")
+    full_env["PYTHONPATH"] = REPO  # not inherited: axon sitecustomize would seize the TPU
     full_env.pop("XLA_FLAGS", None)  # subprocesses don't need 8 fake devices
     if env:
         full_env.update(env)
@@ -157,7 +157,7 @@ def _rank_env(rank, size, port, key):
     env = dict(os.environ)
     env.update({
         "JAX_PLATFORMS": "cpu",
-        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        "PYTHONPATH": REPO,  # not inherited: axon sitecustomize would seize the TPU
         "HOROVOD_RANK": str(rank),
         "HOROVOD_SIZE": str(size),
         "HOROVOD_LOCAL_RANK": str(rank),

@@ -13,11 +13,24 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLES = os.path.join(REPO, "examples")
 
 
-def _run_example(script, args, np_=2, timeout=420, extra_env=None):
+def _example_env(xla_devices=None):
+    """Hermetic child env: CPU platform, PYTHONPATH exactly REPO
+    (inheriting the parent PYTHONPATH can pull in the image's axon
+    sitecustomize, which seizes the real TPU in the child regardless of
+    JAX_PLATFORMS=cpu), optional virtual device count."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO
+    if xla_devices is None:
+        env.pop("XLA_FLAGS", None)
+    else:
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={xla_devices}")
+    return env
+
+
+def _run_example(script, args, np_=2, timeout=420, extra_env=None):
+    env = _example_env()
     if extra_env:
         env.update(extra_env)
     cmd = [sys.executable, "-m", "horovod_tpu.runner", "-np", str(np_),
@@ -28,15 +41,12 @@ def _run_example(script, args, np_=2, timeout=420, extra_env=None):
 
 def test_jax_mnist_single_process(tmp_path):
     """BASELINE config #1: the 1-process allreduce baseline."""
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env.pop("XLA_FLAGS", None)
     res = subprocess.run(
         [sys.executable, os.path.join(EXAMPLES, "jax_mnist.py"),
          "--steps", "80", "--batch-size", "32",
          "--checkpoint-dir", str(tmp_path / "ck")],
-        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+        capture_output=True, text=True, timeout=420, env=_example_env(),
+        cwd=REPO)
     assert res.returncode == 0, res.stdout + res.stderr
     assert "train accuracy" in res.stdout
 
@@ -77,16 +87,13 @@ def test_keras_mnist(tmp_path):
 def test_jax_synthetic_benchmark_json():
     """The flagship bench CLI emits a parseable result."""
     import json
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     res = subprocess.run(
         [sys.executable, os.path.join(EXAMPLES, "jax_synthetic_benchmark.py"),
          "--model", "resnet18", "--batch-size", "2", "--image-size", "32",
          "--num-warmup-batches", "1", "--num-batches-per-iter", "2",
          "--num-iters", "2", "--json"],
-        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+        capture_output=True, text=True, timeout=420,
+        env=_example_env(xla_devices=4), cwd=REPO)
     assert res.returncode == 0, res.stdout + res.stderr
     out = json.loads(res.stdout.strip().splitlines()[-1])
     assert out["n_chips"] == 4
@@ -119,10 +126,7 @@ def test_jax_imagenet_resnet50_resume(tmp_path):
     """The ImageNet recipe trains, checkpoints, and resumes (reference
     keras_imagenet_resnet50.py's resume-from-checkpoint contract)."""
     ck = str(tmp_path / "ck")
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env = _example_env(xla_devices=4)
     args = [sys.executable,
             os.path.join(EXAMPLES, "jax_imagenet_resnet50.py"),
             "--epochs", "2", "--steps-per-epoch", "2", "--batch-size", "2",
@@ -139,3 +143,15 @@ def test_jax_imagenet_resnet50_resume(tmp_path):
     assert res.returncode == 0, res.stdout + res.stderr
     assert "resumed from epoch 1" in res.stdout
     assert "epoch 3" in res.stdout
+
+
+def test_jax_lm_pretrain_dp_tp_sp():
+    """The LM pretraining flagship: 2x2x2 DPxTPxSP mesh, loss decreases."""
+    res = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, "jax_lm_pretrain.py"),
+         "--dp", "2", "--tp", "2", "--sp", "2", "--steps", "20",
+         "--batch-size", "4", "--seq-len", "128", "--n-layers", "1"],
+        capture_output=True, text=True, timeout=420,
+        env=_example_env(xla_devices=8), cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "OK" in res.stdout
