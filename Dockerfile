@@ -1,0 +1,25 @@
+# Test/deploy image (reference Dockerfile + Dockerfile.test.cpu: one image
+# that builds the native runtime and can run the full suite).  The compute
+# path is JAX; swap the pip line for the matching jax[tpu] wheel on real
+# TPU hosts.
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make openssh-client && \
+    rm -rf /var/lib/apt/lists/*
+
+WORKDIR /horovod_tpu
+COPY . .
+
+# CPU jax by default (CI); on TPU hosts use: pip install 'jax[tpu]' \
+#   -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+RUN pip install --no-cache-dir jax flax optax orbax-checkpoint chex \
+        einops numpy pytest pyyaml && \
+    pip install --no-cache-dir -e .
+
+# Native runtime is built by the install hook; fail the image build if the
+# library is missing rather than at first use.
+RUN python -m horovod_tpu.native.build && \
+    python -m horovod_tpu.runner --check-build
+
+CMD ["bash", "ci/run_tests.sh"]

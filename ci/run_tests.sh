@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# CI entry point (reference .buildkite/gen-pipeline.sh: build, then run the
+# pytest suites and the example scripts under the launcher).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "--- build native runtime"
+python -m horovod_tpu.native.build
+
+echo "--- capability report"
+python -m horovod_tpu.runner --check-build
+
+echo "--- unit + SPMD suites (8-device virtual CPU mesh via conftest)"
+python -m pytest tests/ -x -q
+
+echo "--- distributed op matrix under the launcher (the reference's
+--- 'pytest under horovodrun' trick, gen-pipeline.sh:120-190)"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  python -m horovod_tpu.runner -np 2 \
+  python -m pytest tests/distributed -x -q
+
+echo "CI OK"
