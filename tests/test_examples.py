@@ -155,3 +155,25 @@ def test_jax_lm_pretrain_dp_tp_sp():
         env=_example_env(xla_devices=8), cwd=REPO)
     assert res.returncode == 0, res.stdout + res.stderr
     assert "OK" in res.stdout
+
+
+def test_jax_word2vec():
+    """Embedding-family example (reference tensorflow_word2vec.py): topic
+    similarity margin must grow."""
+    res = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, "jax_word2vec.py")],
+        capture_output=True, text=True, timeout=420,
+        env=_example_env(xla_devices=8), cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "OK" in res.stdout
+
+
+def test_jax_moe():
+    """Expert-parallel Switch-MoE example: 2 data x 4 experts, learns."""
+    res = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, "jax_moe.py"),
+         "--steps", "100"],
+        capture_output=True, text=True, timeout=420,
+        env=_example_env(xla_devices=8), cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "OK" in res.stdout
