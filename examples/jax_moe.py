@@ -104,10 +104,15 @@ def main():
         (loss, acc), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params, x, labels)
         # The batch is sharded over BOTH axes (the expert axis doubles as
-        # data parallelism for the non-expert params), so replicated
-        # params average over both; expert-sharded weights average over
-        # 'data' only (their shards are distinct params).
-        grads = {k: lax.pmean(g, "data") if specs[k] != P()
+        # data parallelism for the non-expert params).  Consistent target:
+        # gradients of the GLOBAL mean loss (1/(DP*E) * sum of per-chip
+        # means).  Replicated params: pmean over both axes.  Expert shards:
+        # the all_to_all backward already SUMS the E chips of a data row
+        # into the shard, so pmean over 'data' alone leaves an extra
+        # factor of E — divide it out or SGD-style optimizers see an
+        # E-times larger effective LR on expert weights.
+        e_sz = lax.axis_size("expert")
+        grads = {k: lax.pmean(g, "data") / e_sz if specs[k] != P()
                  else lax.pmean(g, ("data", "expert"))
                  for k, g in grads.items()}
         updates, opt_state = optimizer.update(grads, opt_state, params)
