@@ -14,6 +14,7 @@ the reference exactly — a Horovod-torch user changes only the import.
 
 from __future__ import annotations
 
+import contextlib
 import io
 from typing import Optional
 
@@ -316,8 +317,39 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             with torch.no_grad():
                 p.grad.copy_(self._compression.decompress(wire, ctx))
         self._handles.clear()
+        self._synchronized = True
+
+    @contextlib.contextmanager
+    def skip_synchronize(self):
+        """Context manager for the explicit-synchronize recipe (reference
+        torch/__init__.py: gradient clipping interplay, test_torch.py:1266):
+
+            optimizer.synchronize()
+            torch.nn.utils.clip_grad_norm_(model.parameters(), 1.0)
+            with optimizer.skip_synchronize():
+                optimizer.step()
+
+        Without it, ``step()`` would fire a second (numerically idempotent
+        but wasteful) force-allreduce pass over the already-averaged grads.
+        """
+        self._should_skip_synchronize = True
+        try:
+            yield
+        finally:
+            self._should_skip_synchronize = False
 
     def step(self, closure=None):
+        if getattr(self, "_should_skip_synchronize", False):
+            # Both guards matter: _synchronized proves synchronize() ran
+            # since the last step, and empty _handles proves no backward
+            # enqueued new allreduces after it.
+            if not getattr(self, "_synchronized", False) or self._handles:
+                raise AssertionError(
+                    "optimizer.step() inside skip_synchronize() requires a "
+                    "prior optimizer.synchronize() call (with no backward "
+                    "pass in between)")
+            self._synchronized = False
+            return super(self.__class__, self).step(closure)
         if basics.size() > 1:
             # Any parameter whose hook never fired (e.g. frozen this step
             # but updated before) still needs a matching allreduce on all
@@ -330,6 +362,9 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                             p.grad is not None):
                         self._handles[id(p)] = self._allreduce_grad_async(p)
             self.synchronize()
+        # A normal step consumes the synchronized state — skip_synchronize
+        # on the NEXT step requires its own explicit synchronize() call.
+        self._synchronized = False
         return super(self.__class__, self).step(closure)
 
     def zero_grad(self, set_to_none: bool = True):

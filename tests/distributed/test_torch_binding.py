@@ -170,3 +170,138 @@ def test_torch_alltoall_uneven_splits(thvd, rank, size):
     assert out.shape == ((rank + 1) * size, 2)
     assert not torch.isnan(out).any()
     assert (out[:rank + 1] == 0).all()  # block from rank 0
+
+
+def test_duplicate_inflight_name_error(thvd, rank, size):
+    """Two concurrently in-flight tensors with one name must fail loudly
+    (reference test_torch.py:390 duplicate-name error)."""
+    if size < 2:
+        pytest.skip("needs >= 2 ranks")
+    # Large payload so h1 is provably still in flight when h2 submits
+    # (a tiny tensor can complete in the submit gap on loopback).
+    h1 = thvd.allreduce_async(torch.ones(1 << 21), name="tt.dup")
+    with pytest.raises(Exception, match="same name"):
+        h2 = thvd.allreduce_async(torch.ones(1 << 21), name="tt.dup")
+        thvd.synchronize(h2)
+    thvd.synchronize(h1)
+
+
+def test_backward_passes_per_step(thvd, rank, size):
+    """Gradient accumulation: the allreduce fires on the Nth backward
+    (reference test_torch.py optimizer accumulation tests)."""
+    if size < 2:
+        pytest.skip("hooks only active multi-process")
+    torch.manual_seed(0)
+    model = torch.nn.Linear(3, 1)
+    opt = thvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.05),
+        named_parameters=model.named_parameters(),
+        backward_passes_per_step=2)
+    for _ in range(2):   # two accumulation micro-batches
+        model(torch.ones(2, 3) * (rank + 1)).sum().backward()
+    opt.step()
+    gathered = thvd.allgather(model.weight.data.reshape(1, -1),
+                              name="tt.bpps.w")
+    for r in range(size):
+        assert torch.allclose(gathered[0], gathered[r], atol=1e-6)
+    opt.zero_grad()
+
+
+def test_gradient_clipping_interplay(thvd, rank, size):
+    """synchronize -> clip -> step under skip_synchronize (reference
+    test_torch.py:1266)."""
+    if size < 2:
+        pytest.skip("hooks only active multi-process")
+    torch.manual_seed(0)
+    model = torch.nn.Linear(3, 1)
+    opt = thvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters())
+    (model(torch.ones(2, 3) * (rank + 1) * 100).sum()).backward()
+    opt.synchronize()
+    torch.nn.utils.clip_grad_norm_(model.parameters(), 1.0)
+    total = torch.sqrt(sum((p.grad ** 2).sum()
+                           for p in model.parameters()))
+    assert total <= 1.0 + 1e-5
+    with opt.skip_synchronize():
+        opt.step()
+    gathered = thvd.allgather(model.weight.data.reshape(1, -1),
+                              name="tt.clip.w")
+    for r in range(size):
+        assert torch.allclose(gathered[0], gathered[r], atol=1e-6)
+    opt.zero_grad()
+
+
+def test_model_parallelism_disjoint_names(thvd, rank, size):
+    """Different ranks may allreduce disjoint tensor sets under distinct
+    names concurrently (reference test_torch.py:1158)."""
+    if size < 2:
+        pytest.skip("needs >= 2 ranks")
+    # A tensor every rank reduces, plus one only this rank's "model part"
+    # owns — named per rank, so each is a size-1-rank... no: all ranks
+    # must participate per name; emulate the reference: every rank
+    # submits both names but in rank-dependent ORDER (the coordinator
+    # tolerates unordered submission).
+    names = [f"tt.mp.{i}" for i in range(size)]
+    order = names[rank:] + names[:rank]
+    handles = [thvd.allreduce_async(torch.ones(8) * (rank + 1),
+                                    name=n) for n in order]
+    for h in handles:
+        out = thvd.synchronize(h)
+        assert torch.allclose(out, torch.full(
+            (8,), (size + 1) / 2))
+
+
+def test_dynamic_requires_grad(thvd, rank, size):
+    """Freezing/unfreezing a param between steps must not deadlock
+    (reference test_torch.py:1216): step() force-allreduces params whose
+    hook did not fire."""
+    if size < 2:
+        pytest.skip("hooks only active multi-process")
+    torch.manual_seed(0)
+    model = torch.nn.Linear(3, 1)
+    opt = thvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.05),
+        named_parameters=model.named_parameters())
+    # Step 1: normal.
+    model(torch.ones(2, 3) * (rank + 1)).sum().backward()
+    opt.step()
+    opt.zero_grad()
+    # Step 2: freeze bias -> its hook never fires.  Give it a zero grad
+    # on every rank so step()'s force-allreduce branch (the
+    # deadlock-prevention behavior under test) actually has a tensor to
+    # reduce — with grad None the branch is skipped entirely.
+    model.bias.requires_grad_(False)
+    model(torch.ones(2, 3) * (rank + 1)).sum().backward()
+    model.bias.grad = torch.zeros_like(model.bias)
+    opt.step()
+    opt.zero_grad()
+    model.bias.requires_grad_(True)
+    gathered = thvd.allgather(model.weight.data.reshape(1, -1),
+                              name="tt.dyn.w")
+    for r in range(size):
+        assert torch.allclose(gathered[0], gathered[r], atol=1e-6)
+
+
+def test_skip_synchronize_requires_fresh_synchronize(thvd, rank, size):
+    """A normal step() must consume the synchronized state: step ->
+    backward -> skip_synchronize(step) without synchronize() raises
+    instead of stepping on un-allreduced gradients."""
+    if size < 2:
+        pytest.skip("hooks only active multi-process")
+    torch.manual_seed(0)
+    model = torch.nn.Linear(2, 1)
+    opt = thvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters())
+    model(torch.ones(1, 2)).sum().backward()
+    opt.step()               # normal step (synchronizes internally)
+    opt.zero_grad()
+    model(torch.ones(1, 2)).sum().backward()
+    with pytest.raises(AssertionError, match="synchronize"):
+        with opt.skip_synchronize():
+            opt.step()
+    opt.synchronize()
+    with opt.skip_synchronize():
+        opt.step()           # now legal
+    opt.zero_grad()
