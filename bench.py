@@ -56,7 +56,45 @@ def main():
         out["tflops_per_chip"] = round(res["tflops_per_chip"], 2)
     if res.get("mfu") is not None:
         out["mfu"] = round(res["mfu"], 4)
+    eff = _efficiency_smoke()
+    if eff is not None:
+        out["scaling_efficiency_smoke_8dev_cpu"] = round(eff, 4)
     print(json.dumps(out))
+
+
+def _efficiency_smoke():
+    """Weak-scaling efficiency plumbing proof on an 8-device virtual CPU
+    mesh (BASELINE.md's second metric needs >1 chip; one real chip is
+    available, so the SMOKE number demonstrates the measurement path —
+    real efficiency needs a pod).  Subprocess so the CPU platform forcing
+    cannot disturb this process's TPU backend."""
+    import subprocess
+    if os.environ.get("BENCH_EFFICIENCY_SMOKE", "1") != "1":
+        return None
+    code = (
+        "import os\n"
+        "os.environ['XLA_FLAGS']='--xla_force_host_platform_device_count=8'\n"
+        "import jax; jax.config.update('jax_platforms','cpu')\n"
+        "import json\n"
+        "from horovod_tpu.benchmark import run_scaling_efficiency\n"
+        "r = run_scaling_efficiency('resnet18', batch_size=2,\n"
+        "    image_size=32, n_devices=8, num_warmup_batches=1,\n"
+        "    num_batches_per_iter=2, num_iters=2, verbose=False)\n"
+        "print(json.dumps(r['scaling_efficiency']))\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.abspath(__file__)) +
+                         os.pathsep + env.get("PYTHONPATH", ""))
+    try:
+        res = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, timeout=420)
+        if res.returncode != 0:
+            print(f"bench: efficiency smoke failed (rc={res.returncode}): "
+                  f"{res.stderr.strip()[-500:]}", file=sys.stderr)
+            return None
+        return float(res.stdout.strip().splitlines()[-1])
+    except Exception as e:
+        print(f"bench: efficiency smoke failed: {e}", file=sys.stderr)
+        return None
 
 
 if __name__ == "__main__":

@@ -76,3 +76,30 @@ def hierarchical_pytree_mean(tree, ici_axis: str, dcn_axis: str):
         out.append(red[off:off + n].reshape(l.shape))
         off += n
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def hierarchical_allgather(x, ici_axis: str, dcn_axis: str):
+    """Two-level dim-0 allgather (reference ``MPIHierarchicalAllgather``,
+    ``mpi_operations.cc:164-321``: node-local shared-memory gather + one
+    cross-node allgather per node leader).
+
+    Mesh form: gather over the fast ICI axis first, then exchange the
+    already-assembled slice blocks over DCN — each DCN link carries each
+    byte once (the reference's reason for the hierarchy: only one rank
+    per node touches the slow network).  Concatenation order is
+    (dcn, ici, local dim 0), matching a flat allgather over a mesh whose
+    ICI axis is minor.
+
+    Expressed as masked psums rather than ``lax.all_gather`` for the same
+    reason as :func:`hierarchical_allreduce`'s gather leg: psum output is
+    the one collective vma marks *unvarying*, so the result can flow out
+    of a ``check_vma=True`` shard_map through a replicated ``P()`` spec.
+    """
+    def gather(v, axis):
+        n = lax.axis_size(axis)
+        idx = lax.axis_index(axis)
+        buf = jnp.zeros((n,) + v.shape, v.dtype).at[idx].set(v)
+        out = lax.psum(buf, axis)
+        return out.reshape((n * v.shape[0],) + v.shape[1:])
+
+    return gather(gather(x, ici_axis), dcn_axis)

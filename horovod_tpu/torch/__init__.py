@@ -340,14 +340,19 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
     def step(self, closure=None):
         if getattr(self, "_should_skip_synchronize", False):
-            # Both guards matter: _synchronized proves synchronize() ran
-            # since the last step, and empty _handles proves no backward
-            # enqueued new allreduces after it.
-            if not getattr(self, "_synchronized", False) or self._handles:
+            # All three guards matter: _synchronized proves synchronize()
+            # ran since the last step; empty _handles proves no backward
+            # enqueued new allreduces after it; zero _passes proves no
+            # partial gradient accumulation is pending (with
+            # backward_passes_per_step > 1 a mid-accumulation backward
+            # fires no handle, so synchronize() would be a no-op and the
+            # step would apply raw un-averaged local gradients).
+            if (not getattr(self, "_synchronized", False) or self._handles
+                    or any(self._passes.values())):
                 raise AssertionError(
                     "optimizer.step() inside skip_synchronize() requires a "
                     "prior optimizer.synchronize() call (with no backward "
-                    "pass in between)")
+                    "pass or partial gradient accumulation in between)")
             self._synchronized = False
             return super(self.__class__, self).step(closure)
         if basics.size() > 1:

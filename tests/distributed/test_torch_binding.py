@@ -177,12 +177,13 @@ def test_duplicate_inflight_name_error(thvd, rank, size):
     (reference test_torch.py:390 duplicate-name error)."""
     if size < 2:
         pytest.skip("needs >= 2 ranks")
-    # Large payload so h1 is provably still in flight when h2 submits
-    # (a tiny tensor can complete in the submit gap on loopback).
+    # Large payload so h1 is still in flight when h2 submits (the check
+    # is local, at submit time).  Do NOT wait on h2: if the race ever
+    # resolved differently on one rank, waiting would deadlock the suite
+    # instead of failing the assertion.
     h1 = thvd.allreduce_async(torch.ones(1 << 21), name="tt.dup")
     with pytest.raises(Exception, match="same name"):
-        h2 = thvd.allreduce_async(torch.ones(1 << 21), name="tt.dup")
-        thvd.synchronize(h2)
+        thvd.allreduce_async(torch.ones(1 << 21), name="tt.dup")
     thvd.synchronize(h1)
 
 
@@ -237,11 +238,9 @@ def test_model_parallelism_disjoint_names(thvd, rank, size):
     names concurrently (reference test_torch.py:1158)."""
     if size < 2:
         pytest.skip("needs >= 2 ranks")
-    # A tensor every rank reduces, plus one only this rank's "model part"
-    # owns — named per rank, so each is a size-1-rank... no: all ranks
-    # must participate per name; emulate the reference: every rank
-    # submits both names but in rank-dependent ORDER (the coordinator
-    # tolerates unordered submission).
+    # Every rank submits every name, but in rank-dependent ORDER — the
+    # coordinator must tolerate unordered submission (the reference's
+    # model-parallelism test is exactly this property).
     names = [f"tt.mp.{i}" for i in range(size)]
     order = names[rank:] + names[:rank]
     handles = [thvd.allreduce_async(torch.ones(8) * (rank + 1),

@@ -436,3 +436,22 @@ def test_train_step_adam_tp(hvd):
         losses.append(float(np.asarray(loss)))
     assert np.isfinite(losses).all(), losses
     assert losses[-1] < losses[0], losses
+
+
+def test_hierarchical_allgather(hvd):
+    """Two-level allgather == flat allgather over the composed mesh
+    (reference MPIHierarchicalAllgather semantics)."""
+    mesh = _mesh(hvd, ("dcn", "ici"), (2, 4))
+    per = 3
+
+    def body(x):
+        from horovod_tpu.parallel.hierarchical import hierarchical_allgather
+        return hierarchical_allgather(x, "ici", "dcn")
+
+    x = jnp.arange(8 * per * 2, dtype=jnp.float32).reshape(8 * per, 2)
+    # check_vma=True is the point: the masked-psum gather form makes the
+    # output provably replicated, so it flows through P().
+    out = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=P(("dcn", "ici")),
+        out_specs=P(), check_vma=True))(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
