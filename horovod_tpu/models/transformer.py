@@ -102,6 +102,24 @@ def _rmsnorm(x, scale):
     return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
 
 
+def _mlp_block(x, layer, dt, model_axis):
+    """rmsnorm -> gelu MLP -> row-parallel psum -> residual (shared by the
+    training forward and the KV-cache decode so the two cannot drift)."""
+    h = _rmsnorm(x, layer["ln2_scale"])
+    hi = tp.region_input(h, model_axis) if model_axis else h
+    u = jax.nn.gelu(hi @ layer["w1"].astype(dt))
+    dn = u @ layer["w2"].astype(dt)
+    if model_axis:
+        dn = lax.psum(dn, model_axis)
+    return x + dn
+
+
+def _logits_head(x, params, dt):
+    """Final rmsnorm + tied-embedding projection (shared fwd/decode)."""
+    x = _rmsnorm(x, params["ln_f_scale"])
+    return (x @ params["embed"].T.astype(dt)).astype(jnp.float32)
+
+
 def forward(params, tokens, cfg: TransformerConfig,
             model_axis: Optional[str] = None,
             seq_axis: Optional[str] = None,
@@ -152,17 +170,9 @@ def forward(params, tokens, cfg: TransformerConfig,
         if model_axis:
             o = lax.psum(o, model_axis)
         x = x + o
-        # --- mlp block ---
-        h = _rmsnorm(x, layer["ln2_scale"])
-        hi = tp.region_input(h, model_axis) if model_axis else h
-        u = jax.nn.gelu(hi @ layer["w1"].astype(dt))
-        dn = u @ layer["w2"].astype(dt)
-        if model_axis:
-            dn = lax.psum(dn, model_axis)
-        x = x + dn
+        x = _mlp_block(x, layer, dt, model_axis)
 
-    x = _rmsnorm(x, params["ln_f_scale"])
-    return (x @ params["embed"].T.astype(dt)).astype(jnp.float32)
+    return _logits_head(x, params, dt)
 
 
 def loss_fn(params, tokens, labels, cfg: TransformerConfig,
@@ -227,3 +237,98 @@ def init_abstract(cfg: TransformerConfig):
     """ShapeDtypeStructs of the params (for spec derivation without
     materializing weights)."""
     return jax.eval_shape(lambda: init_params(jax.random.PRNGKey(0), cfg))
+
+
+# ---------------------------------------------------------------------------
+# Inference: KV-cache decode + greedy generation (reference docs/inference
+# topic; Horovod itself ships no inference machinery — this is the
+# TPU-idiomatic decode loop: static shapes, lax.scan, cache updates via
+# dynamic_update_slice so the whole generation compiles to one program).
+# ---------------------------------------------------------------------------
+
+def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int,
+                  model_axis_size: int = 1):
+    """Per-layer K/V caches of shape [B, max_len, H_local, head_dim]
+    (H_local = n_heads / model_axis_size under tensor parallelism)."""
+    h_local = cfg.n_heads // model_axis_size
+    z = lambda: jnp.zeros((batch, max_len, h_local, cfg.head_dim),
+                          cfg.dtype)
+    return [{"k": z(), "v": z()} for _ in range(cfg.n_layers)]
+
+
+def decode_step(params, token, cache, pos, cfg: TransformerConfig,
+                model_axis: Optional[str] = None):
+    """One-token decode.  token: [B] int32, pos: scalar int32 position.
+
+    Returns (logits [B, vocab] fp32, updated cache).  Attention runs over
+    the full static cache length with a position mask (TPU-friendly: no
+    dynamic shapes), so cost is O(max_len) per step.
+    """
+    dt = cfg.dtype
+    hd = cfg.head_dim
+    x = (params["embed"][token] +
+         lax.dynamic_slice_in_dim(params["pos"], pos, 1, axis=0)[0]
+         ).astype(dt)                                    # [B, D]
+    new_cache = []
+    for layer, c in zip(params["layers"], cache):
+        h = _rmsnorm(x, layer["ln1_scale"])
+        hi = tp.region_input(h, model_axis) if model_axis else h
+        q = (hi @ layer["wq"].astype(dt))
+        k = (hi @ layer["wk"].astype(dt))
+        v = (hi @ layer["wv"].astype(dt))
+        b, dh = q.shape
+        q, k, v = (z.reshape(b, dh // hd, hd) for z in (q, k, v))
+        ck = lax.dynamic_update_slice_in_dim(c["k"], k[:, None], pos,
+                                             axis=1)
+        cv = lax.dynamic_update_slice_in_dim(c["v"], v[:, None], pos,
+                                             axis=1)
+        new_cache.append({"k": ck, "v": cv})
+        s = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32),
+                       ck.astype(jnp.float32)) * (hd ** -0.5)
+        mask = jnp.arange(ck.shape[1]) <= pos              # [T]
+        s = jnp.where(mask[None, None, :], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bht,bthd->bhd", p,
+                       cv.astype(jnp.float32)).astype(dt)
+        o = o.reshape(b, dh) @ layer["wo"].astype(dt)
+        if model_axis:
+            o = lax.psum(o, model_axis)
+        x = x + o
+        x = _mlp_block(x, layer, dt, model_axis)
+    return _logits_head(x, params, dt), new_cache
+
+
+def generate(params, prompt, total_len: int, cfg: TransformerConfig,
+             model_axis: Optional[str] = None):
+    """Greedy decode to ``total_len`` tokens, teacher-forcing ``prompt``.
+
+    prompt: [B, P] int32 (P >= 1).  Returns [B, total_len] int32 whose
+    first P entries are the prompt.  One ``lax.scan`` — a single compiled
+    program regardless of length.
+    """
+    b, p_len = prompt.shape
+    if total_len > cfg.max_seq:
+        raise ValueError(
+            f"total_len={total_len} exceeds the positional table "
+            f"(max_seq={cfg.max_seq})")
+    if p_len > total_len:
+        raise ValueError(
+            f"prompt length {p_len} exceeds total_len={total_len}; the "
+            f"output must contain the whole prompt")
+    cache = init_kv_cache(
+        cfg, b, total_len,
+        lax.axis_size(model_axis) if model_axis else 1)
+
+    def body(carry, pos):
+        token, cache = carry
+        logits, cache = decode_step(params, token, cache, pos, cfg,
+                                    model_axis)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # Teacher-force while still inside the prompt.
+        nxt = jnp.where(pos + 1 < p_len, prompt[:, jnp.minimum(
+            pos + 1, p_len - 1)], nxt)
+        return (nxt, cache), nxt
+
+    (last, _), toks = lax.scan(body, (prompt[:, 0], cache),
+                               jnp.arange(total_len - 1))
+    return jnp.concatenate([prompt[:, :1], toks.T], axis=1)

@@ -455,3 +455,36 @@ def test_hierarchical_allgather(hvd):
         body, mesh=mesh, in_specs=P(("dcn", "ici")),
         out_specs=P(), check_vma=True))(x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+def test_transformer_decode_under_tp(hvd):
+    """KV-cache decode with 2-way tensor parallelism matches the
+    single-device decode oracle."""
+    import functools as ft
+
+    from horovod_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                d_ff=64, n_layers=1, max_seq=8,
+                                dtype=jnp.float32)
+    mesh = _mesh(hvd, ("model",), (2,))
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    tok = jnp.asarray([5, 9], jnp.int32)
+
+    cache0 = tfm.init_kv_cache(cfg, 2, 4)
+    oracle, _ = tfm.decode_step(params, tok, cache0, 0, cfg)
+
+    specs = tfm.param_specs(cfg, "model")
+    # GLOBAL-shaped cache; in_specs shards the head dim (the
+    # model_axis_size arg is for manually pre-sharded callers).
+    cache_tp = tfm.init_kv_cache(cfg, 2, 4)
+    cache_spec = [{"k": P(None, None, "model"),
+                   "v": P(None, None, "model")}
+                  for _ in range(cfg.n_layers)]
+    step = jax.jit(jax.shard_map(
+        ft.partial(tfm.decode_step, pos=0, cfg=cfg, model_axis="model"),
+        mesh=mesh, in_specs=(specs, P(), cache_spec),
+        out_specs=(P(), cache_spec), check_vma=False))
+    logits, _ = step(params, tok, cache_tp)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(oracle),
+                               rtol=2e-4, atol=2e-4)
