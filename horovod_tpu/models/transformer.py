@@ -114,6 +114,28 @@ def _mlp_block(x, layer, dt, model_axis):
     return x + dn
 
 
+def _qkv_proj(x, layer, dt, model_axis, head_dim):
+    """rmsnorm -> q/k/v projections -> head split (shared by forward,
+    decode_step and forward_pipelined so the projection math cannot
+    drift).  Returns q, k, v with a trailing [heads, head_dim] split."""
+    h = _rmsnorm(x, layer["ln1_scale"])
+    hi = tp.region_input(h, model_axis) if model_axis else h
+    q = hi @ layer["wq"].astype(dt)
+    k = hi @ layer["wk"].astype(dt)
+    v = hi @ layer["wv"].astype(dt)
+    dh = q.shape[-1]
+    split = q.shape[:-1] + (dh // head_dim, head_dim)
+    return q.reshape(split), k.reshape(split), v.reshape(split), dh
+
+
+def _attn_out(o_flat, x, layer, dt, model_axis):
+    """Output projection (row-parallel psum under TP) + residual."""
+    o = o_flat @ layer["wo"].astype(dt)
+    if model_axis:
+        o = lax.psum(o, model_axis)
+    return x + o
+
+
 def _logits_head(x, params, dt):
     """Final rmsnorm + tied-embedding projection (shared fwd/decode)."""
     x = _rmsnorm(x, params["ln_f_scale"])
@@ -139,14 +161,8 @@ def forward(params, tokens, cfg: TransformerConfig,
 
     for layer in params["layers"]:
         # --- attention block ---
-        h = _rmsnorm(x, layer["ln1_scale"])
-        hi = tp.region_input(h, model_axis) if model_axis else h
-        q = hi @ layer["wq"].astype(dt)
-        k = hi @ layer["wk"].astype(dt)
-        v = hi @ layer["wv"].astype(dt)
-        b, t, dh = q.shape
-        hd = cfg.head_dim
-        q, k, v = (z.reshape(b, t, dh // hd, hd) for z in (q, k, v))
+        q, k, v, dh = _qkv_proj(x, layer, dt, model_axis, cfg.head_dim)
+        b, t = q.shape[:2]
         if seq_axis is not None:
             if attention == "ring":
                 o = seq_mod.ring_attention(q, k, v, seq_axis, causal=True)
@@ -166,10 +182,7 @@ def forward(params, tokens, cfg: TransformerConfig,
             o = flash_attention(q, k, v, True)
         else:
             o = seq_mod.local_attention(q, k, v, causal=True)
-        o = o.reshape(b, t, dh) @ layer["wo"].astype(dt)
-        if model_axis:
-            o = lax.psum(o, model_axis)
-        x = x + o
+        x = _attn_out(o.reshape(b, t, dh), x, layer, dt, model_axis)
         x = _mlp_block(x, layer, dt, model_axis)
 
     return _logits_head(x, params, dt)
@@ -271,18 +284,17 @@ def decode_step(params, token, cache, pos, cfg: TransformerConfig,
          ).astype(dt)                                    # [B, D]
     new_cache = []
     for layer, c in zip(params["layers"], cache):
-        h = _rmsnorm(x, layer["ln1_scale"])
-        hi = tp.region_input(h, model_axis) if model_axis else h
-        q = (hi @ layer["wq"].astype(dt))
-        k = (hi @ layer["wk"].astype(dt))
-        v = (hi @ layer["wv"].astype(dt))
-        b, dh = q.shape
-        q, k, v = (z.reshape(b, dh // hd, hd) for z in (q, k, v))
+        q, k, v, dh = _qkv_proj(x, layer, dt, model_axis, hd)
+        b = q.shape[0]
         ck = lax.dynamic_update_slice_in_dim(c["k"], k[:, None], pos,
                                              axis=1)
         cv = lax.dynamic_update_slice_in_dim(c["v"], v[:, None], pos,
                                              axis=1)
         new_cache.append({"k": ck, "v": cv})
+        # Scores in fp32: a one-token decode is latency-bound, not
+        # MXU-bound, so the extra precision over local_attention's
+        # input-dtype scores is free (identical under fp32 configs,
+        # which is what the decode==forward oracle test runs).
         s = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32),
                        ck.astype(jnp.float32)) * (hd ** -0.5)
         mask = jnp.arange(ck.shape[1]) <= pos              # [T]
@@ -290,10 +302,7 @@ def decode_step(params, token, cache, pos, cfg: TransformerConfig,
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bht,bthd->bhd", p,
                        cv.astype(jnp.float32)).astype(dt)
-        o = o.reshape(b, dh) @ layer["wo"].astype(dt)
-        if model_axis:
-            o = lax.psum(o, model_axis)
-        x = x + o
+        x = _attn_out(o.reshape(b, dh), x, layer, dt, model_axis)
         x = _mlp_block(x, layer, dt, model_axis)
     return _logits_head(x, params, dt), new_cache
 
@@ -332,3 +341,85 @@ def generate(params, prompt, total_len: int, cfg: TransformerConfig,
     (last, _), toks = lax.scan(body, (prompt[:, 0], cache),
                                jnp.arange(total_len - 1))
     return jnp.concatenate([prompt[:, :1], toks.T], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Pipeline-parallel forward: the transformer over a 'pipe' mesh axis
+# (parallel/pipeline.py GPipe schedule; no reference equivalent).
+# ---------------------------------------------------------------------------
+
+def stack_layer_params(params, n_stages: int):
+    """Re-layout the per-layer param list for pipelining.
+
+    Returns a dict of leaves shaped [n_stages, layers_per_stage, ...] —
+    shard the leading dim over the pipe axis (device p holds stage p).
+    """
+    layers = params["layers"]
+    if len(layers) % n_stages:
+        raise ValueError(f"{len(layers)} layers not divisible into "
+                         f"{n_stages} stages")
+    from horovod_tpu.parallel.pipeline import stack_stage_params
+    lps = len(layers) // n_stages
+    return stack_stage_params(
+        [stack_stage_params(layers[s * lps:(s + 1) * lps])
+         for s in range(n_stages)])
+
+
+def stacked_layer_specs(pipe_axis: str):
+    """PartitionSpec for every stacked-layer leaf: stage dim over pipe."""
+    return P(pipe_axis)
+
+
+def forward_pipelined(params, stacked_layers, tokens,
+                      cfg: TransformerConfig, pipe_axis: str = "pipe",
+                      n_microbatches: int = 2):
+    """Forward pass with the layer stack pipelined over ``pipe_axis``.
+
+    ``params`` supplies embed/pos/ln_f (replicated); ``stacked_layers``
+    comes from :func:`stack_layer_params` with its stage dim sharded over
+    the pipe axis (inside shard_map each device sees a [1, lps, ...]
+    slice).  The batch is split into ``n_microbatches`` and flows through
+    :func:`horovod_tpu.parallel.pipeline.pipeline_apply`; embedding and
+    logits head are computed replicated (they are cheap relative to the
+    layer stack, which is where PP's memory win lives).  Attention is
+    local causal (compose PP with DP via a 2-D mesh; TP/SP composition
+    belongs on the model/seq axes of the non-pipelined forward).
+    """
+    from horovod_tpu.parallel.pipeline import pipeline_apply
+
+    dt = cfg.dtype
+    b, t = tokens.shape
+    if b % n_microbatches:
+        raise ValueError(f"batch {b} not divisible by "
+                         f"{n_microbatches} microbatches")
+    hd = cfg.head_dim
+    x = (params["embed"][tokens] +
+         params["pos"][None, :t]).astype(dt)              # [B, T, D]
+    mb = x.reshape(n_microbatches, b // n_microbatches, t, cfg.d_model)
+
+    def one_layer(x, lp):
+        q, k, v, dh = _qkv_proj(x, lp, dt, None, hd)
+        bb, tt = q.shape[:2]
+        o = seq_mod.local_attention(q, k, v, causal=True)
+        x = _attn_out(o.reshape(bb, tt, dh), x, lp, dt, None)
+        x = _mlp_block(x, lp, dt, None)
+        return x, None
+
+    def stage_fn(stage_params, act):
+        # stage_params leaves: [1, lps, ...] — this device's stage.  A
+        # local stage dim > 1 means n_stages exceeded the pipe axis size;
+        # silently running only slice 0 would drop layers, so refuse.
+        lead = {l.shape[0] for l in
+                jax.tree_util.tree_leaves(stage_params)}
+        if lead != {1}:
+            raise ValueError(
+                f"each device must hold exactly one stage; got local "
+                f"stage dims {sorted(lead)} — n_stages passed to "
+                f"stack_layer_params must equal the pipe axis size")
+        local = jax.tree_util.tree_map(lambda l: l[0], stage_params)
+        out, _ = lax.scan(one_layer, act, local)
+        return out
+
+    y = pipeline_apply(stage_fn, stacked_layers, mb, axis_name=pipe_axis)
+    x = y.reshape(b, t, cfg.d_model)
+    return _logits_head(x, params, dt)
