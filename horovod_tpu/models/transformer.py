@@ -188,14 +188,20 @@ def forward(params, tokens, cfg: TransformerConfig,
     return _logits_head(x, params, dt)
 
 
+def xent(logits, labels):
+    """Mean next-token cross-entropy (the one loss formula — shared by
+    the plain and pipelined training steps and the oracle tests)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
 def loss_fn(params, tokens, labels, cfg: TransformerConfig,
             model_axis=None, seq_axis=None, attention="ring"):
     """Mean next-token cross-entropy over the LOCAL shard (callers pmean
     over data/seq axes)."""
-    logits = forward(params, tokens, cfg, model_axis, seq_axis, attention)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    return xent(forward(params, tokens, cfg, model_axis, seq_axis,
+                        attention), labels)
 
 
 def make_train_step(cfg: TransformerConfig, optimizer, mesh,
@@ -423,3 +429,62 @@ def forward_pipelined(params, stacked_layers, tokens,
     y = pipeline_apply(stage_fn, stacked_layers, mb, axis_name=pipe_axis)
     x = y.reshape(b, t, cfg.d_model)
     return _logits_head(x, params, dt)
+
+
+def make_train_step_pipelined(cfg: TransformerConfig, optimizer, mesh,
+                              data_axis: Optional[str] = "data",
+                              pipe_axis: str = "pipe",
+                              n_microbatches: int = 2,
+                              donate: bool = True):
+    """Jitted DP x PP training step.
+
+    Differentiation happens OUTSIDE the shard_map (jit-of-shard_map):
+    JAX transposes the GPipe schedule (scan + ppermute) into the exact
+    backward pipeline, and GSPMD handles the data-axis gradient averaging
+    because the loss is a global-batch mean — verified exact against the
+    plain forward's gradients (tests/test_parallel.py).
+
+    Params layout: ``{"base": embed/pos/ln_f (replicated),
+    "stacked": stack_layer_params(...) (stage dim over pipe)}``.
+    Returns ``(step, param_shardings)`` where ``step(params, opt_state,
+    tokens, labels) -> (params, opt_state, loss)``.
+    """
+    from jax.sharding import NamedSharding
+
+    n_stages = mesh.shape[pipe_axis]
+    if cfg.n_layers % n_stages:
+        raise ValueError(f"{cfg.n_layers} layers not divisible over "
+                         f"{n_stages} pipe stages")
+    sspec_one = stacked_layer_specs(pipe_axis)
+    data_spec = P(data_axis) if data_axis else P()
+
+    def smapped(base, stacked, tokens):
+        bspec = {k: P() for k in base}
+        sspec = {k: sspec_one for k in stacked}
+        return jax.shard_map(
+            lambda b_, s_, t_: forward_pipelined(
+                dict(b_, layers=[]), s_, t_, cfg, pipe_axis,
+                n_microbatches),
+            mesh=mesh, in_specs=(bspec, sspec, data_spec),
+            out_specs=data_spec, check_vma=False)(base, stacked, tokens)
+
+    def _loss(params, tokens, labels):
+        return xent(smapped(params["base"], params["stacked"], tokens),
+                    labels)
+
+    def _step(params, opt_state, tokens, labels):
+        loss, grads = jax.value_and_grad(_loss)(params, tokens, labels)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params,
+                                        updates)
+        return params, opt_state, loss
+
+    def param_shardings(params):
+        return {
+            "base": {k: NamedSharding(mesh, P()) for k in params["base"]},
+            "stacked": {k: NamedSharding(mesh, sspec_one)
+                        for k in params["stacked"]},
+        }
+
+    step = jax.jit(_step, donate_argnums=(0, 1) if donate else ())
+    return step, param_shardings
