@@ -53,6 +53,10 @@ def main():
     p.add_argument("--dp", type=int, default=2)
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline stages (composes with --dp only)")
+    p.add_argument("--microbatches", type=int, default=2,
+                   help="GPipe microbatches per step (with --pp)")
     p.add_argument("--steps", type=int, default=50)
     p.add_argument("--batch-size", type=int, default=4,
                    help="global batch (sequences)")
@@ -64,16 +68,26 @@ def main():
     p.add_argument("--d-ff", type=int, default=512)
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--warmup-steps", type=int, default=10)
-    p.add_argument("--attention", default="ring",
-                   choices=["ring", "ulysses", "local", "flash"])
+    p.add_argument("--attention", default=None,
+                   choices=["ring", "ulysses", "local", "flash"],
+                   help="default: ring (local under --pp)")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--log-every", type=int, default=10)
     args = p.parse_args()
 
     hvd.init()
+    if args.pp > 1 and (args.tp > 1 or args.sp > 1):
+        raise SystemExit("--pp composes with --dp only; TP/SP ride the "
+                         "model/seq axes of the non-pipelined step")
+    if args.attention is None:
+        args.attention = "local" if args.pp > 1 else "ring"
+    elif args.pp > 1 and args.attention != "local":
+        raise SystemExit("--pp uses local attention inside each stage; "
+                         f"--attention {args.attention} is not available "
+                         "(never silently substitute algorithms)")
     axes, shape = [], []
     for name, n in (("data", args.dp), ("model", args.tp),
-                    ("seq", args.sp)):
+                    ("seq", args.sp), ("pipe", args.pp)):
         if n > 1:
             axes.append(name)
             shape.append(n)
@@ -90,28 +104,49 @@ def main():
         else jnp.bfloat16)
     params = tfm.init_params(jax.random.PRNGKey(0), cfg)
 
+    warmup = min(args.warmup_steps, args.steps - 1)
     schedule = optax.warmup_cosine_decay_schedule(
-        0.0, args.lr, args.warmup_steps, max(args.steps, 2))
-    # Sharding-aware clip: the plain optax clip would compute the norm of
-    # LOCAL weight shards inside the TP shard_map (wrong and
-    # model-axis-varying); this one psums sharded leaves' square-sums.
-    from horovod_tpu.parallel.tensor import clip_by_global_norm
-    optimizer = optax.chain(
-        clip_by_global_norm(1.0, tfm.param_specs(cfg, model_axis)),
-        optax.scale_by_adam(),
-        optax.scale_by_schedule(schedule),
-        optax.scale(-1.0))
-    opt_state = optimizer.init(params)
+        0.0, args.lr, warmup, max(args.steps, warmup + 1))
+    if args.pp > 1:
+        # Pipelined path differentiates OUTSIDE the shard_map, so grads
+        # are global arrays and the plain optax clip is correct.
+        optimizer = optax.chain(
+            optax.clip_by_global_norm(1.0),
+            optax.scale_by_adam(),
+            optax.scale_by_schedule(schedule),
+            optax.scale(-1.0))
+        params = tfm.split_pipeline_params(params, args.pp)
+        step_fn, shard_of = tfm.make_train_step_pipelined(
+            cfg, optimizer, mesh,
+            data_axis="data" if args.dp > 1 else None,
+            pipe_axis="pipe", n_microbatches=args.microbatches)
+        p_sh, opt_sh = shard_of(params)
+        params = {g: {k: jax.device_put(v, p_sh[g][k])
+                      for k, v in params[g].items()} for g in params}
+        opt_state = jax.device_put(optimizer.init(params), opt_sh)
+    else:
+        # Sharding-aware clip: the plain optax clip would compute the
+        # norm of LOCAL weight shards inside the TP shard_map (wrong and
+        # model-axis-varying); this one psums sharded square-sums.
+        from horovod_tpu.parallel.tensor import clip_by_global_norm
+        optimizer = optax.chain(
+            clip_by_global_norm(1.0, tfm.param_specs(cfg, model_axis)),
+            optax.scale_by_adam(),
+            optax.scale_by_schedule(schedule),
+            optax.scale(-1.0))
+        opt_state = optimizer.init(params)
 
-    step_fn, specs, opt_specs = tfm.make_train_step(
-        cfg, optimizer, mesh, data_axis="data", model_axis=model_axis,
-        seq_axis=seq_axis, attention=args.attention)
-    params = jax.device_put(
-        params, jax.tree_util.tree_map(
-            lambda s: NamedSharding(mesh, s), specs))
-    opt_state = jax.device_put(
-        opt_state, jax.tree_util.tree_map(
-            lambda s: NamedSharding(mesh, s), opt_specs))
+        step_fn, specs, opt_specs = tfm.make_train_step(
+            cfg, optimizer, mesh,
+            data_axis="data" if args.dp > 1 else None,
+            model_axis=model_axis, seq_axis=seq_axis,
+            attention=args.attention)
+        params = jax.device_put(
+            params, jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), specs))
+        opt_state = jax.device_put(
+            opt_state, jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), opt_specs))
 
     start = 0
     if args.checkpoint_dir:
@@ -123,8 +158,9 @@ def main():
             if hvd.rank() == 0:
                 print(f"resumed from step {last}", flush=True)
 
-    data_spec = NamedSharding(mesh, P("data", seq_axis)
-                              if seq_axis else P("data"))
+    data_ax = "data" if args.dp > 1 else None
+    data_spec = NamedSharding(mesh, P(data_ax, seq_axis)
+                              if seq_axis else P(data_ax))
     rng = np.random.default_rng(0)
     tokens_per_step = args.batch_size * args.seq_len
     t0, first_loss, loss = time.perf_counter(), None, None

@@ -431,6 +431,13 @@ def forward_pipelined(params, stacked_layers, tokens,
     return _logits_head(x, params, dt)
 
 
+def split_pipeline_params(params, n_stages: int):
+    """Re-layout :func:`init_params` output for the pipelined step: the
+    one canonical base/stacked split (used by the example and tests)."""
+    return {"base": {k: v for k, v in params.items() if k != "layers"},
+            "stacked": stack_layer_params(params, n_stages)}
+
+
 def make_train_step_pipelined(cfg: TransformerConfig, optimizer, mesh,
                               data_axis: Optional[str] = "data",
                               pipe_axis: str = "pipe",
@@ -444,10 +451,12 @@ def make_train_step_pipelined(cfg: TransformerConfig, optimizer, mesh,
     because the loss is a global-batch mean — verified exact against the
     plain forward's gradients (tests/test_parallel.py).
 
-    Params layout: ``{"base": embed/pos/ln_f (replicated),
-    "stacked": stack_layer_params(...) (stage dim over pipe)}``.
-    Returns ``(step, param_shardings)`` where ``step(params, opt_state,
-    tokens, labels) -> (params, opt_state, loss)``.
+    Params layout: :func:`split_pipeline_params` output
+    (``{"base": embed/pos/ln_f (replicated), "stacked":
+    stack_layer_params(...) (stage dim over pipe)}``).
+    Returns ``(step, shardings)`` where ``step(params, opt_state, tokens,
+    labels) -> (params, opt_state, loss)`` and ``shardings(params) ->
+    (param_shardings, opt_state_shardings)`` (place both trees).
     """
     from jax.sharding import NamedSharding
 
@@ -479,12 +488,25 @@ def make_train_step_pipelined(cfg: TransformerConfig, optimizer, mesh,
                                         updates)
         return params, opt_state, loss
 
-    def param_shardings(params):
-        return {
+    def shardings(params):
+        """(param_shardings, opt_state_shardings) for ``params``.
+
+        Opt-state momenta inherit the matching param's sharding; scalar
+        leaves (schedule counts) are replicated — place BOTH trees before
+        training or a checkpoint restore brings scalars back committed
+        to one device and jit rejects the mixed placement.
+        """
+        import optax
+        p_sh = {
             "base": {k: NamedSharding(mesh, P()) for k in params["base"]},
             "stacked": {k: NamedSharding(mesh, sspec_one)
                         for k in params["stacked"]},
         }
+        o_sh = optax.tree_map_params(
+            optimizer, lambda _l, s_: s_,
+            jax.eval_shape(optimizer.init, params), p_sh,
+            transform_non_params=lambda _l: NamedSharding(mesh, P()))
+        return p_sh, o_sh
 
     step = jax.jit(_step, donate_argnums=(0, 1) if donate else ())
-    return step, param_shardings
+    return step, shardings

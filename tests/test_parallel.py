@@ -553,8 +553,8 @@ def test_transformer_pipelined_gradients_exact(hvd):
         lambda p: tfm.loss_fn(p, tokens, labels, cfg,
                               attention="local"))(params)
 
-    stacked = tfm.stack_layer_params(params, 4)
-    base = {k: v for k, v in params.items() if k != "layers"}
+    split = tfm.split_pipeline_params(params, 4)
+    base, stacked = split["base"], split["stacked"]
     sspec = {k: P("pipe") for k in stacked}
     bspec = {k: P() for k in base}
 
@@ -591,15 +591,14 @@ def test_make_train_step_pipelined(hvd):
                                 dtype=jnp.float32)
     mesh = _mesh(hvd, ("data", "pipe"), (2, 4))
     full = tfm.init_params(jax.random.PRNGKey(0), cfg)
-    params = {"base": {k: v for k, v in full.items() if k != "layers"},
-              "stacked": tfm.stack_layer_params(full, 4)}
+    params = tfm.split_pipeline_params(full, 4)
     opt = optax.adam(3e-3)
-    step, param_shardings = tfm.make_train_step_pipelined(
+    step, shardings = tfm.make_train_step_pipelined(
         cfg, opt, mesh, data_axis="data", pipe_axis="pipe")
-    sh = param_shardings(params)
-    params = {g: {k: jax.device_put(v, sh[g][k])
+    p_sh, opt_sh = shardings(params)
+    params = {g: {k: jax.device_put(v, p_sh[g][k])
                   for k, v in params[g].items()} for g in params}
-    opt_state = opt.init(params)
+    opt_state = jax.device_put(opt.init(params), opt_sh)
 
     rng = np.random.default_rng(2)
     losses = []
