@@ -31,6 +31,8 @@ from __future__ import annotations
 
 import functools
 import os
+
+import numpy as np
 from typing import Optional
 
 import jax
@@ -55,9 +57,31 @@ def _interpret_default() -> bool:
 # Forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+def _mask_scores(s, qi, kj, block_q, block_k, causal, seg_ref):
+    """Apply causal and/or segment (sequence-packing) masks to a score
+    block.  Segment ids ride a [B, 1, T] layout like the m/l rows; tokens
+    attend only within their own segment."""
+    if causal:
+        qpos = qi * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kpos = kj * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+    if seg_ref is not None:
+        qseg = seg_ref[0, 0, pl.dslice(qi * block_q, block_q)]
+        kseg = seg_ref[0, 0, pl.dslice(kj * block_k, block_k)]
+        s = jnp.where(qseg[:, None] == kseg[None, :], s, NEG_INF)
+    return s
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
                 block_q: int, block_k: int, num_k: int, causal: bool,
-                scale: float):
+                scale: float, segments: bool):
+    if segments:
+        seg_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
+        seg_ref = None
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     rows = pl.dslice(qi * block_q, block_q)
@@ -78,12 +102,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
-        if causal:
-            qpos = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = kj * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        s = _mask_scores(s, qi, kj, block_q, block_k, causal, seg_ref)
         m_blk = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m, m_blk)
         safe_m = jnp.where(m_new == NEG_INF, 0.0, m_new)
@@ -120,8 +139,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, m_ref, l_ref,
-                   dq_ref, acc_ref, *, block_q: int, block_k: int,
-                   num_k: int, causal: bool, scale: float):
+                   *rest, block_q: int, block_k: int,
+                   num_k: int, causal: bool, scale: float,
+                   segments: bool):
+    if segments:
+        seg_ref, dq_ref, acc_ref = rest
+    else:
+        dq_ref, acc_ref = rest
+        seg_ref = None
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     rows = pl.dslice(qi * block_q, block_q)
@@ -144,12 +169,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, m_ref, l_ref,
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
-            qpos = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = kj * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        s = _mask_scores(s, qi, kj, block_q, block_k, causal, seg_ref)
         p = jnp.where(s == NEG_INF, 0.0,
                       jnp.exp(s - safe_m[:, None])) / denom[:, None]
         dp = jax.lax.dot_general(
@@ -171,9 +191,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, m_ref, l_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, m_ref, l_ref,
-                    dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *,
-                    block_q: int, block_k: int, num_q: int, causal: bool,
-                    scale: float):
+                    *rest, block_q: int, block_k: int, num_q: int,
+                    causal: bool, scale: float, segments: bool):
+    if segments:
+        seg_ref, dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = rest
+    else:
+        dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = rest
+        seg_ref = None
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     rows = pl.dslice(qi * block_q, block_q)
@@ -197,12 +221,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, m_ref, l_ref,
         s = jax.lax.dot_general(
             q_blk, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
-        if causal:
-            qpos = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = ki * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        s = _mask_scores(s, qi, ki, block_q, block_k, causal, seg_ref)
         p = jnp.where(s == NEG_INF, 0.0,
                       jnp.exp(s - safe_m[:, None])) / denom[:, None]
         dv_acc_ref[...] += jax.lax.dot_general(
@@ -267,28 +286,47 @@ def _unfold(x, b, h):
     return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
-def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _seg_spec(t, h):
+    # Segment ids ride a [B, 1, T] layout (same tiling story as m/l);
+    # the index map folds the batch*head grid dim back to batch.
+    return pl.BlockSpec((1, 1, t), lambda bh_, i, j: (bh_ // h, 0, 0))
+
+
+def _fwd(q, k, v, seg, causal, scale, block_q, block_k, interpret):
     b, t, h, d = _check_shapes(q, k, v, block_q, block_k)
+    if seg is not None:
+        if seg.shape != (b, t):
+            raise ValueError(
+                f"segment_ids must be [B, T] = {(b, t)} matching q/k/v, "
+                f"got {seg.shape} (pad segment ids with the sequence)")
+        if not jnp.issubdtype(seg.dtype, jnp.integer):
+            raise ValueError(
+                f"segment_ids must be integer, got {seg.dtype}")
     qf, kf, vf = _fold(q), _fold(k), _fold(v)
     bh = b * h
     num_k = t // block_k
     grid = (bh, t // block_q, num_k)
     kernel = functools.partial(_fwd_kernel, block_q=block_q,
                                block_k=block_k, num_k=num_k, causal=causal,
-                               scale=scale)
+                               scale=scale, segments=seg is not None)
     # Causal: masked steps (above the diagonal) clamp the K/V block index
     # to the last live block — same index as the preceding step, so Mosaic
     # elides the DMA instead of fetching a tile whose work pl.when skips.
     kv_map = (_causal_kv_map(block_q, block_k) if causal
               else (lambda bh_, i, j: (bh_, j, 0)))
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh_, i, j: (bh_, i, 0)),
+        pl.BlockSpec((1, block_k, d), kv_map),
+        pl.BlockSpec((1, block_k, d), kv_map),
+    ]
+    operands = [qf, kf, vf]
+    if seg is not None:
+        in_specs.append(_seg_spec(t, h))
+        operands.append(seg.reshape(b, 1, t))
     o, m, l = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh_, i, j: (bh_, i, 0)),
-            pl.BlockSpec((1, block_k, d), kv_map),
-            pl.BlockSpec((1, block_k, d), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh_, i, j: (bh_, i, 0)),
             # TPU tiling: the last two block dims must be (8k, 128k) or
@@ -307,57 +345,70 @@ def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
         ],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf)
-    return _unfold(o, b, h), (qf, kf, vf, o, m, l, b, h)
+    )(*operands)
+    return _unfold(o, b, h), (qf, kf, vf, o, m, l, seg, b, h)
 
 
 def _bwd(causal, scale, block_q, block_k, interpret, res, do):
-    qf, kf, vf, of, m, l, b, h = res
+    qf, kf, vf, of, m, l, seg, b, h = res
     bh, t, d = qf.shape
     dof = _fold(do)
     num_k = t // block_k
     num_q = t // block_q
+    segf = seg.reshape(b, 1, t) if seg is not None else None
     kernel_dq = functools.partial(_bwd_dq_kernel, block_q=block_q,
                                   block_k=block_k, num_k=num_k,
-                                  causal=causal, scale=scale)
+                                  causal=causal, scale=scale,
+                                  segments=seg is not None)
     kv_map = (_causal_kv_map(block_q, block_k) if causal
               else (lambda bh_, i, j: (bh_, j, 0)))
+    dq_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh_, i, j: (bh_, i, 0)),
+        pl.BlockSpec((1, block_k, d), kv_map),
+        pl.BlockSpec((1, block_k, d), kv_map),
+        pl.BlockSpec((1, block_q, d), lambda bh_, i, j: (bh_, i, 0)),
+        pl.BlockSpec((1, block_q, d), lambda bh_, i, j: (bh_, i, 0)),
+        pl.BlockSpec((1, 1, t), lambda bh_, i, j: (bh_, 0, 0)),
+        pl.BlockSpec((1, 1, t), lambda bh_, i, j: (bh_, 0, 0)),
+    ]
+    dq_operands = [qf, kf, vf, of, dof, m, l]
+    if seg is not None:
+        dq_specs.append(_seg_spec(t, h))
+        dq_operands.append(segf)
     dq = pl.pallas_call(
         kernel_dq,
         grid=(bh, num_q, num_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh_, i, j: (bh_, i, 0)),
-            pl.BlockSpec((1, block_k, d), kv_map),
-            pl.BlockSpec((1, block_k, d), kv_map),
-            pl.BlockSpec((1, block_q, d), lambda bh_, i, j: (bh_, i, 0)),
-            pl.BlockSpec((1, block_q, d), lambda bh_, i, j: (bh_, i, 0)),
-            pl.BlockSpec((1, 1, t), lambda bh_, i, j: (bh_, 0, 0)),
-            pl.BlockSpec((1, 1, t), lambda bh_, i, j: (bh_, 0, 0)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, d),
                                lambda bh_, i, j: (bh_, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), qf.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, of, dof, m, l)
+    )(*dq_operands)
 
     kernel_dkv = functools.partial(_bwd_dkv_kernel, block_q=block_q,
                                    block_k=block_k, num_q=num_q,
-                                   causal=causal, scale=scale)
+                                   causal=causal, scale=scale,
+                                   segments=seg is not None)
     q_map = (_causal_q_map(block_q, block_k) if causal
              else (lambda bh_, j, i: (bh_, i, 0)))
+    dkv_specs = [
+        pl.BlockSpec((1, block_q, d), q_map),
+        pl.BlockSpec((1, block_k, d), lambda bh_, j, i: (bh_, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh_, j, i: (bh_, j, 0)),
+        pl.BlockSpec((1, block_q, d), q_map),
+        pl.BlockSpec((1, block_q, d), q_map),
+        pl.BlockSpec((1, 1, t), lambda bh_, j, i: (bh_, 0, 0)),
+        pl.BlockSpec((1, 1, t), lambda bh_, j, i: (bh_, 0, 0)),
+    ]
+    dkv_operands = [qf, kf, vf, of, dof, m, l]
+    if seg is not None:
+        dkv_specs.append(_seg_spec(t, h))
+        dkv_operands.append(segf)
     dk, dv = pl.pallas_call(
         kernel_dkv,
         grid=(bh, num_k, num_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), q_map),
-            pl.BlockSpec((1, block_k, d), lambda bh_, j, i: (bh_, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh_, j, i: (bh_, j, 0)),
-            pl.BlockSpec((1, block_q, d), q_map),
-            pl.BlockSpec((1, block_q, d), q_map),
-            pl.BlockSpec((1, 1, t), lambda bh_, j, i: (bh_, 0, 0)),
-            pl.BlockSpec((1, 1, t), lambda bh_, j, i: (bh_, 0, 0)),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh_, j, i: (bh_, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh_, j, i: (bh_, j, 0)),
@@ -369,24 +420,32 @@ def _bwd(causal, scale, block_q, block_k, interpret, res, do):
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, of, dof, m, l)
-    return _unfold(dq, b, h), _unfold(dk, b, h), _unfold(dv, b, h)
+    )(*dkv_operands)
+    dseg = (np.zeros(seg.shape, jax.dtypes.float0)
+            if seg is not None else None)
+    return (_unfold(dq, b, h), _unfold(dk, b, h), _unfold(dv, b, h),
+            dseg)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal: bool = True,
                     scale: Optional[float] = None, block_q: int = 128,
                     block_k: int = 128,
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None, segment_ids=None):
     """Exact attention, flash-style, as a Pallas TPU kernel.
 
     q/k/v: ``[B, T, H, D]``; returns ``[B, T, H, D]``.  ``T`` must be a
     multiple of the block sizes (pad the sequence).  Numerically matches
     ``parallel/sequence.local_attention`` (the lax oracle) to fp32
     accumulation tolerance, forward and backward.
+
+    ``segment_ids`` ([B, T] int32) enables sequence packing: tokens
+    attend only within their own segment (composes with ``causal``) —
+    the block-sparse masking XLA's fused attention cannot express, and
+    the reason the kernel scaffold exists (docs/kernels.md).
     """
     out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
-                        interpret)
+                        interpret, segment_ids)
     return out
 
 
@@ -396,12 +455,13 @@ def _eff_blocks(t, block_q, block_k):
     return min(block_q, t), min(block_k, t)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+               segment_ids=None):
     d = q.shape[-1]
     scale_ = (d ** -0.5) if scale is None else scale
     interp = _interpret_default() if interpret is None else interpret
     bq, bk = _eff_blocks(q.shape[1], block_q, block_k)
-    return _fwd(q, k, v, causal, scale_, bq, bk, interp)
+    return _fwd(q, k, v, segment_ids, causal, scale_, bq, bk, interp)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
