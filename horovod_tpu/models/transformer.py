@@ -145,13 +145,24 @@ def _logits_head(x, params, dt):
 def forward(params, tokens, cfg: TransformerConfig,
             model_axis: Optional[str] = None,
             seq_axis: Optional[str] = None,
-            attention: str = "ring"):
+            attention: str = "ring",
+            segment_ids=None):
     """tokens: [B, T_local] int32 -> logits [B, T_local, vocab] fp32.
 
     Inside shard_map, weight leaves arrive as LOCAL shards (per
     :func:`param_specs`); outside (single device) they are global and the
     axis args must be None.
+
+    ``segment_ids`` ([B, T] int32, sequence packing) is supported on the
+    ``local`` and ``flash`` attention routes; the sequence-parallel
+    routes (ring/ulysses) reject it loudly rather than silently
+    unmasking cross-segment attention.
     """
+    if segment_ids is not None and seq_axis is not None:
+        raise ValueError(
+            "segment_ids packing is not implemented for the "
+            "sequence-parallel attention routes; use attention='local' "
+            "or 'flash' without a seq axis")
     dt = cfg.dtype
     t_local = tokens.shape[1]
     pos_offset = (lax.axis_index(seq_axis) * t_local) if seq_axis else 0
@@ -179,9 +190,10 @@ def forward(params, tokens, cfg: TransformerConfig,
         elif attention == "flash":
             # Pallas flash kernel (ops/flash_attention.py): same exact
             # math blockwise in VMEM; requires T divisible by its blocks.
-            o = flash_attention(q, k, v, True)
+            o = flash_attention(q, k, v, True, segment_ids=segment_ids)
         else:
-            o = seq_mod.local_attention(q, k, v, causal=True)
+            o = seq_mod.local_attention(q, k, v, causal=True,
+                                        segment_ids=segment_ids)
         x = _attn_out(o.reshape(b, t, dh), x, layer, dt, model_axis)
         x = _mlp_block(x, layer, dt, model_axis)
 
@@ -197,11 +209,12 @@ def xent(logits, labels):
 
 
 def loss_fn(params, tokens, labels, cfg: TransformerConfig,
-            model_axis=None, seq_axis=None, attention="ring"):
+            model_axis=None, seq_axis=None, attention="ring",
+            segment_ids=None):
     """Mean next-token cross-entropy over the LOCAL shard (callers pmean
     over data/seq axes)."""
     return xent(forward(params, tokens, cfg, model_axis, seq_axis,
-                        attention), labels)
+                        attention, segment_ids), labels)
 
 
 def make_train_step(cfg: TransformerConfig, optimizer, mesh,
@@ -209,21 +222,26 @@ def make_train_step(cfg: TransformerConfig, optimizer, mesh,
                     model_axis: Optional[str] = None,
                     seq_axis: Optional[str] = None,
                     attention: str = "ring",
-                    donate: bool = True):
+                    donate: bool = True,
+                    packed: bool = False):
     """Jitted SPMD training step over dp x tp x sp.
 
     Returns ``step(params, opt_state, tokens, labels) ->
     (params, opt_state, loss)`` plus the param spec tree (for placing
-    params with ``jax.device_put``).
+    params with ``jax.device_put``).  ``packed=True`` adds a trailing
+    ``segment_ids`` argument ([B, T] int32, sharded like tokens) so
+    sequence packing reaches the jitted step (local/flash attention
+    only; see :func:`forward`).
     """
     from horovod_tpu.ops.fusion import fused_pytree_mean
 
     specs = param_specs(cfg, model_axis)
     grad_axes = tuple(a for a in (data_axis, seq_axis) if a)
 
-    def _step(params, opt_state, tokens, labels):
+    def _step(params, opt_state, tokens, labels, segment_ids=None):
         loss, grads = jax.value_and_grad(loss_fn)(
-            params, tokens, labels, cfg, model_axis, seq_axis, attention)
+            params, tokens, labels, cfg, model_axis, seq_axis, attention,
+            segment_ids)
         # DP gradient averaging (fused psum) over data (+seq) axes; TP/f-op
         # already settled the model axis.
         grads = fused_pytree_mean(grads, grad_axes)
@@ -243,9 +261,12 @@ def make_train_step(cfg: TransformerConfig, optimizer, mesh,
         transform_non_params=lambda _leaf: P())
 
     data_spec = P(data_axis, seq_axis) if seq_axis else P(data_axis)
+    in_specs = (specs, opt_specs, data_spec, data_spec)
+    if packed:
+        in_specs = in_specs + (data_spec,)
     step = jax.shard_map(
         _step, mesh=mesh,
-        in_specs=(specs, opt_specs, data_spec, data_spec),
+        in_specs=in_specs,
         out_specs=(specs, opt_specs, P()),
         check_vma=True)
     return jax.jit(step, donate_argnums=(0, 1) if donate else ()), specs, \

@@ -149,15 +149,26 @@ def ulysses_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
 
 
 def local_attention(q, k, v, causal: bool = True,
-                    scale: Optional[float] = None):
+                    scale: Optional[float] = None, segment_ids=None):
     """Plain single-device attention (the no-SP reference path; also the
-    numerical oracle the SP tests compare against)."""
+    numerical oracle the SP tests compare against).
+
+    ``segment_ids`` ([B, T] int32) enables sequence packing: tokens
+    attend only within their own segment (composes with ``causal``).
+    """
     d = q.shape[-1]
     scale = (d ** -0.5) if scale is None else scale
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    t = q.shape[1]
     if causal:
-        t = q.shape[1]
-        mask = jnp.tril(jnp.ones((t, t), bool))
-        s = jnp.where(mask[None, None], s, -jnp.inf)
+        s = jnp.where(jnp.tril(jnp.ones((t, t), bool))[None, None], s,
+                      -jnp.inf)
+    if segment_ids is not None:
+        s = jnp.where(segment_ids[:, None, :, None] ==
+                      segment_ids[:, None, None, :], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
+    if segment_ids is not None:
+        # Fully-masked rows (possible only with exotic segment layouts
+        # under causal=False) contribute zeros rather than NaN.
+        p = jnp.where(jnp.isnan(p), 0.0, p)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
