@@ -168,6 +168,8 @@ def run_synthetic_benchmark(model_name: str = "resnet50",
                             mesh=None,
                             per_step_dispatch: bool = False,
                             input_dtype: str = "float32",
+                            stem: str = "conv7",
+                            remat: Optional[str] = None,
                             verbose: bool = True) -> dict:
     """Run the ResNet synthetic benchmark; returns a result dict.
 
@@ -183,10 +185,21 @@ def run_synthetic_benchmark(model_name: str = "resnet50",
     n_chips = mesh_size(mesh)
     global_bs = batch_size * n_chips
 
-    model = get_model(model_name, num_classes=num_classes)
+    # "s2d": space-to-depth input pipeline + exact 4x4/s1 stem
+    # reparameterization (models/resnet.py:space_to_depth) — input arrives
+    # packed [B, H/2, W/2, 12], a pure relayout done once host-side.
+    s2d = stem == "s2d" and model_name.startswith("resnet")
+    extra = {}
+    if s2d:
+        extra["stem"] = "s2d"
+    if remat and model_name.startswith("resnet"):
+        extra["remat"] = remat
+    model = get_model(model_name, num_classes=num_classes, **extra)
+    init_shape = ((1, image_size // 2, image_size // 2, 12) if s2d
+                  else (1, image_size, image_size, 3))
     rng = jax.random.PRNGKey(0)
-    variables = model.init(rng, jnp.zeros((1, image_size, image_size, 3),
-                                          jnp.float32), train=False)
+    variables = model.init(rng, jnp.zeros(init_shape, jnp.float32),
+                           train=False)
     params, batch_stats = variables["params"], variables["batch_stats"]
     optimizer = optax.sgd(learning_rate, momentum=0.9)
     opt_state = optimizer.init(params)
@@ -198,6 +211,9 @@ def run_synthetic_benchmark(model_name: str = "resnet50",
     # unchanged since the model casts to bf16 anyway).
     images_np = np.random.default_rng(0).standard_normal(
         (global_bs, image_size, image_size, 3), dtype=np.float32)
+    if s2d:
+        from horovod_tpu.models.resnet import space_to_depth
+        images_np = space_to_depth(images_np)
     # Cast host-side (ml_dtypes handles bf16 in numpy) so device_put still
     # uploads only per-shard slices; a jnp cast would stage the full
     # global batch on one device first.
@@ -240,6 +256,13 @@ def run_synthetic_benchmark(model_name: str = "resnet50",
         if (flops_per_step and analytic and steps_per_call > 1 and
                 flops_per_step > 2.5 * analytic):
             flops_per_step /= steps_per_call
+        if flops_per_step and s2d:
+            # XLA counts the 45 structurally-zero tap-channels of the
+            # reparameterized 4x4x(4*3) stem (conv7_to_s2d_weights zeroes
+            # them) as FLOPs; subtract so MFU stays comparable with the
+            # conv7 stem (fwd+bwd(dX)+bwd(dW) ~= 3x fwd).
+            out_hw = (image_size // 2) ** 2
+            flops_per_step -= 3 * 2 * global_bs * out_hw * 45 * 64
         step = compiled
     except Exception:
         flops_per_step = _step_flops(None, model_name, global_bs,
@@ -305,6 +328,7 @@ def run_synthetic_benchmark(model_name: str = "resnet50",
     return {
         "model": model_name,
         "batch_size_per_chip": batch_size,
+        "stem": "s2d" if s2d else "conv7",
         "n_chips": n_chips,
         "img_sec_total": img_sec_mean,
         "img_sec_conf": img_sec_conf,

@@ -183,3 +183,32 @@ def test_transformer_generate(hvd):
                else jnp.asarray([nxt], jnp.int32))
         seq.append(int(tok[0]))
     assert seq == [int(v) for v in np.asarray(out[0])], (seq, out)
+
+
+def test_s2d_stem_exact_equivalence(hvd):
+    """The space-to-depth stem computes the SAME function as the 7x7/s2
+    stem under the conv7_to_s2d_weights reparameterization: conv(s2d(x),
+    w4) == conv(x, w7) for the stem conv alone, and the full packed model
+    equals the canonical model when stem weights are mapped and all other
+    weights are shared."""
+    from flax.core import unfreeze
+    from horovod_tpu.models import ResNet18
+    from horovod_tpu.models.resnet import conv7_to_s2d_weights, space_to_depth
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 64, 64, 3), dtype=np.float32)
+
+    m7 = ResNet18(num_classes=7, dtype=jnp.float32)
+    m4 = ResNet18(num_classes=7, dtype=jnp.float32, stem="s2d")
+    v7 = m7.init(jax.random.PRNGKey(0), jnp.asarray(x), train=False)
+    xp = jnp.asarray(space_to_depth(x))
+
+    v4 = unfreeze(jax.tree.map(lambda a: a, v7))
+    w7 = np.asarray(v7["params"]["conv_init"]["kernel"])
+    v4["params"]["conv_init"] = {
+        "kernel": jnp.asarray(conv7_to_s2d_weights(w7))}
+
+    y7 = m7.apply(v7, jnp.asarray(x), train=False)
+    y4 = m4.apply(v4, xp, train=False)
+    np.testing.assert_allclose(np.asarray(y4), np.asarray(y7),
+                               rtol=1e-5, atol=1e-5)

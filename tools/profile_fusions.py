@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Per-fusion time x bytes analysis for the flagship bench step.
+
+Compiles the scanned training loop, traces it with jax.profiler, parses the
+optimized HLO for each fusion's operand/result shapes, and joins trace
+durations with estimated HBM traffic -> achieved GB/s per fusion.  Fusions
+near HBM peak are traffic-limited (fix = reduce bytes); fusions far below
+are compute- or latency-limited (fix = different).
+"""
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+
+import jax
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _bench_setup import setup  # noqa: E402
+from horovod_tpu.benchmark import make_train_step  # noqa: E402
+
+DT_BYTES = {"f32": 4, "bf16": 2, "s32": 4, "u32": 4, "pred": 1, "f16": 2,
+            "s8": 1, "u8": 1, "s64": 8, "u64": 8, "f64": 8}
+SHAPE_RE = re.compile(r"(f32|bf16|s32|u32|pred|f16|s8|u8|s64|u64|f64)"
+                      r"\[([0-9,]*)\]")
+
+
+def shape_bytes(text):
+    """Sum the byte sizes of every typed shape literal in `text`."""
+    total = 0
+    for dt, dims in SHAPE_RE.findall(text):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * DT_BYTES[dt]
+    return total
+
+
+def main():
+    steps = int(os.environ.get("PROF_STEPS", "30"))
+    mesh, ax, model, optimizer, state, inputs = setup()
+    (params, batch_stats, opt_state), (images, labels) = state, inputs
+
+    step = make_train_step(model, optimizer, mesh, ax, steps_per_call=steps)
+    compiled = step.lower(params, batch_stats, opt_state, images,
+                          labels).compile()
+    hlo = compiled.as_text()
+
+    # Parse op definitions: "%name = <result shape(s)> op(...operands...)".
+    # Operand shapes are resolved from the definitions of the operand names.
+    defs = {}      # name -> (result_text, operand_names)
+    for line in hlo.splitlines():
+        m = re.match(r"\s+%([\w.-]+) = (.*)", line)
+        if not m:
+            continue
+        name, rest = m.group(1), m.group(2)
+        # cut backend_config / metadata tails (huge, contain no shapes)
+        rest = rest.split(", metadata=")[0].split(", backend_config=")[0]
+        # result portion = everything up to the op's operand list
+        opm = re.match(r"((?:\([^=]*\)|\S+)) (\w[\w-]*)\((.*)\)$", rest)
+        if not opm:
+            defs[name] = (rest, [])
+            continue
+        result_text, opname, operands = opm.groups()
+        opnames = re.findall(r"%([\w.-]+)", operands)
+        defs[name] = (result_text, opnames)
+
+    # trace
+    p, s, o = params, batch_stats, opt_state
+    p, s, o, loss = compiled(p, s, o, images, labels)
+    float(np.asarray(loss))
+    tracedir = "/tmp/jax_trace_fusions"
+    jax.profiler.start_trace(tracedir)
+    p, s, o, loss = compiled(p, s, o, images, labels)
+    float(np.asarray(loss))
+    jax.profiler.stop_trace()
+
+    tracefile = sorted(glob.glob(
+        tracedir + "/plugins/profile/*/*.trace.json.gz"))[-1]
+    with gzip.open(tracefile) as f:
+        tr = json.load(f)
+    pids = {e['pid']: e['args'].get('name', '')
+            for e in tr['traceEvents']
+            if e.get('ph') == 'M' and e.get('name') == 'process_name'}
+    dev_pid = [k for k, v in pids.items() if 'TPU' in v]
+    dev_pid = dev_pid[0] if dev_pid else 3
+    dur = collections.defaultdict(float)
+    cnt = collections.Counter()
+    for e in tr['traceEvents']:
+        if e.get('ph') == 'X' and e.get('pid') == dev_pid:
+            n = e['name']
+            if n == '0' or n.startswith('jit_') or n.startswith('while'):
+                continue
+            dur[n] += e['dur']
+            cnt[n] += 1
+
+    rows = []
+    for name, us in dur.items():
+        d = defs.get(name)
+        if d is None:
+            rows.append((us, name, None, None, "?", ""))
+            continue
+        result_text, opnames = d
+        rbytes = shape_bytes(result_text)
+        obytes = 0
+        unresolved = 0
+        for op in opnames:
+            od = defs.get(op)
+            if od:
+                # full result text: tuples count every element (the fusion
+                # reads whichever it needs; GTE operands resolve to their
+                # own single-element shape, so tuple reads via GTE are exact)
+                obytes += shape_bytes(od[0].split(" fusion(")[0]
+                                      .split(" convolution(")[0])
+            else:
+                unresolved += 1
+        total = rbytes + obytes
+        # layer attribution from metadata of the definition line
+        meta = ""
+        i = hlo.find("%" + name + " = ")
+        if i >= 0:
+            line = hlo[i:hlo.find("\n", i)]
+            mm = re.search(r'op_name="([^"]*)"', line)
+            if mm:
+                meta = mm.group(1)
+        per_exec_s = (us / max(cnt[name], 1)) * 1e-6
+        gbs = (total / 1e9) / per_exec_s if (total and per_exec_s) else None
+        rows.append((us, name, total, gbs, meta, ""))
+
+    rows.sort(key=lambda r: -r[0])
+    tot_us = sum(dur.values())
+    print(f"total categorized device time: {tot_us/1e3:.1f} ms "
+          f"({tot_us/steps/1e3:.2f} ms/step)")
+    print(f"{'ms/step':>8} {'cum%':>5} {'GB/step':>8} {'GB/s':>7}  name / op")
+    cum = 0.0
+    for us, name, total, gbs, meta, _ in rows[:45]:
+        cum += us
+        tb = f"{total*1/1e9:8.3f}" if total else "       ?"
+        gb = f"{gbs:7.0f}" if gbs else "      ?"
+        short_meta = re.sub(r"jit\(_step\)/", "", meta)[:70]
+        print(f"{us/steps/1e3:8.3f} {100*cum/tot_us:5.1f} {tb} {gb}  "
+              f"{name[:28]:28} {short_meta}")
+
+    # aggregate bytes across all timed fusions
+    tot_bytes = sum(r[2] for r in rows if r[2])
+    print(f"\nsum of per-fusion traffic estimate: {tot_bytes/1e9:.1f} GB/step")
+
+    # per-layer aggregation: stage x direction
+    lay = collections.defaultdict(lambda: [0.0, 0.0])
+    for us, name, total, gbs, meta, _ in rows:
+        direction = "bwd" if "transpose(" in meta else "fwd"
+        m = re.search(r"(BottleneckBlock_\d+|conv_init|norm_init|head|"
+                      r"reduce_window_max|select_and_scatter)", meta)
+        key = (m.group(1) if m else "other", direction)
+        lay[key][0] += us
+        lay[key][1] += total or 0
+    print("\nper-layer (ms/step, GB/step):")
+    for key, (us, byt) in sorted(lay.items(), key=lambda kv: -kv[1][0]):
+        print(f"  {us/steps/1e3:7.3f} ms {byt/1e9:7.3f} GB "
+              f"{byt/1e9/(us/steps/1e3+1e-9)*1000:6.0f} GB/s  {key}")
+    with open("/tmp/fusion_rows.json", "w") as f:
+        json.dump([{ "us": r[0], "name": r[1], "bytes": r[2], "meta": r[4]}
+                   for r in rows], f)
+    print("rows -> /tmp/fusion_rows.json; HLO -> /tmp/loop_hlo.txt")
+    with open("/tmp/loop_hlo.txt", "w") as f:
+        f.write(hlo)
+
+
+if __name__ == "__main__":
+    main()
