@@ -57,6 +57,11 @@ def main():
                    help="pipeline stages (composes with --dp only)")
     p.add_argument("--microbatches", type=int, default=2,
                    help="GPipe microbatches per step (with --pp)")
+    p.add_argument("--pp-schedule", choices=("gpipe", "1f1b"),
+                   default="gpipe",
+                   help="pipeline schedule: gpipe (AD backward pipeline) "
+                        "or 1f1b (O(stages) activation memory, "
+                        "docs/parallelism.md)")
     p.add_argument("--steps", type=int, default=50)
     p.add_argument("--batch-size", type=int, default=4,
                    help="global batch (sequences)")
@@ -119,7 +124,8 @@ def main():
         step_fn, shard_of = tfm.make_train_step_pipelined(
             cfg, optimizer, mesh,
             data_axis="data" if args.dp > 1 else None,
-            pipe_axis="pipe", n_microbatches=args.microbatches)
+            pipe_axis="pipe", n_microbatches=args.microbatches,
+            schedule=args.pp_schedule)
         p_sh, opt_sh = shard_of(params)
         params = {g: {k: jax.device_put(v, p_sh[g][k])
                       for k, v in params[g].items()} for g in params}

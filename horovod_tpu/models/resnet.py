@@ -164,6 +164,9 @@ class ResNet(nn.Module):
             axis_name=self.axis_name if train else None)
 
         x = x.astype(self.dtype)
+        if self.stem not in ("conv7", "s2d"):
+            raise ValueError(
+                f"stem={self.stem!r}: expected 'conv7' or 's2d'")
         if self.stem == "s2d":
             x = conv(self.num_filters, (4, 4), (1, 1),
                      padding=[(2, 1), (2, 1)], name="conv_init")(x)

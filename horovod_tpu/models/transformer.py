@@ -419,10 +419,20 @@ def forward_pipelined(params, stacked_layers, tokens,
     if b % n_microbatches:
         raise ValueError(f"batch {b} not divisible by "
                          f"{n_microbatches} microbatches")
-    hd = cfg.head_dim
     x = (params["embed"][tokens] +
          params["pos"][None, :t]).astype(dt)              # [B, T, D]
     mb = x.reshape(n_microbatches, b // n_microbatches, t, cfg.d_model)
+
+    y = pipeline_apply(_pipe_stage_fn(cfg), stacked_layers, mb,
+                       axis_name=pipe_axis)
+    x = y.reshape(b, t, cfg.d_model)
+    return _logits_head(x, params, dt)
+
+
+def _pipe_stage_fn(cfg: TransformerConfig):
+    """stage_fn for the pipeline schedules: scan this device's layer
+    slice (leaves [1, lps, ...]) over the activation."""
+    dt, hd = cfg.dtype, cfg.head_dim
 
     def one_layer(x, lp):
         q, k, v, dh = _qkv_proj(x, lp, dt, None, hd)
@@ -430,7 +440,10 @@ def forward_pipelined(params, stacked_layers, tokens,
         o = seq_mod.local_attention(q, k, v, causal=True)
         x = _attn_out(o.reshape(bb, tt, dh), x, lp, dt, None)
         x = _mlp_block(x, lp, dt, None)
-        return x, None
+        # attention computes in f32; pin the carried activation to the
+        # model dtype so the layer scan (and the pipeline's microbatch
+        # buffers) keep a stable, bf16-safe type
+        return x.astype(dt), None
 
     def stage_fn(stage_params, act):
         # stage_params leaves: [1, lps, ...] — this device's stage.  A
@@ -447,9 +460,7 @@ def forward_pipelined(params, stacked_layers, tokens,
         out, _ = lax.scan(one_layer, act, local)
         return out
 
-    y = pipeline_apply(stage_fn, stacked_layers, mb, axis_name=pipe_axis)
-    x = y.reshape(b, t, cfg.d_model)
-    return _logits_head(x, params, dt)
+    return stage_fn
 
 
 def split_pipeline_params(params, n_stages: int):
@@ -463,14 +474,24 @@ def make_train_step_pipelined(cfg: TransformerConfig, optimizer, mesh,
                               data_axis: Optional[str] = "data",
                               pipe_axis: str = "pipe",
                               n_microbatches: int = 2,
-                              donate: bool = True):
+                              donate: bool = True,
+                              schedule: str = "gpipe"):
     """Jitted DP x PP training step.
 
-    Differentiation happens OUTSIDE the shard_map (jit-of-shard_map):
-    JAX transposes the GPipe schedule (scan + ppermute) into the exact
-    backward pipeline, and GSPMD handles the data-axis gradient averaging
-    because the loss is a global-batch mean — verified exact against the
-    plain forward's gradients (tests/test_parallel.py).
+    ``schedule="gpipe"``: differentiation happens OUTSIDE the shard_map
+    (jit-of-shard_map): JAX transposes the GPipe schedule (scan +
+    ppermute) into the exact backward pipeline, and GSPMD handles the
+    data-axis gradient averaging because the loss is a global-batch
+    mean — verified exact against the plain forward's gradients
+    (tests/test_parallel.py).
+
+    ``schedule="1f1b"``: the hand-scheduled one-forward-one-backward
+    pipeline (:func:`horovod_tpu.parallel.pipeline.pipeline_1f1b`) —
+    same exact gradients (same oracle), but peak activation state is
+    O(pipe) instead of O(n_microbatches) saved microbatches per stage:
+    choose it when many microbatches of residuals don't fit HBM.  On a
+    lockstep SPMD mesh its bubble is NOT smaller than GPipe's — see
+    docs/parallelism.md for the measured comparison.
 
     Params layout: :func:`split_pipeline_params` output
     (``{"base": embed/pos/ln_f (replicated), "stacked":
@@ -498,9 +519,43 @@ def make_train_step_pipelined(cfg: TransformerConfig, optimizer, mesh,
             mesh=mesh, in_specs=(bspec, sspec, data_spec),
             out_specs=data_spec, check_vma=False)(base, stacked, tokens)
 
-    def _loss(params, tokens, labels):
-        return xent(smapped(params["base"], params["stacked"], tokens),
-                    labels)
+    if schedule == "1f1b":
+        from horovod_tpu.parallel.pipeline import make_pipeline_1f1b_loss
+
+        def head_loss(y, tgt, base):
+            return xent(_logits_head(y, base, cfg.dtype), tgt)
+
+        # microbatches/targets: [M, mb, T, ...] with the microbatch dim
+        # sharded over data (GSPMD reshards the embedded activations once
+        # per step; semantics are unchanged — the loss is a global mean).
+        mb_spec = P(None, data_axis) if data_axis else P()
+
+        def _loss(params, tokens, labels):
+            f = make_pipeline_1f1b_loss(
+                _pipe_stage_fn(cfg), head_loss, mesh,
+                stage_spec={k: sspec_one for k in params["stacked"]},
+                mb_spec=mb_spec,
+                aux_spec={k: P() for k in params["base"]},
+                axis_name=pipe_axis,
+                data_axes=(data_axis,) if data_axis else ())
+            base = params["base"]
+            b, t = tokens.shape
+            if b % n_microbatches:
+                raise ValueError(f"batch {b} not divisible by "
+                                 f"{n_microbatches} microbatches")
+            x = (base["embed"][tokens] +
+                 base["pos"][None, :t]).astype(cfg.dtype)
+            mb = x.reshape(n_microbatches, b // n_microbatches, t,
+                           cfg.d_model)
+            tgt = labels.reshape(n_microbatches, b // n_microbatches, t)
+            return f(params["stacked"], base, mb, tgt)
+    elif schedule == "gpipe":
+        def _loss(params, tokens, labels):
+            return xent(smapped(params["base"], params["stacked"], tokens),
+                        labels)
+    else:
+        raise ValueError(f"schedule={schedule!r}: expected 'gpipe' or "
+                         f"'1f1b'")
 
     def _step(params, opt_state, tokens, labels):
         loss, grads = jax.value_and_grad(_loss)(params, tokens, labels)

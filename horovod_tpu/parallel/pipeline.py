@@ -102,3 +102,214 @@ def stack_stage_params(per_stage_params):
     (shard this output over the pipe axis with PartitionSpec('pipe', ...))."""
     return jax.tree_util.tree_map(
         lambda *leaves: jnp.stack(leaves), *per_stage_params)
+
+
+def pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params, aux,
+                  microbatches, targets, axis_name: str = "pipe"):
+    """One-forward-one-backward (1F1B) pipeline schedule, hand-scheduled.
+
+    GPipe here (:func:`pipeline_apply` + ``jax.grad``) runs all M forwards
+    then all M backwards, so AD keeps **M microbatches of residuals** live
+    per stage.  This schedule interleaves: each scan tick does one forward
+    sub-step and one backward sub-step, saving only stage *inputs* in a
+    ``2P``-slot ring buffer and rematerializing the stage forward inside
+    the backward's VJP.  Peak activation state drops from O(M) to O(P)
+    microbatches — the reason to pick 1F1B when M >> P (long-context
+    training).  On a lockstep SPMD mesh the *bubble* is NOT smaller than
+    GPipe's: every device executes both sub-steps every tick (masked when
+    its wavefront hasn't arrived), and the schedule runs M + 2(P-1) ticks
+    vs GPipe's 2(M+P-1) half-ticks; 1F1B's classic latency win assumes an
+    async runtime (e.g. the per-device command queues of PipeDream /
+    Megatron), which a single fused XLA program does not have.  See
+    docs/parallelism.md for the measured comparison.
+
+    Gradients are EXACT (same oracle as GPipe — tests/test_parallel.py).
+
+    Args:
+      stage_fn: ``(stage_params, x) -> y`` with ``y.shape == x.shape``.
+      loss_fn: ``(y, target_mb, aux) -> scalar`` — applied at the LAST
+        stage (e.g. final layernorm + logits head + xent, with ``aux``
+        holding those replicated params).
+      stage_params: this device's stage slice (leaves [1, ...]).
+      aux: replicated pytree consumed by ``loss_fn``.
+      microbatches: ``[M, mb...]`` (replicated over the pipe axis).
+      targets: ``[M, ...]`` per-microbatch targets.
+
+    Returns ``(loss, stage_grads, aux_grads, d_microbatches)``: the mean
+    microbatch loss and exact gradients w.r.t. stage_params / aux /
+    microbatches (use :func:`make_pipeline_1f1b_loss` to compose with
+    outer AD for embedding parameters).
+    """
+    size = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+    ticks = m + 2 * (size - 1)
+    nbuf = 2 * size   # in-flight saved inputs <= 2(P-1)+1 < 2P
+
+    right_perm = [(i, (i + 1) % size) for i in range(size)]
+    left_perm = [(i, (i - 1) % size) for i in range(size)]
+
+    def _vma(v):
+        try:
+            return set(jax.typeof(v).vma)
+        except AttributeError:
+            return set()
+
+    target_vma = {axis_name} | _vma(microbatches) | _vma(targets)
+    for leaf in jax.tree_util.tree_leaves((stage_params, aux)):
+        target_vma |= _vma(leaf)
+
+    def _pin(v):
+        missing = tuple(sorted(target_vma - _vma(v)))
+        if not missing:
+            return v
+        try:
+            return lax.pcast(v, missing, to="varying")
+        except ValueError:
+            return v
+
+    zeros_like_pinned = lambda t: jax.tree_util.tree_map(
+        lambda l: _pin(jnp.zeros(l.shape, l.dtype)), t)
+
+    def tick(carry, t):
+        fwd_in, bwd_in, buf, g_stage, g_aux, d_mb, loss_acc = carry
+
+        # -- forward sub-step: stage p runs microbatch mf = t - p.
+        mf = t - idx
+        fwd_valid = (mf >= 0) & (mf < m)
+        feed = lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(t, 0, m - 1), axis=0, keepdims=False)
+        x = jnp.where(idx == 0, feed, fwd_in)
+        y = stage_fn(stage_params, x)
+        slot = jnp.maximum(mf, 0) % nbuf
+        buf = jnp.where(
+            fwd_valid,
+            lax.dynamic_update_index_in_dim(buf, x, slot, axis=0), buf)
+        fwd_out = lax.ppermute(y, axis_name, right_perm)
+
+        # -- backward sub-step: stage p runs microbatch
+        # mbk = t - 2(P-1) + p (at the last stage mbk == mf: it backwards
+        # the microbatch it just forwarded, seeding from the loss).
+        mbk = t - 2 * (size - 1) + idx
+        bwd_valid = (mbk >= 0) & (mbk < m)
+        x_saved = lax.dynamic_index_in_dim(
+            buf, jnp.maximum(mbk, 0) % nbuf, axis=0, keepdims=False)
+        tgt = lax.dynamic_index_in_dim(
+            targets, jnp.clip(mbk, 0, m - 1), axis=0, keepdims=False)
+        # Remat: recompute this stage's forward to get the pullback
+        # (saving inputs, not residuals, is what makes the buffer small).
+        y2, pull = jax.vjp(stage_fn, stage_params, x_saved)
+        loss_val, (dy_loss, daux) = jax.value_and_grad(
+            loss_fn, argnums=(0, 2))(y2, tgt, aux)
+        dy = jnp.where(idx == size - 1, dy_loss, bwd_in)
+        dparams, dx = pull(dy)
+
+        def _acc(acc, g, valid):
+            return jax.tree_util.tree_map(
+                lambda a, b: a + jnp.where(valid, b, jnp.zeros_like(b)),
+                acc, g)
+
+        g_stage = _acc(g_stage, dparams, bwd_valid)
+        g_aux = _acc(g_aux, daux, bwd_valid & (idx == size - 1))
+        d_mb = jnp.where(
+            bwd_valid & (idx == 0),
+            lax.dynamic_update_index_in_dim(
+                d_mb, dx.astype(d_mb.dtype), jnp.clip(mbk, 0, m - 1),
+                axis=0),
+            d_mb)
+        loss_acc = loss_acc + jnp.where(
+            bwd_valid & (idx == size - 1), loss_val, 0.0)
+        bwd_out = lax.ppermute(dx, axis_name, left_perm)
+        return (fwd_out, bwd_out, buf, g_stage, g_aux, d_mb,
+                loss_acc), None
+
+    init = (
+        _pin(jnp.zeros(mb_shape, microbatches.dtype)),        # fwd_in
+        _pin(jnp.zeros(mb_shape, microbatches.dtype)),        # bwd_in
+        _pin(jnp.zeros((nbuf,) + mb_shape, microbatches.dtype)),
+        zeros_like_pinned(stage_params),
+        zeros_like_pinned(aux),
+        _pin(jnp.zeros((m,) + mb_shape, jnp.float32)),        # d_mb
+        _pin(jnp.zeros((), jnp.float32)),
+    )
+    (_, _, _, g_stage, g_aux, d_mb, loss_acc), _ = lax.scan(
+        tick, init, jnp.arange(ticks))
+
+    inv_m = 1.0 / m
+    scale = lambda t: jax.tree_util.tree_map(
+        lambda l: (l * inv_m).astype(l.dtype), t)
+    # loss/aux/d_mb live on single stages — psum broadcasts them SPMD-wide
+    # (stage grads stay local: each device owns its stage slice).
+    loss = lax.psum(loss_acc * inv_m, axis_name)
+    g_aux = jax.tree_util.tree_map(
+        lambda l: lax.psum(l * inv_m, axis_name), g_aux)
+    d_mb = lax.psum(d_mb * inv_m, axis_name).astype(microbatches.dtype)
+    return loss, scale(g_stage), g_aux, d_mb
+
+
+def make_pipeline_1f1b_loss(stage_fn: Callable, loss_fn: Callable, mesh,
+                            stage_spec, mb_spec, tgt_spec=None, aux_spec=None,
+                            axis_name: str = "pipe", data_axes=()):
+    """Differentiable scalar-loss wrapper around :func:`pipeline_1f1b`.
+
+    Returns ``f(stage_params, aux, microbatches, targets) -> loss``, a
+    jit-level function whose ``jax.grad`` w.r.t. (stage_params, aux,
+    microbatches) replays the 1F1B-computed exact gradients — so
+    embedding layers upstream of the pipeline get their gradients through
+    ordinary AD of ``d_microbatches``.
+
+    The shard_map lives INSIDE the custom_vjp: outer AD never transposes
+    the shard_map (the 1F1B schedule already computed every gradient), so
+    the unmapped-output cotangent scaling of shard_map transposition
+    cannot bite.  ``data_axes`` names mesh axes to gradient-average over
+    (the Horovod DP allreduce, fused here as pmean).
+    """
+    from jax.sharding import PartitionSpec
+
+    tgt_spec = tgt_spec if tgt_spec is not None else mb_spec
+    aux_spec = aux_spec if aux_spec is not None else PartitionSpec()
+
+    def body(stage_params, aux, microbatches, targets):
+        loss, gs, ga, dmb = pipeline_1f1b(
+            stage_fn, loss_fn, stage_params, aux, microbatches, targets,
+            axis_name)
+        for ax in data_axes:
+            loss = lax.pmean(loss, ax)
+            gs = jax.tree_util.tree_map(lambda l: lax.pmean(l, ax), gs)
+            ga = jax.tree_util.tree_map(lambda l: lax.pmean(l, ax), ga)
+            # d_microbatches stays per-shard (each shard's embeddings),
+            # but the global loss is the data-MEAN of per-shard losses —
+            # scale the per-shard cotangent accordingly.
+            dmb = dmb / lax.axis_size(ax)
+        return loss, gs, ga, dmb
+
+    def run(stage_params, aux, microbatches, targets):
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(stage_spec, aux_spec, mb_spec, tgt_spec),
+            out_specs=(PartitionSpec(), stage_spec, aux_spec, mb_spec),
+            check_vma=False)(stage_params, aux, microbatches, targets)
+
+    @jax.custom_vjp
+    def f(stage_params, aux, microbatches, targets):
+        return run(stage_params, aux, microbatches, targets)[0]
+
+    def f_fwd(stage_params, aux, microbatches, targets):
+        loss, gs, ga, dmb = run(stage_params, aux, microbatches, targets)
+        return loss, (gs, ga, dmb, targets)
+
+    def f_bwd(res, ct):
+        gs, ga, dmb, targets = res
+        sc = lambda t: jax.tree_util.tree_map(lambda g: g * ct, t)
+        # integer targets carry symbolic-zero cotangents (float0);
+        # float targets get real zeros — d(loss)/d(targets) is NOT
+        # computed by the 1F1B schedule (targets are training labels)
+        dt = jax.tree_util.tree_map(
+            lambda l: (jnp.zeros(l.shape, jax.dtypes.float0)
+                       if not jnp.issubdtype(l.dtype, jnp.inexact)
+                       else jnp.zeros_like(l)), targets)
+        return (sc(gs), sc(ga), sc(dmb), dt)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f

@@ -611,3 +611,105 @@ def test_make_train_step_pipelined(hvd):
         losses.append(float(np.asarray(loss)))
     assert np.isfinite(losses).all(), losses
     assert losses[-1] < losses[0], losses
+
+
+def test_pipeline_1f1b_matches_oracle(hvd):
+    """1F1B loss AND gradients (stage params, aux head, microbatch inputs)
+    equal the plain sequential computation — the same exact-gradient gate
+    GPipe passes, on the hand-scheduled interleaved schedule."""
+    from horovod_tpu.parallel.pipeline import (make_pipeline_1f1b_loss,
+                                               stack_stage_params)
+
+    mesh = _mesh(hvd, ("pipe",), (4,))
+    d, mb, m = 8, 2, 6
+    rng = np.random.default_rng(7)
+    stage_ws = [jnp.asarray(rng.standard_normal((d, d)) * 0.3, jnp.float32)
+                for _ in range(4)]
+    stacked = stack_stage_params([{"w": w} for w in stage_ws])
+    xs = jnp.asarray(rng.standard_normal((m, mb, d)), jnp.float32)
+    tgts = jnp.asarray(rng.standard_normal((m, mb, d)), jnp.float32)
+    aux = {"scale": jnp.asarray(rng.standard_normal((d,)), jnp.float32)}
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"][0])
+
+    def loss_fn(y, tgt, aux):
+        return jnp.mean((y * aux["scale"] - tgt) ** 2)
+
+    def oracle(ws, aux, xs):
+        y = xs
+        for i in range(4):
+            y = jnp.tanh(y @ ws["w"][i])
+        per_mb = jnp.mean((y * aux["scale"] - tgts) ** 2, axis=(1, 2))
+        return jnp.mean(per_mb)
+
+    want_loss = oracle(stacked, aux, xs)
+    g_want = jax.grad(oracle, argnums=(0, 1, 2))(stacked, aux, xs)
+
+    f = make_pipeline_1f1b_loss(stage_fn, loss_fn, mesh,
+                                stage_spec={"w": P("pipe", None, None)},
+                                mb_spec=P(), axis_name="pipe")
+    got_loss = jax.jit(f)(stacked, aux, xs, tgts)
+    np.testing.assert_allclose(np.asarray(got_loss), np.asarray(want_loss),
+                               rtol=2e-5, atol=2e-5)
+
+    g_got = jax.jit(jax.grad(
+        lambda ws, a, x: f(ws, a, x, tgts), argnums=(0, 1, 2)))(
+            stacked, aux, xs)
+    np.testing.assert_allclose(np.asarray(g_got[0]["w"]),
+                               np.asarray(g_want[0]["w"]),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(g_got[1]["scale"]),
+                               np.asarray(g_want[1]["scale"]),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(g_got[2]), np.asarray(g_want[2]),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("dp", [1, 2])
+def test_train_step_1f1b_matches_gpipe(hvd, dp):
+    """One SGD step under schedule='1f1b' produces the SAME params as
+    schedule='gpipe' (=> identical exact gradients end-to-end), with and
+    without a data axis."""
+    import optax
+
+    from horovod_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(vocab_size=32, d_model=16, n_heads=2,
+                                d_ff=32, n_layers=4, max_seq=8,
+                                dtype=jnp.float32)
+    axes = ("data", "pipe") if dp > 1 else ("pipe",)
+    shape = (dp, 4) if dp > 1 else (4,)
+    mesh = _mesh(hvd, axes, shape)
+    data_axis = "data" if dp > 1 else None
+    full = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    params0 = tfm.split_pipeline_params(full, 4)
+    opt = optax.sgd(0.1)
+
+    rng = np.random.default_rng(3)
+    toks = rng.integers(0, 32, (4, 9))
+    tokens = jnp.asarray(toks[:, :-1], jnp.int32)
+    labels = jnp.asarray(toks[:, 1:], jnp.int32)
+
+    results = {}
+    for sched in ("gpipe", "1f1b"):
+        step, shardings = tfm.make_train_step_pipelined(
+            cfg, opt, mesh, data_axis=data_axis, pipe_axis="pipe",
+            n_microbatches=2, schedule=sched, donate=False)
+        p_sh, opt_sh = shardings(params0)
+        params = {g: {k: jax.device_put(v, p_sh[g][k])
+                      for k, v in params0[g].items()} for g in params0}
+        opt_state = jax.device_put(opt.init(params), opt_sh)
+        params, opt_state, loss = step(params, opt_state, tokens, labels)
+        results[sched] = (jax.tree_util.tree_map(np.asarray, params),
+                          float(np.asarray(loss)))
+
+    assert np.isclose(results["gpipe"][1], results["1f1b"][1],
+                      rtol=1e-5), (results["gpipe"][1], results["1f1b"][1])
+    flat_g, _ = jax.tree_util.tree_flatten_with_path(results["gpipe"][0])
+    flat_f = dict(jax.tree_util.tree_flatten_with_path(
+        results["1f1b"][0])[0])
+    for path, leaf in flat_g:
+        np.testing.assert_allclose(
+            flat_f[path], leaf, rtol=2e-4, atol=1e-5,
+            err_msg=jax.tree_util.keystr(path))

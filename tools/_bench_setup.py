@@ -19,13 +19,15 @@ def setup():
     state = (params, batch_stats, opt_state) and inputs = (images, labels),
     matching bench.py's protocol env knobs."""
     model_name = os.environ.get("BENCH_MODEL", "resnet50")
-    batch = int(os.environ.get("BENCH_BATCH_SIZE", "256"))
     input_dtype = os.environ.get("BENCH_INPUT_DTYPE", "bfloat16")
     stem = os.environ.get("BENCH_STEM", "s2d")
     image_size = 224
     hvd.init()
     mesh = hvd.mesh()
     ax = data_axis(mesh)
+    # BENCH_BATCH_SIZE is PER CHIP, exactly as in run_synthetic_benchmark
+    from horovod_tpu.topology import mesh_size
+    batch = int(os.environ.get("BENCH_BATCH_SIZE", "256")) * mesh_size(mesh)
 
     s2d = stem == "s2d" and model_name.startswith("resnet")
     model = get_model(model_name, num_classes=1000,
