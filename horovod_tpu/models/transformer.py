@@ -153,16 +153,12 @@ def forward(params, tokens, cfg: TransformerConfig,
     :func:`param_specs`); outside (single device) they are global and the
     axis args must be None.
 
-    ``segment_ids`` ([B, T] int32, sequence packing) is supported on the
-    ``local`` and ``flash`` attention routes; the sequence-parallel
-    routes (ring/ulysses) reject it loudly rather than silently
-    unmasking cross-segment attention.
+    ``segment_ids`` ([B, T] int32, sequence packing) is supported on
+    every attention route; under a ``seq_axis`` pass this shard's slice
+    (sharded exactly like ``tokens``) — ring attention rotates the
+    K-side ids with the K/V blocks, Ulysses all-gathers them (int32 per
+    token) after its head scatter.
     """
-    if segment_ids is not None and seq_axis is not None:
-        raise ValueError(
-            "segment_ids packing is not implemented for the "
-            "sequence-parallel attention routes; use attention='local' "
-            "or 'flash' without a seq axis")
     dt = cfg.dtype
     t_local = tokens.shape[1]
     pos_offset = (lax.axis_index(seq_axis) * t_local) if seq_axis else 0
@@ -176,9 +172,11 @@ def forward(params, tokens, cfg: TransformerConfig,
         b, t = q.shape[:2]
         if seq_axis is not None:
             if attention == "ring":
-                o = seq_mod.ring_attention(q, k, v, seq_axis, causal=True)
+                o = seq_mod.ring_attention(q, k, v, seq_axis, causal=True,
+                                           segment_ids=segment_ids)
             elif attention == "ulysses":
-                o = seq_mod.ulysses_attention(q, k, v, seq_axis, causal=True)
+                o = seq_mod.ulysses_attention(q, k, v, seq_axis, causal=True,
+                                              segment_ids=segment_ids)
             else:
                 # The flash kernel is single-device attention; under
                 # sequence parallelism K/V blocks arrive over ICI and the
@@ -230,8 +228,8 @@ def make_train_step(cfg: TransformerConfig, optimizer, mesh,
     (params, opt_state, loss)`` plus the param spec tree (for placing
     params with ``jax.device_put``).  ``packed=True`` adds a trailing
     ``segment_ids`` argument ([B, T] int32, sharded like tokens) so
-    sequence packing reaches the jitted step (local/flash attention
-    only; see :func:`forward`).
+    sequence packing reaches the jitted step on every attention route,
+    including the sequence-parallel ones (see :func:`forward`).
     """
     from horovod_tpu.ops.fusion import fused_pytree_mean
 
@@ -414,19 +412,25 @@ def forward_pipelined(params, stacked_layers, tokens,
     """
     from horovod_tpu.parallel.pipeline import pipeline_apply
 
-    dt = cfg.dtype
+    b, t = tokens.shape
+    mb = _embed_microbatches(params, tokens, cfg, n_microbatches)
+    y = pipeline_apply(_pipe_stage_fn(cfg), stacked_layers, mb,
+                       axis_name=pipe_axis)
+    x = y.reshape(b, t, cfg.d_model)
+    return _logits_head(x, params, cfg.dtype)
+
+
+def _embed_microbatches(base, tokens, cfg: TransformerConfig,
+                        n_microbatches: int):
+    """Embedding prologue shared by both pipeline schedules:
+    tokens [B, T] -> microbatched activations [M, B/M, T, D]."""
     b, t = tokens.shape
     if b % n_microbatches:
         raise ValueError(f"batch {b} not divisible by "
                          f"{n_microbatches} microbatches")
-    x = (params["embed"][tokens] +
-         params["pos"][None, :t]).astype(dt)              # [B, T, D]
-    mb = x.reshape(n_microbatches, b // n_microbatches, t, cfg.d_model)
-
-    y = pipeline_apply(_pipe_stage_fn(cfg), stacked_layers, mb,
-                       axis_name=pipe_axis)
-    x = y.reshape(b, t, cfg.d_model)
-    return _logits_head(x, params, dt)
+    x = (base["embed"][tokens] +
+         base["pos"][None, :t]).astype(cfg.dtype)          # [B, T, D]
+    return x.reshape(n_microbatches, b // n_microbatches, t, cfg.d_model)
 
 
 def _pipe_stage_fn(cfg: TransformerConfig):
@@ -540,13 +544,7 @@ def make_train_step_pipelined(cfg: TransformerConfig, optimizer, mesh,
                 data_axes=(data_axis,) if data_axis else ())
             base = params["base"]
             b, t = tokens.shape
-            if b % n_microbatches:
-                raise ValueError(f"batch {b} not divisible by "
-                                 f"{n_microbatches} microbatches")
-            x = (base["embed"][tokens] +
-                 base["pos"][None, :t]).astype(cfg.dtype)
-            mb = x.reshape(n_microbatches, b // n_microbatches, t,
-                           cfg.d_model)
+            mb = _embed_microbatches(base, tokens, cfg, n_microbatches)
             tgt = labels.reshape(n_microbatches, b // n_microbatches, t)
             return f(params["stacked"], base, mb, tgt)
     elif schedule == "gpipe":

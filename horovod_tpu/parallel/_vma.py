@@ -1,0 +1,35 @@
+"""Shared varying-manual-axes (vma) helpers for shard_map scan carries.
+
+A scan carry's vma type must be stable across iterations: after one step
+the online state varies over every axis the inputs vary over, so initial
+zeros must be pcast up to the union of the inputs' vma sets.  Kept in one
+place because the probe (jax.typeof) and the no-mesh fallback are
+JAX-version-sensitive.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def vma_of(x) -> set:
+    """The value's varying-manual-axes set ({} outside shard_map)."""
+    try:
+        return set(jax.typeof(x).vma)
+    except AttributeError:   # outside shard_map / old tracer
+        return set()
+
+
+def pin_to(target: set):
+    """Returns f(x) that pcasts ``x`` up to vary over ``target`` (no-op on
+    axes it already varies over; tolerant of running without a mesh)."""
+    def _pin(x):
+        missing = tuple(sorted(target - vma_of(x)))
+        if not missing:
+            return x
+        try:
+            return lax.pcast(x, missing, to="varying")
+        except ValueError:   # no surrounding mesh context (vma untracked)
+            return x
+    return _pin

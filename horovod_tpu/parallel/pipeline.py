@@ -69,24 +69,12 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
     # Carry is varying over the pipe axis from tick 1 on — and over every
     # axis the inputs vary over (e.g. 'data' when composed with DP).  Pin
     # the union at init so the scan carry type is stable across iterations.
-    def _vma(v):
-        try:
-            return set(jax.typeof(v).vma)
-        except AttributeError:
-            return set()
+    from horovod_tpu.parallel._vma import pin_to, vma_of
 
-    target = {axis_name} | _vma(microbatches)
+    target = {axis_name} | vma_of(microbatches)
     for leaf in jax.tree_util.tree_leaves(stage_params):
-        target |= _vma(leaf)
-
-    def _pin(v):
-        missing = tuple(sorted(target - _vma(v)))
-        if not missing:
-            return v
-        try:
-            return lax.pcast(v, missing, to="varying")
-        except ValueError:  # no surrounding mesh context
-            return v
+        target |= vma_of(leaf)
+    _pin = pin_to(target)
 
     init = (_pin(jnp.zeros(mb_shape, microbatches.dtype)),
             _pin(jnp.zeros((m,) + mb_shape, microbatches.dtype)))
@@ -150,24 +138,12 @@ def pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params, aux,
     right_perm = [(i, (i + 1) % size) for i in range(size)]
     left_perm = [(i, (i - 1) % size) for i in range(size)]
 
-    def _vma(v):
-        try:
-            return set(jax.typeof(v).vma)
-        except AttributeError:
-            return set()
+    from horovod_tpu.parallel._vma import pin_to, vma_of
 
-    target_vma = {axis_name} | _vma(microbatches) | _vma(targets)
+    target_vma = {axis_name} | vma_of(microbatches) | vma_of(targets)
     for leaf in jax.tree_util.tree_leaves((stage_params, aux)):
-        target_vma |= _vma(leaf)
-
-    def _pin(v):
-        missing = tuple(sorted(target_vma - _vma(v)))
-        if not missing:
-            return v
-        try:
-            return lax.pcast(v, missing, to="varying")
-        except ValueError:
-            return v
+        target_vma |= vma_of(leaf)
+    _pin = pin_to(target_vma)
 
     zeros_like_pinned = lambda t: jax.tree_util.tree_map(
         lambda l: _pin(jnp.zeros(l.shape, l.dtype)), t)

@@ -27,12 +27,14 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _block_attention(q, k, v, m, l, o, *, q_offset, k_offset, causal, scale):
+def _block_attention(q, k, v, m, l, o, *, q_offset, k_offset, causal, scale,
+                     q_seg=None, k_seg=None):
     """One q-block x k-block update of the online-softmax state.
 
     q: [B, Tq, H, D]; k, v: [B, Tk, H, D]
     m, l: [B, H, Tq] running max / denominator; o: [B, Tq, H, D] running
-    numerator.  Returns updated (m, l, o).
+    numerator.  ``q_seg``/``k_seg`` ([B, Tq]/[B, Tk]) mask cross-segment
+    pairs for sequence packing.  Returns updated (m, l, o).
     """
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # [B, H, Tq, Tk]
     if causal:
@@ -40,6 +42,9 @@ def _block_attention(q, k, v, m, l, o, *, q_offset, k_offset, causal, scale):
         qpos = q_offset + jnp.arange(tq)[:, None]
         kpos = k_offset + jnp.arange(tk)[None, :]
         s = jnp.where(qpos >= kpos, s, -jnp.inf)
+    if q_seg is not None:
+        s = jnp.where(q_seg[:, None, :, None] == k_seg[:, None, None, :],
+                      s, -jnp.inf)
     m_blk = jnp.max(s, axis=-1)                       # [B, H, Tq]
     m_new = jnp.maximum(m, m_blk)
     # Guard fully-masked rows: exp(-inf - -inf) -> nan without the select.
@@ -55,13 +60,21 @@ def _block_attention(q, k, v, m, l, o, *, q_offset, k_offset, causal, scale):
 
 
 def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None, segment_ids=None):
     """Exact attention over a sequence sharded across ``axis_name``.
 
     q/k/v: [B, T_local, H, D] (this shard's chunk).  K/V blocks rotate
     around the ring via ``ppermute`` while each device accumulates its
     queries' online softmax; after axis_size steps every query has seen
     every key.  Returns [B, T_local, H, D].
+
+    ``segment_ids`` ([B, T_local] int32, THIS shard's slice of the global
+    packing layout) restricts attention to same-segment pairs: the K-side
+    ids rotate around the ring with their K/V block, and the block mask is
+    segment equality — the same composition the flash kernel uses.  The
+    online-softmax state already tolerates fully-masked blocks (m stays
+    -inf, l stays 0), so segments that live entirely on other shards cost
+    only the masked matmul.
     """
     size = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -76,50 +89,52 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
     # state varies over EVERY axis q/k/v vary over (e.g. 'model' too when
     # composed with tensor parallelism), not just the ring axis.  Pcast the
     # initial zeros up to the union of the inputs' vma sets.
-    def _vma(x):
-        try:
-            return set(jax.typeof(x).vma)
-        except AttributeError:  # outside shard_map / old tracer
-            return set()
-
-    target = _vma(q) | _vma(k) | _vma(v) | {axis_name}
-
-    def _match_vma(x):
-        missing = tuple(sorted(target - _vma(x)))
-        if not missing:
-            return x
-        try:
-            return lax.pcast(x, missing, to="varying")
-        except ValueError:  # no surrounding mesh context (vma untracked)
-            return x
+    from horovod_tpu.parallel._vma import pin_to, vma_of
+    _match_vma = pin_to(vma_of(q) | vma_of(k) | vma_of(v) | {axis_name})
 
     m, l, o = _match_vma(m), _match_vma(l), _match_vma(o)
     q_offset = idx * t
+    perm = [(i, (i + 1) % size) for i in range(size)]
 
     def step(carry, s):
-        m, l, o, k_blk, v_blk = carry
+        if segment_ids is None:
+            m, l, o, k_blk, v_blk = carry
+            k_seg = None
+        else:
+            m, l, o, k_blk, v_blk, k_seg = carry
         # Block s arrived from rank (idx - s) mod size.
         k_offset = ((idx - s) % size) * t
         m, l, o = _block_attention(q, k_blk, v_blk, m, l, o,
                                    q_offset=q_offset, k_offset=k_offset,
-                                   causal=causal, scale=scale)
-        # Rotate K/V to the right neighbor (ICI ring).
-        perm = [(i, (i + 1) % size) for i in range(size)]
+                                   causal=causal, scale=scale,
+                                   q_seg=segment_ids, k_seg=k_seg)
+        # Rotate K/V (and their segment ids) to the right neighbor (ICI).
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
-        return (m, l, o, k_blk, v_blk), None
+        if segment_ids is None:
+            return (m, l, o, k_blk, v_blk), None
+        k_seg = lax.ppermute(k_seg, axis_name, perm)
+        return (m, l, o, k_blk, v_blk, k_seg), None
 
-    (m, l, o, _, _), _ = lax.scan(step, (m, l, o, k, v), jnp.arange(size))
+    init = ((m, l, o, k, v) if segment_ids is None
+            else (m, l, o, k, v, segment_ids))
+    out = lax.scan(step, init, jnp.arange(size))[0]
+    m, l, o = out[0], out[1], out[2]
     denom = jnp.where(l == 0.0, 1.0, l).transpose(0, 2, 1)[..., None]
     return o / denom
 
 
 def ulysses_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
-                      scale: Optional[float] = None):
+                      scale: Optional[float] = None, segment_ids=None):
     """DeepSpeed-Ulysses: all-to-all from sequence-sharded to head-sharded,
     full local attention, all-to-all back.  Heads must divide axis size.
 
     q/k/v: [B, T_local, H, D] -> returns [B, T_local, H, D].
+
+    ``segment_ids`` ([B, T_local], this shard's slice) enables sequence
+    packing: after the all-to-all each device holds the FULL sequence for
+    its head subset, so the ids are all-gathered over the seq axis once
+    (tiny: int32 per token) and applied as a dense segment-equality mask.
     """
     size = lax.axis_size(axis_name)
     b, t, h, d = q.shape
@@ -139,11 +154,24 @@ def ulysses_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
     qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
     scale_ = (d ** -0.5) if scale is None else scale
     s = jnp.einsum("bqhd,bkhd->bhqk", qg, kg) * scale_
+    tg = qg.shape[1]
+    allowed = None
     if causal:
-        tg = qg.shape[1]
-        mask = jnp.tril(jnp.ones((tg, tg), bool))
-        s = jnp.where(mask[None, None], s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
+        allowed = jnp.tril(jnp.ones((tg, tg), bool))[None, None]
+    if segment_ids is not None:
+        seg_g = lax.all_gather(segment_ids, axis_name, axis=1, tiled=True)
+        seg_ok = seg_g[:, None, :, None] == seg_g[:, None, None, :]
+        allowed = seg_ok if allowed is None else (allowed & seg_ok)
+    if allowed is not None:
+        s = jnp.where(allowed, s, -jnp.inf)
+    if segment_ids is not None:
+        # Pre-softmax guard for fully-masked rows: zeros with zero
+        # gradients (see local_attention).
+        row_valid = allowed.any(axis=-1, keepdims=True)
+        s = jnp.where(row_valid, s, 0.0)
+        p = jnp.where(row_valid, jax.nn.softmax(s, axis=-1), 0.0)
+    else:
+        p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", p, vg)
     return gather_heads(out)
 
@@ -160,15 +188,24 @@ def local_attention(q, k, v, causal: bool = True,
     scale = (d ** -0.5) if scale is None else scale
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     t = q.shape[1]
+    allowed = None
     if causal:
-        s = jnp.where(jnp.tril(jnp.ones((t, t), bool))[None, None], s,
-                      -jnp.inf)
+        allowed = jnp.tril(jnp.ones((t, t), bool))[None, None]
     if segment_ids is not None:
-        s = jnp.where(segment_ids[:, None, :, None] ==
-                      segment_ids[:, None, None, :], s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
+        seg_ok = (segment_ids[:, None, :, None] ==
+                  segment_ids[:, None, None, :])
+        allowed = seg_ok if allowed is None else (allowed & seg_ok)
+    if allowed is not None:
+        s = jnp.where(allowed, s, -jnp.inf)
     if segment_ids is not None:
         # Fully-masked rows (possible only with exotic segment layouts
-        # under causal=False) contribute zeros rather than NaN.
-        p = jnp.where(jnp.isnan(p), 0.0, p)
+        # under causal=False) must yield zeros with zero GRADIENTS: guard
+        # BEFORE the softmax (softmax of an all -inf row is NaN in both
+        # forward and backward; a post-hoc isnan patch fixes only the
+        # forward), matching the flash kernel's l==0 denominator handling.
+        row_valid = allowed.any(axis=-1, keepdims=True)   # [B,1,T,1]
+        s = jnp.where(row_valid, s, 0.0)
+        p = jnp.where(row_valid, jax.nn.softmax(s, axis=-1), 0.0)
+    else:
+        p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)

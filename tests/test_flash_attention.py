@@ -235,10 +235,9 @@ def test_transformer_packed_sequences():
     alone = tfm.forward(params, tokens[:, :24], cfg, attention="local")
     np.testing.assert_allclose(np.asarray(a[:, :24]), np.asarray(alone),
                                rtol=2e-4, atol=2e-4)
-
-    with pytest.raises(ValueError, match="sequence-parallel"):
-        tfm.forward(params, tokens, cfg, seq_axis="seq",
-                    attention="ring", segment_ids=seg)
+    # (The SP routes used to reject segment_ids; they are now supported —
+    # seq-sharded coverage lives in test_parallel.py and
+    # test_packed_train_step_seq_sharded below.)
 
 
 def test_packed_train_step(hvd, mesh8):
@@ -277,3 +276,51 @@ def test_packed_train_step(hvd, mesh8):
         losses.append(float(np.asarray(loss)))
     assert np.isfinite(losses).all(), losses
     assert losses[-1] < losses[0], losses
+
+
+def test_packed_train_step_seq_sharded(hvd):
+    """The two-packed-languages train step on a SEQ-SHARDED mesh
+    (ring attention): segment_ids reach the SP route and the step learns
+    both packed languages — previously rejected with ValueError."""
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.models import transformer as tfm
+    from horovod_tpu.topology import build_mesh
+
+    cfg = tfm.TransformerConfig(vocab_size=32, d_model=32, n_heads=2,
+                                d_ff=64, n_layers=1, max_seq=16,
+                                dtype=jnp.float32)
+    mesh = build_mesh(axes=("data", "seq"), shape=(2, 4))
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    opt = optax.adam(1e-2)
+    step, specs, opt_specs = tfm.make_train_step(
+        cfg, opt, mesh, data_axis="data", seq_axis="seq",
+        attention="ring", packed=True)
+    params = jax.device_put(params, jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs))
+    opt_state = jax.device_put(opt.init(params), jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), opt_specs,
+        is_leaf=lambda x: isinstance(x, P)))
+
+    # Two "languages" packed per row: segment 0 counts +1, segment 1
+    # counts +2 (mod 32).  Boundary at 8 (not on every 4-wide shard edge).
+    rng = np.random.default_rng(5)
+    sh = NamedSharding(mesh, P("data", "seq"))
+    seg = jax.device_put(jnp.asarray(np.concatenate(
+        [np.zeros(8), np.ones(8)]).astype(np.int32)[None].repeat(4, 0)),
+        sh)
+    losses = []
+    for _ in range(30):
+        s0 = rng.integers(0, 32, (4, 1))
+        s1 = rng.integers(0, 32, (4, 1))
+        a = (s0 + np.arange(9)) % 32          # +1 language, 9 tokens
+        b = (s1 + 2 * np.arange(9)) % 32      # +2 language, 9 tokens
+        toks = np.concatenate([a[:, :-1], b[:, :-1]], axis=1)
+        labs = np.concatenate([a[:, 1:], b[:, 1:]], axis=1)
+        toks = jax.device_put(jnp.asarray(toks, jnp.int32), sh)
+        labs = jax.device_put(jnp.asarray(labs, jnp.int32), sh)
+        params, opt_state, loss = step(params, opt_state, toks, labs, seg)
+        losses.append(float(np.asarray(loss)))
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < 0.7 * losses[0], (losses[0], losses[-1])
