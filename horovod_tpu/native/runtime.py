@@ -204,43 +204,83 @@ class Runtime:
             out = out.reshape((int(n) // inner,) + tuple(trailing_shape))
         return (out, received) if read_splits else out
 
-    def allreduce(self, name: str, arr: np.ndarray, op_code: int,
-                  set_id: int = 0) -> np.ndarray:
+    # -- split submit/finish surface (true async: submit is the native
+    #    enqueue and returns immediately; finish blocks in hvd_wait, which
+    #    releases the GIL.  The TF graph binding rides this so N tensors
+    #    negotiate concurrently with zero extra Python threads). ---------
+
+    def allreduce_submit(self, name, arr, op_code, set_id=0):
         arr = np.asarray(arr)
         h = self._submit(0, name, arr, op_code, set_id=set_id)
-        return self._wait_read(h, arr.dtype, arr.shape[1:]).reshape(arr.shape)
+        return (h, arr.dtype, arr.shape)
 
-    def allgather(self, name: str, arr: np.ndarray,
-                  set_id: int = 0) -> np.ndarray:
+    def allreduce_finish(self, tok):
+        h, dtype, shape = tok
+        return self._wait_read(h, dtype, shape[1:]).reshape(shape)
+
+    def allgather_submit(self, name, arr, set_id=0):
         arr = np.asarray(arr)
         if arr.ndim == 0:
             arr = arr.reshape(1)
         h = self._submit(1, name, arr, set_id=set_id)
-        return self._wait_read(h, arr.dtype, arr.shape[1:])
+        return (h, arr.dtype, arr.shape)
+
+    def allgather_finish(self, tok):
+        h, dtype, shape = tok
+        return self._wait_read(h, dtype, shape[1:])
+
+    def broadcast_submit(self, name, arr, root, set_id=0):
+        arr = np.asarray(arr)
+        h = self._submit(2, name, arr, root, set_id=set_id)
+        return (h, arr.dtype, arr.shape)
+
+    broadcast_finish = allreduce_finish
+
+    def alltoall_submit(self, name, arr, splits=None, set_id=0):
+        arr = np.asarray(arr)
+        if arr.ndim == 0:
+            arr = arr.reshape(1)
+        h = self._submit(3, name, arr, 0, splits=splits, set_id=set_id)
+        return (h, arr.dtype, arr.shape)
+
+    def alltoall_finish(self, tok):
+        h, dtype, shape = tok
+        return self._wait_read(h, dtype, shape[1:], read_splits=True)
+
+    def reducescatter_submit(self, name, arr, op_code, set_id=0):
+        arr = np.asarray(arr)
+        h = self._submit(4, name, arr, op_code, set_id=set_id)
+        return (h, arr.dtype, arr.shape)
+
+    reducescatter_finish = allgather_finish
+
+    def allreduce(self, name: str, arr: np.ndarray, op_code: int,
+                  set_id: int = 0) -> np.ndarray:
+        return self.allreduce_finish(
+            self.allreduce_submit(name, arr, op_code, set_id))
+
+    def allgather(self, name: str, arr: np.ndarray,
+                  set_id: int = 0) -> np.ndarray:
+        return self.allgather_finish(
+            self.allgather_submit(name, arr, set_id=set_id))
 
     def broadcast(self, name: str, arr: np.ndarray, root: int,
                   set_id: int = 0) -> np.ndarray:
-        arr = np.asarray(arr)
-        h = self._submit(2, name, arr, root, set_id=set_id)
-        return self._wait_read(h, arr.dtype, arr.shape[1:]).reshape(arr.shape)
+        return self.broadcast_finish(
+            self.broadcast_submit(name, arr, root, set_id=set_id))
 
     def alltoall(self, name: str, arr: np.ndarray,
                  splits: Optional[np.ndarray] = None, set_id: int = 0):
         """Returns ``(output, received_splits)`` — the concatenated blocks
         and the dim-0 row count received from each source (position within
         the process set; parity with later-Horovod received_splits)."""
-        arr = np.asarray(arr)
-        if arr.ndim == 0:
-            arr = arr.reshape(1)
-        h = self._submit(3, name, arr, 0, splits=splits, set_id=set_id)
-        return self._wait_read(h, arr.dtype, arr.shape[1:],
-                               read_splits=True)
+        return self.alltoall_finish(
+            self.alltoall_submit(name, arr, splits, set_id=set_id))
 
     def reducescatter(self, name: str, arr: np.ndarray, op_code: int,
                       set_id: int = 0) -> np.ndarray:
-        arr = np.asarray(arr)
-        h = self._submit(4, name, arr, op_code, set_id=set_id)
-        return self._wait_read(h, arr.dtype, arr.shape[1:])
+        return self.reducescatter_finish(
+            self.reducescatter_submit(name, arr, op_code, set_id=set_id))
 
     def barrier(self, name: str = "hvd.barrier", set_id: int = 0) -> None:
         """Native barrier: the negotiation round IS the barrier (all

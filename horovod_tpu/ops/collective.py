@@ -331,6 +331,97 @@ def _eager_allreduce(x, op: ReduceOp, name: str, prescale_factor,
     return out
 
 
+# --- split submit/finish pairs (graph-async bindings: submit is the
+#     non-blocking native enqueue; finish blocks in hvd_wait.  The token
+#     is (native_token_or_None, fallback_result)). -------------------------
+
+def _eager_allreduce_submit(x, op: ReduceOp, name: str, prescale_factor,
+                            set_id=0):
+    rt = basics.runtime()
+    arr = np.asarray(x)
+    if prescale_factor != 1.0:
+        arr = arr * prescale_factor
+    if rt is None:
+        return (None, arr.copy())
+    return (rt.allreduce_submit(name, arr, op.code, set_id=set_id), None)
+
+
+def _eager_allreduce_finish(tok, op: ReduceOp, postscale_factor,
+                            set_size=None):
+    native, done = tok
+    out = done if native is None else basics.runtime().allreduce_finish(
+        native)
+    if op is Average or op is Adasum:
+        out = out / (set_size if set_size else basics.size())
+    if postscale_factor != 1.0:
+        out = out * postscale_factor
+    return out
+
+
+def _eager_allgather_submit(x, name: str, set_id=0):
+    rt = basics.runtime()
+    arr = np.asarray(x)
+    if rt is None:
+        return (None, arr.copy())
+    return (rt.allgather_submit(name, arr, set_id=set_id), None)
+
+
+def _eager_allgather_finish(tok):
+    native, done = tok
+    return done if native is None else basics.runtime().allgather_finish(
+        native)
+
+
+def _eager_broadcast_submit(x, root_rank: int, name: str, set_id=0):
+    rt = basics.runtime()
+    arr = np.asarray(x)
+    if rt is None:
+        if root_rank != 0:
+            raise ValueError(
+                f"broadcast root_rank {root_rank} out of range for size 1")
+        return (None, arr.copy())
+    return (rt.broadcast_submit(name, arr, root_rank, set_id=set_id), None)
+
+
+def _eager_broadcast_finish(tok):
+    native, done = tok
+    return done if native is None else basics.runtime().broadcast_finish(
+        native)
+
+
+def _eager_alltoall_submit(x, splits, name: str, set_id=0):
+    rt = basics.runtime()
+    if rt is None:
+        return (None, _eager_alltoall(x, splits, name, set_id=set_id))
+    arr = np.asarray(x)
+    return (rt.alltoall_submit(name, arr, splits, set_id=set_id), None)
+
+
+def _eager_alltoall_finish(tok):
+    """Returns (output, received_splits)."""
+    native, done = tok
+    return done if native is None else basics.runtime().alltoall_finish(
+        native)
+
+
+def _eager_reducescatter_submit(x, op: ReduceOp, name: str, set_id=0):
+    rt = basics.runtime()
+    arr = np.asarray(x)
+    if rt is None:
+        return (None, arr.copy())
+    return (rt.reducescatter_submit(name, arr, op.code, set_id=set_id),
+            None)
+
+
+def _eager_reducescatter_finish(tok, op: ReduceOp, set_size=None):
+    native, done = tok
+    out = (done if native is None
+           else basics.runtime().reducescatter_finish(native))
+    if op is Average:
+        out = out / (set_size or basics.size())
+    return out
+
+
 def _eager_allgather(x, name: str, set_id=0):
     rt = basics.runtime()
     arr = np.asarray(x)

@@ -159,3 +159,45 @@ def test_tf_alltoall_uneven_splits(tfhvd, rank, size):
     assert np.array_equal(received2.numpy(), np.full(size, rank + 1))
     assert out2.shape[0] == (rank + 1) * size
     del splits
+
+
+def test_grouped_allreduce(tfhvd, rank, size):
+    """grouped_allreduce averages every tensor in the group — the async
+    enqueue + single sync-barrier path the gradient wrappers use."""
+    hvd = tfhvd
+    ts = [tf.constant(np.full((3, 2), float(rank + 1) * (i + 1),
+                              np.float32)) for i in range(5)]
+    outs = hvd.grouped_allreduce(ts, average=True, name="grp.eager")
+    want_base = np.mean([r + 1 for r in range(size)])
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(o.numpy(), np.full((3, 2),
+                                   want_base * (i + 1), np.float32),
+                                   rtol=1e-6)
+
+
+def test_grouped_allreduce_graph_and_grad(tfhvd, rank, size):
+    """Graph-mode grouped allreduce: values AND gradients (the gradient
+    of a group is a grouped sum-allreduce of the upstream gradients)."""
+    hvd = tfhvd
+    vs = [tf.Variable(np.full((2, 2), float(rank + 1) * (i + 1),
+                              np.float32)) for i in range(4)]
+
+    @tf.function
+    def run():
+        with tf.GradientTape() as tape:
+            outs = hvd.grouped_allreduce([v * 1.0 for v in vs],
+                                         average=True, name="grp.graph")
+            loss = tf.add_n([tf.reduce_sum(o) for o in outs])
+        return outs, tape.gradient(loss, vs)
+
+    outs, grads = run()
+    want_base = np.mean([r + 1 for r in range(size)])
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(o.numpy(), np.full((2, 2),
+                                   want_base * (i + 1), np.float32),
+                                   rtol=1e-6)
+    # d(loss)/d(v) = allreduce-sum(ones)/size... average's local divide
+    # makes each rank's grad = ones * size / size = ones.
+    for g in grads:
+        np.testing.assert_allclose(g.numpy(), np.ones((2, 2), np.float32),
+                                   rtol=1e-6)
