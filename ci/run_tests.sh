@@ -19,4 +19,10 @@ JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
   python -m horovod_tpu.runner -np 2 \
   python -m pytest tests/distributed -x -q
 
+echo "--- hierarchical allreduce correctness (4 ranks, 2x2 simulated hosts)"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  HOROVOD_HIERARCHICAL_ALLREDUCE=1 HOROVOD_HIERARCHICAL_ALLREDUCE_THRESHOLD=0 \
+  python -m horovod_tpu.runner -np 4 \
+  python tests/distributed/hier_check_np4.py
+
 echo "CI OK"

@@ -117,16 +117,37 @@ def bench_fusion(n_tensors=64, tensor_bytes=64 << 10, iters=10):
 
 
 def main():
+    import os
     p = argparse.ArgumentParser(description="Eager allreduce bandwidth")
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--max-mb", type=int, default=64)
+    p.add_argument("--simulate-hosts", type=int, default=0,
+                   help="pretend the N ranks are spread over this many "
+                        "hosts (rewrites HOROVOD_LOCAL_RANK/SIZE before "
+                        "init) — pair with "
+                        "HOROVOD_HIERARCHICAL_ALLREDUCE=1 to exercise the "
+                        "2-level path on one machine")
     args = p.parse_args()
+
+    if args.simulate_hosts > 1:
+        if "HOROVOD_RANK" not in os.environ:
+            raise SystemExit("run under the launcher: hvdrun -np N ...")
+        rank = int(os.environ["HOROVOD_RANK"])
+        size = int(os.environ["HOROVOD_SIZE"])
+        if size % args.simulate_hosts:
+            raise SystemExit("--simulate-hosts must divide world size")
+        ls = size // args.simulate_hosts
+        os.environ["HOROVOD_LOCAL_SIZE"] = str(ls)
+        os.environ["HOROVOD_LOCAL_RANK"] = str(rank % ls)
 
     hvd.init()
     if hvd.size() < 2:
         raise SystemExit("run under the launcher: hvdrun -np 2 ...")
 
-    results = {"size": hvd.size()}
+    results = {"size": hvd.size(),
+               "local_size": hvd.local_size(),
+               "hierarchical": os.environ.get(
+                   "HOROVOD_HIERARCHICAL_ALLREDUCE", "0")}
     if hvd.rank() == 0:
         results["loopback_GBs"] = loopback_baseline()
 

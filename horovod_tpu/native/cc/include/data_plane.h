@@ -45,7 +45,23 @@ class DataPlane {
   // sockets.  Position-indexed arguments (counts, splits) are indexed by
   // group POSITION, which equals global rank for the default group.
 
-  // In-place ring allreduce over buf (count elements).
+  // LOCAL/CROSS topology for the 2-level allreduce (reference
+  // NCCLHierarchicalAllreduce, nccl_operations.cc:151-346).  Applies only
+  // to the global group under the block rank mapping
+  // (rank = host*local_size + local_rank); other shapes fall back to the
+  // flat ring.
+  void SetTopology(int local_rank, int local_size, bool hierarchical,
+                   int64_t threshold_bytes) {
+    local_rank_ = local_rank;
+    local_size_ = local_size;
+    hier_enabled_ = hierarchical;
+    hier_threshold_ = threshold_bytes;
+  }
+
+  // In-place ring allreduce over buf (count elements).  Dispatches to the
+  // hierarchical path (intra-host reduce-scatter -> cross-host allreduce
+  // per chunk -> intra-host allgather) when SetTopology enabled it and
+  // the payload/topology qualify.
   Status Allreduce(void* buf, int64_t count, DataType dtype, ReduceOp op,
                    const std::vector<int32_t>& group = {});
   // Reduce across ranks, keep my dim-0 chunk: in has count elems,
@@ -80,8 +96,24 @@ class DataPlane {
   Status SendRecv(int send_peer, const void* sbuf, size_t sbytes,
                   int recv_peer, void* rbuf, size_t rbytes);
 
+  // The two halves of the ring (chunk layout = ChunkOffsets(count, n)):
+  // after the reduce-scatter phase, member at position p holds the full
+  // reduction of chunk (p+1)%n; the allgather phase circulates the
+  // finished chunks.  Shared by the flat and hierarchical paths.
+  Status RingReduceScatterPhase(const std::vector<int32_t>& group,
+                                void* buf, int64_t count, DataType dtype,
+                                ReduceOp op);
+  Status RingAllgatherPhase(const std::vector<int32_t>& group, void* buf,
+                            int64_t count, DataType dtype);
+  Status HierarchicalAllreduce(void* buf, int64_t count, DataType dtype,
+                               ReduceOp op);
+
   int rank_ = 0;
   int size_ = 1;
+  int local_rank_ = 0;
+  int local_size_ = 1;
+  bool hier_enabled_ = false;
+  int64_t hier_threshold_ = 0;
   TcpSocket listener_;
   std::vector<std::unique_ptr<TcpSocket>> peers_;  // [size], self = null
 };

@@ -386,51 +386,138 @@ Status MakeView(const std::vector<int32_t>& group, int my_rank,
 
 }  // namespace
 
-Status DataPlane::Allreduce(void* buf, int64_t count, DataType dtype,
-                            ReduceOp op,
-                            const std::vector<int32_t>& group) {
-  GroupView v;
-  Status gs = MakeView(group, rank_, size_, &v);
-  if (!gs.ok()) return gs;
-  if (v.size == 1) return Status::OK();
-  const size_t esz = DataTypeSize(dtype);
-  auto off = ChunkOffsets(count, v.size);
-  auto bytes_of = [&](int c) {
-    return static_cast<size_t>(off[c + 1] - off[c]) * esz;
-  };
-  auto ptr_of = [&](int c) {
-    return static_cast<char*>(buf) + static_cast<size_t>(off[c]) * esz;
-  };
-  const int right = v.global_of((v.me + 1) % v.size);
-  const int left = v.global_of((v.me - 1 + v.size) % v.size);
-  int64_t max_chunk = 0;
-  for (int c = 0; c < v.size; ++c)
-    max_chunk = std::max(max_chunk, off[c + 1] - off[c]);
-  std::vector<char> scratch(static_cast<size_t>(max_chunk) * esz);
+namespace {
 
-  // Phase 1: ring reduce-scatter.  After size-1 steps, chunk (pos+1)%size
-  // holds the full reduction on this member.  The reduce stays OUTSIDE
-  // the exchange: folding it into the recv drain was measured slower —
-  // the single-threaded drain stops feeding the send direction while it
-  // reduces, stalling the stream for longer than the saved memory pass.
-  for (int s = 0; s < v.size - 1; ++s) {
-    int send_c = (v.me - s + v.size) % v.size;
-    int recv_c = (v.me - s - 1 + v.size) % v.size;
-    Status st = SendRecv(right, ptr_of(send_c), bytes_of(send_c),
-                         left, scratch.data(), bytes_of(recv_c));
-    if (!st.ok()) return st;
-    ReduceInto(ptr_of(recv_c), scratch.data(), off[recv_c + 1] - off[recv_c],
-               dtype, op);
+// Shared ring prologue: group view, chunk layout, neighbors.
+struct RingCtx {
+  GroupView v;
+  std::vector<int64_t> off;
+  size_t esz;
+  int left, right;
+  char* base;
+  size_t bytes_of(int c) const {
+    return static_cast<size_t>(off[c + 1] - off[c]) * esz;
   }
-  // Phase 2: ring allgather of the reduced chunks.
-  for (int s = 0; s < v.size - 1; ++s) {
-    int send_c = (v.me + 1 - s + v.size) % v.size;
-    int recv_c = (v.me - s + v.size) % v.size;
-    Status st = SendRecv(right, ptr_of(send_c), bytes_of(send_c),
-                         left, ptr_of(recv_c), bytes_of(recv_c));
+  char* ptr_of(int c) const {
+    return base + static_cast<size_t>(off[c]) * esz;
+  }
+};
+
+Status MakeRing(const std::vector<int32_t>& group, int rank, int size,
+                void* buf, int64_t count, DataType dtype, RingCtx* ctx) {
+  Status gs = MakeView(group, rank, size, &ctx->v);
+  if (!gs.ok()) return gs;
+  ctx->off = ChunkOffsets(count, ctx->v.size);
+  ctx->esz = DataTypeSize(dtype);
+  ctx->right = ctx->v.global_of((ctx->v.me + 1) % ctx->v.size);
+  ctx->left = ctx->v.global_of((ctx->v.me - 1 + ctx->v.size) % ctx->v.size);
+  ctx->base = static_cast<char*>(buf);
+  return Status::OK();
+}
+
+}  // namespace
+
+Status DataPlane::RingReduceScatterPhase(const std::vector<int32_t>& group,
+                                         void* buf, int64_t count,
+                                         DataType dtype, ReduceOp op) {
+  RingCtx c;
+  Status gs = MakeRing(group, rank_, size_, buf, count, dtype, &c);
+  if (!gs.ok()) return gs;
+  if (c.v.size == 1) return Status::OK();
+  int64_t max_chunk = 0;
+  for (int i = 0; i < c.v.size; ++i)
+    max_chunk = std::max(max_chunk, c.off[i + 1] - c.off[i]);
+  std::vector<char> scratch(static_cast<size_t>(max_chunk) * c.esz);
+
+  // Ring reduce-scatter: after size-1 steps, chunk (pos+1)%size holds the
+  // full reduction on this member.  The reduce stays OUTSIDE the
+  // exchange: folding it into the recv drain was measured slower — the
+  // single-threaded drain stops feeding the send direction while it
+  // reduces, stalling the stream for longer than the saved memory pass.
+  for (int s = 0; s < c.v.size - 1; ++s) {
+    int send_c = (c.v.me - s + c.v.size) % c.v.size;
+    int recv_c = (c.v.me - s - 1 + c.v.size) % c.v.size;
+    Status st = SendRecv(c.right, c.ptr_of(send_c), c.bytes_of(send_c),
+                         c.left, scratch.data(), c.bytes_of(recv_c));
+    if (!st.ok()) return st;
+    ReduceInto(c.ptr_of(recv_c), scratch.data(),
+               c.off[recv_c + 1] - c.off[recv_c], dtype, op);
+  }
+  return Status::OK();
+}
+
+Status DataPlane::RingAllgatherPhase(const std::vector<int32_t>& group,
+                                     void* buf, int64_t count,
+                                     DataType dtype) {
+  RingCtx c;
+  Status gs = MakeRing(group, rank_, size_, buf, count, dtype, &c);
+  if (!gs.ok()) return gs;
+  if (c.v.size == 1) return Status::OK();
+  for (int s = 0; s < c.v.size - 1; ++s) {
+    int send_c = (c.v.me + 1 - s + c.v.size) % c.v.size;
+    int recv_c = (c.v.me - s + c.v.size) % c.v.size;
+    Status st = SendRecv(c.right, c.ptr_of(send_c), c.bytes_of(send_c),
+                         c.left, c.ptr_of(recv_c), c.bytes_of(recv_c));
     if (!st.ok()) return st;
   }
   return Status::OK();
+}
+
+Status DataPlane::Allreduce(void* buf, int64_t count, DataType dtype,
+                            ReduceOp op,
+                            const std::vector<int32_t>& group) {
+  // 2-level path: global group only, over the threshold.  hier_enabled_
+  // is set ONLY after the bootstrap agreement check (operations.cc):
+  // every rank verified the same homogeneous block mapping, so this
+  // branch is taken identically on every rank.
+  if (group.empty() && hier_enabled_ &&
+      count * static_cast<int64_t>(DataTypeSize(dtype)) >= hier_threshold_)
+    return HierarchicalAllreduce(buf, count, dtype, op);
+  Status st = RingReduceScatterPhase(group, buf, count, dtype, op);
+  if (!st.ok()) return st;
+  return RingAllgatherPhase(group, buf, count, dtype);
+}
+
+// 2-level allreduce (reference NCCLHierarchicalAllreduce structure,
+// nccl_operations.cc:151-346: NCCL reduce-scatter on the host, MPI
+// allreduce across hosts, NCCL allgather on the host — here both levels
+// are TCP rings, but the cross-host leg moves each byte ONCE per host
+// instead of once per rank):
+//   A. intra-host ring reduce-scatter   (traffic: local links)
+//   B. cross-host ring allreduce of my finished chunk, among the ranks
+//      with the same local position on every host (all local ranks
+//      participate, each on its own 1/local_size slice — the bandwidth
+//      point of the design)
+//   C. intra-host ring allgather
+Status DataPlane::HierarchicalAllreduce(void* buf, int64_t count,
+                                        DataType dtype, ReduceOp op) {
+  const int host = rank_ / local_size_;
+  const int nhosts = size_ / local_size_;
+  std::vector<int32_t> local_group(local_size_);
+  for (int j = 0; j < local_size_; ++j)
+    local_group[j] = host * local_size_ + j;
+  std::vector<int32_t> cross_group(nhosts);
+  for (int h = 0; h < nhosts; ++h)
+    cross_group[h] = h * local_size_ + local_rank_;
+
+  Status st = RingReduceScatterPhase(local_group, buf, count, dtype, op);
+  if (!st.ok()) return st;
+
+  // My finished chunk under the local ring layout.
+  auto off = ChunkOffsets(count, local_size_);
+  const int done_c = (local_rank_ + 1) % local_size_;
+  const int64_t ccount = off[done_c + 1] - off[done_c];
+  if (ccount > 0) {
+    char* cptr = static_cast<char*>(buf) +
+                 static_cast<size_t>(off[done_c]) * DataTypeSize(dtype);
+    // Same chunk index on every host (same count) — a flat ring among
+    // the same-local-position ranks.
+    st = RingReduceScatterPhase(cross_group, cptr, ccount, dtype, op);
+    if (!st.ok()) return st;
+    st = RingAllgatherPhase(cross_group, cptr, ccount, dtype);
+    if (!st.ok()) return st;
+  }
+  return RingAllgatherPhase(local_group, buf, count, dtype);
 }
 
 Status DataPlane::Reducescatter(const void* in, void* out, int64_t count,

@@ -450,6 +450,40 @@ void BackgroundThread() {
                            &g->cache, &peers);
     if (s.ok() && g->size > 1)
       s = g->data_plane.Connect(g->rank, g->size, peers);
+    // 2-level allreduce over the LOCAL/CROSS topology (reference env knob
+    // HOROVOD_HIERARCHICAL_ALLREDUCE).  The enable decision must be
+    // IDENTICAL on every rank — a per-rank gate diverges on heterogeneous
+    // hosts or non-block rank mappings and a collective where members run
+    // different algorithms hangs — so each rank's local view is validated
+    // and then AGREED over two tiny (still-flat) allreduces: enable only
+    // if every rank sees a valid block mapping with the same local_size.
+    if (s.ok() && g->size > 1 &&
+        EnvBool("HOROVOD_HIERARCHICAL_ALLREDUCE", false)) {
+      int64_t ok = (g->local_size > 1 && g->size > g->local_size &&
+                    g->size % g->local_size == 0 &&
+                    g->local_rank == g->rank % g->local_size)
+                       ? g->local_size : 0;
+      int64_t mn = ok, mx = ok;
+      Status as = g->data_plane.Allreduce(&mn, 1, DataType::kInt64,
+                                          ReduceOp::kMin);
+      if (as.ok())
+        as = g->data_plane.Allreduce(&mx, 1, DataType::kInt64,
+                                     ReduceOp::kMax);
+      const bool enable = as.ok() && mn == mx && mn > 1;
+      if (enable) {
+        // Threshold default 256 KB: measured crossover on the loopback
+        // rig (docs/eager_performance.md) — below it the extra local
+        // phases cost more latency than the cross-link traffic saved.
+        g->data_plane.SetTopology(
+            g->local_rank, g->local_size, true,
+            EnvInt("HOROVOD_HIERARCHICAL_ALLREDUCE_THRESHOLD", 262144));
+      } else if (g->rank == 0) {
+        LOG(Warning) << "HOROVOD_HIERARCHICAL_ALLREDUCE requested but the "
+                        "topology is not a homogeneous block mapping "
+                        "(min/max local_size view " << mn << "/" << mx
+                     << "); using the flat ring";
+      }
+    }
   }
   g->timeline.Initialize(EnvStr("HOROVOD_TIMELINE"), g->rank);
   g->cycle_time_ms = EnvDouble("HOROVOD_CYCLE_TIME", 1.0);

@@ -1,0 +1,30 @@
+"""4-rank 2x2-simulated-host correctness check for the hierarchical
+allreduce (NOT pytest-collected: needs -np 4; ci/run_tests.sh runs it as
+  HOROVOD_HIERARCHICAL_ALLREDUCE=1 HOROVOD_HIERARCHICAL_ALLREDUCE_THRESHOLD=0 \
+  hvdrun -np 4 python tests/distributed/hier_check_np4.py
+Odd payload sizes exercise uneven ring chunks; bf16 exercises the
+software-rounded reduction kernels)."""
+import os
+import numpy as np
+rank = int(os.environ["HOROVOD_RANK"]); size = int(os.environ["HOROVOD_SIZE"])
+os.environ["HOROVOD_LOCAL_SIZE"] = str(size // 2)
+os.environ["HOROVOD_LOCAL_RANK"] = str(rank % (size // 2))
+import horovod_tpu as hvd
+hvd.init()
+rng = np.random.default_rng(rank)
+for n in (1, 7, 100_000, 1_000_003):   # odd sizes exercise uneven chunks
+    x = rng.standard_normal(n).astype(np.float32)
+    got = np.asarray(hvd.allreduce(x, average=False, name=f"chk.{n}"))
+    # oracle via allgather of inputs
+    allx = np.asarray(hvd.allgather(x[None], name=f"gin.{n}"))
+    want = allx.sum(axis=0)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+# bf16 path
+x16 = (np.ones(4097) * (rank + 1)).astype(np.float32)
+import jax.numpy as jnp
+got = np.asarray(hvd.allreduce(jnp.asarray(x16, jnp.bfloat16),
+                               average=False, name="chk.bf16"),
+                 dtype=np.float32)
+np.testing.assert_allclose(got, np.ones(4097) * 10.0, rtol=1e-2)
+if rank == 0:
+    print("hierarchical allreduce correctness OK")
