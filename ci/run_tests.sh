@@ -19,6 +19,11 @@ JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
   python -m horovod_tpu.runner -np 2 \
   python -m pytest tests/distributed -x -q
 
+echo "--- keras binding on the JAX backend (the TPU-native Keras 3 path)"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" KERAS_BACKEND=jax \
+  python -m horovod_tpu.runner -np 2 \
+  python -m pytest tests/distributed/test_keras_binding.py -x -q
+
 echo "--- hierarchical allreduce correctness (4 ranks, 2x2 simulated hosts)"
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
   HOROVOD_HIERARCHICAL_ALLREDUCE=1 HOROVOD_HIERARCHICAL_ALLREDUCE_THRESHOLD=0 \

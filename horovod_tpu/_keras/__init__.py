@@ -8,12 +8,19 @@ training path goes through is ``Optimizer.apply_gradients`` (both
 wrapper intercepts there: allreduce the gradients, then hand the averaged
 set to the wrapped class.
 
-Works with any Keras 3 backend: with the TensorFlow backend the allreduce
-rides ``horovod_tpu.tensorflow`` (py_function inside the traced train
-step); with the JAX backend Keras runs the step jitted and per-op
-collectives cannot be injected mid-graph, so wrapping raises with a
-pointer at the native JAX API (``horovod_tpu.DistributedOptimizer`` /
-``make_training_step``), which is the TPU-idiomatic path anyway.
+Works with either Keras 3 backend this image ships: with the TensorFlow
+backend the allreduce rides ``horovod_tpu.tensorflow`` (py_function
+inside the traced train step); with the JAX backend the allreduce is
+injected into the jitted train step with ``jax.experimental.io_callback``
+pairs — a non-blocking native enqueue per gradient (data-chained so every
+rank submits in the same order) and a blocking sync per gradient.  The
+chain makes the schedule deadlock-free: a rank blocked in sync_i has
+already enqueued 1..i, so the smallest-index blocked sync anywhere always
+has every rank's contribution and completes (same argument as the TF
+binding's enqueue chain).  For TPU-scale training prefer the native JAX
+API (``horovod_tpu.make_training_step``) — it lowers the averaging to
+XLA collectives instead of host callbacks; this wrapper is the
+drop-in-compatibility path.
 """
 
 from __future__ import annotations
@@ -28,13 +35,15 @@ def make_distributed_optimizer_class(keras, base_cls, name=None,
     ``from_config``, it can be registered as a Keras 3 custom object for
     ``load_model``."""
     backend = keras.backend.backend()
+    if backend == "jax":
+        # sparse_as_dense is a no-op on JAX (gradients arrive dense —
+        # there is no IndexedSlices analogue); compression is honored.
+        return _make_jax_distributed_class(keras, base_cls, name,
+                                           compression=compression)
     if backend != "tensorflow":
         raise ValueError(
             f"horovod_tpu.keras.DistributedOptimizer supports the "
-            f"TensorFlow Keras backend (got {backend!r}). For the JAX "
-            f"backend use the native API: horovod_tpu.DistributedOptimizer "
-            f"/ horovod_tpu.make_training_step, which jits collectives "
-            f"into the step instead of injecting them per-op.")
+            f"tensorflow and jax Keras backends (got {backend!r}).")
 
     import tensorflow as tf
     import horovod_tpu.tensorflow as hvd
@@ -64,6 +73,89 @@ def make_distributed_optimizer_class(keras, base_cls, name=None,
                 grads_and_vars = list(zip(avg, variables))
             return super(self.__class__, self).apply_gradients(
                 grads_and_vars, *args, **kwargs)
+
+    return type(base_cls.__name__, (base_cls,),
+                dict(_DistributedOptimizer.__dict__))
+
+
+def _make_jax_distributed_class(keras, base_cls, name=None,
+                                compression=None):
+    """JAX-backend distributed subclass: intercepts ``Optimizer.apply``
+    (the Keras-3 choke point both ``apply_gradients`` and the JAX
+    trainer's ``stateless_apply`` funnel through) and averages gradients
+    over the eager plane via io_callback pairs (module docstring).
+
+    ``compression`` (fp16/bf16 wire compression) is applied numpy-side
+    inside the enqueue callback; the decompression context rides the
+    token table to the matching sync callback."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import io_callback
+
+    from horovod_tpu import basics
+    from horovod_tpu.ops import collective as _c
+
+    if compression is None:
+        from horovod_tpu.ops.compression import Compression
+        compression = Compression.none
+
+    import threading
+    tokens: dict = {}
+    lock = threading.Lock()
+    counter = [0]
+
+    def _allreduce_all(grads, tag):
+        n = basics.size()
+        # int32 keys: x64 is disabled by default in JAX
+        chain = jnp.zeros((), jnp.int32)
+        keys = {}
+        for i, g in enumerate(grads):
+            if g is None:
+                continue
+
+            def enq(gv, _tok, nm=f"{tag}.grad.{i}"):
+                wire, ctx = compression.compress(np.asarray(gv))
+                tok = _c._eager_allreduce_submit(np.asarray(wire), _c.Sum,
+                                                 nm, 1.0)
+                with lock:
+                    key = counter[0]
+                    counter[0] += 1
+                    tokens[key] = (tok, ctx)
+                return np.int32(key)
+
+            chain = io_callback(
+                enq, jax.ShapeDtypeStruct((), jnp.int32), g, chain,
+                ordered=False)
+            keys[i] = chain
+
+        out = list(grads)
+        for i, key in keys.items():
+            g = grads[i]
+
+            def syn(k, _shape=g.shape, _dtype=g.dtype):
+                with lock:
+                    tok, ctx = tokens.pop(int(k))
+                o = _c._eager_allreduce_finish(tok, _c.Sum, 1.0)
+                o = compression.decompress(o, ctx)
+                return np.asarray(o).astype(_dtype).reshape(_shape)
+
+            summed = io_callback(
+                syn, jax.ShapeDtypeStruct(g.shape, g.dtype), key,
+                ordered=False)
+            out[i] = summed / n
+        return out
+
+    class _DistributedOptimizer(base_cls):
+        _hvd_wrapped = True
+
+        def apply(self, grads, trainable_variables=None):
+            grads = list(grads)
+            if basics.size() > 1 and grads:
+                tag = name or "Distributed%s" % self.__class__.__name__
+                grads = _allreduce_all(grads, tag)
+            return super(self.__class__, self).apply(
+                grads, trainable_variables)
 
     return type(base_cls.__name__, (base_cls,),
                 dict(_DistributedOptimizer.__dict__))

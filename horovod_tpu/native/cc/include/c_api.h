@@ -24,6 +24,8 @@ int hvd_rank();
 int hvd_size();
 int hvd_local_rank();
 int hvd_local_size();
+// 1 when the bootstrap agreement enabled the 2-level allreduce.
+int hvd_hierarchical_enabled();
 int hvd_is_initialized();
 
 // Enqueue a collective.  `shape` has `ndim` dims (scalar: ndim=0).

@@ -36,6 +36,7 @@ struct GlobalState {
   int size = 1;
   int local_rank = 0;
   int local_size = 1;
+  bool hierarchical_enabled = false;
   std::string rendezvous_addr;
   int rendezvous_port = 0;
 
@@ -457,9 +458,13 @@ void BackgroundThread() {
     // different algorithms hangs — so each rank's local view is validated
     // and then AGREED over two tiny (still-flat) allreduces: enable only
     // if every rank sees a valid block mapping with the same local_size.
-    if (s.ok() && g->size > 1 &&
-        EnvBool("HOROVOD_HIERARCHICAL_ALLREDUCE", false)) {
-      int64_t ok = (g->local_size > 1 && g->size > g->local_size &&
+    // EVERY rank runs the agreement unconditionally (a rank whose env
+    // lacks the flag contributes 0, disabling everywhere): gating the
+    // agreement itself on the per-rank env would desynchronize the
+    // bootstrap traffic when the flag is set on only some hosts.
+    if (s.ok() && g->size > 1) {
+      int64_t ok = (EnvBool("HOROVOD_HIERARCHICAL_ALLREDUCE", false) &&
+                    g->local_size > 1 && g->size > g->local_size &&
                     g->size % g->local_size == 0 &&
                     g->local_rank == g->rank % g->local_size)
                        ? g->local_size : 0;
@@ -477,12 +482,15 @@ void BackgroundThread() {
         g->data_plane.SetTopology(
             g->local_rank, g->local_size, true,
             EnvInt("HOROVOD_HIERARCHICAL_ALLREDUCE_THRESHOLD", 262144));
-      } else if (g->rank == 0) {
+      } else if (g->rank == 0 && mx > 0) {
+        // mx > 0: at least one rank requested it — worth a warning.
         LOG(Warning) << "HOROVOD_HIERARCHICAL_ALLREDUCE requested but the "
-                        "topology is not a homogeneous block mapping "
-                        "(min/max local_size view " << mn << "/" << mx
+                        "topology is not a homogeneous block mapping or "
+                        "the flag is not set on every rank (min/max "
+                        "local_size view " << mn << "/" << mx
                      << "); using the flat ring";
       }
+      g->hierarchical_enabled = enable;
     }
   }
   g->timeline.Initialize(EnvStr("HOROVOD_TIMELINE"), g->rank);
@@ -655,6 +663,9 @@ int hvd_rank() { return g ? g->rank : -1; }
 int hvd_size() { return g ? g->size : -1; }
 int hvd_local_rank() { return g ? g->local_rank : -1; }
 int hvd_local_size() { return g ? g->local_size : -1; }
+int hvd_hierarchical_enabled() {
+  return g && g->hierarchical_enabled ? 1 : 0;
+}
 int hvd_is_initialized() { return g && g->initialized.load() ? 1 : 0; }
 
 int64_t hvd_enqueue(int op_type, const char* name, const void* data,

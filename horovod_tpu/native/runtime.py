@@ -129,6 +129,7 @@ class Runtime:
         lib.hvd_last_error.argtypes = []
         lib.hvd_last_error.restype = ctypes.c_char_p
         addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR", "127.0.0.1")
+        self._hier_fn = getattr(lib, "hvd_hierarchical_enabled", None)
         port = int(os.environ.get("HOROVOD_RENDEZVOUS_PORT", "0"))
         rc = lib.hvd_init(self.rank, self.size, self.local_rank,
                           self.local_size, addr.encode(), port)
@@ -142,6 +143,11 @@ class Runtime:
         if self._lib is not None:
             self._lib.hvd_shutdown()
             self._lib = None
+
+    def hierarchical_enabled(self) -> bool:
+        """True when the bootstrap agreement enabled the 2-level
+        allreduce (tests/CI assert the path under test is engaged)."""
+        return bool(self._hier_fn and self._hier_fn())
 
     # -- collectives -------------------------------------------------------
 

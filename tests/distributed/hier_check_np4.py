@@ -11,6 +11,12 @@ os.environ["HOROVOD_LOCAL_SIZE"] = str(size // 2)
 os.environ["HOROVOD_LOCAL_RANK"] = str(rank % (size // 2))
 import horovod_tpu as hvd
 hvd.init()
+from horovod_tpu import basics
+# The point of this gate is the 2-LEVEL path; if the bootstrap agreement
+# regressed to the flat ring, correct sums would still pass — fail loudly
+# instead.
+assert basics.runtime().hierarchical_enabled(), \
+    "hierarchical allreduce did not engage (agreement rejected topology?)"
 rng = np.random.default_rng(rank)
 for n in (1, 7, 100_000, 1_000_003):   # odd sizes exercise uneven chunks
     x = rng.standard_normal(n).astype(np.float32)

@@ -1,5 +1,10 @@
 """Keras binding tests (reference test/test_keras.py:48-173), rank-aware —
-run standalone (size 1) or under ``hvdrun -np N``."""
+run standalone (size 1) or under ``hvdrun -np N``.
+
+Backend-parametrized by environment: the suite runs as-is under BOTH
+``KERAS_BACKEND=tensorflow`` and ``KERAS_BACKEND=jax`` (ci/run_tests.sh
+runs the jax pass explicitly; the backend is fixed per process, so the
+two passes are separate pytest invocations)."""
 
 import os
 
@@ -7,10 +12,11 @@ import numpy as np
 import pytest
 
 keras = pytest.importorskip("keras")
-tf = pytest.importorskip("tensorflow")
 
-if keras.backend.backend() != "tensorflow":
-    pytest.skip("keras TF backend required", allow_module_level=True)
+BACKEND = keras.backend.backend()
+if BACKEND not in ("tensorflow", "jax"):
+    pytest.skip(f"unsupported keras backend {BACKEND}",
+                allow_module_level=True)
 
 
 @pytest.fixture(scope="session")
