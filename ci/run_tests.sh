@@ -30,4 +30,32 @@ JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
   python -m horovod_tpu.runner -np 4 \
   python tests/distributed/hier_check_np4.py
 
+echo "--- stalled-cached-tensor watchdog (2 ranks)"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  python -m horovod_tpu.runner -np 2 \
+  python tests/distributed/stall_check_np2.py
+
+echo "--- TSAN build + smoke (races inside libhorovod_tpu.so fail CI)"
+make -C horovod_tpu/native/cc tsan
+rm -f /tmp/tsan_ci.*
+LD_PRELOAD="$(g++ -print-file-name=libtsan.so)" \
+  TSAN_OPTIONS="log_path=/tmp/tsan_ci exitcode=0" \
+  JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  python -m horovod_tpu.runner -np 2 \
+  python -m pytest tests/distributed/test_native_ops.py -x -q
+# jaxlib's uninstrumented XLA internals produce known-noise reports
+# (whose stacks may even pass through interposed frames of our .so);
+# only races TSAN itself ATTRIBUTES to our library — the SUMMARY line —
+# are failures.
+if grep -lE "SUMMARY: ThreadSanitizer.*libhorovod_tpu" /tmp/tsan_ci.* \
+    2>/dev/null; then
+  echo "TSAN: data race attributed to libhorovod_tpu.so"
+  grep -nE -B2 -A20 "SUMMARY: ThreadSanitizer.*libhorovod_tpu" \
+    /tmp/tsan_ci.* | head -80
+  exit 1
+fi
+# restore the uninstrumented library for anything run after CI
+make -C horovod_tpu/native/cc clean >/dev/null
+python -m horovod_tpu.native.build >/dev/null
+
 echo "CI OK"

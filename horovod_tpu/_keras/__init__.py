@@ -34,6 +34,12 @@ def make_distributed_optimizer_class(keras, base_cls, name=None,
     trick, ``_keras/__init__.py:75-82``) — and, being a real class with
     ``from_config``, it can be registered as a Keras 3 custom object for
     ``load_model``."""
+    if getattr(base_cls, "_hvd_wrapped", False):
+        # Idempotent: re-wrapping (e.g. DistributedOptimizer around a
+        # load_model-restored optimizer that is already wrapped) would
+        # double-allreduce and, with the dynamic subclassing below,
+        # recurse at the super() hop.
+        return base_cls
     backend = keras.backend.backend()
     if backend == "jax":
         # sparse_as_dense is a no-op on JAX (gradients arrive dense —
@@ -71,11 +77,15 @@ def make_distributed_optimizer_class(keras, base_cls, name=None,
                             g, compression=compression,
                             name=f"{scope}.grad.{i}"))
                 grads_and_vars = list(zip(avg, variables))
-            return super(self.__class__, self).apply_gradients(
+            # super(_cls[0], ...) not super(self.__class__, ...): the
+            # latter recurses under further subclassing/wrapping.
+            return super(_cls[0], self).apply_gradients(
                 grads_and_vars, *args, **kwargs)
 
-    return type(base_cls.__name__, (base_cls,),
-                dict(_DistributedOptimizer.__dict__))
+    _cls = [None]
+    _cls[0] = type(base_cls.__name__, (base_cls,),
+                   dict(_DistributedOptimizer.__dict__))
+    return _cls[0]
 
 
 def _make_jax_distributed_class(keras, base_cls, name=None,
@@ -154,11 +164,14 @@ def _make_jax_distributed_class(keras, base_cls, name=None,
             if basics.size() > 1 and grads:
                 tag = name or "Distributed%s" % self.__class__.__name__
                 grads = _allreduce_all(grads, tag)
-            return super(self.__class__, self).apply(
-                grads, trainable_variables)
+            # super(_cls[0], ...): self.__class__ would recurse under
+            # further subclassing/wrapping.
+            return super(_cls[0], self).apply(grads, trainable_variables)
 
-    return type(base_cls.__name__, (base_cls,),
-                dict(_DistributedOptimizer.__dict__))
+    _cls = [None]
+    _cls[0] = type(base_cls.__name__, (base_cls,),
+                   dict(_DistributedOptimizer.__dict__))
+    return _cls[0]
 
 
 def create_distributed_optimizer(keras, optimizer, name=None,

@@ -83,17 +83,21 @@ def hierarchical_allgather(x, ici_axis: str, dcn_axis: str):
     ``mpi_operations.cc:164-321``: node-local shared-memory gather + one
     cross-node allgather per node leader).
 
-    Mesh form: gather over the fast ICI axis first, then exchange the
-    already-assembled slice blocks over DCN — each DCN link carries each
-    byte once (the reference's reason for the hierarchy: only one rank
-    per node touches the slow network).  Concatenation order is
-    (dcn, ici, local dim 0), matching a flat allgather over a mesh whose
-    ICI axis is minor.
+    Mesh form: gather over the fast ICI axis first, then over DCN.
+    Concatenation order is (dcn, ici, local dim 0), matching a flat
+    allgather over a mesh whose ICI axis is minor.
 
     Expressed as masked psums rather than ``lax.all_gather`` for the same
     reason as :func:`hierarchical_allreduce`'s gather leg: psum output is
     the one collective vma marks *unvarying*, so the result can flow out
     of a ``check_vma=True`` shard_map through a replicated ``P()`` spec.
+    CAVEAT: the masked-psum form pays for that typing property with
+    bandwidth — each gather leg reduces a zero-padded GLOBAL-size buffer,
+    so every link carries O(global) bytes per level, NOT the
+    each-byte-once traffic of the reference's leader scheme.  Semantics
+    match; if XLA's psum-of-one-hot pattern matching does not rewrite it
+    to a gather on your target, prefer ``lax.all_gather`` per level and
+    handle the vma/replication annotation explicitly.
     """
     def gather(v, axis):
         n = lax.axis_size(axis)

@@ -331,6 +331,15 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
         Without it, ``step()`` would fire a second (numerically idempotent
         but wasteful) force-allreduce pass over the already-averaged grads.
+
+        DELIBERATE deviation from the reference: the reference silently
+        skips synchronization for ANY ``step()`` inside this context,
+        even when a backward pass enqueued fresh un-averaged gradients
+        after the last ``synchronize()`` — silently applying per-rank
+        gradients and diverging the replicas.  Here such a ``step()``
+        raises (see the three guards in :meth:`step`); code ported from
+        the reference that relied on the silent skip must call
+        ``synchronize()`` first, which is the recipe's contract anyway.
         """
         self._should_skip_synchronize = True
         try:

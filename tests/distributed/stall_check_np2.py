@@ -1,0 +1,48 @@
+"""Stalled-CACHED-tensor detection check (run: hvdrun -np 2, see
+ci/run_tests.sh).
+
+The reference invalidates cached responses for stalled tensors
+(``stall_inspector.cc:112`` InvalidateStalledCachedTensors) because its
+cached tensors coordinate via a bitvector side path that bypasses the
+request table.  In THIS runtime the cache-bit fast path is a wire-format
+optimization only: the coordinator EXPANDS announced bits back into full
+requests (``controller.cc`` Ingest -> ResponseCache::Expand), so cached
+tensors land in the same negotiation table and the same stall inspection
+as everything else — no separate invalidation pass exists to forget.
+This check proves that property end-to-end: a tensor is allreduced once
+(seeding the response cache), then submitted again by rank 0 only; the
+stall watchdog must surface the error to rank 0 even though the second
+submission traveled as a cache bit.
+"""
+import os
+
+os.environ["HOROVOD_STALL_CHECK_TIME_SECONDS"] = "0.5"
+os.environ["HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"] = "1.0"
+
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+hvd.init()
+rank = hvd.rank()
+x = np.ones(4, np.float32)
+
+# Round 1: both ranks submit -> completes AND seeds the response cache
+# (same name+params next time travels as one cache bit).
+out = hvd.allreduce(x, average=False, name="stall.x")
+assert np.asarray(out).tolist() == [2.0] * 4
+
+# Round 2: only rank 0 submits the (now cached) tensor.
+if rank == 0:
+    try:
+        hvd.allreduce(x, average=False, name="stall.x")
+    except RuntimeError as e:
+        assert "Stalled" in str(e), f"unexpected error: {e}"
+        print("stalled cached tensor detected OK")
+    else:
+        raise SystemExit("expected a stalled-collective error")
+else:
+    # Stay alive past the shutdown window without submitting.
+    time.sleep(3)
