@@ -74,7 +74,7 @@ def main():
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--warmup-steps", type=int, default=10)
     p.add_argument("--attention", default=None,
-                   choices=["ring", "ulysses", "local", "flash"],
+                   choices=["ring", "ulysses", "local", "flash", "auto"],
                    help="default: ring (local under --pp)")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--log-every", type=int, default=10)
@@ -86,7 +86,9 @@ def main():
                          "model/seq axes of the non-pipelined step")
     if args.attention is None:
         args.attention = "local" if args.pp > 1 else "ring"
-    elif args.pp > 1 and args.attention != "local":
+    elif args.pp > 1 and args.attention not in ("local", "auto"):
+        # "auto" resolving to local inside stages IS its documented
+        # behavior — only explicit ring/ulysses/flash must fail loudly.
         raise SystemExit("--pp uses local attention inside each stage; "
                          f"--attention {args.attention} is not available "
                          "(never silently substitute algorithms)")

@@ -136,6 +136,22 @@ def _attn_out(o_flat, x, layer, dt, model_axis):
     return x + o
 
 
+def _flash_profitable(t: int) -> bool:
+    """``attention="auto"``'s flash-vs-lax decision, made at TRACE time
+    from the (static) sequence length.  The default threshold (2048) is
+    the measured TRAINING crossover (docs/kernels.md: fwd+bwd at T=2048
+    is 7.3 ms flash vs 8.9 ms lax) — training steps are auto's dominant
+    caller.  Forward-ONLY workloads at T in [2048, 4096) measure faster
+    on the lax route (4.1 ms vs 5.9 ms at T=2048); inference callers in
+    that band should pass attention="local" explicitly or raise
+    HOROVOD_FLASH_AUTO_MIN_T to ~4096.  Auto also refuses lengths the
+    compiled kernel cannot tile (below/indivisible by the 128-lane
+    block)."""
+    import os
+    min_t = int(os.environ.get("HOROVOD_FLASH_AUTO_MIN_T", "2048"))
+    return t >= min_t and t % 128 == 0
+
+
 def _logits_head(x, params, dt):
     """Final rmsnorm + tied-embedding projection (shared fwd/decode)."""
     x = _rmsnorm(x, params["ln_f_scale"])
@@ -171,7 +187,7 @@ def forward(params, tokens, cfg: TransformerConfig,
         q, k, v, dh = _qkv_proj(x, layer, dt, model_axis, cfg.head_dim)
         b, t = q.shape[:2]
         if seq_axis is not None:
-            if attention == "ring":
+            if attention in ("ring", "auto"):  # auto: ring under SP
                 o = seq_mod.ring_attention(q, k, v, seq_axis, causal=True,
                                            segment_ids=segment_ids)
             elif attention == "ulysses":
@@ -185,7 +201,8 @@ def forward(params, tokens, cfg: TransformerConfig,
                 raise ValueError(
                     f"attention={attention!r} is not available with a "
                     f"sequence axis; choose 'ring' or 'ulysses'")
-        elif attention == "flash":
+        elif attention == "flash" or (attention == "auto" and
+                                      _flash_profitable(t)):
             # Pallas flash kernel (ops/flash_attention.py): same exact
             # math blockwise in VMEM; requires T divisible by its blocks.
             o = flash_attention(q, k, v, True, segment_ids=segment_ids)

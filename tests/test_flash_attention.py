@@ -324,3 +324,32 @@ def test_packed_train_step_seq_sharded(hvd):
         losses.append(float(np.asarray(loss)))
     assert np.isfinite(losses).all(), losses
     assert losses[-1] < 0.7 * losses[0], (losses[0], losses[-1])
+
+
+def test_attention_auto_dispatch(hvd, monkeypatch):
+    """attention='auto' picks local below the crossover (exactly equals
+    the local route) and the flash kernel above it (still equals local —
+    same math — proving the flash route was viable where chosen)."""
+    from horovod_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(vocab_size=32, d_model=32, n_heads=2,
+                                d_ff=64, n_layers=1, max_seq=256,
+                                dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(17)
+
+    # small T: auto == local (flash would need T%128==0 anyway at 96)
+    toks = jnp.asarray(rng.integers(0, 32, (1, 96)), jnp.int32)
+    a = tfm.forward(params, toks, cfg, attention="auto")
+    b = tfm.forward(params, toks, cfg, attention="local")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+    # above the (lowered) threshold: auto takes the flash kernel
+    monkeypatch.setenv("HOROVOD_FLASH_AUTO_MIN_T", "256")
+    toks = jnp.asarray(rng.integers(0, 32, (1, 256)), jnp.int32)
+    a = tfm.forward(params, toks, cfg, attention="auto")
+    f = tfm.forward(params, toks, cfg, attention="flash")
+    b = tfm.forward(params, toks, cfg, attention="local")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(f), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                               atol=2e-4)
