@@ -138,17 +138,14 @@ def _attn_out(o_flat, x, layer, dt, model_axis):
 
 def _flash_profitable(t: int) -> bool:
     """``attention="auto"``'s flash-vs-lax decision, made at TRACE time
-    from the (static) sequence length.  The default threshold (2048) is
-    the measured TRAINING crossover (docs/kernels.md: fwd+bwd at T=2048
-    is 7.3 ms flash vs 8.9 ms lax) — training steps are auto's dominant
-    caller.  Forward-ONLY workloads at T in [2048, 4096) measure faster
-    on the lax route (4.1 ms vs 5.9 ms at T=2048); inference callers in
-    that band should pass attention="local" explicitly or raise
-    HOROVOD_FLASH_AUTO_MIN_T to ~4096.  Auto also refuses lengths the
-    compiled kernel cannot tile (below/indivisible by the 128-lane
-    block)."""
+    from the (static) sequence length.  With the kernel's auto block
+    sizes (r3 sweep, docs/kernels.md table): measured fwd-only PARITY at
+    T=1024 and measured wins from T=2048 up (fwd-only and fwd+bwd), so
+    1024 is the safe default threshold — at worst a tie; override with
+    HOROVOD_FLASH_AUTO_MIN_T.  Auto also refuses lengths the compiled
+    kernel cannot tile (below/indivisible by the 128-lane block)."""
     import os
-    min_t = int(os.environ.get("HOROVOD_FLASH_AUTO_MIN_T", "2048"))
+    min_t = int(os.environ.get("HOROVOD_FLASH_AUTO_MIN_T", "1024"))
     return t >= min_t and t % 128 == 0
 
 

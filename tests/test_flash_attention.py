@@ -353,3 +353,26 @@ def test_attention_auto_dispatch(hvd, monkeypatch):
     np.testing.assert_allclose(np.asarray(a), np.asarray(f), rtol=1e-6)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
                                atol=2e-4)
+
+
+def test_auto_blocks_default_path():
+    """The DEFAULT (auto) block path — the only form the transformer
+    uses — matches the oracle, and non-128-divisible lengths fail with
+    the actionable pad-the-sequence error instead of a degenerate grid."""
+    rs = np.random.default_rng(20)
+    q, k, v = _make_qkv(rs, t=256, d=32)
+    out = flash_attention(q, k, v, True)          # block_q=block_k=None
+    ref = local_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    # auto floor: T=1992 is 8-divisible but not 128-divisible
+    qb, kb, vb = _make_qkv(rs, t=1992, d=16, b=1, h=1)
+    with pytest.raises(ValueError, match="divisible by 128"):
+        flash_attention(qb, kb, vb, True)
+    # short-T clamp path still works through auto
+    qs, ks, vs = _make_qkv(rs, t=64, d=16)
+    outs = flash_attention(qs, ks, vs, True)
+    refs = local_attention(qs, ks, vs, causal=True)
+    np.testing.assert_allclose(np.asarray(outs), np.asarray(refs),
+                               rtol=2e-5, atol=2e-5)
