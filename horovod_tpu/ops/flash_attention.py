@@ -57,6 +57,18 @@ def _interpret_default() -> bool:
 # Forward
 # ---------------------------------------------------------------------------
 
+
+def _out_vma(*arrays):
+    """vma set for pallas out_shapes: inside a check_vma=True shard_map,
+    outputs vary over every axis the inputs vary over (ShapeDtypeStructs
+    with vma=None are rejected there); frozenset() outside shard_map."""
+    from horovod_tpu.parallel._vma import vma_of
+    out = set()
+    for a in arrays:
+        out |= vma_of(a)
+    return frozenset(out)
+
+
 def _mask_scores(s, qi, kj, block_q, block_k, causal, seg_ref):
     """Apply causal and/or segment (sequence-packing) masks to a score
     block.  Segment ids ride a [B, 1, T] layout like the m/l rows; tokens
@@ -323,6 +335,7 @@ def _fwd(q, k, v, seg, causal, scale, block_q, block_k, interpret):
     if seg is not None:
         in_specs.append(_seg_spec(t, h))
         operands.append(seg.reshape(b, 1, t))
+    vma = _out_vma(*operands)
     o, m, l = pl.pallas_call(
         kernel,
         grid=grid,
@@ -339,9 +352,9 @@ def _fwd(q, k, v, seg, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, 1, t), lambda bh_, i, j: (bh_, 0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
-            jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype, vma=vma),
+            jax.ShapeDtypeStruct((bh, 1, t), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((bh, 1, t), jnp.float32, vma=vma),
         ],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
@@ -375,13 +388,14 @@ def _bwd(causal, scale, block_q, block_k, interpret, res, do):
     if seg is not None:
         dq_specs.append(_seg_spec(t, h))
         dq_operands.append(segf)
+    vma = _out_vma(*dq_operands)
     dq = pl.pallas_call(
         kernel_dq,
         grid=(bh, num_q, num_k),
         in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, d),
                                lambda bh_, i, j: (bh_, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), qf.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), qf.dtype, vma=vma),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(*dq_operands)
@@ -405,6 +419,7 @@ def _bwd(causal, scale, block_q, block_k, interpret, res, do):
     if seg is not None:
         dkv_specs.append(_seg_spec(t, h))
         dkv_operands.append(segf)
+    vma = _out_vma(*dkv_operands)
     dk, dv = pl.pallas_call(
         kernel_dkv,
         grid=(bh, num_k, num_q),
@@ -414,8 +429,8 @@ def _bwd(causal, scale, block_q, block_k, interpret, res, do):
             pl.BlockSpec((1, block_k, d), lambda bh_, j, i: (bh_, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t, d), qf.dtype),
-            jax.ShapeDtypeStruct((bh, t, d), qf.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), qf.dtype, vma=vma),
+            jax.ShapeDtypeStruct((bh, t, d), qf.dtype, vma=vma),
         ],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
