@@ -157,24 +157,16 @@ def make_train_step(model, optimizer, mesh, axis_name: Optional[str] = None,
     return jax.jit(smapped, donate_argnums=(0, 1, 2))
 
 
-def run_synthetic_benchmark(model_name: str = "resnet50",
-                            batch_size: int = 64,
-                            image_size: int = 224,
-                            num_classes: int = 1000,
-                            num_warmup_batches: int = 5,
-                            num_batches_per_iter: int = 10,
-                            num_iters: int = 10,
-                            learning_rate: float = 0.01,
-                            mesh=None,
-                            per_step_dispatch: bool = False,
-                            input_dtype: str = "float32",
-                            stem: str = "conv7",
-                            remat: Optional[str] = None,
-                            verbose: bool = True) -> dict:
-    """Run the ResNet synthetic benchmark; returns a result dict.
-
-    ``batch_size`` is per chip, as in the reference (``--batch-size`` is per
-    worker, ``tensorflow2_synthetic_benchmark.py:20``).
+def make_bench_state(model_name: str = "resnet50", batch_size: int = 64,
+                     image_size: int = 224, num_classes: int = 1000,
+                     input_dtype: str = "float32", stem: str = "conv7",
+                     remat: Optional[str] = None, mesh=None,
+                     learning_rate: float = 0.01):
+    """The ONE benchmark-state recipe, shared by the throughput run, the
+    --profile path and the standalone profiling tools so they always
+    measure the same program.  Returns ``(mesh, ax, model, optimizer,
+    s2d, (params, batch_stats, opt_state), (images, labels))`` with the
+    batch sharded over the data axis and state replicated.
     """
     from horovod_tpu.models import get_model
 
@@ -182,8 +174,7 @@ def run_synthetic_benchmark(model_name: str = "resnet50",
         hvd.init()
     mesh = mesh if mesh is not None else hvd.mesh()
     ax = data_axis(mesh)
-    n_chips = mesh_size(mesh)
-    global_bs = batch_size * n_chips
+    global_bs = batch_size * mesh_size(mesh)
 
     # "s2d": space-to-depth input pipeline + exact 4x4/s1 stem
     # reparameterization (models/resnet.py:space_to_depth) — input arrives
@@ -227,6 +218,37 @@ def run_synthetic_benchmark(model_name: str = "resnet50",
     repl = NamedSharding(mesh, P())
     params, batch_stats, opt_state = jax.device_put(
         (params, batch_stats, opt_state), repl)
+    return (mesh, ax, model, optimizer, s2d,
+            (params, batch_stats, opt_state), (images, labels))
+
+
+def run_synthetic_benchmark(model_name: str = "resnet50",
+                            batch_size: int = 64,
+                            image_size: int = 224,
+                            num_classes: int = 1000,
+                            num_warmup_batches: int = 5,
+                            num_batches_per_iter: int = 10,
+                            num_iters: int = 10,
+                            learning_rate: float = 0.01,
+                            mesh=None,
+                            per_step_dispatch: bool = False,
+                            input_dtype: str = "float32",
+                            stem: str = "conv7",
+                            remat: Optional[str] = None,
+                            verbose: bool = True) -> dict:
+    """Run the ResNet synthetic benchmark; returns a result dict.
+
+    ``batch_size`` is per chip, as in the reference (``--batch-size`` is per
+    worker, ``tensorflow2_synthetic_benchmark.py:20``).
+    """
+    (mesh, ax, model, optimizer, s2d,
+     (params, batch_stats, opt_state),
+     (images, labels)) = make_bench_state(
+        model_name, batch_size, image_size=image_size,
+        num_classes=num_classes, input_dtype=input_dtype, stem=stem,
+        remat=remat, mesh=mesh, learning_rate=learning_rate)
+    n_chips = mesh_size(mesh)
+    global_bs = batch_size * n_chips
 
     # Fused dispatch (default): each timed round is ONE compiled program
     # of num_batches_per_iter scanned steps, so host->device dispatch
@@ -406,6 +428,41 @@ def run_scaling_efficiency(model_name: str = "resnet50",
     }
 
 
+def run_profile(model_name: str = "resnet50", batch_size: int = 64,
+                image_size: int = 224, steps: int = 10,
+                input_dtype: str = "bfloat16", stem: str = "conv7",
+                remat: Optional[str] = None, mesh=None) -> None:
+    """Trace ``steps`` scanned training steps with jax.profiler and print
+    the per-fusion-category and per-layer device-time breakdown — the
+    device-side complement of the native runtime's chrome timeline
+    (docs/benchmarks.md's roofline section was produced with this).
+    Same state recipe as the throughput benchmark (make_bench_state), so
+    the profile explains exactly the program the benchmark measures."""
+    from horovod_tpu.utils import profiling
+
+    (mesh, ax, model, optimizer, _s2d,
+     (params, batch_stats, opt_state),
+     (images, labels)) = make_bench_state(
+        model_name, batch_size, image_size=image_size,
+        input_dtype=input_dtype, stem=stem, remat=remat, mesh=mesh)
+
+    step = make_train_step(model, optimizer, mesh, ax,
+                           steps_per_call=steps)
+    compiled = step.lower(params, batch_stats, opt_state, images,
+                          labels).compile()
+    # The step donates its state buffers — rethread them through each call.
+    state = compiled(params, batch_stats, opt_state, images, labels)
+    float(np.asarray(state[3]))    # warm + real barrier
+
+    def run():
+        nonlocal state
+        state = compiled(state[0], state[1], state[2], images, labels)
+        float(np.asarray(state[3]))
+
+    trace = profiling.trace_once(run)
+    profiling.print_profile(trace, compiled.as_text(), steps=steps)
+
+
 def _main():
     import argparse
     parser = argparse.ArgumentParser(
@@ -420,16 +477,25 @@ def _main():
     parser.add_argument("--num-iters", type=int, default=10)
     parser.add_argument("--efficiency", action="store_true",
                         help="weak-scaling efficiency: 1 device vs all")
+    parser.add_argument("--profile", action="store_true",
+                        help="trace one round and print the per-op/"
+                             "per-layer device-time breakdown")
+    parser.add_argument("--stem", default="conv7",
+                        choices=("conv7", "s2d"))
     args = parser.parse_args()
 
     kwargs = dict(image_size=args.image_size,
                   num_warmup_batches=args.num_warmup_batches,
                   num_batches_per_iter=args.num_batches_per_iter,
                   num_iters=args.num_iters)
-    if args.efficiency:
+    if args.profile:
+        run_profile(args.model, args.batch_size, args.image_size,
+                    steps=args.num_batches_per_iter, stem=args.stem)
+    elif args.efficiency:
         run_scaling_efficiency(args.model, args.batch_size, **kwargs)
     else:
-        run_synthetic_benchmark(args.model, args.batch_size, **kwargs)
+        run_synthetic_benchmark(args.model, args.batch_size, stem=args.stem,
+                                **kwargs)
 
 
 if __name__ == "__main__":

@@ -1,57 +1,20 @@
-"""Shared setup for the profiling tools: build the exact program state
-bench.py measures (same model, optimizer, sharding, input dtype and stem),
+"""Shared setup for the profiling tools: delegates to
+``horovod_tpu.benchmark.make_bench_state`` (the ONE benchmark-state
+recipe) so the tools always measure the same program bench.py does,
 controlled by the same BENCH_* env knobs."""
 import os
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
-from jax.sharding import NamedSharding, PartitionSpec as P
-
-import horovod_tpu as hvd
-from horovod_tpu.models import get_model
-from horovod_tpu.topology import data_axis
+from horovod_tpu.benchmark import make_bench_state
 
 
 def setup():
     """Returns (mesh, ax, model, optimizer, state, inputs) where
     state = (params, batch_stats, opt_state) and inputs = (images, labels),
-    matching bench.py's protocol env knobs."""
-    model_name = os.environ.get("BENCH_MODEL", "resnet50")
-    input_dtype = os.environ.get("BENCH_INPUT_DTYPE", "bfloat16")
-    stem = os.environ.get("BENCH_STEM", "s2d")
-    image_size = 224
-    hvd.init()
-    mesh = hvd.mesh()
-    ax = data_axis(mesh)
-    # BENCH_BATCH_SIZE is PER CHIP, exactly as in run_synthetic_benchmark
-    from horovod_tpu.topology import mesh_size
-    batch = int(os.environ.get("BENCH_BATCH_SIZE", "256")) * mesh_size(mesh)
-
-    s2d = stem == "s2d" and model_name.startswith("resnet")
-    model = get_model(model_name, num_classes=1000,
-                      **({"stem": "s2d"} if s2d else {}))
-    init_shape = ((1, image_size // 2, image_size // 2, 12) if s2d
-                  else (1, image_size, image_size, 3))
-    variables = model.init(jax.random.PRNGKey(0),
-                           jnp.zeros(init_shape, jnp.float32), train=False)
-    params, batch_stats = variables["params"], variables["batch_stats"]
-    optimizer = optax.sgd(0.01, momentum=0.9)
-    opt_state = optimizer.init(params)
-
-    images_np = np.random.default_rng(0).standard_normal(
-        (batch, image_size, image_size, 3), dtype=np.float32)
-    if s2d:
-        from horovod_tpu.models.resnet import space_to_depth
-        images_np = space_to_depth(images_np)
-    images = jax.device_put(images_np.astype(jnp.dtype(input_dtype)),
-                            NamedSharding(mesh, P(ax)))
-    labels = jax.device_put(
-        np.random.default_rng(1).integers(0, 1000, (batch,), dtype=np.int32),
-        NamedSharding(mesh, P(ax)))
-    repl = NamedSharding(mesh, P())
-    params, batch_stats, opt_state = jax.device_put(
-        (params, batch_stats, opt_state), repl)
-    return (mesh, ax, model, optimizer,
-            (params, batch_stats, opt_state), (images, labels))
+    matching bench.py's protocol env knobs (BENCH_BATCH_SIZE is PER CHIP,
+    exactly as in run_synthetic_benchmark)."""
+    (mesh, ax, model, optimizer, _s2d, state, inputs) = make_bench_state(
+        model_name=os.environ.get("BENCH_MODEL", "resnet50"),
+        batch_size=int(os.environ.get("BENCH_BATCH_SIZE", "256")),
+        input_dtype=os.environ.get("BENCH_INPUT_DTYPE", "bfloat16"),
+        stem=os.environ.get("BENCH_STEM", "s2d"))
+    return mesh, ax, model, optimizer, state, inputs

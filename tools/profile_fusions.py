@@ -8,14 +8,11 @@ near HBM peak are traffic-limited (fix = reduce bytes); fusions far below
 are compute- or latency-limited (fix = different).
 """
 import collections
-import glob
-import gzip
 import json
 import os
 import re
 import sys
 
-import jax
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -73,30 +70,17 @@ def main():
     p, s, o = params, batch_stats, opt_state
     p, s, o, loss = compiled(p, s, o, images, labels)
     float(np.asarray(loss))
-    tracedir = "/tmp/jax_trace_fusions"
-    jax.profiler.start_trace(tracedir)
-    p, s, o, loss = compiled(p, s, o, images, labels)
-    float(np.asarray(loss))
-    jax.profiler.stop_trace()
 
-    tracefile = sorted(glob.glob(
-        tracedir + "/plugins/profile/*/*.trace.json.gz"))[-1]
-    with gzip.open(tracefile) as f:
-        tr = json.load(f)
-    pids = {e['pid']: e['args'].get('name', '')
-            for e in tr['traceEvents']
-            if e.get('ph') == 'M' and e.get('name') == 'process_name'}
-    dev_pid = [k for k, v in pids.items() if 'TPU' in v]
-    dev_pid = dev_pid[0] if dev_pid else 3
-    dur = collections.defaultdict(float)
-    cnt = collections.Counter()
-    for e in tr['traceEvents']:
-        if e.get('ph') == 'X' and e.get('pid') == dev_pid:
-            n = e['name']
-            if n == '0' or n.startswith('jit_') or n.startswith('while'):
-                continue
-            dur[n] += e['dur']
-            cnt[n] += 1
+    def run():
+        nonlocal p, s, o
+        p, s, o, l = compiled(p, s, o, images, labels)
+        float(np.asarray(l))
+
+    from horovod_tpu.utils import profiling
+    tracefile = profiling.trace_once(run, "/tmp/jax_trace_fusions")
+    durcnt = profiling.device_op_durations(tracefile)
+    dur = {k: v[0] for k, v in durcnt.items()}
+    cnt = {k: v[1] for k, v in durcnt.items()}
 
     rows = []
     for name, us in dur.items():
