@@ -189,3 +189,18 @@ def test_jax_lm_pretrain_dp_pp():
         env=_example_env(xla_devices=8), cwd=REPO)
     assert res.returncode == 0, res.stdout + res.stderr
     assert "OK" in res.stdout
+
+
+def test_jax_lm_pretrain_dp_pp_1f1b():
+    """The LM example's --pp-schedule 1f1b path: same topology as the
+    GPipe test, hand-scheduled 1F1B (O(stages) activation memory), loss
+    decreases."""
+    res = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, "jax_lm_pretrain.py"),
+         "--dp", "2", "--pp", "4", "--pp-schedule", "1f1b", "--steps",
+         "30", "--warmup-steps", "3", "--batch-size", "4", "--seq-len",
+         "64", "--n-layers", "4"],
+        capture_output=True, text=True, timeout=420,
+        env=_example_env(xla_devices=8), cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "OK" in res.stdout
