@@ -1,4 +1,4 @@
-"""4-rank 2x2-simulated-host correctness check for the hierarchical
+"""2xN-simulated-host correctness check (any even -np; CI runs 4) for the hierarchical
 allreduce (NOT pytest-collected: needs -np 4; ci/run_tests.sh runs it as
   HOROVOD_HIERARCHICAL_ALLREDUCE=1 HOROVOD_HIERARCHICAL_ALLREDUCE_THRESHOLD=0 \
   hvdrun -np 4 python tests/distributed/hier_check_np4.py
@@ -31,6 +31,7 @@ import jax.numpy as jnp
 got = np.asarray(hvd.allreduce(jnp.asarray(x16, jnp.bfloat16),
                                average=False, name="chk.bf16"),
                  dtype=np.float32)
-np.testing.assert_allclose(got, np.ones(4097) * 10.0, rtol=1e-2)
+np.testing.assert_allclose(got, np.ones(4097) * (size * (size + 1) / 2),
+                           rtol=1e-2)
 if rank == 0:
     print("hierarchical allreduce correctness OK")
