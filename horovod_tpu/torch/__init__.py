@@ -74,7 +74,11 @@ def _from_numpy(arr: np.ndarray, like: torch.Tensor) -> torch.Tensor:
 
 def synchronize(handle) -> torch.Tensor:
     """Wait for an async op; returns the torch result (reference
-    ``torch/mpi_ops.py:429-445``)."""
+    ``torch/mpi_ops.py:429-445``).  A list/tuple of handles (e.g. from
+    :func:`grouped_allreduce_async`) synchronizes each and returns the
+    list of results."""
+    if isinstance(handle, (list, tuple)):
+        return [synchronize(h) for h in handle]
     out = _synchronize(handle)
     if isinstance(out, torch.Tensor):
         return out
@@ -116,6 +120,32 @@ def allreduce(tensor, average=None, name=None, op=None, compression=None,
                         postscale_factor=postscale_factor,
                         process_set=process_set)
     return compression.decompress(synchronize(h), ctx)
+
+
+def grouped_allreduce_async(tensors, average=None, name=None, op=None,
+                            process_set=None):
+    """Async-enqueue every tensor of the group at once (so the runtime
+    batches their negotiations into shared cycles); returns a list of
+    handles for :func:`synchronize` (later-Horovod grouped_allreduce
+    contract, expressed over this binding's handle model)."""
+    nm = _c._auto_name("grouped_allreduce", name)
+    return [allreduce_async(t, average=average, name=f"{nm}.{i}", op=op,
+                            process_set=process_set)
+            for i, t in enumerate(tensors)]
+
+
+def grouped_allreduce(tensors, average=None, name=None, op=None,
+                      compression=None, process_set=None):
+    """Allreduce a LIST of tensors as one group: all in flight together,
+    one synchronize sweep."""
+    compression = compression or Compression.none
+    if not tensors:
+        return []
+    wires, ctxs = zip(*[compression.compress(t) for t in tensors])
+    hs = grouped_allreduce_async(list(wires), average=average, name=name,
+                                 op=op, process_set=process_set)
+    return [compression.decompress(o, c)
+            for o, c in zip(synchronize(hs), ctxs)]
 
 
 def allreduce_async_(tensor, average=None, name=None, op=None):

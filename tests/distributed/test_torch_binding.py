@@ -304,3 +304,25 @@ def test_skip_synchronize_requires_fresh_synchronize(thvd, rank, size):
     with opt.skip_synchronize():
         opt.step()           # now legal
     opt.zero_grad()
+
+
+def test_grouped_allreduce_torch(thvd, rank, size):
+    """grouped_allreduce: every tensor in flight together, one
+    synchronize sweep; values average across ranks."""
+    hvd = thvd
+    ts = [torch.full((2, 3), float(rank + 1) * (i + 1)) for i in range(6)]
+    outs = hvd.grouped_allreduce(ts, average=True, name="grp.torch")
+    want = np.mean([r + 1 for r in range(size)])
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(o.numpy(),
+                                   np.full((2, 3), want * (i + 1),
+                                           np.float32), rtol=1e-6)
+
+    # async form: list handle -> synchronize returns the list
+    hs = hvd.grouped_allreduce_async(ts, average=False, name="grp.torch2")
+    outs = hvd.synchronize(hs)
+    ssum = sum(r + 1 for r in range(size))
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(o.numpy(),
+                                   np.full((2, 3), ssum * (i + 1),
+                                           np.float32), rtol=1e-6)
