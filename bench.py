@@ -27,15 +27,16 @@ def main():
     from horovod_tpu.benchmark import run_synthetic_benchmark
 
     hvd.init()
-    # 90 batches/round: each round ends in a loss fetch (the sync
+    # 150 batches/round: each round ends in a loss fetch (the sync
     # barrier), and on a tunneled PJRT backend that round trip costs
     # ~100 ms — at 10 batches/round it taxed every measurement ~10%,
-    # at 30 ~3%; 60 measured +2.2% over 30 and 90 a further +0.4%.
+    # at 30 ~3%; 60 measured +2.2% over 30, 90 +0.4% more, 150 a final
+    # +0.4% (2583 vs 2573 img/s); 320/224 batch sizes measured worse.
     protocol = dict(
         model_name=os.environ.get("BENCH_MODEL", "resnet50"),
         batch_size=batch_size,
         num_warmup_batches=int(os.environ.get("BENCH_WARMUP", "5")),
-        num_batches_per_iter=int(os.environ.get("BENCH_BATCHES", "90")),
+        num_batches_per_iter=int(os.environ.get("BENCH_BATCHES", "150")),
         num_iters=int(os.environ.get("BENCH_ITERS", "5")),
         per_step_dispatch=os.environ.get("BENCH_PER_STEP_DISPATCH",
                                          "0") == "1",
