@@ -468,20 +468,30 @@ void BackgroundThread() {
                     g->size % g->local_size == 0 &&
                     g->local_rank == g->rank % g->local_size)
                        ? g->local_size : 0;
-      int64_t mn = ok, mx = ok;
-      Status as = g->data_plane.Allreduce(&mn, 1, DataType::kInt64,
+      // The THRESHOLD must be agreed for the same reason as the flag: a
+      // payload between two ranks' local values would take the
+      // hierarchical path on some ranks and the flat ring on others and
+      // deadlock the data plane.  Agree on the MIN (most conservative:
+      // everything either side of it routes identically everywhere).
+      // Default 256 KB: measured crossover on the loopback rig
+      // (docs/eager_performance.md) — below it the extra local phases
+      // cost more latency than the cross-link traffic saved.
+      const int64_t thr_local =
+          EnvInt("HOROVOD_HIERARCHICAL_ALLREDUCE_THRESHOLD", 262144);
+      // One kMin allreduce agrees all four values (negated entries give
+      // the max), keeping bootstrap at a single round.
+      int64_t agree[4] = {ok, -ok, thr_local, -thr_local};
+      Status as = g->data_plane.Allreduce(agree, 4, DataType::kInt64,
                                           ReduceOp::kMin);
-      if (as.ok())
-        as = g->data_plane.Allreduce(&mx, 1, DataType::kInt64,
-                                     ReduceOp::kMax);
+      const int64_t mn = agree[0], mx = -agree[1];
+      const int64_t thr = agree[2], thr_max = -agree[3];
       const bool enable = as.ok() && mn == mx && mn > 1;
       if (enable) {
-        // Threshold default 256 KB: measured crossover on the loopback
-        // rig (docs/eager_performance.md) — below it the extra local
-        // phases cost more latency than the cross-link traffic saved.
-        g->data_plane.SetTopology(
-            g->local_rank, g->local_size, true,
-            EnvInt("HOROVOD_HIERARCHICAL_ALLREDUCE_THRESHOLD", 262144));
+        if (g->rank == 0 && thr != thr_max)
+          LOG(Warning) << "HOROVOD_HIERARCHICAL_ALLREDUCE_THRESHOLD "
+                          "differs across ranks (min/max " << thr << "/"
+                       << thr_max << "); using the agreed min " << thr;
+        g->data_plane.SetTopology(g->local_rank, g->local_size, true, thr);
       } else if (g->rank == 0 && mx > 0) {
         // mx > 0: at least one rank requested it — worth a warning.
         LOG(Warning) << "HOROVOD_HIERARCHICAL_ALLREDUCE requested but the "

@@ -280,9 +280,11 @@ def grouped_allreduce(tensors, average=True, name=None, op=None,
 
     @tf.custom_gradient
     def fn(*xs):
-        import os
-        sync = (tf.executing_eagerly() or os.environ.get(
-            "HOROVOD_TF_SYNC_COLLECTIVES", "0") == "1")
+        # Same safety gate as _py_collective: the async enqueue+sync pair
+        # is only valid in FuncGraphs (a TF1 session could prune the sync
+        # node and wedge the native tensor table), and _use_async_graph is
+        # also where the wire-name dedup contract lives.
+        sync = not _use_async_graph()
         dtypes = [x.dtype for x in xs]
         if sync:
             outs = [_allreduce(x, name=f"{nm}.{i}", op=wire_op,
