@@ -136,6 +136,9 @@ def _attn_out(o_flat, x, layer, dt, model_axis):
     return x + o
 
 
+_flash_declined_shapes: set = set()
+
+
 def _flash_profitable(t: int) -> bool:
     """``attention="auto"``'s flash-vs-lax decision, made at TRACE time
     from the (static) sequence length.  With the kernel's auto block
@@ -143,10 +146,22 @@ def _flash_profitable(t: int) -> bool:
     T=1024 and measured wins from T=2048 up (fwd-only and fwd+bwd), so
     1024 is the safe default threshold — at worst a tie; override with
     HOROVOD_FLASH_AUTO_MIN_T.  Auto also refuses lengths the compiled
-    kernel cannot tile (below/indivisible by the 128-lane block)."""
+    kernel cannot tile (indivisible by the 128-lane block) and falls
+    back to the lax path — ``auto`` NEVER raises on shape; only an
+    explicit ``attention="flash"`` may (the user asked for the kernel).
+    """
     import os
     min_t = int(os.environ.get("HOROVOD_FLASH_AUTO_MIN_T", "1024"))
-    return t >= min_t and t % 128 == 0
+    if t >= min_t and t % 128 != 0:
+        if t not in _flash_declined_shapes:   # one-time per length
+            _flash_declined_shapes.add(t)
+            import logging
+            logging.getLogger("horovod_tpu").debug(
+                "attention='auto': T=%d is not divisible by 128; using "
+                "the lax attention path (pad the sequence to enable the "
+                "flash kernel)", t)
+        return False
+    return t >= min_t
 
 
 def _logits_head(x, params, dt):
