@@ -80,6 +80,25 @@ def bench_payload(nbytes, iters, warmup=3):
             "busbw_GBs": busbw}
 
 
+def bench_allgather(nbytes, iters, warmup=3):
+    """Allgather sweep (each rank contributes nbytes; busbw uses the
+    nccl-tests allgather convention: total moved = (size-1)/size of the
+    OUTPUT buffer per rank)."""
+    rt = basics.runtime()
+    arr = np.ones(nbytes // 4, np.float32)
+    for _ in range(warmup):
+        rt.allgather("ag.sweep", arr)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        rt.allgather("ag.sweep", arr)
+    dt = (time.perf_counter() - t0) / iters
+    total_out = nbytes * hvd.size()
+    algbw = total_out / dt / 1e9
+    busbw = algbw * (hvd.size() - 1) / hvd.size()
+    return {"bytes_per_rank": nbytes, "secs_per_op": dt,
+            "algbw_GBs": algbw, "busbw_GBs": busbw}
+
+
 def bench_fusion(n_tensors=64, tensor_bytes=64 << 10, iters=10):
     """Submit N small tensors at once (they land in one cycle and fuse)
     vs one-at-a-time (each pays its own negotiation + ring)."""
@@ -161,6 +180,24 @@ def main():
                   f"busbw {r['busbw_GBs']:.3f} GB/s", flush=True)
         nbytes *= 4
     results["sweep"] = sweep
+
+    ag_sweep = []
+    nbytes = 256 << 10
+    # cap the per-rank payload so the gathered OUTPUT stays <= max_mb
+    while nbytes <= (args.max_mb << 20) // hvd.size():
+        r = bench_allgather(nbytes, args.iters if nbytes < (4 << 20) else 5)
+        ag_sweep.append(r)
+        if hvd.rank() == 0:
+            hier = (" [2-level]"
+                    if basics.runtime().hierarchical_allgather_enabled()
+                    else "")
+            print(f"allgather {r['bytes_per_rank']:>10d} B/rank  "
+                  f"algbw {r['algbw_GBs']:.3f} GB/s  "
+                  f"busbw {r['busbw_GBs']:.3f} GB/s{hier}", flush=True)
+        nbytes *= 4
+    results["allgather_sweep"] = ag_sweep
+    results["hierarchical_allgather"] = (
+        basics.runtime().hierarchical_allgather_enabled())
 
     fu = bench_fusion()
     results["fusion"] = fu

@@ -26,6 +26,7 @@ int hvd_local_rank();
 int hvd_local_size();
 // 1 when the bootstrap agreement enabled the 2-level allreduce.
 int hvd_hierarchical_enabled();
+int hvd_hierarchical_allgather_enabled();
 int hvd_is_initialized();
 
 // Enqueue a collective.  `shape` has `ndim` dims (scalar: ndim=0).

@@ -51,11 +51,13 @@ class DataPlane {
   // (rank = host*local_size + local_rank); other shapes fall back to the
   // flat ring.
   void SetTopology(int local_rank, int local_size, bool hierarchical,
-                   int64_t threshold_bytes) {
+                   int64_t threshold_bytes,
+                   bool hierarchical_allgather = false) {
     local_rank_ = local_rank;
     local_size_ = local_size;
     hier_enabled_ = hierarchical;
     hier_threshold_ = threshold_bytes;
+    hier_ag_enabled_ = hierarchical_allgather;
   }
 
   // In-place ring allreduce over buf (count elements).  Dispatches to the
@@ -107,12 +109,15 @@ class DataPlane {
                             int64_t count, DataType dtype);
   Status HierarchicalAllreduce(void* buf, int64_t count, DataType dtype,
                                ReduceOp op);
+  Status HierarchicalAllgather(const void* in, void* out,
+                               const std::vector<int64_t>& counts);
 
   int rank_ = 0;
   int size_ = 1;
   int local_rank_ = 0;
   int local_size_ = 1;
   bool hier_enabled_ = false;
+  bool hier_ag_enabled_ = false;
   int64_t hier_threshold_ = 0;
   TcpSocket listener_;
   std::vector<std::unique_ptr<TcpSocket>> peers_;  // [size], self = null

@@ -564,6 +564,17 @@ Status DataPlane::Reducescatter(const void* in, void* out, int64_t count,
 Status DataPlane::Allgather(const void* in, void* out,
                             const std::vector<int64_t>& counts,
                             const std::vector<int32_t>& group) {
+  // 2-level path: global group only, over the threshold (same agreement
+  // contract as the hierarchical allreduce — hier_ag_enabled_ is only
+  // set after every rank verified the same block mapping AND flag, so
+  // the branch is taken identically everywhere).
+  if (group.empty() && hier_ag_enabled_ &&
+      counts.size() == static_cast<size_t>(size_)) {
+    int64_t total = 0;
+    for (int64_t c : counts) total += c;
+    if (total >= hier_threshold_)
+      return HierarchicalAllgather(in, out, counts);
+  }
   GroupView v;
   Status gs = MakeView(group, rank_, size_, &v);
   if (!gs.ok()) return gs;
@@ -583,6 +594,60 @@ Status DataPlane::Allgather(const void* in, void* out,
                          v.global_of(from), o + displ[from],
                          static_cast<size_t>(counts[from]));
     if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
+// 2-level allgather (reference MPIHierarchicalAllgather structure,
+// mpi_operations.cc:164-321: intra-host shared-memory window + cross-host
+// allgatherv; here both levels are pairwise TCP exchanges, but each
+// host's bytes cross the host boundary ONCE per remote HOST instead of
+// once per remote RANK — a local_size x saving on the cross links):
+//   A. cross-host exchange among same-local-position ranks: my own
+//      block lands in its final slot on every other host (the host's
+//      payload leaves spread over its local ranks in parallel)
+//   B. intra-host fan-out: each local pair exchanges the per-host block
+//      COLUMNS they own after phase A (blocks land at their final
+//      offsets directly — no repack)
+Status DataPlane::HierarchicalAllgather(
+    const void* in, void* out, const std::vector<int64_t>& counts) {
+  const int host = rank_ / local_size_;
+  const int nhosts = size_ / local_size_;
+  std::vector<int64_t> displ(size_ + 1, 0);
+  for (int p = 0; p < size_; ++p) displ[p + 1] = displ[p] + counts[p];
+  char* o = static_cast<char*>(out);
+  if (counts[rank_] > 0)  // joined ranks contribute 0 bytes with in=null
+    std::memcpy(o + displ[rank_], in,
+                static_cast<size_t>(counts[rank_]));
+
+  // A. cross exchange among {(h, local_rank_) for every host h}.
+  for (int k = 1; k < nhosts; ++k) {
+    const int to = ((host + k) % nhosts) * local_size_ + local_rank_;
+    const int from =
+        ((host - k + nhosts) % nhosts) * local_size_ + local_rank_;
+    Status st = SendRecv(to, in, static_cast<size_t>(counts[rank_]),
+                         from, o + displ[from],
+                         static_cast<size_t>(counts[from]));
+    if (!st.ok()) return st;
+  }
+
+  // B. local fan-out: with peer at local position me±k, exchange my
+  //    column (blocks (h, local_rank_) for all h, which phase A
+  //    completed) against theirs, block by block.
+  for (int k = 1; k < local_size_; ++k) {
+    const int to_j = (local_rank_ + k) % local_size_;
+    const int from_j = (local_rank_ - k + local_size_) % local_size_;
+    const int to = host * local_size_ + to_j;
+    const int from = host * local_size_ + from_j;
+    for (int h = 0; h < nhosts; ++h) {
+      const int mine = h * local_size_ + local_rank_;
+      const int theirs = h * local_size_ + from_j;
+      Status st = SendRecv(to, o + displ[mine],
+                           static_cast<size_t>(counts[mine]),
+                           from, o + displ[theirs],
+                           static_cast<size_t>(counts[theirs]));
+      if (!st.ok()) return st;
+    }
   }
   return Status::OK();
 }

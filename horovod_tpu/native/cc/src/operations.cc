@@ -37,6 +37,7 @@ struct GlobalState {
   int local_rank = 0;
   int local_size = 1;
   bool hierarchical_enabled = false;
+  bool hierarchical_allgather_enabled = false;
   std::string rendezvous_addr;
   int rendezvous_port = 0;
 
@@ -463,11 +464,16 @@ void BackgroundThread() {
     // agreement itself on the per-rank env would desynchronize the
     // bootstrap traffic when the flag is set on only some hosts.
     if (s.ok() && g->size > 1) {
+      const bool topo_ok =
+          g->local_size > 1 && g->size > g->local_size &&
+          g->size % g->local_size == 0 &&
+          g->local_rank == g->rank % g->local_size;
       int64_t ok = (EnvBool("HOROVOD_HIERARCHICAL_ALLREDUCE", false) &&
-                    g->local_size > 1 && g->size > g->local_size &&
-                    g->size % g->local_size == 0 &&
-                    g->local_rank == g->rank % g->local_size)
+                    topo_ok)
                        ? g->local_size : 0;
+      int64_t ok_ag = (EnvBool("HOROVOD_HIERARCHICAL_ALLGATHER", false) &&
+                       topo_ok)
+                          ? g->local_size : 0;
       // The THRESHOLD must be agreed for the same reason as the flag: a
       // payload between two ranks' local values would take the
       // hierarchical path on some ranks and the flat ring on others and
@@ -478,21 +484,26 @@ void BackgroundThread() {
       // cost more latency than the cross-link traffic saved.
       const int64_t thr_local =
           EnvInt("HOROVOD_HIERARCHICAL_ALLREDUCE_THRESHOLD", 262144);
-      // One kMin allreduce agrees all four values (negated entries give
+      // One kMin allreduce agrees all six values (negated entries give
       // the max), keeping bootstrap at a single round.
-      int64_t agree[4] = {ok, -ok, thr_local, -thr_local};
-      Status as = g->data_plane.Allreduce(agree, 4, DataType::kInt64,
+      int64_t agree[6] = {ok,        -ok,        ok_ag, -ok_ag,
+                          thr_local, -thr_local};
+      Status as = g->data_plane.Allreduce(agree, 6, DataType::kInt64,
                                           ReduceOp::kMin);
       const int64_t mn = agree[0], mx = -agree[1];
-      const int64_t thr = agree[2], thr_max = -agree[3];
+      const int64_t mn_ag = agree[2], mx_ag = -agree[3];
+      const int64_t thr = agree[4], thr_max = -agree[5];
       const bool enable = as.ok() && mn == mx && mn > 1;
-      if (enable) {
+      const bool enable_ag = as.ok() && mn_ag == mx_ag && mn_ag > 1;
+      if (enable || enable_ag) {
         if (g->rank == 0 && thr != thr_max)
           LOG(Warning) << "HOROVOD_HIERARCHICAL_ALLREDUCE_THRESHOLD "
                           "differs across ranks (min/max " << thr << "/"
                        << thr_max << "); using the agreed min " << thr;
-        g->data_plane.SetTopology(g->local_rank, g->local_size, true, thr);
-      } else if (g->rank == 0 && mx > 0) {
+        g->data_plane.SetTopology(g->local_rank, g->local_size, enable,
+                                  thr, enable_ag);
+      }
+      if (g->rank == 0 && !enable && mx > 0) {
         // mx > 0: at least one rank requested it — worth a warning.
         LOG(Warning) << "HOROVOD_HIERARCHICAL_ALLREDUCE requested but the "
                         "topology is not a homogeneous block mapping or "
@@ -500,7 +511,15 @@ void BackgroundThread() {
                         "local_size view " << mn << "/" << mx
                      << "); using the flat ring";
       }
+      if (g->rank == 0 && !enable_ag && mx_ag > 0) {
+        LOG(Warning) << "HOROVOD_HIERARCHICAL_ALLGATHER requested but the "
+                        "topology is not a homogeneous block mapping or "
+                        "the flag is not set on every rank (min/max "
+                        "local_size view " << mn_ag << "/" << mx_ag
+                     << "); using the flat exchange";
+      }
       g->hierarchical_enabled = enable;
+      g->hierarchical_allgather_enabled = enable_ag;
     }
   }
   g->timeline.Initialize(EnvStr("HOROVOD_TIMELINE"), g->rank);
@@ -675,6 +694,9 @@ int hvd_local_rank() { return g ? g->local_rank : -1; }
 int hvd_local_size() { return g ? g->local_size : -1; }
 int hvd_hierarchical_enabled() {
   return g && g->hierarchical_enabled ? 1 : 0;
+}
+int hvd_hierarchical_allgather_enabled() {
+  return g && g->hierarchical_allgather_enabled ? 1 : 0;
 }
 int hvd_is_initialized() { return g && g->initialized.load() ? 1 : 0; }
 

@@ -130,6 +130,8 @@ class Runtime:
         lib.hvd_last_error.restype = ctypes.c_char_p
         addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR", "127.0.0.1")
         self._hier_fn = getattr(lib, "hvd_hierarchical_enabled", None)
+        self._hier_ag_fn = getattr(
+            lib, "hvd_hierarchical_allgather_enabled", None)
         port = int(os.environ.get("HOROVOD_RENDEZVOUS_PORT", "0"))
         rc = lib.hvd_init(self.rank, self.size, self.local_rank,
                           self.local_size, addr.encode(), port)
@@ -148,6 +150,11 @@ class Runtime:
         """True when the bootstrap agreement enabled the 2-level
         allreduce (tests/CI assert the path under test is engaged)."""
         return bool(self._hier_fn and self._hier_fn())
+
+    def hierarchical_allgather_enabled(self) -> bool:
+        """True when the bootstrap agreement enabled the 2-level
+        allgather (HOROVOD_HIERARCHICAL_ALLGATHER)."""
+        return bool(self._hier_ag_fn and self._hier_ag_fn())
 
     # -- collectives -------------------------------------------------------
 

@@ -33,5 +33,21 @@ got = np.asarray(hvd.allreduce(jnp.asarray(x16, jnp.bfloat16),
                  dtype=np.float32)
 np.testing.assert_allclose(got, np.ones(4097) * (size * (size + 1) / 2),
                            rtol=1e-2)
+if os.environ.get("HOROVOD_HIERARCHICAL_ALLGATHER", "0") == "1":
+    assert basics.runtime().hierarchical_allgather_enabled(), \
+        "hierarchical allgather did not engage (agreement rejected?)"
+    # Deterministic per-rank payloads (value = rank, length varies per
+    # rank) so every rank can compute the expected concatenation locally
+    # — no other collective in the oracle.  Uneven first dims exercise
+    # the counts-driven offsets of both phases.
+    for base in (3, 5000, 200_000):
+        ln = base + rank * 17
+        x = np.full((ln,), float(rank), np.float32)
+        got = np.asarray(hvd.allgather(x, name=f"hag.{base}"))
+        want = np.concatenate([np.full((base + r * 17,), float(r),
+                                       np.float32) for r in range(size)])
+        np.testing.assert_array_equal(got, want)
+    if rank == 0:
+        print("hierarchical allgather correctness OK")
 if rank == 0:
     print("hierarchical allreduce correctness OK")
