@@ -24,11 +24,17 @@ JAX_PLATFORMS=cpu PYTHONPATH="$PWD" KERAS_BACKEND=jax \
   python -m horovod_tpu.runner -np 2 \
   python -m pytest tests/distributed/test_keras_binding.py -x -q
 
-echo "--- hierarchical allreduce correctness (4 ranks, 2x2 simulated hosts)"
+echo "--- hierarchical allreduce + allgather correctness (4 ranks, 2x2 hosts)"
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
-  HOROVOD_HIERARCHICAL_ALLREDUCE=1 HOROVOD_HIERARCHICAL_ALLREDUCE_THRESHOLD=0 \
+  HOROVOD_HIERARCHICAL_ALLREDUCE=1 HOROVOD_HIERARCHICAL_ALLGATHER=1 \
+  HOROVOD_HIERARCHICAL_ALLREDUCE_THRESHOLD=0 \
   python -m horovod_tpu.runner -np 4 \
   python tests/distributed/hier_check_np4.py
+
+echo "--- TF1-session async collectives (2 ranks, pruned-sync reaping)"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" HOROVOD_TF1_ASYNC=1 \
+  python -m horovod_tpu.runner -np 2 \
+  python tests/distributed/tf1_async_check_np2.py
 
 echo "--- stalled-cached-tensor watchdog (2 ranks)"
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \

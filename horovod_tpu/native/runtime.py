@@ -217,6 +217,20 @@ class Runtime:
             out = out.reshape((int(n) // inner,) + tuple(trailing_shape))
         return (out, received) if read_splits else out
 
+    def discard(self, tok) -> None:
+        """Wait out and drop an un-read submit token (``(h, dtype,
+        shape)`` as returned by the ``*_submit`` methods).
+
+        Stale-token reaping for the TF1 async path: a pruned sync node's
+        collective still completed (enqueues are rank-symmetric), so the
+        handle only needs its table entry + result buffer freed.  Errors
+        are swallowed — nobody is left to observe them."""
+        h = int(tok[0])
+        self._lib.hvd_wait(h)
+        with self._inflight_lock:
+            self._inflight.pop(h, None)
+        self._lib.hvd_release(h)
+
     # -- split submit/finish surface (true async: submit is the native
     #    enqueue and returns immediately; finish blocks in hvd_wait, which
     #    releases the GIL.  The TF graph binding rides this so N tensors

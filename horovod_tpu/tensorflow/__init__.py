@@ -84,12 +84,21 @@ _tokens_next = [0]
 
 
 def _use_async_graph():
-    """Async (enqueue node + sync node) is safe only where EVERY traced
-    node executes: tf.function FuncGraphs auto-execute stateful ops.  A
-    TF1 session prunes nodes outside the fetch closure — a pruned sync
-    node would leave its native handle un-waited and wedge the wire name
-    in the runtime's tensor table — so TF1 graphs keep the serialized
-    single-node path (as does HOROVOD_TF_SYNC_COLLECTIVES=1)."""
+    """Async (enqueue node + sync node) is safe where EVERY traced node
+    executes: tf.function FuncGraphs auto-execute stateful ops.  A TF1
+    session prunes nodes outside the fetch closure, so TF1 graphs keep
+    the serialized single-node path by default (as does
+    HOROVOD_TF_SYNC_COLLECTIVES=1).
+
+    ``HOROVOD_TF1_ASYNC=1`` opts TF1 session graphs into the async path:
+    pruning is harmless there once two facts line up — (a) fetches are
+    rank-SYMMETRIC (the same contract Horovod already imposes on op
+    order), so a pruned enqueue is pruned on every rank and a surviving
+    enqueue negotiates + executes on every rank (the wire name leaves
+    the native table at execution, not at the sync's wait); (b) the
+    handle a pruned sync never waits is reclaimed by stale-token
+    reaping at the NEXT enqueue of the same wire name
+    (:func:`_reap_stale`).  See docs/frameworks.md."""
     import os
     if tf.executing_eagerly():
         return False
@@ -97,9 +106,11 @@ def _use_async_graph():
         return False
     try:
         from tensorflow.python.framework.func_graph import FuncGraph
-        return isinstance(tf.compat.v1.get_default_graph(), FuncGraph)
+        if isinstance(tf.compat.v1.get_default_graph(), FuncGraph):
+            return True
     except ImportError:   # private-API drift: fail safe (serialized)
         return False
+    return os.environ.get("HOROVOD_TF1_ASYNC", "0") == "1"
 
 
 def _unique_wire_name(name):
@@ -336,17 +347,39 @@ def grouped_allreduce(tensors, average=True, name=None, op=None,
     return outs
 
 
+_inflight_by_name: dict = {}
+
+
+def _pop_stale(name):
+    """Pop the previous token for ``name`` if its sync node never ran
+    (TF1 fetch-closure pruning).  Returns the stale native handle (or
+    None).  In FuncGraph mode syncs always run, the key is gone, and
+    this is a no-op.  Caller holds the tokens lock."""
+    key = _inflight_by_name.pop(name, None)
+    if key is None or key not in _tokens:
+        return None
+    return _tokens.pop(key)[0]
+
+
 def _py_enqueue_node(submit, x, name):
     """Trace one non-blocking enqueue py_function (chained) returning the
     token key tensor.  The chain head lives on the FuncGraph itself: a
     side dict keyed by graph would pin every retraced graph forever (the
     stored output tensor strongly references its graph)."""
     def enqueue(v):
+        with _tokens_lock:
+            stale = _pop_stale(name)
+        if stale is not None:
+            # The pruned predecessor completed on every rank (enqueues
+            # are rank-symmetric, session.run synchronous): wait it out
+            # and free its buffer + table entry before reusing the name.
+            basics.runtime().discard(stale)
         tok = submit(v)
         with _tokens_lock:
             key = _tokens_next[0]
             _tokens_next[0] += 1
             _tokens[key] = tok
+            _inflight_by_name[name] = key
         return np.int64(key)
 
     graph = tf.compat.v1.get_default_graph()
