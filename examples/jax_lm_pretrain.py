@@ -57,11 +57,16 @@ def main():
                    help="pipeline stages (composes with --dp only)")
     p.add_argument("--microbatches", type=int, default=2,
                    help="GPipe microbatches per step (with --pp)")
-    p.add_argument("--pp-schedule", choices=("gpipe", "1f1b"),
+    p.add_argument("--pp-schedule",
+                   choices=("gpipe", "1f1b", "interleaved"),
                    default="gpipe",
-                   help="pipeline schedule: gpipe (AD backward pipeline) "
-                        "or 1f1b (O(stages) activation memory, "
+                   help="pipeline schedule: gpipe (AD backward pipeline), "
+                        "1f1b (O(stages) activation memory), or "
+                        "interleaved (virtual stages, "
                         "docs/parallelism.md)")
+    p.add_argument("--virtual", type=int, default=2,
+                   help="virtual chunks per device (--pp-schedule "
+                        "interleaved)")
     p.add_argument("--steps", type=int, default=50)
     p.add_argument("--batch-size", type=int, default=4,
                    help="global batch (sequences)")
@@ -122,12 +127,13 @@ def main():
             optax.scale_by_adam(),
             optax.scale_by_schedule(schedule),
             optax.scale(-1.0))
-        params = tfm.split_pipeline_params(params, args.pp)
+        v = args.virtual if args.pp_schedule == "interleaved" else 1
+        params = tfm.split_pipeline_params(params, args.pp, virtual=v)
         step_fn, shard_of = tfm.make_train_step_pipelined(
             cfg, optimizer, mesh,
             data_axis="data" if args.dp > 1 else None,
             pipe_axis="pipe", n_microbatches=args.microbatches,
-            schedule=args.pp_schedule)
+            schedule=args.pp_schedule, virtual=v)
         p_sh, opt_sh = shard_of(params)
         params = {g: {k: jax.device_put(v, p_sh[g][k])
                       for k, v in params[g].items()} for g in params}

@@ -170,11 +170,32 @@ def _logits_head(x, params, dt):
     return (x @ params["embed"].T.astype(dt)).astype(jnp.float32)
 
 
+def _remat_wrap(body, remat: str):
+    """Wrap a per-layer block in ``jax.checkpoint`` per the ``remat``
+    policy — the HBM-for-FLOPs trade that makes compute-bound LM configs
+    fit (docs/benchmarks.md):
+
+    * ``"none"``  — save every intermediate (XLA default).
+    * ``"dots"``  — save matmul outputs only, recompute elementwise
+      (``checkpoint_dots``): the usual sweet spot, cheap recompute.
+    * ``"full"``  — save only layer inputs, recompute the whole block in
+      the backward: O(L) fewer activation bytes, ~1.3x fwd FLOPs.
+    """
+    if remat == "none":
+        return body
+    if remat == "dots":
+        return jax.checkpoint(
+            body, policy=jax.checkpoint_policies.checkpoint_dots)
+    if remat == "full":
+        return jax.checkpoint(body)
+    raise ValueError(f"remat={remat!r}: expected 'none', 'dots' or 'full'")
+
+
 def forward(params, tokens, cfg: TransformerConfig,
             model_axis: Optional[str] = None,
             seq_axis: Optional[str] = None,
             attention: str = "ring",
-            segment_ids=None):
+            segment_ids=None, remat: str = "none"):
     """tokens: [B, T_local] int32 -> logits [B, T_local, vocab] fp32.
 
     Inside shard_map, weight leaves arrive as LOCAL shards (per
@@ -194,7 +215,7 @@ def forward(params, tokens, cfg: TransformerConfig,
          lax.dynamic_slice_in_dim(params["pos"], pos_offset, t_local,
                                   axis=0)[None]).astype(dt)
 
-    for layer in params["layers"]:
+    def layer_block(x, layer, segment_ids):
         # --- attention block ---
         q, k, v, dh = _qkv_proj(x, layer, dt, model_axis, cfg.head_dim)
         b, t = q.shape[:2]
@@ -222,7 +243,11 @@ def forward(params, tokens, cfg: TransformerConfig,
             o = seq_mod.local_attention(q, k, v, causal=True,
                                         segment_ids=segment_ids)
         x = _attn_out(o.reshape(b, t, dh), x, layer, dt, model_axis)
-        x = _mlp_block(x, layer, dt, model_axis)
+        return _mlp_block(x, layer, dt, model_axis)
+
+    layer_block = _remat_wrap(layer_block, remat)
+    for layer in params["layers"]:
+        x = layer_block(x, layer, segment_ids)
 
     return _logits_head(x, params, dt)
 
@@ -237,11 +262,11 @@ def xent(logits, labels):
 
 def loss_fn(params, tokens, labels, cfg: TransformerConfig,
             model_axis=None, seq_axis=None, attention="ring",
-            segment_ids=None):
+            segment_ids=None, remat="none"):
     """Mean next-token cross-entropy over the LOCAL shard (callers pmean
     over data/seq axes)."""
     return xent(forward(params, tokens, cfg, model_axis, seq_axis,
-                        attention, segment_ids), labels)
+                        attention, segment_ids, remat), labels)
 
 
 def make_train_step(cfg: TransformerConfig, optimizer, mesh,
@@ -250,7 +275,9 @@ def make_train_step(cfg: TransformerConfig, optimizer, mesh,
                     seq_axis: Optional[str] = None,
                     attention: str = "ring",
                     donate: bool = True,
-                    packed: bool = False):
+                    packed: bool = False,
+                    remat: str = "none",
+                    steps_per_call: int = 1):
     """Jitted SPMD training step over dp x tp x sp.
 
     Returns ``step(params, opt_state, tokens, labels) ->
@@ -259,16 +286,23 @@ def make_train_step(cfg: TransformerConfig, optimizer, mesh,
     ``segment_ids`` argument ([B, T] int32, sharded like tokens) so
     sequence packing reaches the jitted step on every attention route,
     including the sequence-parallel ones (see :func:`forward`).
+
+    ``remat`` selects the per-layer rematerialization policy (see
+    :func:`_remat_wrap`); ``steps_per_call > 1`` runs that many steps
+    inside one compiled program via ``lax.scan`` on the SAME batch —
+    the benchmark's dispatch-amortization shape (the ResNet harness's
+    rationale at ``benchmark.make_train_step``; not for real training,
+    which wants a fresh batch per step).
     """
     from horovod_tpu.ops.fusion import fused_pytree_mean
 
     specs = param_specs(cfg, model_axis)
     grad_axes = tuple(a for a in (data_axis, seq_axis) if a)
 
-    def _step(params, opt_state, tokens, labels, segment_ids=None):
+    def _one_step(params, opt_state, tokens, labels, segment_ids=None):
         loss, grads = jax.value_and_grad(loss_fn)(
             params, tokens, labels, cfg, model_axis, seq_axis, attention,
-            segment_ids)
+            segment_ids, remat)
         # DP gradient averaging (fused psum) over data (+seq) axes; TP/f-op
         # already settled the model axis.
         grads = fused_pytree_mean(grads, grad_axes)
@@ -276,6 +310,18 @@ def make_train_step(cfg: TransformerConfig, optimizer, mesh,
         new_params = jax.tree_util.tree_map(lambda p, u: p + u, params,
                                             updates)
         return new_params, new_opt, lax.pmean(loss, grad_axes)
+
+    if steps_per_call > 1:
+        def _step(params, opt_state, tokens, labels, segment_ids=None):
+            def body(carry, _):
+                p, o = carry
+                p, o, loss = _one_step(p, o, tokens, labels, segment_ids)
+                return (p, o), loss
+            (params, opt_state), losses = lax.scan(
+                body, (params, opt_state), None, length=steps_per_call)
+            return params, opt_state, losses[-1]
+    else:
+        _step = _one_step
 
     # Param-like opt-state leaves (momenta etc.) inherit the matching
     # param's spec; everything else (step counters, empty states) is
@@ -419,6 +465,25 @@ def stack_layer_params(params, n_stages: int):
          for s in range(n_stages)])
 
 
+def stack_layer_params_interleaved(params, n_devices: int, virtual: int):
+    """Round-robin (Megatron-interleave) re-layout: leaves
+    [n_devices·virtual, layers_per_chunk, ...] ordered so that sharding
+    the leading dim over the pipe axis hands device p local slot k =
+    global chunk ``k·n_devices + p`` (global row ``j = p·v + k`` holds
+    chunk ``(j % v)·P + j // v``)."""
+    layers = params["layers"]
+    n_chunks = n_devices * virtual
+    if len(layers) % n_chunks:
+        raise ValueError(f"{len(layers)} layers not divisible into "
+                         f"{n_chunks} virtual chunks")
+    from horovod_tpu.parallel.pipeline import stack_stage_params
+    lpc = len(layers) // n_chunks
+    chunk = lambda c: stack_stage_params(layers[c * lpc:(c + 1) * lpc])
+    order = [(j % virtual) * n_devices + j // virtual
+             for j in range(n_chunks)]
+    return stack_stage_params([chunk(c) for c in order])
+
+
 def stacked_layer_specs(pipe_axis: str):
     """PartitionSpec for every stacked-layer leaf: stage dim over pipe."""
     return P(pipe_axis)
@@ -426,7 +491,7 @@ def stacked_layer_specs(pipe_axis: str):
 
 def forward_pipelined(params, stacked_layers, tokens,
                       cfg: TransformerConfig, pipe_axis: str = "pipe",
-                      n_microbatches: int = 2):
+                      n_microbatches: int = 2, virtual: int = 1):
     """Forward pass with the layer stack pipelined over ``pipe_axis``.
 
     ``params`` supplies embed/pos/ln_f (replicated); ``stacked_layers``
@@ -439,12 +504,21 @@ def forward_pipelined(params, stacked_layers, tokens,
     local causal (compose PP with DP via a 2-D mesh; TP/SP composition
     belongs on the model/seq axes of the non-pipelined forward).
     """
-    from horovod_tpu.parallel.pipeline import pipeline_apply
+    from horovod_tpu.parallel.pipeline import (pipeline_apply,
+                                               pipeline_apply_interleaved)
 
     b, t = tokens.shape
     mb = _embed_microbatches(params, tokens, cfg, n_microbatches)
-    y = pipeline_apply(_pipe_stage_fn(cfg), stacked_layers, mb,
-                       axis_name=pipe_axis)
+    if virtual > 1:
+        # Round-robin virtual chunks (stack_layer_params_interleaved):
+        # the fill shrinks to (P-1)/v chunk-ticks — see
+        # pipeline_apply_interleaved for the schedule derivation.
+        y = pipeline_apply_interleaved(_pipe_stage_fn(cfg), stacked_layers,
+                                       mb, axis_name=pipe_axis,
+                                       virtual=virtual)
+    else:
+        y = pipeline_apply(_pipe_stage_fn(cfg), stacked_layers, mb,
+                           axis_name=pipe_axis)
     x = y.reshape(b, t, cfg.d_model)
     return _logits_head(x, params, cfg.dtype)
 
@@ -496,11 +570,17 @@ def _pipe_stage_fn(cfg: TransformerConfig):
     return stage_fn
 
 
-def split_pipeline_params(params, n_stages: int):
+def split_pipeline_params(params, n_stages: int, virtual: int = 1):
     """Re-layout :func:`init_params` output for the pipelined step: the
-    one canonical base/stacked split (used by the example and tests)."""
-    return {"base": {k: v for k, v in params.items() if k != "layers"},
-            "stacked": stack_layer_params(params, n_stages)}
+    one canonical base/stacked split (used by the example and tests).
+    ``virtual > 1`` uses the round-robin interleaved chunk layout
+    (``n_stages`` is then the PIPE AXIS size, not the chunk count)."""
+    base = {k: v for k, v in params.items() if k != "layers"}
+    if virtual > 1:
+        return {"base": base,
+                "stacked": stack_layer_params_interleaved(
+                    params, n_stages, virtual)}
+    return {"base": base, "stacked": stack_layer_params(params, n_stages)}
 
 
 def make_train_step_pipelined(cfg: TransformerConfig, optimizer, mesh,
@@ -508,7 +588,8 @@ def make_train_step_pipelined(cfg: TransformerConfig, optimizer, mesh,
                               pipe_axis: str = "pipe",
                               n_microbatches: int = 2,
                               donate: bool = True,
-                              schedule: str = "gpipe"):
+                              schedule: str = "gpipe",
+                              virtual: int = 2):
     """Jitted DP x PP training step.
 
     ``schedule="gpipe"``: differentiation happens OUTSIDE the shard_map
@@ -526,6 +607,13 @@ def make_train_step_pipelined(cfg: TransformerConfig, optimizer, mesh,
     lockstep SPMD mesh its bubble is NOT smaller than GPipe's — see
     docs/parallelism.md for the measured comparison.
 
+    ``schedule="interleaved"``: Megatron-style virtual stages
+    (:func:`horovod_tpu.parallel.pipeline.pipeline_apply_interleaved`)
+    with ``virtual`` round-robin chunks per device — the fill/drain
+    bubble divides by ``virtual`` (GPipe-class activation memory;
+    params from ``split_pipeline_params(params, P, virtual)``).
+    Requires ``n_microbatches % pipe == 0``.
+
     Params layout: :func:`split_pipeline_params` output
     (``{"base": embed/pos/ln_f (replicated), "stacked":
     stack_layer_params(...) (stage dim over pipe)}``).
@@ -536,9 +624,10 @@ def make_train_step_pipelined(cfg: TransformerConfig, optimizer, mesh,
     from jax.sharding import NamedSharding
 
     n_stages = mesh.shape[pipe_axis]
-    if cfg.n_layers % n_stages:
+    v_eff = virtual if schedule == "interleaved" else 1
+    if cfg.n_layers % (n_stages * v_eff):
         raise ValueError(f"{cfg.n_layers} layers not divisible over "
-                         f"{n_stages} pipe stages")
+                         f"{n_stages * v_eff} pipe chunks")
     sspec_one = stacked_layer_specs(pipe_axis)
     data_spec = P(data_axis) if data_axis else P()
 
@@ -548,7 +637,7 @@ def make_train_step_pipelined(cfg: TransformerConfig, optimizer, mesh,
         return jax.shard_map(
             lambda b_, s_, t_: forward_pipelined(
                 dict(b_, layers=[]), s_, t_, cfg, pipe_axis,
-                n_microbatches),
+                n_microbatches, virtual=v_eff),
             mesh=mesh, in_specs=(bspec, sspec, data_spec),
             out_specs=data_spec, check_vma=False)(base, stacked, tokens)
 
@@ -576,13 +665,15 @@ def make_train_step_pipelined(cfg: TransformerConfig, optimizer, mesh,
             mb = _embed_microbatches(base, tokens, cfg, n_microbatches)
             tgt = labels.reshape(n_microbatches, b // n_microbatches, t)
             return f(params["stacked"], base, mb, tgt)
-    elif schedule == "gpipe":
+    elif schedule in ("gpipe", "interleaved"):
+        # Both differentiate through the scanned schedule (jit of
+        # shard_map); interleaved just runs the virtual-chunk scan.
         def _loss(params, tokens, labels):
             return xent(smapped(params["base"], params["stacked"], tokens),
                         labels)
     else:
-        raise ValueError(f"schedule={schedule!r}: expected 'gpipe' or "
-                         f"'1f1b'")
+        raise ValueError(f"schedule={schedule!r}: expected 'gpipe', "
+                         f"'1f1b' or 'interleaved'")
 
     def _step(params, opt_state, tokens, labels):
         loss, grads = jax.value_and_grad(_loss)(params, tokens, labels)

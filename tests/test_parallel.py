@@ -715,6 +715,99 @@ def test_train_step_1f1b_matches_gpipe(hvd, dp):
             err_msg=jax.tree_util.keystr(path))
 
 
+def test_interleaved_pipeline_matches_oracle(hvd):
+    """Interleaved (virtual-stage) schedule at P=4, v=2, M=8: loss AND
+    every gradient (base + all 8 round-robin chunks) equal the plain
+    forward's — the same exact-gradient gate the GPipe/1F1B schedules
+    pass (VERDICT r3 #7)."""
+    from horovod_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(vocab_size=32, d_model=16, n_heads=2,
+                                d_ff=32, n_layers=8, max_seq=8,
+                                dtype=jnp.float32)
+    mesh = _mesh(hvd, ("pipe",), (4,))
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(1)
+    tokens = jnp.asarray(rng.integers(0, 32, (8, 8)), jnp.int32)
+    labels = jnp.asarray(np.roll(np.asarray(tokens), -1, 1), jnp.int32)
+
+    g_oracle = jax.grad(
+        lambda p: tfm.loss_fn(p, tokens, labels, cfg,
+                              attention="local"))(params)
+
+    split = tfm.split_pipeline_params(params, 4, virtual=2)
+    base, stacked = split["base"], split["stacked"]
+    sspec = {k: P("pipe") for k in stacked}
+    bspec = {k: P() for k in base}
+
+    def loss_pp(bp, stk):
+        logits = jax.shard_map(
+            lambda b_, s_, t_: tfm.forward_pipelined(
+                dict(b_, layers=[]), s_, t_, cfg, "pipe",
+                n_microbatches=8, virtual=2),
+            mesh=mesh, in_specs=(bspec, sspec, P()), out_specs=P(),
+            check_vma=False)(bp, stk, tokens)
+        return tfm.xent(logits, labels)
+
+    loss = jax.jit(loss_pp)(base, stacked)
+    oracle_loss = tfm.loss_fn(params, tokens, labels, cfg,
+                              attention="local")
+    np.testing.assert_allclose(float(loss), float(oracle_loss), rtol=1e-5)
+
+    g_base, g_stk = jax.jit(jax.grad(loss_pp, argnums=(0, 1)))(base,
+                                                               stacked)
+    for k in base:
+        np.testing.assert_allclose(np.asarray(g_base[k]),
+                                   np.asarray(g_oracle[k]),
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
+    oracle_stk = tfm.stack_layer_params_interleaved(g_oracle, 4, 2)
+    for k in g_stk:
+        np.testing.assert_allclose(np.asarray(g_stk[k]),
+                                   np.asarray(oracle_stk[k]),
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
+
+
+def test_interleaved_layout_and_guards(hvd):
+    """Round-robin stacking puts global chunk k·P+p at device p slot k;
+    the schedule refuses M not divisible by P and mis-stacked params."""
+    from horovod_tpu.models import transformer as tfm
+    from horovod_tpu.parallel.pipeline import pipeline_apply_interleaved
+
+    cfg = tfm.TransformerConfig(vocab_size=8, d_model=4, n_heads=1,
+                                d_ff=8, n_layers=8, max_seq=4,
+                                dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    stacked = tfm.stack_layer_params_interleaved(params, 4, 2)
+    # global row j = p*v + k holds chunk (j % v)*P + j//v (lpc=1 layer)
+    for j in range(8):
+        chunk = (j % 2) * 4 + j // 2
+        np.testing.assert_array_equal(
+            np.asarray(stacked["wq"][j, 0]),
+            np.asarray(params["layers"][chunk]["wq"]))
+
+    mesh = _mesh(hvd, ("pipe",), (4,))
+    mb = jnp.zeros((6, 1, 4, 4), jnp.float32)   # M=6 not divisible by 4
+
+    def run(stk, mb_):
+        return pipeline_apply_interleaved(
+            tfm._pipe_stage_fn(cfg), stk, mb_, "pipe", virtual=2)
+
+    with pytest.raises(ValueError, match="divisible"):
+        jax.shard_map(run, mesh=mesh,
+                      in_specs=({k: P("pipe") for k in stacked}, P()),
+                      out_specs=P(), check_vma=False)(stacked, mb)
+
+    # mis-stacked params: the contiguous (non-round-robin) layout has
+    # the right leading dim only by accident of v == stages/device; a
+    # wrong-virtual stack must be refused, not silently mis-placed
+    wrong = tfm.stack_layer_params(params, 4)       # leads {1} after shard
+    mb_ok = jnp.zeros((4, 1, 4, 4), jnp.float32)
+    with pytest.raises(ValueError, match="virtual"):
+        jax.shard_map(run, mesh=mesh,
+                      in_specs=({k: P("pipe") for k in wrong}, P()),
+                      out_specs=P(), check_vma=False)(wrong, mb_ok)
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_attention_segment_ids(hvd, causal):
     """Sequence packing on the ring route: segment ids rotate with their
