@@ -69,6 +69,9 @@ def main():
                         "num_iters")}
     # effective stem, not requested (non-resnet models ignore the knob)
     out["protocol"]["stem"] = res.get("stem", "conv7")
+    lm = _lm_bench()
+    if lm is not None:
+        out["lm"] = lm
     eff = _efficiency_smoke()
     if eff is not None:
         out["scaling_efficiency_smoke_8dev_cpu"] = round(eff, 4)
@@ -79,6 +82,45 @@ def main():
             "plumbing-only: 8 virtual CPU devices on one host; "
             "not a TPU scaling measurement")
     print(json.dumps(out))
+
+
+def _lm_bench():
+    """Compute-bound LM MFU datapoint (VERDICT r3 #1): the swept optimum
+    — d3584/L6/H28 (head 128), T=2048, batch 4, flash attention with
+    1024 auto blocks, bf16 momentum — measured ≥60% MFU on v5e-1
+    (docs/benchmarks.md has the full sweep + protocol).  BENCH_LM=0
+    skips; knobs mirror the sweep's axes."""
+    if os.environ.get("BENCH_LM", "1") != "1":
+        return None
+    from horovod_tpu.benchmark import run_lm_benchmark
+    try:
+        r = run_lm_benchmark(
+            d_model=int(os.environ.get("BENCH_LM_D_MODEL", "3584")),
+            n_layers=int(os.environ.get("BENCH_LM_LAYERS", "6")),
+            n_heads=int(os.environ.get("BENCH_LM_HEADS", "28")),
+            seq_len=int(os.environ.get("BENCH_LM_SEQ", "2048")),
+            batch_size=int(os.environ.get("BENCH_LM_BATCH", "4")),
+            attention=os.environ.get("BENCH_LM_ATTENTION", "flash"),
+            remat=os.environ.get("BENCH_LM_REMAT", "none"),
+            num_batches_per_iter=int(os.environ.get("BENCH_LM_BATCHES",
+                                                    "8")),
+            num_iters=int(os.environ.get("BENCH_LM_ITERS", "3")),
+            verbose=os.environ.get("BENCH_VERBOSE", "0") == "1")
+    except Exception as e:
+        print(f"bench: lm bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return None
+    out = {
+        "tok_sec_per_chip": round(r["tok_sec_per_chip"], 1),
+        "tflops_per_chip": round(r["tflops_per_chip"], 2)
+        if r["tflops_per_chip"] else None,
+        "mfu": round(r["mfu"], 4) if r["mfu"] else None,
+        "protocol": {k: r[k] for k in
+                     ("d_model", "n_layers", "d_ff", "n_heads",
+                      "vocab_size", "seq_len", "batch_size", "attention",
+                      "remat")},
+    }
+    return out
 
 
 def _efficiency_smoke():

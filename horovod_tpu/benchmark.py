@@ -14,6 +14,7 @@ overlaps with backprop compute.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Optional
 
@@ -356,6 +357,145 @@ def run_synthetic_benchmark(model_name: str = "resnet50",
         "img_sec_conf": img_sec_conf,
         "img_sec_per_chip": img_sec_mean / n_chips,
         "flops_per_step": flops_per_step,
+        "tflops_per_chip": tflops_per_chip,
+        "mfu": mfu,
+        "loss": float(np.asarray(loss)),
+    }
+
+
+def lm_train_flops(cfg, global_bs: int) -> float:
+    """Analytic GLOBAL FLOPs of one LM training step — the standard MFU
+    accounting (PaLM appendix-B convention): ``6·N·tokens`` for every
+    matmul parameter (2 fwd + 4 bwd FLOPs per param per token; embedding
+    LOOKUP excluded, tied logits head included) plus causal attention
+    ``6·B·T²·d·L`` (QKᵀ and PV are 4·B·T²·d per layer fwd, 3x for
+    train, halved by causality).  Rematerialization recompute is NOT
+    counted (MFU counts model FLOPs, not hardware FLOPs)."""
+    d, f, v = cfg.d_model, cfg.d_ff, cfg.vocab_size
+    l, t = cfg.n_layers, cfg.max_seq
+    n_matmul = l * (4 * d * d + 2 * d * f) + d * v
+    tokens = global_bs * t
+    return 6.0 * n_matmul * tokens + 6.0 * global_bs * t * t * d * l
+
+
+def run_lm_benchmark(d_model: int = 2048, n_layers: int = 8,
+                     n_heads: int = 16, d_ff: Optional[int] = None,
+                     vocab_size: int = 32768, seq_len: int = 2048,
+                     batch_size: int = 8,
+                     attention: str = "flash", remat: str = "none",
+                     num_warmup_batches: int = 2,
+                     num_batches_per_iter: int = 8, num_iters: int = 5,
+                     learning_rate: float = 1e-4, mesh=None,
+                     verbose: bool = True) -> dict:
+    """Transformer-LM synthetic training benchmark (single chip by
+    default) — the compute-bound counterpart to the ResNet harness:
+    same protocol (fixed synthetic batch, scanned rounds, loss-fetch
+    sync barrier), flash attention + optional remat, fp32 master
+    weights with ``cfg.dtype`` (bf16 on TPU) matmuls.
+
+    MFU here uses the ANALYTIC model-FLOPs count (:func:`lm_train_flops`)
+    — XLA's cost analysis cannot see inside the Pallas flash kernel, and
+    counting remat recompute would inflate the number; the dict carries
+    the raw cost-analysis figure too so the two can be compared."""
+    from horovod_tpu.models import transformer as tfm
+
+    if mesh is None:
+        mesh = build_mesh(axes=("data",), shape=(1,),
+                          devices=jax.devices()[:1])
+    n_chips = mesh_size(mesh)
+    global_bs = batch_size * n_chips
+    on_cpu = mesh.devices.ravel()[0].platform == "cpu"
+    cfg = tfm.TransformerConfig(
+        vocab_size=vocab_size, d_model=d_model, n_heads=n_heads,
+        n_layers=n_layers, d_ff=d_ff or 4 * d_model, max_seq=seq_len,
+        dtype=jnp.float32 if on_cpu else jnp.bfloat16)
+
+    # SGD+momentum (the ResNet harness's optimizer): one slot per param —
+    # adam's two would displace ~4 GB of batch/activations at the
+    # compute-bound sizes this harness exists to measure.  BENCH_LM
+    # protocol keeps the slot bf16 (halves optimizer HBM so batch 8 fits
+    # at d4096; fp32 master weights unchanged).
+    acc_dtype = os.environ.get("BENCH_LM_MOMENTUM_DTYPE", "bfloat16")
+    optimizer = optax.sgd(learning_rate, momentum=0.9,
+                          accumulator_dtype=jnp.dtype(acc_dtype).type
+                          if acc_dtype != "float32" else None)
+    steps_per_call = max(num_batches_per_iter, 1)
+    step, specs, opt_specs = tfm.make_train_step(
+        cfg, optimizer, mesh, data_axis="data", attention=attention,
+        remat=remat, steps_per_call=steps_per_call)
+
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    params = jax.device_put(params, jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs))
+    opt_state = jax.device_put(
+        optimizer.init(params), jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), opt_specs,
+            is_leaf=lambda x: isinstance(x, P)))
+
+    rng = np.random.default_rng(0)
+    data_sh = NamedSharding(mesh, P("data"))
+    toks = rng.integers(0, vocab_size, (global_bs, seq_len + 1),
+                        dtype=np.int32)
+    tokens = jax.device_put(toks[:, :-1], data_sh)
+    labels = jax.device_put(toks[:, 1:], data_sh)
+
+    flops_per_step = lm_train_flops(cfg, global_bs)
+    xla_flops = None
+    try:
+        compiled = step.lower(params, opt_state, tokens, labels).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        xla_flops = float(ca.get("flops", 0.0)) * n_chips or None
+        step = compiled
+    except Exception:
+        pass
+
+    if verbose:
+        print(f"LM: d_model={d_model} n_layers={n_layers} d_ff="
+              f"{cfg.d_ff} vocab={vocab_size} T={seq_len} "
+              f"batch={global_bs} attention={attention} remat={remat}",
+              flush=True)
+        print(f"Analytic {flops_per_step / 1e12:.2f} TFLOP/step "
+              f"({flops_per_step / (global_bs * seq_len) / 1e6:.1f} "
+              f"MFLOP/token)", flush=True)
+
+    # Same sync protocol as the ResNet harness: the loss scalar fetch is
+    # the reliable barrier on tunneled PJRT backends.
+    for _ in range(max(1, -(-num_warmup_batches // steps_per_call))):
+        params, opt_state, loss = step(params, opt_state, tokens, labels)
+    float(np.asarray(loss))
+
+    tok_secs = []
+    for i in range(num_iters):
+        t0 = time.perf_counter()
+        params, opt_state, loss = step(params, opt_state, tokens, labels)
+        float(np.asarray(loss))
+        dt = time.perf_counter() - t0
+        tok_sec = global_bs * seq_len * steps_per_call / dt
+        tok_secs.append(tok_sec)
+        if verbose:
+            print(f"Iter #{i}: {tok_sec:,.0f} tok/sec", flush=True)
+
+    tok_sec_mean = float(np.mean(tok_secs))
+    steps_per_sec = tok_sec_mean / (global_bs * seq_len)
+    tflops_per_chip = flops_per_step * steps_per_sec / n_chips / 1e12
+    peak = device_peak_tflops(mesh.devices.ravel()[0])
+    mfu = tflops_per_chip / peak if peak else None
+    if verbose:
+        mfu_s = f", MFU {mfu * 100:.1f}%" if mfu is not None else ""
+        print(f"{tok_sec_mean:,.0f} tok/sec/chip, "
+              f"{tflops_per_chip:.1f} TFLOP/s per chip{mfu_s}",
+              flush=True)
+    return {
+        "d_model": d_model, "n_layers": n_layers, "d_ff": cfg.d_ff,
+        "n_heads": n_heads, "vocab_size": vocab_size,
+        "seq_len": seq_len, "batch_size": global_bs,
+        "attention": attention, "remat": remat,
+        "tok_sec_per_chip": tok_sec_mean / n_chips,
+        "tok_sec_conf": float(1.96 * np.std(tok_secs)) / n_chips,
+        "flops_per_step_analytic": flops_per_step,
+        "flops_per_step_xla": xla_flops,
         "tflops_per_chip": tflops_per_chip,
         "mfu": mfu,
         "loss": float(np.asarray(loss)),

@@ -456,11 +456,12 @@ def flash_attention(q, k, v, causal: bool = True,
     accumulation tolerance, forward and backward.
 
     ``block_q``/``block_k`` default to AUTO: the largest power of two
-    ≤ 512 dividing ``T``.  Swept on a real v5e (docs/kernels.md): 512
+    ≤ 1024 dividing ``T``.  Swept on a real v5e (docs/kernels.md): 512
     blocks run the fwd+bwd pair 2.7× faster than 128 blocks at T=2048
-    and 4.2× at T=8192 (bigger tiles amortize the grid/DMA overhead and
-    feed the MXU longer contractions; 512×512 f32 scores ≈ 1 MB of the
-    ~16 MB VMEM, still comfortable next to the tile operands).
+    and 4.2× at T=8192, and 1024 another 1.13–1.33× over 512 (r4 sweep;
+    bigger tiles amortize the grid/DMA overhead and feed the MXU longer
+    contractions; 1024×1024 f32 scores ≈ 4 MB of the ~16 MB VMEM, still
+    comfortable next to the tile operands).
 
     ``segment_ids`` ([B, T] int32) enables sequence packing: tokens
     attend only within their own segment (composes with ``causal``) —
@@ -484,7 +485,10 @@ def _auto_block(t: int) -> int:
     # Floor at 128: tinier auto blocks (e.g. 8 for T=1992) would explode
     # the grid and run orders of magnitude slower than the error is
     # annoying — same contract as the old fixed-128 default.
-    for b in (512, 256, 128):
+    # 1024 preferred over 512 since r4: measured fwd+bwd 1.33x at T=2048
+    # (B4 H32 D128), 1.13x at T=4096/8192 (docs/kernels.md table);
+    # 1024x1024 f32 scores = 4 MB of VMEM, still comfortable.
+    for b in (1024, 512, 256, 128):
         if t % b == 0:
             return b
     raise ValueError(
@@ -493,7 +497,7 @@ def _auto_block(t: int) -> int:
 
 
 def _eff_blocks(t, block_q, block_k):
-    # None = auto (largest power of two <= 512 dividing T, measured
+    # None = auto (largest power of two <= 1024 dividing T, measured
     # fastest); explicit blocks are clamped to T so e.g. T=64 works with
     # block 128 (divisibility still enforced after clamping).
     bq = _auto_block(t) if block_q is None else min(block_q, t)
