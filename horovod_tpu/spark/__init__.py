@@ -14,9 +14,13 @@ runs ``fn`` and reports the result.  The coordination logic lives in
 ``horovod_tpu.spark.driver`` and is pyspark-independent (unit-tested with
 threads); this module is the thin pyspark veneer.
 
-NOTE: pyspark is not shipped in this image, so ``run`` is validated for
-protocol behavior only (driver tests run threaded); install pyspark to
-use it on a real cluster.
+Execution evidence: ``tests/test_spark_veneer_shim.py`` runs this
+``run()`` end to end — two SPAWNED task processes (own interpreters,
+the local-mode worker contract) register over HMAC RPC, receive rank
+env, ``hvd.init`` and allreduce — against a pyspark-API shim
+(``tests/pyspark_local_shim.py``); only the JVM/py4j transport is
+simulated there.  ``tests/distributed/test_spark_veneer.py`` is the
+real-pyspark twin (Docker image; the authoring host has no JVM).
 """
 
 from __future__ import annotations
