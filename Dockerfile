@@ -25,9 +25,15 @@ RUN apt-get update && apt-get install -y --no-install-recommends \
     rm -rf /var/lib/apt/lists/*
 WORKDIR /horovod_tpu
 COPY . .
-RUN pip install --no-cache-dir jax flax optax chex numpy pytest pyyaml \
-        mxnet && \
-    pip install --no-cache-dir -e . && \
+# Separate resolutions: mxnet's final release pins numpy<2.0, and a
+# single joint resolve could backtrack jax to an ancient version missing
+# the APIs the framework needs (jax.shard_map, vma) — install modern
+# jax first with the numpy<2 constraint mxnet will need, then mxnet
+# alone (it only needs numpy at runtime).
+RUN pip install --no-cache-dir "numpy<2.0" "jax>=0.4.35" flax optax \
+        chex pytest pyyaml && \
+    pip install --no-cache-dir mxnet && \
+    pip install --no-cache-dir --no-deps -e . && \
     python -m horovod_tpu.native.build
 CMD ["sh", "-c", "JAX_PLATFORMS=cpu PYTHONPATH=/horovod_tpu \
      python -m horovod_tpu.runner -np 2 \

@@ -79,8 +79,10 @@ def main():
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--warmup-steps", type=int, default=10)
     p.add_argument("--attention", default=None,
-                   choices=["ring", "ulysses", "local", "flash", "auto"],
-                   help="default: ring (local under --pp)")
+                   choices=["ring", "ring_flash", "ulysses", "local",
+                            "flash", "auto"],
+                   help="default: ring (local under --pp); ring_flash = "
+                        "ring schedule with the Pallas kernel per block")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--log-every", type=int, default=10)
     args = p.parse_args()

@@ -220,24 +220,35 @@ def forward(params, tokens, cfg: TransformerConfig,
         q, k, v, dh = _qkv_proj(x, layer, dt, model_axis, cfg.head_dim)
         b, t = q.shape[:2]
         if seq_axis is not None:
-            if attention in ("ring", "auto"):  # auto: ring under SP
+            if attention == "ring_flash" or (attention == "auto" and
+                                             _flash_profitable(t)):
+                # Ring attention with the flash kernel as the per-step
+                # block math: auto upgrades when the LOCAL chunk length
+                # clears the kernel's measured crossover.
+                o = seq_mod.ring_flash_attention(
+                    q, k, v, seq_axis, True, None, None, segment_ids)
+            elif attention in ("ring", "auto"):
                 o = seq_mod.ring_attention(q, k, v, seq_axis, causal=True,
                                            segment_ids=segment_ids)
             elif attention == "ulysses":
                 o = seq_mod.ulysses_attention(q, k, v, seq_axis, causal=True,
                                               segment_ids=segment_ids)
             else:
-                # The flash kernel is single-device attention; under
-                # sequence parallelism K/V blocks arrive over ICI and the
-                # blockwise math lives in ring_attention.  Never silently
-                # substitute a different algorithm than the user selected.
+                # The single-device flash kernel route makes no sense
+                # under a sequence axis; K/V blocks arrive over ICI and
+                # the blockwise math lives in ring[_flash]_attention.
+                # Never silently substitute a different algorithm.
                 raise ValueError(
                     f"attention={attention!r} is not available with a "
-                    f"sequence axis; choose 'ring' or 'ulysses'")
-        elif attention == "flash" or (attention == "auto" and
-                                      _flash_profitable(t)):
+                    f"sequence axis; choose 'ring', 'ring_flash' or "
+                    f"'ulysses'")
+        elif attention in ("flash", "ring_flash") or (
+                attention == "auto" and _flash_profitable(t)):
             # Pallas flash kernel (ops/flash_attention.py): same exact
             # math blockwise in VMEM; requires T divisible by its blocks.
+            # 'ring_flash' without a seq axis degenerates to exactly
+            # this kernel (a 1-ring's only step is the diagonal one) —
+            # the user still measures the algorithm they selected.
             o = flash_attention(q, k, v, True, segment_ids=segment_ids)
         else:
             o = seq_mod.local_attention(q, k, v, causal=True,

@@ -69,19 +69,24 @@ def _out_vma(*arrays):
     return frozenset(out)
 
 
-def _mask_scores(s, qi, kj, block_q, block_k, causal, seg_ref):
+def _mask_scores(s, qi, kj, block_q, block_k, causal, qseg_ref,
+                 kseg_ref=None):
     """Apply causal and/or segment (sequence-packing) masks to a score
     block.  Segment ids ride a [B, 1, T] layout like the m/l rows; tokens
-    attend only within their own segment."""
+    attend only within their own segment.  ``kseg_ref`` defaults to the
+    q-side ref (self-attention); ring attention passes the ROTATED
+    K-side ids separately."""
     if causal:
         qpos = qi * block_q + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         kpos = kj * block_k + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         s = jnp.where(qpos >= kpos, s, NEG_INF)
-    if seg_ref is not None:
-        qseg = seg_ref[0, 0, pl.dslice(qi * block_q, block_q)]
-        kseg = seg_ref[0, 0, pl.dslice(kj * block_k, block_k)]
+    if qseg_ref is not None:
+        if kseg_ref is None:
+            kseg_ref = qseg_ref
+        qseg = qseg_ref[0, 0, pl.dslice(qi * block_q, block_q)]
+        kseg = kseg_ref[0, 0, pl.dslice(kj * block_k, block_k)]
         s = jnp.where(qseg[:, None] == kseg[None, :], s, NEG_INF)
     return s
 
@@ -90,10 +95,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
                 block_q: int, block_k: int, num_k: int, causal: bool,
                 scale: float, segments: bool):
     if segments:
-        seg_ref, o_ref, m_ref, l_ref, acc_ref = rest
+        qseg_ref, kseg_ref, o_ref, m_ref, l_ref, acc_ref = rest
     else:
         o_ref, m_ref, l_ref, acc_ref = rest
-        seg_ref = None
+        qseg_ref = kseg_ref = None
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     rows = pl.dslice(qi * block_q, block_q)
@@ -114,7 +119,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
-        s = _mask_scores(s, qi, kj, block_q, block_k, causal, seg_ref)
+        s = _mask_scores(s, qi, kj, block_q, block_k, causal, qseg_ref,
+                         kseg_ref)
         m_blk = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m, m_blk)
         safe_m = jnp.where(m_new == NEG_INF, 0.0, m_new)
@@ -155,10 +161,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, m_ref, l_ref,
                    num_k: int, causal: bool, scale: float,
                    segments: bool):
     if segments:
-        seg_ref, dq_ref, acc_ref = rest
+        qseg_ref, kseg_ref, dq_ref, acc_ref = rest
     else:
         dq_ref, acc_ref = rest
-        seg_ref = None
+        qseg_ref = kseg_ref = None
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     rows = pl.dslice(qi * block_q, block_q)
@@ -181,7 +187,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, m_ref, l_ref,
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        s = _mask_scores(s, qi, kj, block_q, block_k, causal, seg_ref)
+        s = _mask_scores(s, qi, kj, block_q, block_k, causal, qseg_ref,
+                         kseg_ref)
         p = jnp.where(s == NEG_INF, 0.0,
                       jnp.exp(s - safe_m[:, None])) / denom[:, None]
         dp = jax.lax.dot_general(
@@ -206,10 +213,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, m_ref, l_ref,
                     *rest, block_q: int, block_k: int, num_q: int,
                     causal: bool, scale: float, segments: bool):
     if segments:
-        seg_ref, dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = rest
+        (qseg_ref, kseg_ref, dk_ref, dv_ref, dk_acc_ref,
+         dv_acc_ref) = rest
     else:
         dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = rest
-        seg_ref = None
+        qseg_ref = kseg_ref = None
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     rows = pl.dslice(qi * block_q, block_q)
@@ -233,7 +241,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, m_ref, l_ref,
         s = jax.lax.dot_general(
             q_blk, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
-        s = _mask_scores(s, qi, ki, block_q, block_k, causal, seg_ref)
+        s = _mask_scores(s, qi, ki, block_q, block_k, causal, qseg_ref,
+                         kseg_ref)
         p = jnp.where(s == NEG_INF, 0.0,
                       jnp.exp(s - safe_m[:, None])) / denom[:, None]
         dv_acc_ref[...] += jax.lax.dot_general(
@@ -304,23 +313,18 @@ def _seg_spec(t, h):
     return pl.BlockSpec((1, 1, t), lambda bh_, i, j: (bh_ // h, 0, 0))
 
 
-def _fwd(q, k, v, seg, causal, scale, block_q, block_k, interpret):
-    b, t, h, d = _check_shapes(q, k, v, block_q, block_k)
-    if seg is not None:
-        if seg.shape != (b, t):
-            raise ValueError(
-                f"segment_ids must be [B, T] = {(b, t)} matching q/k/v, "
-                f"got {seg.shape} (pad segment ids with the sequence)")
-        if not jnp.issubdtype(seg.dtype, jnp.integer):
-            raise ValueError(
-                f"segment_ids must be integer, got {seg.dtype}")
-    qf, kf, vf = _fold(q), _fold(k), _fold(v)
-    bh = b * h
+def _fwd_parts(qf, kf, vf, qsegf, ksegf, h, causal, scale, block_q,
+               block_k, interpret):
+    """Folded-layout forward: (of, m, l) with m/l the [bh, 1, T] online
+    softmax state — the raw pieces ring attention merges across steps.
+    ``qsegf``/``ksegf`` are [B, 1, T] (pass the same array for
+    self-attention)."""
+    bh, t, d = qf.shape
     num_k = t // block_k
     grid = (bh, t // block_q, num_k)
     kernel = functools.partial(_fwd_kernel, block_q=block_q,
                                block_k=block_k, num_k=num_k, causal=causal,
-                               scale=scale, segments=seg is not None)
+                               scale=scale, segments=qsegf is not None)
     # Causal: masked steps (above the diagonal) clamp the K/V block index
     # to the last live block — same index as the preceding step, so Mosaic
     # elides the DMA instead of fetching a tile whose work pl.when skips.
@@ -332,11 +336,11 @@ def _fwd(q, k, v, seg, causal, scale, block_q, block_k, interpret):
         pl.BlockSpec((1, block_k, d), kv_map),
     ]
     operands = [qf, kf, vf]
-    if seg is not None:
-        in_specs.append(_seg_spec(t, h))
-        operands.append(seg.reshape(b, 1, t))
+    if qsegf is not None:
+        in_specs += [_seg_spec(t, h), _seg_spec(t, h)]
+        operands += [qsegf, ksegf]
     vma = _out_vma(*operands)
-    o, m, l = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
@@ -352,27 +356,46 @@ def _fwd(q, k, v, seg, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, 1, t), lambda bh_, i, j: (bh_, 0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t, d), q.dtype, vma=vma),
+            jax.ShapeDtypeStruct((bh, t, d), qf.dtype, vma=vma),
             jax.ShapeDtypeStruct((bh, 1, t), jnp.float32, vma=vma),
             jax.ShapeDtypeStruct((bh, 1, t), jnp.float32, vma=vma),
         ],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(*operands)
+
+
+def _fwd(q, k, v, seg, causal, scale, block_q, block_k, interpret):
+    b, t, h, d = _check_shapes(q, k, v, block_q, block_k)
+    if seg is not None:
+        if seg.shape != (b, t):
+            raise ValueError(
+                f"segment_ids must be [B, T] = {(b, t)} matching q/k/v, "
+                f"got {seg.shape} (pad segment ids with the sequence)")
+        if not jnp.issubdtype(seg.dtype, jnp.integer):
+            raise ValueError(
+                f"segment_ids must be integer, got {seg.dtype}")
+    qf, kf, vf = _fold(q), _fold(k), _fold(v)
+    segf = seg.reshape(b, 1, t) if seg is not None else None
+    o, m, l = _fwd_parts(qf, kf, vf, segf, segf, h, causal, scale,
+                         block_q, block_k, interpret)
     return _unfold(o, b, h), (qf, kf, vf, o, m, l, seg, b, h)
 
 
-def _bwd(causal, scale, block_q, block_k, interpret, res, do):
-    qf, kf, vf, of, m, l, seg, b, h = res
+def _bwd_parts(qf, kf, vf, of, dof, m, l, qsegf, ksegf, h, causal, scale,
+               block_q, block_k, interpret):
+    """Folded-layout backward: (dqf, dkf, dvf) from the GLOBAL (m, l)
+    rows.  Ring attention calls this per rotating block with the final
+    accumulated m/l — the per-block contributions are then the exact
+    global-softmax gradients (p recomputed as exp(s − m)/l)."""
     bh, t, d = qf.shape
-    dof = _fold(do)
     num_k = t // block_k
     num_q = t // block_q
-    segf = seg.reshape(b, 1, t) if seg is not None else None
+    segments = qsegf is not None
     kernel_dq = functools.partial(_bwd_dq_kernel, block_q=block_q,
                                   block_k=block_k, num_k=num_k,
                                   causal=causal, scale=scale,
-                                  segments=seg is not None)
+                                  segments=segments)
     kv_map = (_causal_kv_map(block_q, block_k) if causal
               else (lambda bh_, i, j: (bh_, j, 0)))
     dq_specs = [
@@ -385,9 +408,9 @@ def _bwd(causal, scale, block_q, block_k, interpret, res, do):
         pl.BlockSpec((1, 1, t), lambda bh_, i, j: (bh_, 0, 0)),
     ]
     dq_operands = [qf, kf, vf, of, dof, m, l]
-    if seg is not None:
-        dq_specs.append(_seg_spec(t, h))
-        dq_operands.append(segf)
+    if segments:
+        dq_specs += [_seg_spec(t, h), _seg_spec(t, h)]
+        dq_operands += [qsegf, ksegf]
     vma = _out_vma(*dq_operands)
     dq = pl.pallas_call(
         kernel_dq,
@@ -403,7 +426,7 @@ def _bwd(causal, scale, block_q, block_k, interpret, res, do):
     kernel_dkv = functools.partial(_bwd_dkv_kernel, block_q=block_q,
                                    block_k=block_k, num_q=num_q,
                                    causal=causal, scale=scale,
-                                   segments=seg is not None)
+                                   segments=segments)
     q_map = (_causal_q_map(block_q, block_k) if causal
              else (lambda bh_, j, i: (bh_, i, 0)))
     dkv_specs = [
@@ -416,9 +439,9 @@ def _bwd(causal, scale, block_q, block_k, interpret, res, do):
         pl.BlockSpec((1, 1, t), lambda bh_, j, i: (bh_, 0, 0)),
     ]
     dkv_operands = [qf, kf, vf, of, dof, m, l]
-    if seg is not None:
-        dkv_specs.append(_seg_spec(t, h))
-        dkv_operands.append(segf)
+    if segments:
+        dkv_specs += [_seg_spec(t, h), _seg_spec(t, h)]
+        dkv_operands += [qsegf, ksegf]
     vma = _out_vma(*dkv_operands)
     dk, dv = pl.pallas_call(
         kernel_dkv,
@@ -436,6 +459,16 @@ def _bwd(causal, scale, block_q, block_k, interpret, res, do):
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
     )(*dkv_operands)
+    return dq, dk, dv
+
+
+def _bwd(causal, scale, block_q, block_k, interpret, res, do):
+    qf, kf, vf, of, m, l, seg, b, h = res
+    bh, t, d = qf.shape
+    dof = _fold(do)
+    segf = seg.reshape(b, 1, t) if seg is not None else None
+    dq, dk, dv = _bwd_parts(qf, kf, vf, of, dof, m, l, segf, segf, h,
+                            causal, scale, block_q, block_k, interpret)
     dseg = (np.zeros(seg.shape, jax.dtypes.float0)
             if seg is not None else None)
     return (_unfold(dq, b, h), _unfold(dk, b, h), _unfold(dv, b, h),

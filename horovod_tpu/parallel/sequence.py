@@ -124,6 +124,261 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
     return o / denom
 
 
+def _merge_online(m, l, acc, m_b, l_b, o_b):
+    """Merge a block's (m_b, l_b, o_b-normalized) into the running
+    (m, l, acc-unnormalized) online-softmax state.  All m/l are
+    [bh, 1, T] fp32; acc/o_b are [bh, T, D]."""
+    m_new = jnp.maximum(m, m_b)
+    safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    c1 = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe))
+    c2 = jnp.where(jnp.isneginf(m_b), 0.0, jnp.exp(m_b - safe))
+    l_new = l * c1 + l_b * c2
+    row = lambda x: x[:, 0, :, None]                     # [bh, T, 1]
+    acc_new = acc * row(c1) + o_b.astype(jnp.float32) * row(l_b * c2)
+    return m_new, l_new, acc_new
+
+
+def _lax_fwd_parts(qf, kf, vf, qsegf, ksegf, h, causal, scale, bq, bk,
+                   interp):
+    """Interpret-mode twin of ``flash_attention._fwd_parts``: the same
+    (o, m, l) contract in plain lax ops.  Exists because the Pallas HLO
+    interpreter traces kernel internals into the vma-checked jaxpr and
+    rejects ppermuted operands under ``check_vma=True`` (CPU-only
+    limitation; the compiled TPU path runs the kernel).  Doubles as an
+    independent oracle of the kernel's formulas."""
+    s = jnp.einsum("bqd,bkd->bqk", qf.astype(jnp.float32),
+                   kf.astype(jnp.float32)) * scale
+    t = qf.shape[1]
+    if causal:
+        pos = jnp.arange(t)
+        s = jnp.where(pos[:, None] >= pos[None, :], s, -jnp.inf)
+    if qsegf is not None:
+        qs = jnp.repeat(qsegf[:, 0, :], h, axis=0)       # [bh, T]
+        ks = jnp.repeat(ksegf[:, 0, :], h, axis=0)
+        s = jnp.where(qs[:, :, None] == ks[:, None, :], s, -jnp.inf)
+    m = jnp.max(s, axis=-1)                              # [bh, T]
+    safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    p = jnp.where(jnp.isneginf(s), 0.0, jnp.exp(s - safe[..., None]))
+    l = jnp.sum(p, axis=-1)
+    denom = jnp.where(l == 0.0, 1.0, l)
+    o = (jnp.einsum("bqk,bkd->bqd", p, vf.astype(jnp.float32)) /
+         denom[..., None]).astype(qf.dtype)
+    return o, m[:, None, :], l[:, None, :]
+
+
+def _lax_bwd_parts(qf, kf, vf, of, dof, m, l, qsegf, ksegf, h, causal,
+                   scale, bq, bk, interp):
+    """Interpret-mode twin of ``flash_attention._bwd_parts`` (same
+    global-(m, l) blockwise gradient formulas in plain lax ops)."""
+    f32 = jnp.float32
+    s = jnp.einsum("bqd,bkd->bqk", qf.astype(f32),
+                   kf.astype(f32)) * scale
+    t = qf.shape[1]
+    if causal:
+        pos = jnp.arange(t)
+        s = jnp.where(pos[:, None] >= pos[None, :], s, -jnp.inf)
+    if qsegf is not None:
+        qs = jnp.repeat(qsegf[:, 0, :], h, axis=0)
+        ks = jnp.repeat(ksegf[:, 0, :], h, axis=0)
+        s = jnp.where(qs[:, :, None] == ks[:, None, :], s, -jnp.inf)
+    safe = jnp.where(jnp.isneginf(m[:, 0, :]), 0.0, m[:, 0, :])
+    denom = jnp.where(l[:, 0, :] == 0.0, 1.0, l[:, 0, :])
+    p = jnp.where(jnp.isneginf(s), 0.0,
+                  jnp.exp(s - safe[..., None])) / denom[..., None]
+    do32, o32 = dof.astype(f32), of.astype(f32)
+    di = jnp.sum(do32 * o32, axis=-1)                    # [bh, T]
+    dv = jnp.einsum("bqk,bqd->bkd", p, do32)
+    dp = jnp.einsum("bqd,bkd->bqk", do32, vf.astype(f32))
+    ds = p * (dp - di[..., None])
+    dq = jnp.einsum("bqk,bkd->bqd", ds, kf.astype(f32)) * scale
+    dk = jnp.einsum("bqk,bqd->bkd", ds, qf.astype(f32)) * scale
+    return dq.astype(qf.dtype), dk.astype(kf.dtype), dv.astype(vf.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def ring_flash_attention(q, k, v, axis_name: str = "seq",
+                         causal: bool = True,
+                         scale: Optional[float] = None,
+                         interpret: Optional[bool] = None,
+                         segment_ids=None):
+    """Ring attention with the Pallas flash kernel as the per-step block
+    math (Liu et al. 2023 structure; kernel from
+    ``ops/flash_attention``).
+
+    Identical semantics to :func:`ring_attention` — exact attention over
+    a sequence sharded on ``axis_name``, K/V (and K-side segment ids)
+    rotating via ``ppermute`` — but each ring step runs the flash
+    forward kernel on the (local Q) x (arriving K/V) pair and merges the
+    kernel's online-softmax state (m, l) across steps, so scores never
+    materialize in HBM and the block math rides the measured-faster
+    kernel (docs/kernels.md).  The DIAGONAL step (own block) uses the
+    causal kernel with tile elision; off-diagonal steps are
+    position-free (fully visible or fully masked by ring geometry), so
+    they run the non-causal kernel and masked steps are zeroed at the
+    merge — the same wasted-matmul cost profile as the lax route.
+
+    The backward is a hand-scheduled second ring pass: per arriving
+    block, the flash dq/dkv kernels run with the FINAL (m, l) rows —
+    block contributions under the global softmax are exactly the global
+    gradients — dq accumulates locally while dk/dv accumulate on the
+    rotating block and arrive home after the full cycle.
+    """
+    out, _ = _ring_flash_fwd(q, k, v, axis_name, causal, scale,
+                             interpret, segment_ids)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale, interpret,
+                    segment_ids):
+    from horovod_tpu.ops import flash_attention as fa
+
+    size = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    bq, bk = fa._eff_blocks(q.shape[1], None, None)
+    b, t, h, d = fa._check_shapes(q, k, v, bq, bk)
+    scale_ = (d ** -0.5) if scale is None else scale
+    interp = fa._interpret_default() if interpret is None else interpret
+
+    if segment_ids is not None:
+        if segment_ids.shape != (b, t):
+            raise ValueError(
+                f"segment_ids must be [B, T_local] = {(b, t)} matching "
+                f"this shard's q/k/v, got {segment_ids.shape}")
+        if not jnp.issubdtype(segment_ids.dtype, jnp.integer):
+            raise ValueError(
+                f"segment_ids must be integer, got {segment_ids.dtype}")
+    qf, kf, vf = fa._fold(q), fa._fold(k), fa._fold(v)
+    segf = (segment_ids.reshape(b, 1, t)
+            if segment_ids is not None else None)
+
+    from horovod_tpu.parallel._vma import pin_to, vma_of
+    _pin = pin_to(vma_of(q) | vma_of(k) | vma_of(v) | {axis_name})
+
+    # Parts selection: the compiled TPU path always runs the kernel; an
+    # EXPLICIT interpret=True keeps the kernel in the Pallas interpreter
+    # (the test surface; needs check_vma=False — the interpreter traces
+    # kernel internals into the vma-checked jaxpr and rejects ppermuted
+    # operands); the None-default on a non-TPU backend takes the lax
+    # twin so user CPU runs work under check_vma=True train steps.
+    use_kernel = (interpret is True) or not interp
+    fwd_parts = fa._fwd_parts if use_kernel else _lax_fwd_parts
+
+    # Diagonal step: own K/V, standard causal kernel (tile elision on).
+    o0, m, l = fwd_parts(qf, kf, vf, segf, segf, h, causal, scale_,
+                         bq, bk, interp)
+    row = lambda x: x[:, 0, :, None]
+    acc = o0.astype(jnp.float32) * row(l)
+    m, l, acc = _pin(m), _pin(l), _pin(acc)
+
+    perm = [(i, (i + 1) % size) for i in range(size)]
+    k_rot = lax.ppermute(kf, axis_name, perm)
+    v_rot = lax.ppermute(vf, axis_name, perm)
+    kseg_rot = (lax.ppermute(segf, axis_name, perm)
+                if segf is not None else None)
+
+    def step(carry, s):
+        if segf is None:
+            m, l, acc, k_rot, v_rot = carry
+            kseg = None
+        else:
+            m, l, acc, k_rot, v_rot, kseg = carry
+        o_b, m_b, l_b = fwd_parts(qf, k_rot, v_rot, segf, kseg, h,
+                                  False, scale_, bq, bk, interp)
+        if causal:
+            # Block s arrived from rank (idx - s) mod size: fully
+            # visible iff it sits strictly left of our chunk (s <= idx).
+            vis = (s <= idx)
+            m_b = jnp.where(vis, m_b, -jnp.inf)
+            l_b = jnp.where(vis, l_b, 0.0)
+        m, l, acc = _merge_online(m, l, acc, m_b, l_b, o_b)
+        k_rot = lax.ppermute(k_rot, axis_name, perm)
+        v_rot = lax.ppermute(v_rot, axis_name, perm)
+        if segf is None:
+            return (m, l, acc, k_rot, v_rot), None
+        kseg = lax.ppermute(kseg, axis_name, perm)
+        return (m, l, acc, k_rot, v_rot, kseg), None
+
+    init = ((m, l, acc, k_rot, v_rot) if segf is None
+            else (m, l, acc, k_rot, v_rot, kseg_rot))
+    out = lax.scan(step, init, jnp.arange(1, size))[0]
+    m, l, acc = out[0], out[1], out[2]
+    denom = jnp.where(l == 0.0, 1.0, l)
+    of = (acc / row(denom)).astype(q.dtype)
+    return fa._unfold(of, b, h), (qf, kf, vf, segf, of, m, l, b, h)
+
+
+def _ring_flash_bwd(axis_name, causal, scale, interpret, res, do):
+    from horovod_tpu.ops import flash_attention as fa
+
+    qf, kf, vf, segf, of, m, l, b, h = res
+    size = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    bh, t, d = qf.shape
+    scale_ = (d ** -0.5) if scale is None else scale
+    interp = fa._interpret_default() if interpret is None else interpret
+    bq, bk = fa._eff_blocks(t, None, None)
+    dof = fa._fold(do)
+
+    from horovod_tpu.parallel._vma import pin_to, vma_of
+    _pin = pin_to(vma_of(qf) | vma_of(kf) | vma_of(vf) | {axis_name})
+
+    use_kernel = (interpret is True) or not interp   # see forward
+    bwd_parts = fa._bwd_parts if use_kernel else _lax_bwd_parts
+
+    # Diagonal step with the causal kernels and GLOBAL m/l rows.
+    dq0, dk0, dv0 = bwd_parts(qf, kf, vf, of, dof, m, l, segf, segf,
+                              h, causal, scale_, bq, bk, interp)
+    dq_acc = _pin(dq0.astype(jnp.float32))
+    perm = [(i, (i + 1) % size) for i in range(size)]
+    k_rot = lax.ppermute(kf, axis_name, perm)
+    v_rot = lax.ppermute(vf, axis_name, perm)
+    dk_rot = _pin(lax.ppermute(dk0.astype(jnp.float32), axis_name, perm))
+    dv_rot = _pin(lax.ppermute(dv0.astype(jnp.float32), axis_name, perm))
+    kseg_rot = (lax.ppermute(segf, axis_name, perm)
+                if segf is not None else None)
+
+    def step(carry, s):
+        if segf is None:
+            dq_acc, dk_rot, dv_rot, k_rot, v_rot = carry
+            kseg = None
+        else:
+            dq_acc, dk_rot, dv_rot, k_rot, v_rot, kseg = carry
+        dq_b, dk_b, dv_b = bwd_parts(qf, k_rot, v_rot, of, dof, m, l,
+                                     segf, kseg, h, False, scale_,
+                                     bq, bk, interp)
+        if causal:
+            vis = (s <= idx)
+            z = lambda g: jnp.where(vis, g.astype(jnp.float32), 0.0)
+        else:
+            z = lambda g: g.astype(jnp.float32)
+        dq_acc = dq_acc + z(dq_b)
+        dk_rot = dk_rot + z(dk_b)
+        dv_rot = dv_rot + z(dv_b)
+        k_rot = lax.ppermute(k_rot, axis_name, perm)
+        v_rot = lax.ppermute(v_rot, axis_name, perm)
+        dk_rot = lax.ppermute(dk_rot, axis_name, perm)
+        dv_rot = lax.ppermute(dv_rot, axis_name, perm)
+        if segf is None:
+            return (dq_acc, dk_rot, dv_rot, k_rot, v_rot), None
+        kseg = lax.ppermute(kseg, axis_name, perm)
+        return (dq_acc, dk_rot, dv_rot, k_rot, v_rot, kseg), None
+
+    init = ((dq_acc, dk_rot, dv_rot, k_rot, v_rot) if segf is None
+            else (dq_acc, dk_rot, dv_rot, k_rot, v_rot, kseg_rot))
+    out = lax.scan(step, init, jnp.arange(1, size))[0]
+    dq_acc, dk_fin, dv_fin = out[0], out[1], out[2]
+    dq = fa._unfold(dq_acc.astype(qf.dtype), b, h)
+    dk = fa._unfold(dk_fin.astype(kf.dtype), b, h)
+    dv = fa._unfold(dv_fin.astype(vf.dtype), b, h)
+    import numpy as np
+    dseg = (np.zeros((b, t), jax.dtypes.float0)
+            if segf is not None else None)
+    return dq, dk, dv, dseg
+
+
+ring_flash_attention.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
 def ulysses_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
                       scale: Optional[float] = None, segment_ids=None):
     """DeepSpeed-Ulysses: all-to-all from sequence-sharded to head-sharded,

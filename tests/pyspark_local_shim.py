@@ -57,6 +57,8 @@ class _Mapped:
         self._payload = cloudpickle.dumps(f)
 
     def collect(self):
+        import queue as _queue
+        import time
         ctx = mp.get_context("spawn")
         q = ctx.Queue()
         procs = [ctx.Process(target=_worker, args=(self._payload, i, q))
@@ -64,8 +66,22 @@ class _Mapped:
         for p in procs:
             p.start()
         results = {}
-        for _ in range(self._n):
-            idx, kind, val = q.get(timeout=600)
+        deadline = time.monotonic() + 600
+        while len(results) < self._n:
+            try:
+                idx, kind, val = q.get(timeout=5)
+            except _queue.Empty:
+                # Fail fast with the real cause when a worker died
+                # without reporting (spawn failure, OOM kill).
+                dead = [(i, p.exitcode) for i, p in enumerate(procs)
+                        if not p.is_alive() and i not in results]
+                if dead or time.monotonic() > deadline:
+                    for p in procs:
+                        p.terminate()
+                    raise RuntimeError(
+                        f"tasks died without reporting: {dead}"
+                        if dead else "timed out waiting for tasks")
+                continue
             if kind == "err":
                 for p in procs:
                     p.terminate()

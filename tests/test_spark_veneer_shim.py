@@ -32,6 +32,7 @@ def test_spark_run_veneer_shim():
         pytest.skip("real pyspark present; the distributed twin covers it")
     except ImportError:
         pass
+    pytest.importorskip("cloudpickle")   # the shim's task serializer
     import pyspark_local_shim
     pyspark_local_shim.install()
     try:
