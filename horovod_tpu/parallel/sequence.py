@@ -195,6 +195,17 @@ def _lax_bwd_parts(qf, kf, vf, of, dof, m, l, qsegf, ksegf, h, causal,
     return dq.astype(qf.dtype), dk.astype(kf.dtype), dv.astype(vf.dtype)
 
 
+def _ring_use_kernel(interpret, interp) -> bool:
+    """Kernel vs lax-twin selection for the ring parts: compiled (TPU)
+    always runs the kernel; an EXPLICIT interpreter request — the
+    ``interpret=True`` argument or ``HOROVOD_FLASH_INTERPRET=1`` —
+    keeps the kernel in the Pallas interpreter (kernel-debug surface);
+    only the implicit non-TPU default takes the lax twin."""
+    import os
+    return ((interpret is True) or not interp or
+            os.environ.get("HOROVOD_FLASH_INTERPRET") == "1")
+
+
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def ring_flash_attention(q, k, v, axis_name: str = "seq",
                          causal: bool = True,
@@ -255,12 +266,14 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, scale, interpret,
     _pin = pin_to(vma_of(q) | vma_of(k) | vma_of(v) | {axis_name})
 
     # Parts selection: the compiled TPU path always runs the kernel; an
-    # EXPLICIT interpret=True keeps the kernel in the Pallas interpreter
-    # (the test surface; needs check_vma=False — the interpreter traces
-    # kernel internals into the vma-checked jaxpr and rejects ppermuted
-    # operands); the None-default on a non-TPU backend takes the lax
-    # twin so user CPU runs work under check_vma=True train steps.
-    use_kernel = (interpret is True) or not interp
+    # EXPLICIT interpreter request (interpret=True or
+    # HOROVOD_FLASH_INTERPRET=1) keeps the kernel in the Pallas
+    # interpreter (the kernel-debug/test surface; needs check_vma=False
+    # — the interpreter traces kernel internals into the vma-checked
+    # jaxpr and rejects ppermuted operands); the None-default on a
+    # non-TPU backend takes the lax twin so user CPU runs work under
+    # check_vma=True train steps.
+    use_kernel = _ring_use_kernel(interpret, interp)
     fwd_parts = fa._fwd_parts if use_kernel else _lax_fwd_parts
 
     # Diagonal step: own K/V, standard causal kernel (tile elision on).
@@ -322,7 +335,7 @@ def _ring_flash_bwd(axis_name, causal, scale, interpret, res, do):
     from horovod_tpu.parallel._vma import pin_to, vma_of
     _pin = pin_to(vma_of(qf) | vma_of(kf) | vma_of(vf) | {axis_name})
 
-    use_kernel = (interpret is True) or not interp   # see forward
+    use_kernel = _ring_use_kernel(interpret, interp)   # see forward
     bwd_parts = fa._bwd_parts if use_kernel else _lax_bwd_parts
 
     # Diagonal step with the causal kernels and GLOBAL m/l rows.

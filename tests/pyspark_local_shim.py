@@ -72,9 +72,12 @@ class _Mapped:
                 idx, kind, val = q.get(timeout=5)
             except _queue.Empty:
                 # Fail fast with the real cause when a worker died
-                # without reporting (spawn failure, OOM kill).
+                # without reporting (spawn failure, OOM kill).  A clean
+                # exit (code 0) right after its put() is NOT dead — the
+                # result may still be in the pipe; loop and drain it.
                 dead = [(i, p.exitcode) for i, p in enumerate(procs)
-                        if not p.is_alive() and i not in results]
+                        if not p.is_alive() and p.exitcode != 0
+                        and i not in results]
                 if dead or time.monotonic() > deadline:
                     for p in procs:
                         p.terminate()

@@ -52,6 +52,29 @@ def test_headline_models_train_step(hvd, mesh8):
         assert res["img_sec_per_chip"] > 0
 
 
+def test_lm_benchmark_plumbing(hvd):
+    """run_lm_benchmark (the bench.py 'lm' key) end-to-end on a tiny
+    config: finite loss, throughput, and the analytic FLOP accounting
+    present (MFU itself is None on CPU — no known peak)."""
+    from horovod_tpu.benchmark import lm_train_flops, run_lm_benchmark
+
+    res = run_lm_benchmark(
+        d_model=32, n_layers=2, n_heads=2, vocab_size=64, seq_len=64,
+        batch_size=2, attention="local", remat="dots",
+        num_warmup_batches=1, num_batches_per_iter=2, num_iters=2,
+        verbose=False)
+    assert np.isfinite(res["loss"])
+    assert res["tok_sec_per_chip"] > 0
+    assert res["flops_per_step_analytic"] > 0
+    # the analytic count matches the hand formula
+    from horovod_tpu.models.transformer import TransformerConfig
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=128, max_seq=64)
+    n_matmul = 2 * (4 * 32 * 32 + 2 * 32 * 128) + 32 * 64
+    want = 6.0 * n_matmul * 2 * 64 + 6.0 * 2 * 64 * 64 * 32 * 2
+    assert lm_train_flops(cfg, 2) == want
+
+
 def test_registry(hvd):
     from horovod_tpu.models import get_model, list_models
 
