@@ -86,8 +86,8 @@ def main():
 
 def _lm_bench():
     """Compute-bound LM MFU datapoint (VERDICT r3 #1): the swept optimum
-    — d3584/L6/H28 (head 128), T=2048, batch 4, flash attention with
-    1024 auto blocks, bf16 momentum — measured ≥60% MFU on v5e-1
+    — d3072/L10/H24 (head 128), T=2048, batch 4, flash attention with
+    1024 auto blocks, bf16 momentum — measured 75% MFU on v5e-1
     (docs/benchmarks.md has the full sweep + protocol).  BENCH_LM=0
     skips; knobs mirror the sweep's axes."""
     if os.environ.get("BENCH_LM", "1") != "1":
@@ -95,9 +95,9 @@ def _lm_bench():
     from horovod_tpu.benchmark import run_lm_benchmark
     try:
         r = run_lm_benchmark(
-            d_model=int(os.environ.get("BENCH_LM_D_MODEL", "3584")),
-            n_layers=int(os.environ.get("BENCH_LM_LAYERS", "6")),
-            n_heads=int(os.environ.get("BENCH_LM_HEADS", "28")),
+            d_model=int(os.environ.get("BENCH_LM_D_MODEL", "3072")),
+            n_layers=int(os.environ.get("BENCH_LM_LAYERS", "10")),
+            n_heads=int(os.environ.get("BENCH_LM_HEADS", "24")),
             seq_len=int(os.environ.get("BENCH_LM_SEQ", "2048")),
             batch_size=int(os.environ.get("BENCH_LM_BATCH", "4")),
             attention=os.environ.get("BENCH_LM_ATTENTION", "flash"),

@@ -502,6 +502,55 @@ def run_lm_benchmark(d_model: int = 2048, n_layers: int = 8,
     }
 
 
+def run_decode_benchmark(d_model: int = 2048, n_layers: int = 8,
+                         n_heads: int = 16, vocab_size: int = 32768,
+                         batch_size: int = 8, prompt_len: int = 16,
+                         total_len: int = 512, num_iters: int = 3,
+                         verbose: bool = True) -> dict:
+    """Greedy-decode (KV-cache) throughput: new tokens/sec and ms/step.
+
+    Decode is HBM-bandwidth-bound (every step reads the full weight
+    set); the scanned ``generate`` loop compiles to one program, so the
+    measured ms/step is the device cost.  bf16 on TPU."""
+    from horovod_tpu.models import transformer as tfm
+
+    if prompt_len >= total_len:
+        raise ValueError(f"prompt_len ({prompt_len}) must be < "
+                         f"total_len ({total_len}) to decode anything")
+    on_cpu = jax.devices()[0].platform == "cpu"
+    cfg = tfm.TransformerConfig(
+        vocab_size=vocab_size, d_model=d_model, n_heads=n_heads,
+        n_layers=n_layers, d_ff=4 * d_model, max_seq=total_len,
+        dtype=jnp.float32 if on_cpu else jnp.bfloat16)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(0, vocab_size, (batch_size, prompt_len)), jnp.int32)
+    gen = jax.jit(lambda p, pr: tfm.generate(p, pr, total_len, cfg))
+    out = gen(params, prompt)
+    int(np.asarray(out)[0, -1])           # sync barrier (scalar fetch)
+    t0 = time.perf_counter()
+    for _ in range(num_iters):
+        out = gen(params, prompt)
+    int(np.asarray(out)[0, -1])
+    dt = (time.perf_counter() - t0) / num_iters
+    new_tokens = batch_size * (total_len - prompt_len)
+    # generate's scan runs total_len - 1 decode steps (prompt positions
+    # are teacher-forced but still stepped); per-step latency divides
+    # by the STEPS, tok/s by the NEW tokens.
+    res = {
+        "d_model": d_model, "n_layers": n_layers,
+        "batch_size": batch_size, "total_len": total_len,
+        "decode_tok_sec": new_tokens / dt,
+        "ms_per_step": dt / (total_len - 1) * 1e3,
+    }
+    if verbose:
+        print(f"decode d{d_model} L{n_layers} B{batch_size}: "
+              f"{res['decode_tok_sec']:,.0f} tok/s, "
+              f"{res['ms_per_step']:.2f} ms/step", flush=True)
+    return res
+
+
 def run_scaling_efficiency(model_name: str = "resnet50",
                            batch_size: int = 64,
                            n_devices: Optional[int] = None,

@@ -75,6 +75,29 @@ def test_lm_benchmark_plumbing(hvd):
     assert lm_train_flops(cfg, 2) == want
 
 
+def test_decode_benchmark_plumbing_and_bf16(hvd):
+    """run_decode_benchmark end-to-end on a tiny config, plus the bf16
+    regression: decode_step must accept a bf16 cfg (the rmsnorm f32
+    scale used to promote k/v past the cache dtype — r4 fix)."""
+    import jax.numpy as jnp
+
+    from horovod_tpu.benchmark import run_decode_benchmark
+    from horovod_tpu.models import transformer as tfm
+
+    res = run_decode_benchmark(d_model=32, n_layers=2, n_heads=2,
+                               vocab_size=64, batch_size=2,
+                               prompt_len=4, total_len=16,
+                               num_iters=1, verbose=False)
+    assert res["decode_tok_sec"] > 0
+
+    cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_seq=16,
+                                dtype=jnp.bfloat16)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    out = tfm.generate(params, jnp.zeros((1, 2), jnp.int32), 8, cfg)
+    assert out.shape == (1, 8)
+
+
 def test_registry(hvd):
     from horovod_tpu.models import get_model, list_models
 
