@@ -19,6 +19,17 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _slotify(pos, gate, capacity: int):
+    """Queue positions [T, E] (-1 = not routed there) + per-token gate
+    -> (dispatch [T, E, C] one-hot, combine = dispatch * gate); tokens
+    whose position exceeds capacity are dropped.  Shared by both
+    routers so capacity semantics cannot diverge."""
+    in_cap = (pos >= 0) & (pos < capacity)
+    dispatch = (jax.nn.one_hot(jnp.clip(pos, 0, capacity - 1), capacity) *
+                in_cap[..., None]).astype(jnp.float32)        # [T, E, C]
+    return dispatch, dispatch * gate[:, None, None]
+
+
 def top1_routing(logits, capacity: int):
     """Switch-style top-1 routing with fixed capacity.
 
@@ -34,15 +45,48 @@ def top1_routing(logits, capacity: int):
     onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)   # [T, E]
     # Position of each token within its expert's queue.
     pos = jnp.cumsum(onehot, axis=0) * onehot - 1             # [T, E]
-    in_cap = (pos >= 0) & (pos < capacity)
-    dispatch = (jax.nn.one_hot(jnp.clip(pos, 0, capacity - 1), capacity) *
-                in_cap[..., None]).astype(jnp.float32)        # [T, E, C]
-    combine = dispatch * gate[:, None, None]
-    return dispatch, combine
+    return _slotify(pos, gate, capacity)
+
+
+def top2_routing(logits, capacity: int):
+    """GShard-style top-2 routing with fixed capacity.
+
+    logits: [T, E] router scores.  Each token goes to its best AND
+    second-best expert; the two gates are renormalized to sum to 1
+    (GShard eq. 4 — keeps the layer's output scale independent of how
+    probability mass splits between the pair).  Capacity is assigned
+    first-come-first-served with ALL first choices queued before any
+    second choice at the same expert (the standard priority rule:
+    dropping a token's backup hurts less than dropping its primary).
+    Returns (dispatch [T, E, C], combine [T, E, C]); overflow drops.
+    """
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    idx1 = jnp.argmax(probs, axis=-1)                          # [T]
+    p1 = jnp.take_along_axis(probs, idx1[:, None], axis=-1)[:, 0]
+    masked = probs * (1.0 - jax.nn.one_hot(idx1, e))
+    idx2 = jnp.argmax(masked, axis=-1)
+    p2 = jnp.take_along_axis(masked, idx2[:, None], axis=-1)[:, 0]
+    denom = p1 + p2 + 1e-9
+    g1, g2 = p1 / denom, p2 / denom
+
+    oh1 = jax.nn.one_hot(idx1, e, dtype=jnp.int32)             # [T, E]
+    oh2 = jax.nn.one_hot(idx2, e, dtype=jnp.int32)
+    pos1 = jnp.cumsum(oh1, axis=0) * oh1 - 1                   # [T, E]
+    # Second choices queue behind every first choice of that expert.
+    count1 = oh1.sum(axis=0)                                   # [E]
+    pos2 = (jnp.cumsum(oh2, axis=0) + count1[None, :]) * oh2 - 1
+
+    d1, c1 = _slotify(pos1, g1, capacity)
+    d2, c2 = _slotify(pos2, g2, capacity)
+    # A token's two choices are distinct experts, so the slots never
+    # collide and the sums stay one-hot per (token, choice).
+    return d1 + d2, c1 + c2
 
 
 def moe_layer(x, router_w, expert_fn: Callable, expert_params,
-              axis_name: str = "expert", capacity_factor: float = 1.25):
+              axis_name: str = "expert", capacity_factor: float = 1.25,
+              router: str = "top1"):
     """Apply a distributed MoE layer inside shard_map.
 
     x: [T_local, D] local tokens; router_w: [D, E_total];
@@ -50,7 +94,11 @@ def moe_layer(x, router_w, expert_fn: Callable, expert_params,
     experts-per-chip, here fixed to 1 for clarity);
     expert_fn(params, tokens[C, D]) -> [C, D].
 
-    Total experts = axis size.  Returns [T_local, D].
+    Total experts = axis size.  ``router`` selects Switch top-1 or
+    GShard top-2 (each token to its two best experts, renormalized
+    gates — roughly doubles per-expert traffic at equal capacity
+    factor, so top-2 users typically also raise ``capacity_factor``).
+    Returns [T_local, D].
     """
     size = lax.axis_size(axis_name)
     t, d = x.shape
@@ -58,7 +106,12 @@ def moe_layer(x, router_w, expert_fn: Callable, expert_params,
     capacity = max(int(capacity_factor * t / e), 1)
 
     logits = x @ router_w                                     # [T, E]
-    dispatch, combine = top1_routing(logits, capacity)
+    if router == "top1":
+        dispatch, combine = top1_routing(logits, capacity)
+    elif router == "top2":
+        dispatch, combine = top2_routing(logits, capacity)
+    else:
+        raise ValueError(f"router={router!r}: expected 'top1' or 'top2'")
 
     # Gather this shard's tokens per expert: [E, C, D].
     buffers = jnp.einsum("td,tec->ecd", x, dispatch)

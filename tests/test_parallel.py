@@ -295,6 +295,80 @@ def test_moe_layer_end_to_end(hvd):
                                rtol=1e-5, atol=1e-6)
 
 
+def test_top2_routing(hvd):
+    """GShard top-2: both choices dispatched with renormalized gates;
+    second choices queue behind firsts and drop first at capacity."""
+    from horovod_tpu.parallel.expert import top2_routing
+
+    t, e = 8, 4
+    rng = np.random.default_rng(3)
+    logits = jnp.asarray(rng.standard_normal((t, e)), jnp.float32)
+    dispatch, combine = top2_routing(logits, capacity=2 * t)
+
+    probs = np.asarray(jax.nn.softmax(logits, -1))
+    i1 = probs.argmax(-1)
+    masked = probs * (1 - np.eye(e)[i1])
+    i2 = masked.argmax(-1)
+    # two dispatches per token; gates renormalize to 1
+    np.testing.assert_allclose(np.asarray(dispatch.sum(axis=(1, 2))), 2.0)
+    np.testing.assert_allclose(np.asarray(combine.sum(axis=(1, 2))), 1.0,
+                               rtol=1e-5)
+    # dispatched exactly to the two argmax experts
+    per_expert = np.asarray(dispatch.sum(axis=2))          # [T, E]
+    for tok in range(t):
+        assert per_expert[tok, i1[tok]] == 1.0
+        assert per_expert[tok, i2[tok]] == 1.0
+
+    # capacity 1: at each expert only ONE slot — and a first choice
+    # outranks any earlier-arriving second choice
+    d1, _ = top2_routing(logits, capacity=1)
+    kept = np.asarray(d1.sum(axis=2))                      # [T, E]
+    for ex in range(e):
+        takers = np.nonzero(kept[:, ex])[0]
+        assert len(takers) <= 1
+        if len(takers) == 1 and (i1 == ex).any():
+            # the surviving slot belongs to the FIRST first-choice token
+            assert takers[0] == np.nonzero(i1 == ex)[0][0]
+
+
+def test_moe_layer_top2_matches_dense(hvd):
+    """Distributed top-2 MoE output equals the dense per-token oracle
+    (gate1*E_i1(x) + gate2*E_i2(x)) when capacity admits everything;
+    experts scale by (expert_index + 1) so wrong routing is visible."""
+    from horovod_tpu.parallel.expert import moe_layer
+
+    mesh = _mesh(hvd, ("expert",), (4,))
+    t, d, e = 8, 6, 4
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.standard_normal((4 * t, d)), jnp.float32)
+    router_w = jnp.asarray(rng.standard_normal((d, e)), jnp.float32)
+
+    def expert_fn(params, tokens):
+        # params: this chip's scale (expert_index + 1)
+        return tokens * params
+
+    scales = jnp.arange(1.0, e + 1.0)
+    run = jax.jit(jax.shard_map(
+        lambda x, s: moe_layer(x, router_w, expert_fn, s,
+                               axis_name="expert", capacity_factor=8.0,
+                               router="top2"),
+        mesh=mesh, in_specs=(P("expert"), P("expert")),
+        out_specs=P("expert"), check_vma=True))
+    out = np.asarray(run(x, scales))
+
+    probs = np.asarray(jax.nn.softmax(np.asarray(x) @ np.asarray(router_w),
+                                      -1))
+    i1 = probs.argmax(-1)
+    p1 = probs[np.arange(4 * t), i1]
+    masked = probs * (1 - np.eye(e)[i1])
+    i2 = masked.argmax(-1)
+    p2 = masked[np.arange(4 * t), i2]
+    g1, g2 = p1 / (p1 + p2 + 1e-9), p2 / (p1 + p2 + 1e-9)
+    want = (g1[:, None] * (i1 + 1)[:, None] * np.asarray(x) +
+            g2[:, None] * (i2 + 1)[:, None] * np.asarray(x))
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
 # ---------------------------------------------------------------------------
 # Transformer LM end-to-end
 # ---------------------------------------------------------------------------
