@@ -393,7 +393,8 @@ ring_flash_attention.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 def ulysses_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
-                      scale: Optional[float] = None, segment_ids=None):
+                      scale: Optional[float] = None, segment_ids=None,
+                      use_flash: Optional[bool] = None):
     """DeepSpeed-Ulysses: all-to-all from sequence-sharded to head-sharded,
     full local attention, all-to-all back.  Heads must divide axis size.
 
@@ -403,6 +404,14 @@ def ulysses_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
     packing: after the all-to-all each device holds the FULL sequence for
     its head subset, so the ids are all-gathered over the seq axis once
     (tiny: int32 per token) and applied as a dense segment-equality mask.
+
+    ``use_flash``: the post-all-to-all attention is plain single-device
+    attention over the FULL T_global, so the Pallas flash kernel applies
+    directly — same exact math, O(block) instead of O(T_global²) score
+    memory (r4).  ``None`` auto-selects it on a compiled TPU backend
+    when T_global divides the kernel blocks; the lax route remains the
+    CPU/oracle path (interpret-mode kernels need ``check_vma=False``,
+    see :func:`_ring_use_kernel`).
     """
     size = lax.axis_size(axis_name)
     b, t, h, d = q.shape
@@ -421,6 +430,31 @@ def ulysses_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
 
     qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
     scale_ = (d ** -0.5) if scale is None else scale
+    tg_ = qg.shape[1]
+    if use_flash is None:
+        import os
+        try:
+            on_tpu = jax.default_backend() == "tpu"
+        except Exception:  # pragma: no cover
+            on_tpu = False
+        # Auto mirrors the model-level flash gate: COMPILED kernel only
+        # (HOROVOD_FLASH_INTERPRET=1 means the interpreter-debug
+        # surface, which needs check_vma=False — explicit use_flash
+        # there), 128-divisible T_global, and above the measured
+        # flash-vs-lax crossover (HOROVOD_FLASH_AUTO_MIN_T, same knob
+        # as attention="auto").
+        min_t = int(os.environ.get("HOROVOD_FLASH_AUTO_MIN_T", "1024"))
+        use_flash = (on_tpu and
+                     os.environ.get("HOROVOD_FLASH_INTERPRET") != "1" and
+                     tg_ % 128 == 0 and tg_ >= min_t)
+    if use_flash:
+        from horovod_tpu.ops.flash_attention import flash_attention
+        seg_g = (lax.all_gather(segment_ids, axis_name, axis=1,
+                                tiled=True)
+                 if segment_ids is not None else None)
+        out = flash_attention(qg, kg, vg, causal, scale_,
+                              segment_ids=seg_g)
+        return gather_heads(out)
     s = jnp.einsum("bqhd,bkhd->bhqk", qg, kg) * scale_
     tg = qg.shape[1]
     allowed = None
