@@ -50,7 +50,16 @@ def main():
     p.add_argument("--steps", type=int, default=150)
     p.add_argument("--lr", type=float, default=3e-2)
     p.add_argument("--aux-weight", type=float, default=0.01)
+    p.add_argument("--router", choices=("top1", "top2"), default="top1",
+                   help="Switch top-1 or GShard top-2 routing")
+    p.add_argument("--capacity-factor", type=float, default=None,
+                   help="expert capacity factor (default 1.25 for top1, "
+                        "2.5 for top2 - top-2 emits twice the "
+                        "token-choices)")
     args = p.parse_args()
+
+    cap_factor = (args.capacity_factor if args.capacity_factor is not None
+                  else (2.5 if args.router == "top2" else 1.25))
 
     hvd.init()
     mesh = build_mesh(axes=("data", "expert"),
@@ -92,7 +101,8 @@ def main():
         logits_r = x @ params["router"]
         y = moe_layer(x, params["router"],
                       expert_fn, {"w1": params["w1"], "w2": params["w2"]},
-                      axis_name="expert")
+                      axis_name="expert", router=args.router,
+                      capacity_factor=cap_factor)
         out = (x + y) @ params["head"]
         ce = optax.softmax_cross_entropy_with_integer_labels(
             out, labels).mean()
