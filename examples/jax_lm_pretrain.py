@@ -58,15 +58,17 @@ def main():
     p.add_argument("--microbatches", type=int, default=2,
                    help="GPipe microbatches per step (with --pp)")
     p.add_argument("--pp-schedule",
-                   choices=("gpipe", "1f1b", "interleaved"),
+                   choices=("gpipe", "1f1b", "interleaved",
+                            "interleaved_1f1b"),
                    default="gpipe",
                    help="pipeline schedule: gpipe (AD backward pipeline), "
-                        "1f1b (O(stages) activation memory), or "
-                        "interleaved (virtual stages, "
-                        "docs/parallelism.md)")
+                        "1f1b (O(stages) activation memory), "
+                        "interleaved (virtual stages), or "
+                        "interleaved_1f1b (full Megatron: bubble/v at "
+                        "O(stages) memory, docs/parallelism.md)")
     p.add_argument("--virtual", type=int, default=2,
                    help="virtual chunks per device (--pp-schedule "
-                        "interleaved)")
+                        "interleaved / interleaved_1f1b)")
     p.add_argument("--steps", type=int, default=50)
     p.add_argument("--batch-size", type=int, default=4,
                    help="global batch (sequences)")
@@ -129,7 +131,8 @@ def main():
             optax.scale_by_adam(),
             optax.scale_by_schedule(schedule),
             optax.scale(-1.0))
-        v = args.virtual if args.pp_schedule == "interleaved" else 1
+        v = (args.virtual if args.pp_schedule in
+             ("interleaved", "interleaved_1f1b") else 1)
         params = tfm.split_pipeline_params(params, args.pp, virtual=v)
         step_fn, shard_of = tfm.make_train_step_pipelined(
             cfg, optimizer, mesh,

@@ -633,6 +633,14 @@ def make_train_step_pipelined(cfg: TransformerConfig, optimizer, mesh,
     params from ``split_pipeline_params(params, P, virtual)``).
     Requires ``n_microbatches % pipe == 0``.
 
+    ``schedule="interleaved_1f1b"``: the FULL Megatron schedule
+    (:func:`horovod_tpu.parallel.pipeline.pipeline_1f1b_interleaved`):
+    virtual-stage round-robin + hand-scheduled 1F1B with a fwd-packed
+    warmup and bwd drain — bubble ÷ v at O(pipe) activation memory
+    (2v·P saved chunk inputs).  Same exact gradients; same params
+    layout as "interleaved"; requires ``n_microbatches % pipe == 0``
+    and ``n_microbatches >= pipe``.
+
     Params layout: :func:`split_pipeline_params` output
     (``{"base": embed/pos/ln_f (replicated), "stacked":
     stack_layer_params(...) (stage dim over pipe)}``).
@@ -643,7 +651,8 @@ def make_train_step_pipelined(cfg: TransformerConfig, optimizer, mesh,
     from jax.sharding import NamedSharding
 
     n_stages = mesh.shape[pipe_axis]
-    v_eff = virtual if schedule == "interleaved" else 1
+    v_eff = (virtual if schedule in ("interleaved", "interleaved_1f1b")
+             else 1)
     if cfg.n_layers % (n_stages * v_eff):
         raise ValueError(f"{cfg.n_layers} layers not divisible over "
                          f"{n_stages * v_eff} pipe chunks")
@@ -660,7 +669,7 @@ def make_train_step_pipelined(cfg: TransformerConfig, optimizer, mesh,
             mesh=mesh, in_specs=(bspec, sspec, data_spec),
             out_specs=data_spec, check_vma=False)(base, stacked, tokens)
 
-    if schedule == "1f1b":
+    if schedule in ("1f1b", "interleaved_1f1b"):
         from horovod_tpu.parallel.pipeline import make_pipeline_1f1b_loss
 
         def head_loss(y, tgt, base):
@@ -678,7 +687,8 @@ def make_train_step_pipelined(cfg: TransformerConfig, optimizer, mesh,
                 mb_spec=mb_spec,
                 aux_spec={k: P() for k in params["base"]},
                 axis_name=pipe_axis,
-                data_axes=(data_axis,) if data_axis else ())
+                data_axes=(data_axis,) if data_axis else (),
+                virtual=v_eff)
             base = params["base"]
             b, t = tokens.shape
             mb = _embed_microbatches(base, tokens, cfg, n_microbatches)
@@ -692,7 +702,7 @@ def make_train_step_pipelined(cfg: TransformerConfig, optimizer, mesh,
                         labels)
     else:
         raise ValueError(f"schedule={schedule!r}: expected 'gpipe', "
-                         f"'1f1b' or 'interleaved'")
+                         f"'1f1b', 'interleaved' or 'interleaved_1f1b'")
 
     def _step(params, opt_state, tokens, labels):
         loss, grads = jax.value_and_grad(_loss)(params, tokens, labels)

@@ -218,22 +218,11 @@ def pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params, aux,
     size = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     m = microbatches.shape[0]
-    mb_shape = microbatches.shape[1:]
     ticks = m + 2 * (size - 1)
     nbuf = 2 * size   # in-flight saved inputs <= 2(P-1)+1 < 2P
 
-    right_perm = [(i, (i + 1) % size) for i in range(size)]
-    left_perm = [(i, (i - 1) % size) for i in range(size)]
-
-    from horovod_tpu.parallel._vma import pin_to, vma_of
-
-    target_vma = {axis_name} | vma_of(microbatches) | vma_of(targets)
-    for leaf in jax.tree_util.tree_leaves((stage_params, aux)):
-        target_vma |= vma_of(leaf)
-    _pin = pin_to(target_vma)
-
-    zeros_like_pinned = lambda t: jax.tree_util.tree_map(
-        lambda l: _pin(jnp.zeros(l.shape, l.dtype)), t)
+    right_perm, left_perm, _pin, init = _1f1b_setup(
+        axis_name, size, stage_params, aux, microbatches, targets, nbuf)
 
     def tick(carry, t):
         fwd_in, bwd_in, buf, g_stage, g_aux, d_mb, loss_acc = carry
@@ -287,6 +276,30 @@ def pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params, aux,
         return (fwd_out, bwd_out, buf, g_stage, g_aux, d_mb,
                 loss_acc), None
 
+    (_, _, _, g_stage, g_aux, d_mb, loss_acc), _ = lax.scan(
+        tick, init, jnp.arange(ticks))
+    return _1f1b_finalize(axis_name, m, microbatches, g_stage, g_aux,
+                          d_mb, loss_acc)
+
+
+def _1f1b_setup(axis_name, size, stage_params, aux, microbatches,
+                targets, nbuf):
+    """Shared 1F1B scaffolding: ring permutations, the vma pin for the
+    scan carry, and the 7-element init carry (fwd_in, bwd_in, buf,
+    g_stage, g_aux, d_mb, loss_acc)."""
+    m = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+    right_perm = [(i, (i + 1) % size) for i in range(size)]
+    left_perm = [(i, (i - 1) % size) for i in range(size)]
+
+    from horovod_tpu.parallel._vma import pin_to, vma_of
+
+    target_vma = {axis_name} | vma_of(microbatches) | vma_of(targets)
+    for leaf in jax.tree_util.tree_leaves((stage_params, aux)):
+        target_vma |= vma_of(leaf)
+    _pin = pin_to(target_vma)
+    zeros_like_pinned = lambda t: jax.tree_util.tree_map(
+        lambda l: _pin(jnp.zeros(l.shape, l.dtype)), t)
     init = (
         _pin(jnp.zeros(mb_shape, microbatches.dtype)),        # fwd_in
         _pin(jnp.zeros(mb_shape, microbatches.dtype)),        # bwd_in
@@ -296,14 +309,17 @@ def pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params, aux,
         _pin(jnp.zeros((m,) + mb_shape, jnp.float32)),        # d_mb
         _pin(jnp.zeros((), jnp.float32)),
     )
-    (_, _, _, g_stage, g_aux, d_mb, loss_acc), _ = lax.scan(
-        tick, init, jnp.arange(ticks))
+    return right_perm, left_perm, _pin, init
 
+
+def _1f1b_finalize(axis_name, m, microbatches, g_stage, g_aux, d_mb,
+                   loss_acc):
+    """Shared 1F1B epilogue: mean over microbatches; loss/aux/d_mb live
+    on single stages — psum broadcasts them SPMD-wide (stage grads stay
+    local: each device owns its stage/chunk slice)."""
     inv_m = 1.0 / m
     scale = lambda t: jax.tree_util.tree_map(
         lambda l: (l * inv_m).astype(l.dtype), t)
-    # loss/aux/d_mb live on single stages — psum broadcasts them SPMD-wide
-    # (stage grads stay local: each device owns its stage slice).
     loss = lax.psum(loss_acc * inv_m, axis_name)
     g_aux = jax.tree_util.tree_map(
         lambda l: lax.psum(l * inv_m, axis_name), g_aux)
@@ -311,10 +327,167 @@ def pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params, aux,
     return loss, scale(g_stage), g_aux, d_mb
 
 
+def pipeline_1f1b_interleaved(stage_fn: Callable, loss_fn: Callable,
+                              stage_params, aux, microbatches, targets,
+                              axis_name: str = "pipe", virtual: int = 2):
+    """Interleaved (virtual-stage) 1F1B — Megatron's full schedule as
+    THREE lockstep scans over round-robin chunks.
+
+    Device p holds chunks ``{k·P+p : k < v}`` (leaves [v, ...],
+    :func:`horovod_tpu.models.transformer.stack_layer_params_interleaved`
+    layout).  Work units per device: fwd unit ``uf`` at global fwd-time
+    ``uf + p`` and bwd unit ``ub`` at global bwd-time ``ub + (P−1−p)``,
+    with ``(chunk, microbatch) = ((u//P) mod v  [reversed for bwd],
+    (u//(P·v))·P + u mod P)`` — consecutive stages land on consecutive
+    times, so one ppermute-right chain carries activations and one
+    ppermute-left chain carries cotangents (same invariant as
+    :func:`pipeline_apply_interleaved`).
+
+    The bubble win over the one-scan 1F1B needs PHASES (a uniform
+    one-fwd-one-bwd tick pays full price for masked warmup sub-steps):
+
+    * **warmup** — ``v·P`` fwd-only ticks of a 1/v-size chunk each
+      (cost ``P·t_f`` total; exactly enough for microbatch 0 to clear
+      all ``v·P`` stages),
+    * **steady** — ``v·M − v·P + P − 1`` one-fwd-one-bwd ticks,
+    * **drain** — ``v·P`` bwd-only ticks.
+
+    Total = ``M(t_f+t_b) + (P−1)(t_f+t_b)/v`` EXACTLY (the warmup's
+    ``P·t_f`` and drain's ``P·t_b`` cancel against the steady phase's
+    deficit) — the full Megatron bubble ÷ v, while activation state
+    stays O(P):
+    a ``2vP``-slot ring buffer of saved chunk INPUTS (2× the plain
+    1F1B buffer at v=2, still ≪ GPipe's O(M)).  Gradients are EXACT
+    (chunk forwards recomputed in the backward from saved inputs, the
+    same remat contract as :func:`pipeline_1f1b`).
+
+    Requires ``M % P == 0`` and ``M >= P``.  Returns
+    ``(loss, stage_grads [v, ...], aux_grads, d_microbatches)``.
+    """
+    size = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    v = virtual
+    leads = {l.shape[0] for l in jax.tree_util.tree_leaves(stage_params)}
+    if leads != {v}:
+        raise ValueError(
+            f"interleaved stage_params leaves must have leading dim "
+            f"virtual={v}; got {sorted(leads)}")
+    if m % size or m < size:
+        raise ValueError(
+            f"interleaved 1F1B needs n_microbatches ({m}) divisible by "
+            f"and >= the pipe axis size ({size})")
+    warmup = v * size                     # fwd-only ticks
+    steady = v * m - v * size + size - 1  # 1f1b ticks
+    drain = v * size                      # bwd-only ticks
+    nbuf = 2 * v * size                   # max fwd->bwd slot gap
+
+    right_perm, left_perm, _pin, init = _1f1b_setup(
+        axis_name, size, stage_params, aux, microbatches, targets, nbuf)
+
+    def chunk_of(params, k):
+        return jax.tree_util.tree_map(
+            lambda l: lax.dynamic_index_in_dim(l, k, axis=0,
+                                               keepdims=True), params)
+
+    def fwd_substep(carry, f_time):
+        """One fwd chunk: uf = f_time − idx."""
+        (fwd_in, bwd_in, buf, g_stage, g_aux, d_mb, loss_acc) = carry
+        uf = jnp.maximum(f_time - idx, 0)
+        k = (uf // size) % v
+        mb_idx = (uf // (size * v)) * size + (uf % size)
+        valid = (f_time - idx >= 0) & (uf < v * m)
+        feed = lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(mb_idx, 0, m - 1), axis=0,
+            keepdims=False)
+        x = jnp.where((idx == 0) & (k == 0), feed, fwd_in)
+        y = stage_fn(chunk_of(stage_params, k), x)
+        # slot index is p-independent: P(v*(m//P)+k) + m%P  ==  uf
+        buf = jnp.where(
+            valid,
+            lax.dynamic_update_index_in_dim(buf, x, uf % nbuf, axis=0),
+            buf)
+        fwd_out = lax.ppermute(y, axis_name, right_perm)
+        return (fwd_out, bwd_in, buf, g_stage, g_aux, d_mb, loss_acc)
+
+    def bwd_substep(carry, b_time):
+        """One bwd chunk: ub = b_time − (P−1−idx)."""
+        (fwd_in, bwd_in, buf, g_stage, g_aux, d_mb, loss_acc) = carry
+        skew = size - 1 - idx
+        ub = jnp.maximum(b_time - skew, 0)
+        k_b = v - 1 - (ub // size) % v
+        mb_idx = (ub // (size * v)) * size + (ub % size)
+        valid = (b_time - skew >= 0) & (ub < v * m)
+        # the consumed fwd unit shares the (m, k) coordinates: its slot
+        # is P(v*(m//P)+k_b) + m%P
+        slot = (size * (v * (mb_idx // size) + k_b) +
+                (mb_idx % size)) % nbuf
+        x_saved = lax.dynamic_index_in_dim(buf, slot, axis=0,
+                                           keepdims=False)
+        tgt = lax.dynamic_index_in_dim(
+            targets, jnp.clip(mb_idx, 0, m - 1), axis=0, keepdims=False)
+        params_k = chunk_of(stage_params, k_b)
+        y2, pull = jax.vjp(stage_fn, params_k, x_saved)
+        loss_val, (dy_loss, daux) = jax.value_and_grad(
+            loss_fn, argnums=(0, 2))(y2, tgt, aux)
+        last = (idx == size - 1) & (k_b == v - 1)
+        dy = jnp.where(last, dy_loss, bwd_in)
+        dparams, dx = pull(dy)
+
+        def _acc_chunk(acc, g):
+            # accumulate into chunk slot k_b (read-modify-write under
+            # the validity mask)
+            return jax.tree_util.tree_map(
+                lambda a, b: jnp.where(
+                    valid,
+                    lax.dynamic_update_index_in_dim(
+                        a,
+                        lax.dynamic_index_in_dim(a, k_b, axis=0,
+                                                 keepdims=True) + b,
+                        k_b, axis=0),
+                    a),
+                acc, g)
+
+        g_stage = _acc_chunk(g_stage, dparams)
+        g_aux = jax.tree_util.tree_map(
+            lambda a, b: a + jnp.where(valid & last, b,
+                                       jnp.zeros_like(b)),
+            g_aux, daux)
+        d_mb = jnp.where(
+            valid & (idx == 0) & (k_b == 0),
+            lax.dynamic_update_index_in_dim(
+                d_mb, dx.astype(d_mb.dtype), jnp.clip(mb_idx, 0, m - 1),
+                axis=0),
+            d_mb)
+        loss_acc = loss_acc + jnp.where(valid & last, loss_val, 0.0)
+        bwd_out = lax.ppermute(dx, axis_name, left_perm)
+        return (fwd_in, bwd_out, buf, g_stage, g_aux, d_mb, loss_acc)
+
+    # Phase A: warmup, fwd-only (fwd time 0..warmup-1).
+    carry, _ = lax.scan(
+        lambda c, t: (fwd_substep(c, t), None), init, jnp.arange(warmup))
+    # Phase B: steady 1F1B (fwd time warmup+j, bwd time j).
+    def steady_tick(c, j):
+        c = fwd_substep(c, warmup + j)
+        c = bwd_substep(c, j)
+        return c, None
+    carry, _ = lax.scan(steady_tick, carry, jnp.arange(steady))
+    # Phase C: drain, bwd-only (bwd time steady..steady+drain-1).
+    carry, _ = lax.scan(
+        lambda c, t: (bwd_substep(c, t), None), carry,
+        jnp.arange(steady, steady + drain))
+
+    (_, _, _, g_stage, g_aux, d_mb, loss_acc) = carry
+    return _1f1b_finalize(axis_name, m, microbatches, g_stage, g_aux,
+                          d_mb, loss_acc)
+
+
 def make_pipeline_1f1b_loss(stage_fn: Callable, loss_fn: Callable, mesh,
                             stage_spec, mb_spec, tgt_spec=None, aux_spec=None,
-                            axis_name: str = "pipe", data_axes=()):
-    """Differentiable scalar-loss wrapper around :func:`pipeline_1f1b`.
+                            axis_name: str = "pipe", data_axes=(),
+                            virtual: int = 1):
+    """Differentiable scalar-loss wrapper around :func:`pipeline_1f1b`
+    (or :func:`pipeline_1f1b_interleaved` when ``virtual > 1``).
 
     Returns ``f(stage_params, aux, microbatches, targets) -> loss``, a
     jit-level function whose ``jax.grad`` w.r.t. (stage_params, aux,
@@ -334,9 +507,14 @@ def make_pipeline_1f1b_loss(stage_fn: Callable, loss_fn: Callable, mesh,
     aux_spec = aux_spec if aux_spec is not None else PartitionSpec()
 
     def body(stage_params, aux, microbatches, targets):
-        loss, gs, ga, dmb = pipeline_1f1b(
-            stage_fn, loss_fn, stage_params, aux, microbatches, targets,
-            axis_name)
+        if virtual > 1:
+            loss, gs, ga, dmb = pipeline_1f1b_interleaved(
+                stage_fn, loss_fn, stage_params, aux, microbatches,
+                targets, axis_name, virtual)
+        else:
+            loss, gs, ga, dmb = pipeline_1f1b(
+                stage_fn, loss_fn, stage_params, aux, microbatches,
+                targets, axis_name)
         for ax in data_axes:
             loss = lax.pmean(loss, ax)
             gs = jax.tree_util.tree_map(lambda l: lax.pmean(l, ax), gs)

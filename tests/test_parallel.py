@@ -841,6 +841,67 @@ def test_interleaved_pipeline_matches_oracle(hvd):
                                    rtol=1e-4, atol=1e-5, err_msg=k)
 
 
+@pytest.mark.parametrize("dp,n_micro", [(1, 8), (2, 8), (1, 16)])
+def test_interleaved_1f1b_matches_gpipe(hvd, dp, n_micro):
+    """The FULL Megatron schedule (3-phase interleaved 1F1B, P=4, v=2):
+    one SGD step produces the SAME loss and the SAME updated params as
+    GPipe (exact gradients), with and without a data axis.  M=16 covers
+    the saved-input ring-buffer WRAPAROUND (v·M=32 > nbuf=2vP=16 — at
+    M=8 every slot is used exactly once and `% nbuf` never wraps).
+    The round-robin [vP, ...] chunk rows are re-mapped onto GPipe's
+    contiguous [P, lps, ...] stages for the comparison."""
+    import optax
+
+    from horovod_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(vocab_size=32, d_model=16, n_heads=2,
+                                d_ff=32, n_layers=8, max_seq=8,
+                                dtype=jnp.float32)
+    axes = ("data", "pipe") if dp > 1 else ("pipe",)
+    shape = (dp, 4) if dp > 1 else (4,)
+    mesh = _mesh(hvd, axes, shape)
+    data_axis = "data" if dp > 1 else None
+    full = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(3)
+    # GPipe microbatches each data shard locally: local batch must be
+    # divisible by M, so the global batch scales with dp.
+    toks = rng.integers(0, 32, (n_micro * dp, 9))
+    tokens = jnp.asarray(toks[:, :-1], jnp.int32)
+    labels = jnp.asarray(toks[:, 1:], jnp.int32)
+    opt = optax.sgd(0.1)
+
+    results = {}
+    for sched, v in (("gpipe", 1), ("interleaved_1f1b", 2)):
+        params0 = tfm.split_pipeline_params(
+            jax.tree_util.tree_map(jnp.array, full), 4, virtual=v)
+        step, shardings = tfm.make_train_step_pipelined(
+            cfg, opt, mesh, data_axis=data_axis, pipe_axis="pipe",
+            n_microbatches=n_micro, schedule=sched, virtual=v,
+            donate=False)
+        p_sh, opt_sh = shardings(params0)
+        params = {g: {k: jax.device_put(x, p_sh[g][k])
+                      for k, x in params0[g].items()} for g in params0}
+        opt_state = jax.device_put(opt.init(params), opt_sh)
+        params, opt_state, loss = step(params, opt_state, tokens, labels)
+        results[sched] = (jax.tree_util.tree_map(np.asarray, params),
+                          float(np.asarray(loss)))
+
+    gp, il = results["gpipe"], results["interleaved_1f1b"]
+    np.testing.assert_allclose(gp[1], il[1], rtol=1e-5)
+    for k in gp[0]["base"]:
+        np.testing.assert_allclose(il[0]["base"][k], gp[0]["base"][k],
+                                   rtol=2e-4, atol=1e-5, err_msg=k)
+    for k in gp[0]["stacked"]:
+        g = gp[0]["stacked"][k]       # [4, 2, ...]: stage row, layer col
+        i = il[0]["stacked"][k]       # [8, 1, ...]: row p*v+kk = chunk kk*4+p
+        for row in range(8):
+            p, kk = row // 2, row % 2
+            chunk = kk * 4 + p
+            np.testing.assert_allclose(
+                i[row, 0], g[chunk // 2, chunk % 2],
+                rtol=2e-4, atol=1e-5, err_msg=f"{k} row{row}")
+
+
 def test_interleaved_layout_and_guards(hvd):
     """Round-robin stacking puts global chunk k·P+p at device p slot k;
     the schedule refuses M not divisible by P and mis-stacked params."""
