@@ -484,7 +484,7 @@ def run_lm_benchmark(d_model: int = 2048, n_layers: int = 8,
     mfu = tflops_per_chip / peak if peak else None
     if verbose:
         mfu_s = f", MFU {mfu * 100:.1f}%" if mfu is not None else ""
-        print(f"{tok_sec_mean:,.0f} tok/sec/chip, "
+        print(f"{tok_sec_mean / n_chips:,.0f} tok/sec/chip, "
               f"{tflops_per_chip:.1f} TFLOP/s per chip{mfu_s}",
               flush=True)
     return {

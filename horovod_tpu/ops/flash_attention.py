@@ -44,9 +44,48 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = float("-inf")
 
 
-def _interpret_default() -> bool:
+_warned_no_abstract_device = False
+
+
+def _exec_on_tpu(x) -> bool:
+    """Whether the mesh actually EXECUTING this computation is TPU.
+
+    ``jax.default_backend()`` is the wrong question inside shard_map: on
+    a TPU host driving a CPU/virtual mesh it answers "tpu" and would
+    select the compiled Pallas kernel for a CPU computation.  The
+    abstract mesh attached to the tracer's sharding carries the real
+    device kind of the mesh the shard_map runs on."""
+    global _warned_no_abstract_device
+    try:
+        kind = jax.typeof(x).sharding.mesh.abstract_device.device_kind
+        if kind is not None:
+            return "tpu" in str(kind).lower()
+    except AttributeError:
+        # abstract_device is internal surface — if a JAX upgrade renames
+        # it, say so once instead of silently reverting to the
+        # host-backend answer this helper exists to avoid.
+        if not _warned_no_abstract_device:
+            _warned_no_abstract_device = True
+            import logging
+            logging.getLogger(__name__).debug(
+                "AbstractMesh.abstract_device.device_kind unavailable on "
+                "this JAX; falling back to jax.default_backend() for the "
+                "flash kernel platform gate")
+    try:  # outside shard_map / no mesh info: fall back to the backend
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _interpret_default(x=None) -> bool:
+    """Interpret-mode default for the kernel: the explicit debug env
+    knob wins; otherwise interpret iff the computation does NOT execute
+    on TPU — judged from the operand's executing mesh when one is given
+    (see :func:`_exec_on_tpu`), else from the host's default backend."""
     if os.environ.get("HOROVOD_FLASH_INTERPRET") == "1":
         return True
+    if x is not None:
+        return not _exec_on_tpu(x)
     try:
         return jax.default_backend() != "tpu"
     except Exception:  # pragma: no cover
@@ -489,7 +528,9 @@ def flash_attention(q, k, v, causal: bool = True,
     accumulation tolerance, forward and backward.
 
     ``block_q``/``block_k`` default to AUTO: the largest power of two
-    ≤ 1024 dividing ``T``.  Swept on a real v5e (docs/kernels.md): 512
+    ≤ 1024 dividing ``T`` (≤ 512 when ``D > 128`` — the 1024 sweep only
+    covered head dims ≤ 128, and bigger heads roughly double the bwd
+    kernel's VMEM pressure).  Swept on a real v5e (docs/kernels.md): 512
     blocks run the fwd+bwd pair 2.7× faster than 128 blocks at T=2048
     and 4.2× at T=8192, and 1024 another 1.13–1.33× over 512 (r4 sweep;
     bigger tiles amortize the grid/DMA overhead and feed the MXU longer
@@ -506,7 +547,7 @@ def flash_attention(q, k, v, causal: bool = True,
     return out
 
 
-def _auto_block(t: int) -> int:
+def _auto_block(t: int, head_dim: Optional[int] = None) -> int:
     if t < 128:
         # Short sequences (interpret mode / tests): old clamp behavior.
         for b in (64, 32, 16, 8):
@@ -520,8 +561,14 @@ def _auto_block(t: int) -> int:
     # annoying — same contract as the old fixed-128 default.
     # 1024 preferred over 512 since r4: measured fwd+bwd 1.33x at T=2048
     # (B4 H32 D128), 1.13x at T=4096/8192 (docs/kernels.md table);
-    # 1024x1024 f32 scores = 4 MB of VMEM, still comfortable.
-    for b in (1024, 512, 256, 128):
+    # 1024x1024 f32 scores = 4 MB of VMEM, still comfortable.  The 1024
+    # preference was swept at head_dim<=128 only; larger head dims
+    # roughly double the dkv kernel's operand + f32 score/p VMEM
+    # pressure, so cap the auto choice at 512 there (explicit
+    # block_q/block_k still override).
+    prefs = (512, 256, 128) if (head_dim or 0) > 128 else (1024, 512,
+                                                           256, 128)
+    for b in prefs:
         if t % b == 0:
             return b
     raise ValueError(
@@ -529,12 +576,13 @@ def _auto_block(t: int) -> int:
         f"sizing (pad the sequence, or pass explicit block_q/block_k)")
 
 
-def _eff_blocks(t, block_q, block_k):
-    # None = auto (largest power of two <= 1024 dividing T, measured
-    # fastest); explicit blocks are clamped to T so e.g. T=64 works with
-    # block 128 (divisibility still enforced after clamping).
-    bq = _auto_block(t) if block_q is None else min(block_q, t)
-    bk = _auto_block(t) if block_k is None else min(block_k, t)
+def _eff_blocks(t, block_q, block_k, head_dim=None):
+    # None = auto (largest power of two <= 1024 dividing T — capped at
+    # 512 when head_dim > 128, see _auto_block — measured fastest);
+    # explicit blocks are clamped to T so e.g. T=64 works with block
+    # 128 (divisibility still enforced after clamping).
+    bq = _auto_block(t, head_dim) if block_q is None else min(block_q, t)
+    bk = _auto_block(t, head_dim) if block_k is None else min(block_k, t)
     return bq, bk
 
 
@@ -542,16 +590,16 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
                segment_ids=None):
     d = q.shape[-1]
     scale_ = (d ** -0.5) if scale is None else scale
-    interp = _interpret_default() if interpret is None else interpret
-    bq, bk = _eff_blocks(q.shape[1], block_q, block_k)
+    interp = _interpret_default(q) if interpret is None else interpret
+    bq, bk = _eff_blocks(q.shape[1], block_q, block_k, d)
     return _fwd(q, k, v, segment_ids, causal, scale_, bq, bk, interp)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
     t, d = res[0].shape[1], res[0].shape[-1]
     scale_ = (d ** -0.5) if scale is None else scale
-    interp = _interpret_default() if interpret is None else interpret
-    bq, bk = _eff_blocks(t, block_q, block_k)
+    interp = _interpret_default(res[0]) if interpret is None else interpret
+    bq, bk = _eff_blocks(t, block_q, block_k, d)
     return _bwd(causal, scale_, bq, bk, interp, res, do)
 
 

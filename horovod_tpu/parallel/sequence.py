@@ -195,6 +195,21 @@ def _lax_bwd_parts(qf, kf, vf, of, dof, m, l, qsegf, ksegf, h, causal,
     return dq.astype(qf.dtype), dk.astype(kf.dtype), dv.astype(vf.dtype)
 
 
+def _exec_on_tpu(x) -> bool:
+    """See :func:`horovod_tpu.ops.flash_attention._exec_on_tpu` — the
+    mesh-executing-the-computation platform answer (not the host's
+    default backend)."""
+    from horovod_tpu.ops import flash_attention as fa
+    return fa._exec_on_tpu(x)
+
+
+def _interp_default_for(x) -> bool:
+    """Operand-aware kernel interpret default — delegates to
+    :func:`horovod_tpu.ops.flash_attention._interpret_default`."""
+    from horovod_tpu.ops import flash_attention as fa
+    return fa._interpret_default(x)
+
+
 def _ring_use_kernel(interpret, interp) -> bool:
     """Kernel vs lax-twin selection for the ring parts: compiled (TPU)
     always runs the kernel; an EXPLICIT interpreter request — the
@@ -245,10 +260,10 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, scale, interpret,
 
     size = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
-    bq, bk = fa._eff_blocks(q.shape[1], None, None)
+    bq, bk = fa._eff_blocks(q.shape[1], None, None, q.shape[-1])
     b, t, h, d = fa._check_shapes(q, k, v, bq, bk)
     scale_ = (d ** -0.5) if scale is None else scale
-    interp = fa._interpret_default() if interpret is None else interpret
+    interp = _interp_default_for(q) if interpret is None else interpret
 
     if segment_ids is not None:
         if segment_ids.shape != (b, t):
@@ -328,8 +343,8 @@ def _ring_flash_bwd(axis_name, causal, scale, interpret, res, do):
     idx = lax.axis_index(axis_name)
     bh, t, d = qf.shape
     scale_ = (d ** -0.5) if scale is None else scale
-    interp = fa._interpret_default() if interpret is None else interpret
-    bq, bk = fa._eff_blocks(t, None, None)
+    interp = _interp_default_for(qf) if interpret is None else interpret
+    bq, bk = fa._eff_blocks(t, None, None, d)
     dof = fa._fold(do)
 
     from horovod_tpu.parallel._vma import pin_to, vma_of
@@ -431,12 +446,16 @@ def ulysses_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
     qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
     scale_ = (d ** -0.5) if scale is None else scale
     tg_ = qg.shape[1]
+    # The kernel's own interpret default keys on the host's default
+    # backend; answer it here from the EXECUTING mesh instead so a host
+    # whose default backend disagrees with the mesh can neither select
+    # the compiled TPU kernel for a CPU mesh (explicit use_flash=True)
+    # nor flip into the interpreter-debug surface mid-gate (auto path).
+    # HOROVOD_FLASH_INTERPRET=1 still wins inside _interp_default_for.
+    flash_interpret = _interp_default_for(qg)
     if use_flash is None:
         import os
-        try:
-            on_tpu = jax.default_backend() == "tpu"
-        except Exception:  # pragma: no cover
-            on_tpu = False
+        on_tpu = _exec_on_tpu(qg)
         # Auto mirrors the model-level flash gate: COMPILED kernel only
         # (HOROVOD_FLASH_INTERPRET=1 means the interpreter-debug
         # surface, which needs check_vma=False — explicit use_flash
@@ -453,6 +472,7 @@ def ulysses_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
                                 tiled=True)
                  if segment_ids is not None else None)
         out = flash_attention(qg, kg, vg, causal, scale_,
+                              interpret=flash_interpret,
                               segment_ids=seg_g)
         return gather_heads(out)
     s = jnp.einsum("bqhd,bkhd->bhqk", qg, kg) * scale_

@@ -98,7 +98,7 @@ def _use_async_graph():
     the native table at execution, not at the sync's wait); (b) the
     handle a pruned sync never waits is reclaimed by stale-token
     reaping at the NEXT enqueue of the same wire name
-    (:func:`_reap_stale`).  See docs/frameworks.md."""
+    (:func:`_pop_stale`).  See docs/frameworks.md."""
     import os
     if tf.executing_eagerly():
         return False
