@@ -10,8 +10,12 @@ python -m horovod_tpu.native.build
 echo "--- capability report"
 python -m horovod_tpu.runner --check-build
 
-echo "--- unit + SPMD suites (8-device virtual CPU mesh via conftest)"
+echo "--- unit + SPMD suites, fast lane (8-device virtual CPU mesh)"
 python -m pytest tests/ -x -q
+
+echo "--- slow lane (multi-minute end-to-end oracles; pyproject addopts
+--- deselects these by default, CI runs them explicitly)"
+python -m pytest tests/ -x -q -m slow
 
 echo "--- distributed op matrix under the launcher (the reference's
 --- 'pytest under horovodrun' trick, gen-pipeline.sh:120-190)"
@@ -23,6 +27,13 @@ echo "--- keras binding on the JAX backend (the TPU-native Keras 3 path)"
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" KERAS_BACKEND=jax \
   python -m horovod_tpu.runner -np 2 \
   python -m pytest tests/distributed/test_keras_binding.py -x -q
+
+echo "--- joint launcher + multi-process SPMD (2 procs x 4 virtual devices:
+--- jax.distributed global mesh + native plane in ONE job)"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+  python -m horovod_tpu.runner -np 2 --jax-distributed \
+  python tests/distributed/spmd_np2_check.py
 
 echo "--- hierarchical allreduce + allgather correctness (4 ranks, 2x2 hosts)"
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \

@@ -57,13 +57,15 @@ def _exec_on_tpu(x) -> bool:
     device kind of the mesh the shard_map runs on."""
     global _warned_no_abstract_device
     try:
-        kind = jax.typeof(x).sharding.mesh.abstract_device.device_kind
-        if kind is not None:
-            return "tpu" in str(kind).lower()
-    except AttributeError:
-        # abstract_device is internal surface — if a JAX upgrade renames
-        # it, say so once instead of silently reverting to the
+        # abstract_device is None on eager/concrete arrays (normal: fall
+        # through to the backend answer, silently); it is internal
+        # surface, so a MISSING attribute means a JAX upgrade renamed it
+        # — say so once instead of silently reverting to the
         # host-backend answer this helper exists to avoid.
+        ad = jax.typeof(x).sharding.mesh.abstract_device
+        if ad is not None and ad.device_kind is not None:
+            return "tpu" in str(ad.device_kind).lower()
+    except AttributeError:
         if not _warned_no_abstract_device:
             _warned_no_abstract_device = True
             import logging

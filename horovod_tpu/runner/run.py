@@ -48,6 +48,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rendezvous-port", type=int, default=0,
                    help="Fixed controller rendezvous port (default: pick "
                         "a free port).")
+    p.add_argument("--jax-distributed", action="store_true", default=False,
+                   help="Bootstrap jax.distributed in every rank "
+                        "(multi-process SPMD: each process drives its "
+                        "local devices, jax.devices() is the global "
+                        "set).  Sets HOROVOD_JAX_DISTRIBUTED=1 and "
+                        "HOROVOD_COORDINATOR_ADDR to rank 0's host; "
+                        "hvd.init() then calls "
+                        "jax.distributed.initialize before any backend "
+                        "init.")
+    p.add_argument("--jax-coordinator-port", type=int, default=0,
+                   help="Fixed port for the jax.distributed coordinator "
+                        "on rank 0's host (default: pick a free port; "
+                        "for multi-host jobs pass a port known open on "
+                        "rank 0's host).")
 
     tune = p.add_argument_group("tunables")
     tune.add_argument("--fusion-threshold-mb", type=float, default=None)
@@ -139,6 +153,15 @@ def run_command(args) -> int:
         network.check_hosts_reachable(remote)
     addr = "127.0.0.1" if all_local else infos[0].hostname
     port = args.rendezvous_port or launch.find_free_port()
+    if getattr(args, "jax_distributed", False):
+        # The jax.distributed coordinator runs INSIDE rank 0 (unlike the
+        # controller rendezvous, which lives in this launcher process),
+        # so the port must be free on rank 0's host.  A launcher-side
+        # free-port probe is only authoritative when rank 0 is local;
+        # multi-host jobs should pin --jax-coordinator-port.
+        jport = args.jax_coordinator_port or launch.find_free_port()
+        extra_env["HOROVOD_JAX_DISTRIBUTED"] = "1"
+        extra_env["HOROVOD_COORDINATOR_ADDR"] = f"{addr}:{jport}"
     env_per_rank = [
         config_parser.runtime_env(info, addr, port, extra_env)
         for info in infos

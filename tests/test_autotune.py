@@ -40,7 +40,7 @@ def test_autotune_tunes_and_pins(tmp_path):
 
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = REPO  # exactly: inherited paths can pull in the axon sitecustomize
     env.pop("XLA_FLAGS", None)
     # Fast schedule so the search completes within the workload.
     env.update({
@@ -88,7 +88,7 @@ def test_autotune_off_by_default(tmp_path):
     """))
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = REPO  # exactly: inherited paths can pull in the axon sitecustomize
     env.pop("XLA_FLAGS", None)
     env["HOROVOD_AUTOTUNE_LOG"] = str(log)   # env set, flag absent
     res = subprocess.run(

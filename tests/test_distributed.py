@@ -35,6 +35,7 @@ def _hvdrun(args, script=None, np_=2, timeout=180, env=None, tmp_path=None):
                           timeout=timeout, env=full_env, cwd=REPO)
 
 
+@pytest.mark.slow
 def test_native_ops_under_launcher(tmp_path):
     """The full eager op matrix under a real 2-process job."""
     res = _hvdrun([sys.executable, "-m", "pytest", "-x", "-q",
@@ -44,6 +45,24 @@ def test_native_ops_under_launcher(tmp_path):
     assert res.returncode == 0, res.stdout + res.stderr
 
 
+@pytest.mark.slow
+def test_jax_distributed_spmd_under_launcher(tmp_path):
+    """hvdrun --jax-distributed: 2 processes x 4 virtual CPU devices run
+    one jax.distributed-initialized SPMD train step over a GLOBAL
+    8-device mesh, with the native TCP plane live in the same job
+    (tests/distributed/spmd_np2_check.py; the joint-certification seam,
+    reference .buildkite/gen-pipeline.sh:120-190)."""
+    res = _hvdrun(["--jax-distributed", sys.executable,
+                   os.path.join(REPO, "tests", "distributed",
+                                "spmd_np2_check.py")],
+                  np_=2, timeout=300,
+                  env={"XLA_FLAGS":
+                       "--xla_force_host_platform_device_count=4"})
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "SPMD_NP2_OK" in res.stdout
+
+
+@pytest.mark.slow
 def test_failure_fan_out(tmp_path):
     """A crashing rank must take the job down, non-zero (reference
     gloo_run.py:256-262)."""
@@ -83,6 +102,7 @@ def test_timeline_artifact(tmp_path):
     json.loads(content)  # must be valid JSON
 
 
+@pytest.mark.slow
 def test_stall_detection(tmp_path):
     """A rank that never submits triggers the stall watchdog: warning with
     missing ranks, then coordinated shutdown error (reference

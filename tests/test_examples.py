@@ -39,6 +39,7 @@ def _run_example(script, args, np_=2, timeout=420, extra_env=None):
                           timeout=timeout, env=env, cwd=REPO)
 
 
+@pytest.mark.slow
 def test_jax_mnist_single_process(tmp_path):
     """BASELINE config #1: the 1-process allreduce baseline."""
     res = subprocess.run(
@@ -51,12 +52,14 @@ def test_jax_mnist_single_process(tmp_path):
     assert "train accuracy" in res.stdout
 
 
+@pytest.mark.slow
 def test_jax_mnist_two_ranks(tmp_path):
     res = _run_example("jax_mnist.py", ["--steps", "60", "--batch-size",
                                         "32"])
     assert res.returncode == 0, res.stdout + res.stderr
 
 
+@pytest.mark.slow
 def test_pytorch_synthetic_benchmark():
     res = _run_example("pytorch_synthetic_benchmark.py",
                        ["--model", "resnet18", "--batch-size", "2",
@@ -66,6 +69,7 @@ def test_pytorch_synthetic_benchmark():
     assert "Total img/sec" in res.stdout
 
 
+@pytest.mark.slow
 def test_tensorflow2_mnist(tmp_path):
     pytest.importorskip("tensorflow")
     res = _run_example("tensorflow2_mnist.py",
@@ -75,6 +79,7 @@ def test_tensorflow2_mnist(tmp_path):
     assert "train accuracy" in res.stdout
 
 
+@pytest.mark.slow
 def test_keras_mnist(tmp_path):
     pytest.importorskip("keras")
     res = _run_example("keras_mnist.py",
@@ -84,6 +89,7 @@ def test_keras_mnist(tmp_path):
     assert "final train accuracy" in res.stdout
 
 
+@pytest.mark.slow
 def test_jax_synthetic_benchmark_json():
     """The flagship bench CLI emits a parseable result."""
     import json
@@ -100,6 +106,7 @@ def test_jax_synthetic_benchmark_json():
     assert out["img_sec_total"] > 0
 
 
+@pytest.mark.slow
 def test_pytorch_mnist_two_ranks():
     """Full torch MNIST recipe under the launcher (reference
     examples/pytorch_mnist.py run by CI under horovodrun)."""
@@ -113,6 +120,7 @@ def test_pytorch_mnist_two_ranks():
     assert "accuracy" in res.stdout
 
 
+@pytest.mark.slow
 def test_mxnet_mnist_two_ranks():
     pytest.importorskip("mxnet")
     res = _run_example("mxnet_mnist.py",
@@ -122,6 +130,7 @@ def test_mxnet_mnist_two_ranks():
     assert "OK" in res.stdout
 
 
+@pytest.mark.slow
 def test_jax_imagenet_resnet50_resume(tmp_path):
     """The ImageNet recipe trains, checkpoints, and resumes (reference
     keras_imagenet_resnet50.py's resume-from-checkpoint contract)."""
@@ -145,6 +154,7 @@ def test_jax_imagenet_resnet50_resume(tmp_path):
     assert "epoch 3" in res.stdout
 
 
+@pytest.mark.slow
 def test_jax_lm_pretrain_dp_tp_sp():
     """The LM pretraining flagship: 2x2x2 DPxTPxSP mesh, loss decreases."""
     res = subprocess.run(
@@ -179,6 +189,7 @@ def test_jax_moe():
     assert "OK" in res.stdout
 
 
+@pytest.mark.slow
 def test_jax_lm_pretrain_dp_pp():
     """The LM example's --pp path: 2 data x 4 pipe stages, loss decreases."""
     res = subprocess.run(
@@ -191,6 +202,7 @@ def test_jax_lm_pretrain_dp_pp():
     assert "OK" in res.stdout
 
 
+@pytest.mark.slow
 def test_jax_lm_pretrain_dp_pp_1f1b():
     """The LM example's --pp-schedule 1f1b path: same topology as the
     GPipe test, hand-scheduled 1F1B (O(stages) activation memory), loss

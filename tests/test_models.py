@@ -18,6 +18,7 @@ def test_resnet18_forward_shapes(hvd):
     assert out.dtype == jnp.float32
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("name,size", [("vgg16", 32), ("inception3", 96)])
 def test_headline_model_forward(hvd, name, size):
     """VGG-16 and Inception V3 — the reference's other two headline scaling
@@ -38,6 +39,7 @@ def test_headline_model_forward(hvd, name, size):
     assert "batch_stats" in mutated
 
 
+@pytest.mark.slow
 def test_headline_models_train_step(hvd, mesh8):
     """The synthetic benchmark harness must drive the new families end-to-end
     (registry -> make_train_step -> finite loss)."""
@@ -108,6 +110,7 @@ def test_registry(hvd):
         get_model("nope")
 
 
+@pytest.mark.slow
 def test_train_step_runs_and_learns(hvd, mesh8):
     """One full distributed step must run and reduce loss over a few steps."""
     from horovod_tpu.benchmark import make_train_step
@@ -141,6 +144,7 @@ def test_train_step_runs_and_learns(hvd, mesh8):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_benchmark_reports_flops_and_efficiency(hvd, monkeypatch):
     """run_synthetic_benchmark must report FLOPs (XLA cost analysis) and
     run_scaling_efficiency must compute the 1-vs-N ratio — the metric

@@ -564,6 +564,7 @@ def test_transformer_decode_under_tp(hvd):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_transformer_pipelined_matches_forward(hvd):
     """forward_pipelined over 4 pipe stages == plain forward (values and
     gradients) — PP composed with a real model, not just a toy stage."""
@@ -608,6 +609,7 @@ def test_transformer_pipelined_matches_forward(hvd):
         assert all(n > 0 for n in norms), (k, norms)
 
 
+@pytest.mark.slow
 def test_transformer_pipelined_gradients_exact(hvd):
     """Gradients THROUGH the pipeline (base + every stage) equal the
     plain forward's gradients — the property make_train_step_pipelined
@@ -740,6 +742,7 @@ def test_pipeline_1f1b_matches_oracle(hvd):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("dp", [1, 2])
 def test_train_step_1f1b_matches_gpipe(hvd, dp):
     """One SGD step under schedule='1f1b' produces the SAME params as
@@ -789,6 +792,7 @@ def test_train_step_1f1b_matches_gpipe(hvd, dp):
             err_msg=jax.tree_util.keystr(path))
 
 
+@pytest.mark.slow
 def test_interleaved_pipeline_matches_oracle(hvd):
     """Interleaved (virtual-stage) schedule at P=4, v=2, M=8: loss AND
     every gradient (base + all 8 round-robin chunks) equal the plain
@@ -841,6 +845,7 @@ def test_interleaved_pipeline_matches_oracle(hvd):
                                    rtol=1e-4, atol=1e-5, err_msg=k)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("dp,n_micro", [(1, 8), (2, 8), (1, 16)])
 def test_interleaved_1f1b_matches_gpipe(hvd, dp, n_micro):
     """The FULL Megatron schedule (3-phase interleaved 1F1B, P=4, v=2):

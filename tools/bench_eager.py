@@ -1,0 +1,296 @@
+#!/usr/bin/env python
+"""Eager-plane (TCP data plane) allreduce bandwidth sweep.
+
+Publishes the number the native runtime has never had in an artifact:
+steady-state allreduce bandwidth over local multi-process TCP, swept over
+payload size x fusion threshold x hierarchical on/off x autotune, and
+shows the autotuner's pinned configuration against the defaults
+(VERDICT r4 #3; reference anchor: the tunables surface of
+``horovod/common/parameter_manager.h:33-246`` and the autotune CSV wiring
+``horovod/run/run.py:474-477``).
+
+Driver mode (default) spawns each configuration as its own launcher job::
+
+    python tools/bench_eager.py --out BENCH_eager.json [--np 2] [--quick]
+
+Worker mode is selected by the driver via ``BENCH_EAGER_MODE`` and runs
+under ``python -m horovod_tpu.runner -np N``.  All numbers are LOOPBACK
+TCP on one host — they measure the runtime's protocol + memory path
+(framing, fusion, negotiation, ring arithmetic), not a NIC.
+
+Bus bandwidth uses the standard ring accounting: each rank moves
+``2 (n-1)/n x bytes`` through its slowest link, so
+``busbw = algbw x 2(n-1)/n`` where ``algbw = payload_bytes / time``.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Worker
+# ---------------------------------------------------------------------------
+
+
+def _time_reps(fn, warmup, reps, barrier):
+    """Best-of-reps wall time of ``fn`` with a barrier fencing each rep
+    (both ranks start together; the slowest rank defines the rep).  Best,
+    not median: on a contended 1-core host the distribution is one-sided
+    scheduler noise and the minimum estimates the plane itself."""
+    for _ in range(warmup):
+        fn()
+    best = float("inf")
+    for _ in range(reps):
+        barrier()
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _worker():
+    import numpy as np
+    # Simulated 2-host topology (the hierarchical path groups by
+    # LOCAL_SIZE; same trick as tests/distributed/hier_check_np4.py).
+    if os.environ.get("BENCH_EAGER_FAKE_HOSTS") == "2":
+        rank = int(os.environ["HOROVOD_RANK"])
+        size = int(os.environ["HOROVOD_SIZE"])
+        os.environ["HOROVOD_LOCAL_SIZE"] = str(size // 2)
+        os.environ["HOROVOD_LOCAL_RANK"] = str(rank % (size // 2))
+    import horovod_tpu as hvd
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    mode = os.environ["BENCH_EAGER_MODE"]
+    barrier = lambda: hvd.barrier()
+    ring = 2.0 * (size - 1) / size
+    out = {"mode": mode, "np": size}
+
+    if mode == "large":
+        # One big tensor per size: the pure data-plane path (negotiation
+        # amortized by the response cache after the first round).
+        sizes_mb = [float(s) for s in
+                    os.environ.get("BENCH_EAGER_SIZES_MB",
+                                   "1,4,16,64").split(",")]
+        rows = []
+        for mb in sizes_mb:
+            n = int(mb * (1 << 20) / 4)
+            x = np.random.default_rng(rank).standard_normal(n) \
+                .astype(np.float32)
+            fn = lambda: hvd.allreduce(x, op=hvd.Sum,
+                                       name=f"bench.large.{n}")
+            t = _time_reps(fn, warmup=3, reps=10, barrier=barrier)
+            algbw = n * 4 / t / 1e9
+            rows.append({"mb": mb, "sec": round(t, 6),
+                         "algbw_gbs": round(algbw, 3),
+                         "busbw_gbs": round(algbw * ring, 3)})
+        out["rows"] = rows
+
+    elif mode == "fused":
+        # Fusion-buffer workload: many small named tensors in flight at
+        # once, same names every step (steady-state cache) — the shape
+        # of a DP gradient bucket the tuner actually optimizes.
+        n_tensors = int(os.environ.get("BENCH_EAGER_TENSORS", "64"))
+        kb = int(os.environ.get("BENCH_EAGER_TENSOR_KB", "256"))
+        n = kb * 1024 // 4
+        xs = [np.random.default_rng(rank * 1000 + i)
+              .standard_normal(n).astype(np.float32)
+              for i in range(n_tensors)]
+
+        def step():
+            hs = [hvd.allreduce_async(x, op=hvd.Sum,
+                                      name=f"bench.fused.{i}")
+                  for i, x in enumerate(xs)]
+            for h in hs:
+                hvd.synchronize(h)
+
+        autotune = os.environ.get("HOROVOD_AUTOTUNE") == "1"
+        if autotune:
+            # Drive the tuner to convergence before timing: warmup +
+            # trials x samples x steps busy cycles (reduced knobs set by
+            # the driver), then measure the PINNED configuration.
+            settle = int(os.environ.get("BENCH_EAGER_AUTOTUNE_STEPS",
+                                        "220"))
+            for _ in range(settle):
+                step()
+        # Streaming throughput, not barrier-fenced latency: steps run
+        # back-to-back (the shape of a training loop, and the metric the
+        # autotuner's bytes/usec score optimizes).  Best block of several
+        # — on a 1-core host the scheduler's noise floor is ~2x, and the
+        # best block is the least-perturbed estimate of the plane itself.
+        blocks, steps_per_block = 6, 8
+        for _ in range(5):
+            step()
+        t = float("inf")
+        for _ in range(blocks):
+            barrier()
+            t0 = time.perf_counter()
+            for _ in range(steps_per_block):
+                step()
+            t = min(t, (time.perf_counter() - t0) / steps_per_block)
+        payload = n_tensors * n * 4
+        algbw = payload / t / 1e9
+        out.update({
+            "n_tensors": n_tensors, "tensor_kb": kb,
+            "step_payload_mb": round(payload / (1 << 20), 1),
+            "sec_per_step": round(t, 6),
+            "algbw_gbs": round(algbw, 3),
+            "busbw_gbs": round(algbw * ring, 3),
+            "fusion_threshold_mb":
+                int(os.environ.get("HOROVOD_FUSION_THRESHOLD", str(64 << 20)))
+                / (1 << 20),
+            "cycle_time_ms": float(os.environ.get("HOROVOD_CYCLE_TIME",
+                                                  "1.0")),
+            "autotune": autotune,
+        })
+    else:
+        raise SystemExit(f"unknown BENCH_EAGER_MODE={mode!r}")
+
+    if os.environ.get("BENCH_EAGER_FAKE_HOSTS") == "2":
+        from horovod_tpu import basics
+        out["hierarchical_engaged"] = bool(
+            basics.runtime().hierarchical_enabled())
+    barrier()
+    if rank == 0:
+        print("BENCH_EAGER_RESULT " + json.dumps(out), flush=True)
+    hvd.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+
+def _run_config(name, np_, env, timeout=600):
+    """Launch one worker configuration under the launcher; returns the
+    rank-0 result dict (or raises with the captured tail)."""
+    full_env = dict(os.environ)
+    full_env.update(env)
+    # Exactly the repo: an inherited site dir can re-register an
+    # accelerator plugin in every worker (and ignore JAX_PLATFORMS).
+    full_env["PYTHONPATH"] = REPO
+    full_env["JAX_PLATFORMS"] = "cpu"  # numpy plane only
+    cmd = [sys.executable, "-m", "horovod_tpu.runner", "-np", str(np_),
+           sys.executable, os.path.abspath(__file__)]
+    res = subprocess.run(cmd, env=full_env, capture_output=True,
+                         text=True, timeout=timeout, cwd=REPO)
+    marker = "BENCH_EAGER_RESULT "
+    # A marker from a job that then failed (e.g. one rank crashed in
+    # shutdown) is not a clean number — the job must also exit 0.
+    if res.returncode == 0:
+        for line in res.stdout.splitlines():
+            if marker in line:
+                r = json.loads(line.split(marker, 1)[1])
+                r["config"] = name
+                return r
+    raise RuntimeError(
+        f"config {name}: no clean result (rc={res.returncode})\n"
+        f"stdout tail: {res.stdout[-1000:]}\n"
+        f"stderr tail: {res.stderr[-1000:]}")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--np", type=int, default=2,
+                    help="ranks for the non-hierarchical configs")
+    ap.add_argument("--out", default=None,
+                    help="write results JSON here (default: stdout only)")
+    ap.add_argument("--quick", action="store_true",
+                    help="small sizes / fewer configs (CI smoke)")
+    args = ap.parse_args()
+
+    sizes = "1,4" if args.quick else "1,4,16,64"
+    autotune_log = os.path.join(tempfile.gettempdir(),
+                                f"bench_eager_autotune_{os.getpid()}.csv")
+    # Reduced tuner schedule so convergence fits the settle loop:
+    # 2 warmup + <=12 trials x 3 samples x 5 steps ~ 190 busy cycles.
+    tuner_env = {
+        "HOROVOD_AUTOTUNE": "1",
+        "HOROVOD_AUTOTUNE_WARMUP_SAMPLES": "2",
+        "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE": "5",
+        "HOROVOD_AUTOTUNE_SAMPLES": "3",
+        "HOROVOD_AUTOTUNE_BAYES_TRIALS": "12",
+        "HOROVOD_AUTOTUNE_LOG": autotune_log,
+        "BENCH_EAGER_AUTOTUNE_STEPS": "200",
+    }
+    configs = [
+        ("large_defaults", args.np,
+         {"BENCH_EAGER_MODE": "large", "BENCH_EAGER_SIZES_MB": sizes}),
+        ("fused_defaults", args.np, {"BENCH_EAGER_MODE": "fused"}),
+        ("fused_no_fusion", args.np,
+         {"BENCH_EAGER_MODE": "fused", "HOROVOD_FUSION_THRESHOLD": "0"}),
+        ("fused_2mb", args.np,
+         {"BENCH_EAGER_MODE": "fused",
+          "HOROVOD_FUSION_THRESHOLD": str(2 << 20)}),
+        ("fused_no_cache", args.np,
+         {"BENCH_EAGER_MODE": "fused", "HOROVOD_CACHE_CAPACITY": "0"}),
+        ("fused_autotune", args.np,
+         dict(BENCH_EAGER_MODE="fused", **tuner_env)),
+    ]
+    if not args.quick:
+        hier = {"BENCH_EAGER_MODE": "large",
+                "BENCH_EAGER_SIZES_MB": "16",
+                "BENCH_EAGER_FAKE_HOSTS": "2"}
+        configs += [
+            ("hier_off_np4_16mb", 4, dict(hier)),
+            ("hier_on_np4_16mb", 4,
+             dict(hier, HOROVOD_HIERARCHICAL_ALLREDUCE="1",
+                  HOROVOD_HIERARCHICAL_ALLREDUCE_THRESHOLD="0")),
+        ]
+
+    results = []
+    for name, np_, env in configs:
+        print(f"--- {name} (np={np_})", file=sys.stderr, flush=True)
+        try:
+            results.append(_run_config(name, np_, env))
+        except Exception as e:  # keep sweeping; record the failure
+            results.append({"config": name, "error": str(e)[:2000]})
+        print(json.dumps(results[-1]), file=sys.stderr, flush=True)
+
+    # Attach the tuner's trial log (trial rows + the pinned row) so the
+    # artifact shows WHAT the tuner chose, not just that it helped.
+    pinned = None
+    try:
+        with open(autotune_log) as f:
+            lines = [ln.strip() for ln in f if ln.strip()]
+        for ln in lines[1:]:
+            cols = ln.split(",")
+            if cols[-1] == "1":
+                pinned = {"cycle_time_ms": float(cols[1]),
+                          "fusion_threshold_mb": float(cols[2]),
+                          "cache_enabled": cols[3] == "1"}
+        os.unlink(autotune_log)
+    except (OSError, ValueError, IndexError):
+        # A truncated row (worker killed mid-write) must not lose the
+        # whole sweep's artifact.
+        pass
+
+    doc = {"bench": "eager_allreduce_tcp_loopback",
+           "host_cores": os.cpu_count(),
+           "note": ("loopback TCP on one host; measures the runtime's "
+                    "protocol+memory path, not a NIC. On a 1-core host "
+                    "both ranks and the kernel share the core: absolute "
+                    "GB/s is environment-capped, read the RELATIVE "
+                    "comparisons (fusion/cycle/autotune)"),
+           "autotune_pinned": pinned,
+           "results": results}
+    line = json.dumps(doc)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    failures = [r for r in results if "error" in r]
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    if os.environ.get("BENCH_EAGER_MODE"):
+        _worker()
+    else:
+        sys.exit(main())
