@@ -28,12 +28,9 @@ JAX_PLATFORMS=cpu PYTHONPATH="$PWD" KERAS_BACKEND=jax \
   python -m horovod_tpu.runner -np 2 \
   python -m pytest tests/distributed/test_keras_binding.py -x -q
 
-echo "--- joint launcher + multi-process SPMD (2 procs x 4 virtual devices:
---- jax.distributed global mesh + native plane in ONE job)"
-JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
-  XLA_FLAGS=--xla_force_host_platform_device_count=4 \
-  python -m horovod_tpu.runner -np 2 --jax-distributed \
-  python tests/distributed/spmd_np2_check.py
+#  (The joint launcher+SPMD certification — hvdrun --jax-distributed with
+#   tests/distributed/spmd_np2_check.py — runs inside the slow lane via
+#   tests/test_distributed.py::test_jax_distributed_spmd_under_launcher.)
 
 echo "--- hierarchical allreduce + allgather correctness (4 ranks, 2x2 hosts)"
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \

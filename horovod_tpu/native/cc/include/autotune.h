@@ -86,6 +86,13 @@ struct TunedParams {
   double cycle_time_ms = 1.0;
   int64_t fusion_threshold = 64 * 1024 * 1024;
   bool cache_enabled = true;
+  // Hierarchical routing as categorical dimensions (reference
+  // parameter_manager.h:133-246 tunes the same booleans); explored only
+  // when the bootstrap agreement verified a homogeneous block topology
+  // on every rank (operations.cc), and applied at the same
+  // response-stream position everywhere so routing never diverges.
+  bool hier_allreduce = false;
+  bool hier_allgather = false;
 };
 
 // Coordinator-side tuner: warmup -> samples of bytes/usec -> median score
@@ -99,8 +106,13 @@ class ParameterManager {
   //   HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE  busy cycles per sample (10)
   //   HOROVOD_AUTOTUNE_SAMPLES           samples per trial, median (5)
   //   HOROVOD_AUTOTUNE_BAYES_TRIALS      max trials before pinning (20)
+  // hier_*_state: the bootstrap-agreed initial routing; hier_available:
+  // every rank verified the same homogeneous block mapping, making the
+  // two hierarchical booleans explorable (otherwise they are pinned at
+  // their bootstrap state, like cache with capacity 0).
   void Initialize(int rank, double cycle_ms, int64_t fusion_bytes,
-                  bool cache_enabled);
+                  bool cache_enabled, bool hier_allreduce = false,
+                  bool hier_allgather = false, bool hier_available = false);
 
   bool active() const { return active_; }
 
@@ -125,6 +137,9 @@ class ParameterManager {
   int64_t fusion_threshold_ = 64 * 1024 * 1024;
   bool cache_enabled_ = true;
   bool cache_available_ = true;  // false: cache capacity 0, don't explore
+  bool hier_ar_ = false;
+  bool hier_ag_ = false;
+  bool hier_available_ = false;  // false: topology can't go 2-level
 
   // Sampling state.
   int warmup_remaining_ = 3;
@@ -139,7 +154,7 @@ class ParameterManager {
   int no_improve_streak_ = 0;
   double best_seen_ = -1e300;
 
-  BayesianOptimizer optimizer_{3};
+  BayesianOptimizer optimizer_{5};
   std::ofstream log_;
 };
 

@@ -60,6 +60,15 @@ class DataPlane {
     hier_ag_enabled_ = hierarchical_allgather;
   }
 
+  // Autotune flip of the hierarchical routing (topology/threshold stay as
+  // SetTopology primed them).  Only called from the background thread at
+  // an agreed response-stream position (operations.cc applies TunedParams
+  // before fusing each list), so every rank routes identically.
+  void SetHierarchicalEnabled(bool allreduce, bool allgather) {
+    hier_enabled_ = allreduce;
+    hier_ag_enabled_ = allgather;
+  }
+
   // In-place ring allreduce over buf (count elements).  Dispatches to the
   // hierarchical path (intra-host reduce-scatter -> cross-host allreduce
   // per chunk -> intra-host allgather) when SetTopology enabled it and

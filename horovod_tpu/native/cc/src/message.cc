@@ -126,6 +126,8 @@ std::string ResponseList::Serialize() const {
     PutPod<double>(&buf, params.cycle_time_ms);
     PutPod<int64_t>(&buf, params.fusion_threshold);
     PutPod<uint8_t>(&buf, params.cache_enabled ? 1 : 0);
+    PutPod<uint8_t>(&buf, params.hier_allreduce ? 1 : 0);
+    PutPod<uint8_t>(&buf, params.hier_allgather ? 1 : 0);
   }
   return buf;
 }
@@ -161,12 +163,15 @@ Status ResponseList::Parse(const std::string& buf, ResponseList* out) {
   if (!rd.GetPod(&present)) return Malformed("params");
   out->params.present = present != 0;
   if (out->params.present) {
-    uint8_t tuning, cache;
+    uint8_t tuning, cache, har, hag;
     if (!rd.GetPod(&tuning) || !rd.GetPod(&out->params.cycle_time_ms) ||
-        !rd.GetPod(&out->params.fusion_threshold) || !rd.GetPod(&cache))
+        !rd.GetPod(&out->params.fusion_threshold) || !rd.GetPod(&cache) ||
+        !rd.GetPod(&har) || !rd.GetPod(&hag))
       return Malformed("params body");
     out->params.tuning = tuning != 0;
     out->params.cache_enabled = cache != 0;
+    out->params.hier_allreduce = har != 0;
+    out->params.hier_allgather = hag != 0;
   }
   return Status::OK();
 }

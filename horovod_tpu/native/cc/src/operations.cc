@@ -36,8 +36,14 @@ struct GlobalState {
   int size = 1;
   int local_rank = 0;
   int local_size = 1;
-  bool hierarchical_enabled = false;
-  bool hierarchical_allgather_enabled = false;
+  // Atomics: the autotuner now flips these from the background thread
+  // while framework threads may poll hvd_hierarchical_enabled().
+  std::atomic<bool> hierarchical_enabled{false};
+  std::atomic<bool> hierarchical_allgather_enabled{false};
+  // Every rank verified the same homogeneous block topology at bootstrap
+  // (2-level routing is POSSIBLE); the autotuner may then explore the
+  // hierarchical booleans even when the env flags left them off.
+  bool hierarchical_available = false;
   std::string rendezvous_addr;
   int rendezvous_port = 0;
 
@@ -484,25 +490,35 @@ void BackgroundThread() {
       // cost more latency than the cross-link traffic saved.
       const int64_t thr_local =
           EnvInt("HOROVOD_HIERARCHICAL_ALLREDUCE_THRESHOLD", 262144);
-      // One kMin allreduce agrees all six values (negated entries give
-      // the max), keeping bootstrap at a single round.
-      int64_t agree[6] = {ok,        -ok,        ok_ag, -ok_ag,
-                          thr_local, -thr_local};
-      Status as = g->data_plane.Allreduce(agree, 6, DataType::kInt64,
+      // One kMin allreduce agrees all eight values (negated entries give
+      // the max), keeping bootstrap at a single round.  The topo pair
+      // agrees AVAILABILITY independently of the env flags, so the
+      // autotuner can explore the hierarchical booleans on a capable
+      // topology the user never opted into (reference
+      // parameter_manager.h:133-246 tunes the same booleans).
+      const int64_t topo = topo_ok ? g->local_size : 0;
+      int64_t agree[8] = {ok,        -ok,         ok_ag, -ok_ag,
+                          thr_local, -thr_local,  topo,  -topo};
+      Status as = g->data_plane.Allreduce(agree, 8, DataType::kInt64,
                                           ReduceOp::kMin);
       const int64_t mn = agree[0], mx = -agree[1];
       const int64_t mn_ag = agree[2], mx_ag = -agree[3];
       const int64_t thr = agree[4], thr_max = -agree[5];
+      const int64_t topo_mn = agree[6], topo_mx = -agree[7];
       const bool enable = as.ok() && mn == mx && mn > 1;
       const bool enable_ag = as.ok() && mn_ag == mx_ag && mn_ag > 1;
-      if (enable || enable_ag) {
+      const bool available = as.ok() && topo_mn == topo_mx && topo_mn > 1;
+      if (enable || enable_ag || available) {
         if (g->rank == 0 && thr != thr_max)
           LOG(Warning) << "HOROVOD_HIERARCHICAL_ALLREDUCE_THRESHOLD "
                           "differs across ranks (min/max " << thr << "/"
                        << thr_max << "); using the agreed min " << thr;
+        // available-but-disabled still primes local topology + threshold
+        // so a later autotune flip only toggles the routing booleans.
         g->data_plane.SetTopology(g->local_rank, g->local_size, enable,
                                   thr, enable_ag);
       }
+      g->hierarchical_available = available;
       if (g->rank == 0 && !enable && mx > 0) {
         // mx > 0: at least one rank requested it — worth a warning.
         LOG(Warning) << "HOROVOD_HIERARCHICAL_ALLREDUCE requested but the "
@@ -529,7 +545,10 @@ void BackgroundThread() {
   if (g->autotune)
     g->param_manager.Initialize(g->rank, g->cycle_time_ms,
                                 g->controller.fusion_threshold(),
-                                g->cache_enabled);
+                                g->cache_enabled,
+                                g->hierarchical_enabled,
+                                g->hierarchical_allgather_enabled,
+                                g->hierarchical_available);
 
   if (s.ok()) g->initialized.store(true);  // before the init_cv handshake:
   // the caller may enqueue the moment hvd_init returns.
@@ -584,6 +603,16 @@ void BackgroundThread() {
       g->cycle_time_ms = responses.params.cycle_time_ms;
       g->controller.set_fusion_threshold(responses.params.fusion_threshold);
       g->cache_enabled = responses.params.cache_enabled;
+      // The tuner only proposes hierarchical=true on an agreed-available
+      // topology; applying here (before this list executes) keeps the
+      // routing flip at the same response-stream position on every rank.
+      if (g->hierarchical_available) {
+        g->data_plane.SetHierarchicalEnabled(
+            responses.params.hier_allreduce,
+            responses.params.hier_allgather);
+        g->hierarchical_enabled = responses.params.hier_allreduce;
+        g->hierarchical_allgather_enabled = responses.params.hier_allgather;
+      }
     }
     // The verdict list arrives unfused (per-name) so ExecuteResponse can
     // refresh the cache; fuse locally with the master's own walk.

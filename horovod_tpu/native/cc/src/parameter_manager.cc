@@ -21,12 +21,17 @@ constexpr double kFusionMinMb = 1.0, kFusionMaxMb = 64.0;
 }  // namespace
 
 void ParameterManager::Initialize(int rank, double cycle_ms,
-                                  int64_t fusion_bytes, bool cache_enabled) {
+                                  int64_t fusion_bytes, bool cache_enabled,
+                                  bool hier_allreduce, bool hier_allgather,
+                                  bool hier_available) {
   rank_ = rank;
   cycle_time_ms_ = cycle_ms;
   fusion_threshold_ = fusion_bytes;
   cache_enabled_ = cache_enabled;
   cache_available_ = cache_enabled;  // capacity 0: never explore cache=on
+  hier_ar_ = hier_allreduce;
+  hier_ag_ = hier_allgather;
+  hier_available_ = hier_available;
   active_ = EnvBool("HOROVOD_AUTOTUNE", false);
   if (!active_) return;
 
@@ -43,6 +48,7 @@ void ParameterManager::Initialize(int rank, double cycle_ms,
     if (!path.empty()) {
       log_.open(path, std::ios::trunc);
       log_ << "trial,cycle_time_ms,fusion_threshold_mb,cache_enabled,"
+              "hier_allreduce,hier_allgather,"
               "score_bytes_per_usec,best_score,pinned\n";
       log_.flush();
     }
@@ -53,14 +59,16 @@ void ParameterManager::Initialize(int rank, double cycle_ms,
 }
 
 std::vector<double> ParameterManager::CurrentPoint() const {
-  // Unit-box encoding: x0 = log-cycle, x1 = fusion MB, x2 = cache.
+  // Unit-box encoding: x0 = log-cycle, x1 = fusion MB, x2 = cache,
+  // x3/x4 = hierarchical allreduce/allgather (categorical, rounded).
   double x0 = (std::log(cycle_time_ms_) - std::log(kCycleMinMs)) /
               (std::log(kCycleMaxMs) - std::log(kCycleMinMs));
   double x1 = (static_cast<double>(fusion_threshold_) / (1024 * 1024) -
                kFusionMinMb) /
               (kFusionMaxMb - kFusionMinMb);
   return {std::min(std::max(x0, 0.0), 1.0), std::min(std::max(x1, 0.0), 1.0),
-          cache_enabled_ ? 1.0 : 0.0};
+          cache_enabled_ ? 1.0 : 0.0, hier_ar_ ? 1.0 : 0.0,
+          hier_ag_ ? 1.0 : 0.0};
 }
 
 void ParameterManager::ApplyPoint(const std::vector<double>& x) {
@@ -70,6 +78,13 @@ void ParameterManager::ApplyPoint(const std::vector<double>& x) {
   double mb = kFusionMinMb + x[1] * (kFusionMaxMb - kFusionMinMb);
   fusion_threshold_ = static_cast<int64_t>(mb * 1024 * 1024);
   cache_enabled_ = cache_available_ && x[2] >= 0.5;
+  // Unavailable topology pins the hierarchical booleans at their
+  // bootstrap state (the GP still wanders in those dims; the rounded
+  // application is what every rank actually routes by).
+  if (hier_available_) {
+    hier_ar_ = x[3] >= 0.5;
+    hier_ag_ = x[4] >= 0.5;
+  }
 }
 
 bool ParameterManager::Update(int64_t bytes) {
@@ -119,15 +134,21 @@ bool ParameterManager::Tune(double median_score) {
 
   bool pin = trials_ >= max_trials_ ||
              (trials_ >= 8 && no_improve_streak_ >= 5);
-  LogTrial(median_score, pin);
+  // The trial row records the configuration that was just SCORED; the
+  // pinned row must record the configuration the runtime will RUN, so it
+  // is logged only after ApplyPoint(best_x) below.
+  LogTrial(median_score, false);
 
   if (pin) {
     ApplyPoint(optimizer_.best_x());
+    LogTrial(optimizer_.best_score(), true);
     active_ = false;
     LOG(Info) << "Autotuner: converged after " << trials_
               << " trials; pinned cycle_time_ms=" << cycle_time_ms_
               << " fusion_threshold=" << fusion_threshold_
               << " cache=" << (cache_enabled_ ? 1 : 0)
+              << " hier_allreduce=" << (hier_ar_ ? 1 : 0)
+              << " hier_allgather=" << (hier_ag_ ? 1 : 0)
               << " (best " << optimizer_.best_score() << " bytes/usec)";
     if (log_.is_open()) log_.flush();
     return true;
@@ -141,7 +162,8 @@ void ParameterManager::LogTrial(double score, bool pinned) {
   if (!log_.is_open()) return;
   log_ << trials_ << "," << cycle_time_ms_ << ","
        << (static_cast<double>(fusion_threshold_) / (1024 * 1024)) << ","
-       << (cache_enabled_ ? 1 : 0) << "," << score << ","
+       << (cache_enabled_ ? 1 : 0) << "," << (hier_ar_ ? 1 : 0) << ","
+       << (hier_ag_ ? 1 : 0) << "," << score << ","
        << optimizer_.best_score() << "," << (pinned ? 1 : 0) << "\n";
   log_.flush();
 }
@@ -153,6 +175,8 @@ TunedParams ParameterManager::Current() const {
   p.cycle_time_ms = cycle_time_ms_;
   p.fusion_threshold = fusion_threshold_;
   p.cache_enabled = cache_enabled_;
+  p.hier_allreduce = hier_ar_;
+  p.hier_allgather = hier_ag_;
   return p;
 }
 

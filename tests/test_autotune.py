@@ -97,3 +97,71 @@ def test_autotune_off_by_default(tmp_path):
         capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
     assert res.returncode == 0, res.stdout + res.stderr
     assert not log.exists()
+
+
+def test_autotune_explores_hierarchical_and_ranks_agree(tmp_path):
+    """The tuner explores the hierarchical allreduce/allgather booleans as
+    categorical dimensions (reference parameter_manager.h:133-246) on a
+    topology the bootstrap agreed is CAPABLE — without the user setting
+    the HOROVOD_HIERARCHICAL_* env flags — flipping the routing
+    mid-stream at an agreed response position; results stay correct
+    through every flip and all ranks end on the same routing state."""
+    log = tmp_path / "autotune.csv"
+    script = tmp_path / "workload.py"
+    script.write_text(textwrap.dedent("""\
+        import os
+        import numpy as np
+        rank = int(os.environ["HOROVOD_RANK"])
+        size = int(os.environ["HOROVOD_SIZE"])
+        # Simulated 2-host block topology (hier_check_np4.py trick): makes
+        # the hierarchical path AVAILABLE; the env flags stay unset.
+        os.environ["HOROVOD_LOCAL_SIZE"] = str(size // 2)
+        os.environ["HOROVOD_LOCAL_RANK"] = str(rank % (size // 2))
+        import horovod_tpu as hvd
+        from horovod_tpu import basics
+        hvd.init()
+        # Payloads above the (agreed, env-zeroed) threshold so a flipped
+        # hierarchical flag actually changes the routing; correctness
+        # must hold through every mid-stream flip the tuner makes.
+        x = np.arange(100_003, dtype=np.float32)
+        for step in range(420):
+            out = np.asarray(hvd.allreduce(x * (rank + 1), average=False,
+                                           name=f"g.{step % 8}"))
+            np.testing.assert_allclose(
+                out, x * (size * (size + 1) / 2), rtol=1e-5)
+        # All ranks must agree on the final routing state (a diverged
+        # flag would already have deadlocked above, but assert it
+        # explicitly end-to-end).
+        state = float(basics.runtime().hierarchical_enabled())
+        states = np.asarray(hvd.allgather(np.array([state]), name="hs"))
+        assert len(set(states.tolist())) == 1, states
+        print(f"rank {rank}: hier state {state} agreed")
+    """))
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO  # exactly: inherited paths can pull in the axon sitecustomize
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "HOROVOD_HIERARCHICAL_ALLREDUCE_THRESHOLD": "0",
+        "HOROVOD_AUTOTUNE_WARMUP_SAMPLES": "1",
+        "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE": "3",
+        "HOROVOD_AUTOTUNE_SAMPLES": "3",
+        "HOROVOD_AUTOTUNE_BAYES_TRIALS": "10",
+    })
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "4",
+         "--autotune", "--autotune-log-file", str(log),
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert res.stdout.count("agreed") == 4, res.stdout
+
+    with open(log) as f:
+        rows = list(csv.DictReader(f))
+    assert len(rows) >= 5, rows
+    # The tuner actually explored the hierarchical dimension: both
+    # routing states appear across trials.
+    hier_vals = {row["hier_allreduce"] for row in rows}
+    assert hier_vals == {"0", "1"}, rows
+    assert rows[-1]["pinned"] == "1", rows[-1]

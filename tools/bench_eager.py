@@ -257,16 +257,20 @@ def main():
     # artifact shows WHAT the tuner chose, not just that it helped.
     pinned = None
     try:
+        import csv
         with open(autotune_log) as f:
-            lines = [ln.strip() for ln in f if ln.strip()]
-        for ln in lines[1:]:
-            cols = ln.split(",")
-            if cols[-1] == "1":
-                pinned = {"cycle_time_ms": float(cols[1]),
-                          "fusion_threshold_mb": float(cols[2]),
-                          "cache_enabled": cols[3] == "1"}
+            for row in csv.DictReader(f):
+                if row.get("pinned") == "1":
+                    pinned = {
+                        "cycle_time_ms": float(row["cycle_time_ms"]),
+                        "fusion_threshold_mb":
+                            float(row["fusion_threshold_mb"]),
+                        "cache_enabled": row["cache_enabled"] == "1",
+                        "hier_allreduce": row.get("hier_allreduce") == "1",
+                        "hier_allgather": row.get("hier_allgather") == "1",
+                    }
         os.unlink(autotune_log)
-    except (OSError, ValueError, IndexError):
+    except (OSError, ValueError, KeyError, TypeError):
         # A truncated row (worker killed mid-write) must not lose the
         # whole sweep's artifact.
         pass
