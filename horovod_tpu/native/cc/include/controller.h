@@ -45,6 +45,12 @@ class Controller {
   // full peer table (reference gloo rendezvous, gloo_context.cc:56-157).
   // `cache` (may be null) lets the coordinator expand bit-announced cached
   // tensors back into requests.
+  // The rendezvous listener deliberately binds ALL interfaces even when
+  // HOROVOD_NETWORK_INTERFACE pins the data plane: the launcher hands
+  // workers a rendezvous address it chose (loopback for all-local jobs,
+  // rank 0's hostname otherwise) that need not route over the pinned
+  // NIC, and the channel is a tiny HMAC-authenticated bootstrap stream —
+  // restricting its bind buys nothing and breaks reachability.
   Status Init(int rank, int size, const std::string& master_addr,
               int master_port, const std::string& my_data_host,
               int my_data_port, const ResponseCache* cache,

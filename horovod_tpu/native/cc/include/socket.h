@@ -15,6 +15,12 @@
 
 namespace hvd {
 
+// First IPv4 address of the first interface whose name appears in the
+// comma-separated list (checked in LIST order — the caller's preference
+// ranking, reference horovodrun --network-interface).  Empty string when
+// none match or none carries an IPv4 address.
+std::string InterfaceAddr(const std::string& names_csv);
+
 class TcpSocket {
  public:
   TcpSocket() = default;

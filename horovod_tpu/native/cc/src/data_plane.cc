@@ -209,7 +209,21 @@ Status DataPlane::Connect(int rank, int size,
   for (int r = 0; r < rank; ++r) {
     auto sock = std::unique_ptr<TcpSocket>(new TcpSocket());
     Status s = sock->Connect(peers[r].host, peers[r].port);
-    if (!s.ok()) return s;
+    if (!s.ok())
+      // Attributed reachability failure: this bootstrap dial doubles as
+      // the cross-rank probe of every peer's ADVERTISED address — name
+      // the pair and the knobs that control the advertisement so a
+      // multi-NIC misconfiguration is a one-line diagnosis, not a
+      // 120-second opaque timeout (reference interface intersection,
+      // run/run.py:195-265).
+      return Status::Unknown(
+          "data plane: rank " + std::to_string(rank) +
+          " cannot reach rank " + std::to_string(r) + " at " +
+          peers[r].host + ":" + std::to_string(peers[r].port) + " (" +
+          s.reason + "); that address is what rank " + std::to_string(r) +
+          " advertised — on multi-NIC hosts pin it with "
+          "HOROVOD_NETWORK_INTERFACE (bind+advertise) or "
+          "HOROVOD_HOSTNAME (advertise only)");
     s = AuthConnect(*sock, key);
     if (!s.ok()) return s;
     int32_t me = rank;
@@ -225,8 +239,20 @@ Status DataPlane::Connect(int rank, int size,
   for (int registered = 0; registered < size - rank - 1;) {
     auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
         deadline - std::chrono::steady_clock::now()).count();
-    if (left <= 0)
-      return Status::Unknown("data-plane mesh timed out waiting for peers");
+    if (left <= 0) {
+      // Name the missing ranks: they dialed MY advertised address and
+      // never arrived, so my advertisement (or a fabric between us) is
+      // the thing to inspect.
+      std::string missing;
+      for (int r = rank + 1; r < size; ++r)
+        if (!peers_[r]) missing += (missing.empty() ? "" : ",") +
+                                   std::to_string(r);
+      return Status::Unknown(
+          "data-plane mesh timed out waiting for rank(s) " + missing +
+          " to dial rank " + std::to_string(rank) +
+          "'s advertised address; on multi-NIC hosts pin it with "
+          "HOROVOD_NETWORK_INTERFACE or HOROVOD_HOSTNAME");
+    }
     TcpSocket conn;
     Status s = listener_.Accept(&conn, static_cast<int>(left));
     if (!s.ok()) return s;

@@ -445,14 +445,31 @@ void BackgroundThread() {
   // Bootstrap: data-plane listener, controller rendezvous, full mesh.
   // Capacity default mirrors the reference (global_state.h:88); 0 disables.
   g->cache.Initialize(EnvInt("HOROVOD_CACHE_CAPACITY", 1024));
-  Status s = g->data_plane.Listen("");
+  // Multi-NIC pinning (reference horovodrun --network-interface,
+  // run/run.py:195-265): HOROVOD_NETWORK_INTERFACE names the NIC(s) to
+  // bind AND advertise; HOROVOD_HOSTNAME overrides just the advertised
+  // address.  Unset = bind all interfaces, advertise the address the
+  // coordinator observes.
+  std::string bind_addr;
+  std::string host = EnvStr("HOROVOD_HOSTNAME", "");
+  const std::string ifaces = EnvStr("HOROVOD_NETWORK_INTERFACE", "");
+  Status s;
+  if (!ifaces.empty()) {
+    bind_addr = InterfaceAddr(ifaces);
+    if (bind_addr.empty())
+      s = Status::InvalidArgument(
+          "HOROVOD_NETWORK_INTERFACE=" + ifaces +
+          ": no such interface with an IPv4 address on this host");
+    else if (host.empty())
+      host = bind_addr;  // advertise exactly what we bind
+  }
+  if (s.ok()) s = g->data_plane.Listen(bind_addr);
   if (s.ok()) {
     std::vector<PeerAddr> peers;
     // Empty when unset: the controller then falls back to the address it
     // OBSERVES on the rendezvous connection, which is correct for remote
     // workers launched without hvdrun (a hardcoded 127.0.0.1 here would
     // shadow that fallback and break manual multi-host launches).
-    std::string host = EnvStr("HOROVOD_HOSTNAME", "");
     s = g->controller.Init(g->rank, g->size, g->rendezvous_addr,
                            g->rendezvous_port, host, g->data_plane.port(),
                            &g->cache, &peers);

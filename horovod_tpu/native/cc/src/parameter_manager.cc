@@ -34,6 +34,12 @@ void ParameterManager::Initialize(int rank, double cycle_ms,
   hier_available_ = hier_available;
   active_ = EnvBool("HOROVOD_AUTOTUNE", false);
   if (!active_) return;
+  // Size the search space to the knobs that can actually move: on a
+  // topology that cannot go 2-level the hierarchical coordinates would
+  // be dead dimensions — identical real configs observed as distinct
+  // points whose score differences are pure noise, degrading the
+  // surrogate for the three live knobs.
+  optimizer_ = BayesianOptimizer(hier_available_ ? 5 : 3);
 
   warmup_remaining_ =
       static_cast<int>(EnvInt("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 3));
@@ -59,16 +65,22 @@ void ParameterManager::Initialize(int rank, double cycle_ms,
 }
 
 std::vector<double> ParameterManager::CurrentPoint() const {
-  // Unit-box encoding: x0 = log-cycle, x1 = fusion MB, x2 = cache,
-  // x3/x4 = hierarchical allreduce/allgather (categorical, rounded).
+  // Unit-box encoding: x0 = log-cycle, x1 = fusion MB, x2 = cache, and —
+  // only when the topology can go 2-level — x3/x4 = hierarchical
+  // allreduce/allgather (categorical, rounded).
   double x0 = (std::log(cycle_time_ms_) - std::log(kCycleMinMs)) /
               (std::log(kCycleMaxMs) - std::log(kCycleMinMs));
   double x1 = (static_cast<double>(fusion_threshold_) / (1024 * 1024) -
                kFusionMinMb) /
               (kFusionMaxMb - kFusionMinMb);
-  return {std::min(std::max(x0, 0.0), 1.0), std::min(std::max(x1, 0.0), 1.0),
-          cache_enabled_ ? 1.0 : 0.0, hier_ar_ ? 1.0 : 0.0,
-          hier_ag_ ? 1.0 : 0.0};
+  std::vector<double> x = {std::min(std::max(x0, 0.0), 1.0),
+                           std::min(std::max(x1, 0.0), 1.0),
+                           cache_enabled_ ? 1.0 : 0.0};
+  if (hier_available_) {
+    x.push_back(hier_ar_ ? 1.0 : 0.0);
+    x.push_back(hier_ag_ ? 1.0 : 0.0);
+  }
+  return x;
 }
 
 void ParameterManager::ApplyPoint(const std::vector<double>& x) {
@@ -78,10 +90,10 @@ void ParameterManager::ApplyPoint(const std::vector<double>& x) {
   double mb = kFusionMinMb + x[1] * (kFusionMaxMb - kFusionMinMb);
   fusion_threshold_ = static_cast<int64_t>(mb * 1024 * 1024);
   cache_enabled_ = cache_available_ && x[2] >= 0.5;
-  // Unavailable topology pins the hierarchical booleans at their
-  // bootstrap state (the GP still wanders in those dims; the rounded
-  // application is what every rank actually routes by).
-  if (hier_available_) {
+  // The hierarchical coordinates exist only on a 2-level-capable
+  // topology (see Initialize); otherwise the booleans stay pinned at
+  // their bootstrap state.
+  if (hier_available_ && x.size() >= 5) {
     hier_ar_ = x[3] >= 0.5;
     hier_ag_ = x[4] >= 0.5;
   }

@@ -2,6 +2,7 @@
 
 #include <arpa/inet.h>
 #include <errno.h>
+#include <ifaddrs.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -221,6 +222,41 @@ Status TcpSocket::RecvFrame(std::string* out) const {
                            " exceeds sanity cap");
   out->resize(len);
   return len ? RecvAll(&(*out)[0], len) : Status::OK();
+}
+
+std::string InterfaceAddr(const std::string& names_csv) {
+  ifaddrs* ifs = nullptr;
+  if (getifaddrs(&ifs) != 0) return "";
+  std::string result;
+  // Honor the caller's preference ORDER: first listed name that exists
+  // with an IPv4 address wins (not first enumeration hit).
+  size_t start = 0;
+  while (start <= names_csv.size() && result.empty()) {
+    size_t comma = names_csv.find(',', start);
+    std::string want = names_csv.substr(
+        start, comma == std::string::npos ? std::string::npos
+                                          : comma - start);
+    // trim spaces
+    while (!want.empty() && want.front() == ' ') want.erase(want.begin());
+    while (!want.empty() && want.back() == ' ') want.pop_back();
+    if (!want.empty()) {
+      for (ifaddrs* it = ifs; it != nullptr; it = it->ifa_next) {
+        if (it->ifa_addr == nullptr ||
+            it->ifa_addr->sa_family != AF_INET || want != it->ifa_name)
+          continue;
+        char buf[INET_ADDRSTRLEN];
+        auto* sa = reinterpret_cast<sockaddr_in*>(it->ifa_addr);
+        if (inet_ntop(AF_INET, &sa->sin_addr, buf, sizeof(buf))) {
+          result = buf;
+          break;
+        }
+      }
+    }
+    if (comma == std::string::npos) break;
+    start = comma + 1;
+  }
+  freeifaddrs(ifs);
+  return result;
 }
 
 std::string TcpSocket::peer_addr() const {

@@ -48,6 +48,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rendezvous-port", type=int, default=0,
                    help="Fixed controller rendezvous port (default: pick "
                         "a free port).")
+    p.add_argument("--network-interface", dest="network_interface",
+                   default=None,
+                   help="Comma-separated NIC name(s), in preference "
+                        "order, for the controller rendezvous and TCP "
+                        "data plane on every host (reference "
+                        "horovodrun --network-interface): each rank "
+                        "binds its listeners to the first matching "
+                        "interface's IPv4 address and advertises it. "
+                        "Per-host overrides: HOROVOD_NETWORK_INTERFACE "
+                        "or HOROVOD_HOSTNAME in that host's env.")
     p.add_argument("--jax-distributed", action="store_true", default=False,
                    help="Bootstrap jax.distributed in every rank "
                         "(multi-process SPMD: each process drives its "

@@ -45,6 +45,59 @@ def test_native_ops_under_launcher(tmp_path):
     assert res.returncode == 0, res.stdout + res.stderr
 
 
+def test_network_interface_pins_loopback(tmp_path):
+    """--network-interface lo: both ranks bind AND advertise loopback's
+    address; the job runs collectives normally (reference horovodrun
+    --network-interface, run/run.py:195-265)."""
+    script = textwrap.dedent("""\
+        import numpy as np
+        import horovod_tpu as hvd
+        hvd.init()
+        out = np.asarray(hvd.allreduce(np.ones(4, np.float32),
+                                       op=hvd.Sum, name="t"))
+        assert out[0] == hvd.size()
+        print("nic pinned ok")
+    """)
+    res = _hvdrun(["--network-interface", "lo"], script=script, np_=2,
+                  timeout=120, tmp_path=tmp_path)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert res.stdout.count("nic pinned ok") == 2
+
+
+def test_network_interface_unknown_fails_fast(tmp_path):
+    """A bogus NIC name must fail init immediately with an attributed
+    error, not hang out the rendezvous deadline."""
+    script = textwrap.dedent("""\
+        import horovod_tpu as hvd
+        hvd.init()
+    """)
+    res = _hvdrun([], script=script, np_=2, timeout=60, tmp_path=tmp_path,
+                  env={"HOROVOD_NETWORK_INTERFACE": "bogus0"})
+    assert res.returncode != 0
+    assert "bogus0: no such interface" in res.stdout + res.stderr
+
+
+@pytest.mark.slow
+def test_misadvertised_address_attributed_error(tmp_path):
+    """An advertised address peers cannot reach must surface WHO cannot
+    reach WHOM at WHAT address and name the knobs — the bootstrap dial
+    doubles as the cross-rank reachability probe."""
+    script = textwrap.dedent("""\
+        import horovod_tpu as hvd
+        hvd.init()
+    """)
+    # Bind loopback's 127.0.0.1 but advertise 127.0.0.2: the listener
+    # never accepts there, so the peer's dial is refused until its
+    # deadline and the attributed diagnosis fires.
+    res = _hvdrun(["--network-interface", "lo"], script=script, np_=2,
+                  timeout=120, tmp_path=tmp_path,
+                  env={"HOROVOD_HOSTNAME": "127.0.0.2"})
+    assert res.returncode != 0
+    out = res.stdout + res.stderr
+    assert "cannot reach rank" in out and "127.0.0.2" in out, out
+    assert "HOROVOD_NETWORK_INTERFACE" in out, out
+
+
 @pytest.mark.slow
 def test_jax_distributed_spmd_under_launcher(tmp_path):
     """hvdrun --jax-distributed: 2 processes x 4 virtual CPU devices run

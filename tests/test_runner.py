@@ -94,6 +94,20 @@ def test_runtime_env():
     assert env["HOROVOD_RENDEZVOUS_PORT"] == "1234"
     assert env["FOO"] == "bar"
     assert os.environ.get("PATH", "") == env.get("PATH", "")
+    assert env["HOROVOD_HOSTNAME"] == "localhost"
+
+    # A pinned NIC (flag-mapped extra OR inherited env) suppresses the
+    # generic hostname injection — it would shadow the interface-resolved
+    # advertised address (docs/running.md NIC selection); an explicit
+    # user HOROVOD_HOSTNAME still survives as the advertise override.
+    env = config_parser.runtime_env(
+        info, "127.0.0.1", 1234, {"HOROVOD_NETWORK_INTERFACE": "eth1"})
+    assert "HOROVOD_HOSTNAME" not in env
+    env = config_parser.runtime_env(
+        info, "127.0.0.1", 1234,
+        {"HOROVOD_NETWORK_INTERFACE": "eth1",
+         "HOROVOD_HOSTNAME": "10.0.0.7"})
+    assert env["HOROVOD_HOSTNAME"] == "10.0.0.7"
 
 
 def test_packaging_metadata():
