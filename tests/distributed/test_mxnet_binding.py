@@ -1,14 +1,23 @@
 """MXNet binding tests (reference test/test_mxnet.py op matrix).
 
-MXNet is not shipped in this image, so the whole module skips unless
-mxnet is importable; the binding's numpy-plane collectives underneath are
-exercised by the torch/TF binding suites either way.
+MXNet is not installable in this image (archived upstream, no py>=3.12
+wheel), so the binding executes against ``tests/mxnet_api_shim.py`` — an
+API-faithful numpy-backed stand-in, the same runtime-evidence pattern as
+the pyspark shim (``test_spark_veneer_shim.py``).  With real mxnet on the
+path (the opt-in py3.11 Docker stage, docs/docker.md) the shim steps
+aside and the same tests run against it unchanged.
 """
+
+import os
+import sys
 
 import numpy as np
 import pytest
 
-mx = pytest.importorskip("mxnet")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import mxnet_api_shim  # noqa: E402
+
+mx = mxnet_api_shim.install()
 
 import horovod_tpu.mxnet as mxhvd  # noqa: E402
 
@@ -49,3 +58,89 @@ def test_mx_distributed_optimizer(hvd, rank, size):
     expect = 1.0 - 0.1 * (sum(range(1, size + 1)) / size)
     np.testing.assert_allclose(w.asnumpy(), np.full((4,), expect),
                                rtol=1e-5)
+
+
+def test_mx_distributed_optimizer_grouped_update(hvd, rank, size):
+    """The list-index form of update: one allreduce per grad, all summed
+    (reference mxnet/__init__.py:57-66 loops the index list).  The
+    binding's list branch is the subject; the wrapped optimizer's own
+    list handling differs across real-mxnet versions, so this runs on
+    the shim (see _shim_only below for the pattern)."""
+    if not getattr(mx, "__is_horovod_tpu_shim__", False):
+        pytest.skip("list-form SGD.update support varies across mxnet "
+                    "versions; the binding's list branch is shim-covered")
+    opt = mxhvd.DistributedOptimizer(mx.optimizer.SGD(learning_rate=1.0))
+    ws = [mx.nd.ones((3,)), mx.nd.ones((2,))]
+    gs = [mx.nd.ones((3,)) * (rank + 1), mx.nd.ones((2,)) * 2 * (rank + 1)]
+    opt.update([10, 11], ws, gs, [None, None])
+    mean1 = sum(range(1, size + 1)) / size
+    np.testing.assert_allclose(ws[0].asnumpy(), 1.0 - mean1, rtol=1e-5)
+    np.testing.assert_allclose(ws[1].asnumpy(), 1.0 - 2 * mean1, rtol=1e-5)
+
+
+# The trainer/deferred tests below drive gluon Parameters through the
+# shim's value-`initialize` convenience (real gluon materializes shapes
+# via a net forward); under REAL mxnet (Docker py3.11 stage) they skip —
+# the op matrix + optimizer tests above run there unchanged.
+_shim_only = pytest.mark.skipif(
+    not getattr(mx, "__is_horovod_tpu_shim__", False),
+    reason="drives Parameter.initialize(value), a shim convenience")
+
+
+@_shim_only
+def test_mx_distributed_trainer(hvd, rank, size):
+    """Gluon trainer path: _allreduce_grads sums ranks' grads, _scale is
+    divided by world size, so a step applies the cross-rank mean
+    (reference mxnet/__init__.py:85-105)."""
+    params = mx.gluon.parameter.ParameterDict()
+    for name, val in (("dense.w", np.ones((4,), np.float32)),
+                      ("dense.b", np.zeros((2,), np.float32))):
+        p = mx.gluon.parameter.Parameter(name)
+        p.initialize(val)
+        params[name] = p
+    trainer = mxhvd.DistributedTrainer(params, "sgd",
+                                       {"learning_rate": 1.0})
+    assert trainer._scale == pytest.approx(1.0 / size)
+    # Per-rank gradients differ; the step must apply the same mean on
+    # every rank.
+    for p in trainer._params:
+        p.list_grad()[0][:] = np.ones(p.data().shape) * (rank + 1)
+    trainer.step(batch_size=1)
+    mean = sum(range(1, size + 1)) / size
+    got = {p.name: p.data().asnumpy() for p in trainer._params}
+    np.testing.assert_allclose(got["dense.w"], 1.0 - mean, rtol=1e-5)
+    np.testing.assert_allclose(got["dense.b"], -mean, rtol=1e-5)
+    # And the result is bit-identical across ranks.
+    flat = np.concatenate([got["dense.w"], got["dense.b"]])
+    gathered = np.asarray(hvd.allgather(flat[None], name="mx.tr.chk"))
+    for r in range(size):
+        np.testing.assert_array_equal(gathered[r], flat)
+
+
+def test_mx_broadcast_parameters_dict(hvd, rank, size):
+    """Module-style dict broadcast: every rank ends with root's values
+    (reference mxnet/__init__.py:109-154)."""
+    arrs = {"w": mx.nd.ones((3,)) * (rank + 10),
+            "b": mx.nd.ones((2,)) * (rank + 100)}
+    mxhvd.broadcast_parameters(arrs, root_rank=0)
+    np.testing.assert_allclose(arrs["w"].asnumpy(), 10.0)
+    np.testing.assert_allclose(arrs["b"].asnumpy(), 100.0)
+
+
+@_shim_only
+def test_mx_broadcast_parameters_deferred(hvd, rank, size):
+    """Deferred-init parameters broadcast lazily at materialization: the
+    reference wraps _finish_deferred_init (mxnet/__init__.py:131-154);
+    the binding hooks the same instance attribute."""
+    params = mx.gluon.parameter.ParameterDict()
+    ready = mx.gluon.parameter.Parameter("ready")
+    ready.initialize(np.full((2,), float(rank), np.float32))
+    lazy = mx.gluon.parameter.Parameter("lazy")
+    params["ready"] = ready
+    params["lazy"] = lazy
+    mxhvd.broadcast_parameters(params, root_rank=0)
+    # Materialized immediately: already broadcast.
+    np.testing.assert_allclose(ready.data().asnumpy(), 0.0)
+    # Deferred: broadcast fires the moment the data materializes.
+    lazy.initialize(np.full((3,), float(rank + 50), np.float32))
+    np.testing.assert_allclose(lazy.data().asnumpy(), 50.0)
