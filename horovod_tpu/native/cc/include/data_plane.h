@@ -73,6 +73,18 @@ class DataPlane {
   // hierarchical path (intra-host reduce-scatter -> cross-host allreduce
   // per chunk -> intra-host allgather) when SetTopology enabled it and
   // the payload/topology qualify.
+  // Real Adasum (Maleki et al. 2020; reference adasum/adasum_mpi.*):
+  // recursive-doubling butterfly where each pair combines FULL vectors
+  // with the scaled-projection formula
+  //   (1 - a.b/(2|a|^2)) a + (1 - a.b/(2|b|^2)) b
+  // (identical inputs -> identity, orthogonal -> sum).  Non-power-of-2:
+  // extra ranks fold into a butterfly member first and receive the
+  // result back.  Both pair members compute the same expression in the
+  // same order, so results are bitwise identical on every rank.
+  // Floating dtypes only; fp16/bf16 stage through f32.
+  Status AdasumAllreduce(void* buf, int64_t count, DataType dtype,
+                         const std::vector<int32_t>& group = {});
+
   Status Allreduce(void* buf, int64_t count, DataType dtype, ReduceOp op,
                    const std::vector<int32_t>& group = {});
   // Reduce across ranks, keep my dim-0 chunk: in has count elems,
@@ -101,11 +113,15 @@ class DataPlane {
 
   void Shutdown();
 
- private:
   // Full-duplex send+recv with one peer (avoids head-of-line deadlock on
-  // large payloads).
+  // large payloads).  Public for the cc-local Adasum butterfly helper;
+  // not a general-purpose API.  Pass self_rank() for the direction that
+  // is not used (its buffer may be null with 0 bytes).
   Status SendRecv(int send_peer, const void* sbuf, size_t sbytes,
                   int recv_peer, void* rbuf, size_t rbytes);
+  int self_rank() const { return rank_; }
+
+ private:
 
   // The two halves of the ring (chunk layout = ChunkOffsets(count, n)):
   // after the reduce-scatter phase, member at position p holds the full

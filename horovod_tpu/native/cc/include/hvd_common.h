@@ -105,7 +105,7 @@ inline const char* OpTypeName(OpType t) {
 enum class ReduceOp : int32_t {
   kAverage = 0,   // executed as Sum; the Python layer divides
   kSum = 1,
-  kAdasum = 2,    // executed as Sum
+  kAdasum = 2,    // scaled-projection butterfly (data_plane.cc)
   kMin = 3,
   kMax = 4,
 };

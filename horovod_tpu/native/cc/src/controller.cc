@@ -666,7 +666,11 @@ void Controller::Fuse(std::vector<Response>* responses) {
   // else's.
   std::vector<Response> fused;
   for (auto& r : *responses) {
-    bool fusible = !r.error && r.op_type == OpType::kAllreduce;
+    // Adasum never fuses: its projection coefficients are per-TENSOR
+    // dot products, and a concatenated buffer would compute one joint
+    // projection over unrelated tensors.
+    bool fusible = !r.error && r.op_type == OpType::kAllreduce &&
+                   static_cast<ReduceOp>(r.arg) != ReduceOp::kAdasum;
     if (fusible && !fused.empty()) {
       Response& prev = fused.back();
       int64_t prev_elems = 0;

@@ -303,7 +303,7 @@ Status DataPlane::SendRecv(int send_peer, const void* sbuf, size_t sbytes,
   }
   TcpSocket* ssock = send_peer == rank_ ? nullptr : peers_[send_peer].get();
   TcpSocket* rsock = recv_peer == rank_ ? nullptr : peers_[recv_peer].get();
-  if (send_peer == rank_) std::memcpy(rbuf, sbuf, sbytes);
+  if (send_peer == rank_ && sbytes > 0) std::memcpy(rbuf, sbuf, sbytes);
 
   const char* sp = static_cast<const char*>(sbuf);
   char* rp = static_cast<char*>(rbuf);
@@ -487,6 +487,121 @@ Status DataPlane::RingAllgatherPhase(const std::vector<int32_t>& group,
     if (!st.ok()) return st;
   }
   return Status::OK();
+}
+
+namespace {
+
+// Pair combine for Adasum on float/double vectors: dst = ac*a + bc*b,
+// where `a` is ALWAYS the lower position's vector.  Both members of a
+// pair evaluate the identical expression in the identical order, so the
+// results are bitwise-equal on both sides.  `dst` may alias either
+// input (per-element read precedes the write).
+template <typename T>
+void AdasumCombine(const T* a, const T* b, T* dst, int64_t n) {
+  double dot = 0, na = 0, nb = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const double x = static_cast<double>(a[i]);
+    const double y = static_cast<double>(b[i]);
+    dot += x * y;
+    na += x * x;
+    nb += y * y;
+  }
+  // Zero-norm guards (Horovod's AdasumOp does the same): a zero vector
+  // is an identity — adasum(a, 0) = a.
+  const double ac = na > 0 ? 1.0 - dot / (2.0 * na) : 1.0;
+  const double bc = nb > 0 ? 1.0 - dot / (2.0 * nb) : 1.0;
+  for (int64_t i = 0; i < n; ++i)
+    dst[i] = static_cast<T>(ac * static_cast<double>(a[i]) +
+                            bc * static_cast<double>(b[i]));
+}
+
+template <typename T>
+Status AdasumButterfly(DataPlane* dp, const GroupView& v, T* vec,
+                       int64_t n) {
+  const size_t bytes = static_cast<size_t>(n) * sizeof(T);
+  std::vector<T> other(static_cast<size_t>(n));
+  // Largest power of two <= group size; extras fold into [0, p2).
+  int p2 = 1;
+  while (p2 * 2 <= v.size) p2 *= 2;
+  const bool extra = v.me >= p2;
+  const int fold_peer = extra ? v.me - p2
+                              : (v.me + p2 < v.size ? v.me + p2 : -1);
+  if (extra) {
+    // Send my vector to the fold target, receive the final result after
+    // the butterfly (SendRecv with distinct peers would deadlock the
+    // lockstep here; two directed halves are correct and simple).
+    Status s = dp->SendRecv(v.global_of(fold_peer), vec, bytes,
+                            dp->self_rank(), nullptr, 0);
+    if (!s.ok()) return s;
+  } else if (fold_peer >= 0) {
+    Status s = dp->SendRecv(dp->self_rank(), nullptr, 0,
+                            v.global_of(fold_peer), other.data(), bytes);
+    if (!s.ok()) return s;
+    // Fold: lower position's vector is `a`.
+    AdasumCombine(vec, other.data(), vec, n);
+  }
+  if (!extra) {
+    for (int dist = 1; dist < p2; dist *= 2) {
+      const int partner = v.me ^ dist;
+      Status s = dp->SendRecv(v.global_of(partner), vec, bytes,
+                              v.global_of(partner), other.data(), bytes);
+      if (!s.ok()) return s;
+      // Deterministic ordering rule: lower position's vector is `a`;
+      // dst aliases my vector either way (no extra copies).
+      if (v.me < partner)
+        AdasumCombine(vec, other.data(), vec, n);
+      else
+        AdasumCombine(other.data(), vec, vec, n);
+    }
+    if (fold_peer >= 0) {
+      Status s = dp->SendRecv(v.global_of(fold_peer), vec, bytes,
+                              dp->self_rank(), nullptr, 0);
+      if (!s.ok()) return s;
+    }
+  } else {
+    Status s = dp->SendRecv(dp->self_rank(), nullptr, 0,
+                            v.global_of(fold_peer), vec, bytes);
+    if (!s.ok()) return s;
+  }
+  return Status::OK();
+}
+
+}  // namespace
+
+Status DataPlane::AdasumAllreduce(void* buf, int64_t count, DataType dtype,
+                                  const std::vector<int32_t>& group) {
+  GroupView v;
+  Status gs = MakeView(group, rank_, size_, &v);
+  if (!gs.ok()) return gs;
+  if (v.size == 1 || count == 0) return Status::OK();
+  switch (dtype) {
+    case DataType::kFloat32:
+      return AdasumButterfly(this, v, static_cast<float*>(buf), count);
+    case DataType::kFloat64:
+      return AdasumButterfly(this, v, static_cast<double*>(buf), count);
+    case DataType::kFloat16:
+    case DataType::kBfloat16: {
+      // Stage through f32: the projection coefficients need real dot
+      // products, and the wire cost doubles only for the 16-bit case.
+      auto* h = static_cast<uint16_t*>(buf);
+      std::vector<float> f(static_cast<size_t>(count));
+      if (dtype == DataType::kFloat16)
+        for (int64_t i = 0; i < count; ++i) f[i] = HalfToFloat(h[i]);
+      else
+        for (int64_t i = 0; i < count; ++i) f[i] = Bf16ToFloat(h[i]);
+      Status s = AdasumButterfly(this, v, f.data(), count);
+      if (!s.ok()) return s;
+      if (dtype == DataType::kFloat16)
+        for (int64_t i = 0; i < count; ++i) h[i] = FloatToHalf(f[i]);
+      else
+        for (int64_t i = 0; i < count; ++i) h[i] = FloatToBf16(f[i]);
+      return Status::OK();
+    }
+    default:
+      return Status::InvalidArgument(
+          "Adasum is defined for floating-point tensors only (got dtype " +
+          std::to_string(static_cast<int>(dtype)) + ")");
+  }
 }
 
 Status DataPlane::Allreduce(void* buf, int64_t count, DataType dtype,

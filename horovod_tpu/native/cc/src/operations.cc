@@ -112,8 +112,13 @@ void ParticipateJoined(const Response& resp) {
       for (auto d : resp.first_dims) total += d;
       if (total == 0) return;
       std::vector<char> buf(static_cast<size_t>(total) * esz, 0);
-      st = g->data_plane.Allreduce(buf.data(), total, resp.dtype,
-                                   static_cast<ReduceOp>(resp.arg));
+      if (static_cast<ReduceOp>(resp.arg) == ReduceOp::kAdasum)
+        // Zero vectors are an Adasum identity (combine guards), so a
+        // joined rank participates harmlessly here too.
+        st = g->data_plane.AdasumAllreduce(buf.data(), total, resp.dtype);
+      else
+        st = g->data_plane.Allreduce(buf.data(), total, resp.dtype,
+                                     static_cast<ReduceOp>(resp.arg));
       break;
     }
     case OpType::kAllgather: {
@@ -243,8 +248,15 @@ int64_t ExecuteResponse(const Response& resp) {
         std::memcpy(e->output.data(), e->input, e->output.size());
         e->output_count = e->count;
         g->timeline.ActivityStart(e->name, "TCP_ALLREDUCE");
-        st = g->data_plane.Allreduce(e->output.data(), e->count, resp.dtype,
-                                     rop, *group);
+        if (rop == ReduceOp::kAdasum)
+          // Real Adasum (scaled-projection butterfly, data_plane.cc);
+          // never fused — the projection is per-TENSOR, and Fuse()
+          // excludes kAdasum responses.
+          st = g->data_plane.AdasumAllreduce(e->output.data(), e->count,
+                                             resp.dtype, *group);
+        else
+          st = g->data_plane.Allreduce(e->output.data(), e->count,
+                                       resp.dtype, rop, *group);
         g->timeline.ActivityEnd(e->name);
         g->timeline.End(e->name);
       } else {
@@ -280,8 +292,22 @@ int64_t ExecuteResponse(const Response& resp) {
         }
         if (!entries.empty())
           g->timeline.ActivityStart(entries[0]->name, "TCP_ALLREDUCE");
-        st = g->data_plane.Allreduce(buf, static_cast<int64_t>(total / esz),
-                                     resp.dtype, rop, *group);
+        if (rop == ReduceOp::kAdasum) {
+          // Fuse() keeps Adasum responses single-name, but a rank that
+          // holds none of the entries (joined) still lands here; the
+          // projection is per-TENSOR either way, so run it per name
+          // over the buffer slices.
+          size_t aoff = 0;
+          for (size_t i = 0; i < resp.names.size() && st.ok(); ++i) {
+            st = g->data_plane.AdasumAllreduce(
+                buf + aoff, resp.first_dims[i], resp.dtype, *group);
+            aoff += static_cast<size_t>(resp.first_dims[i]) * esz;
+          }
+        } else {
+          st = g->data_plane.Allreduce(
+              buf, static_cast<int64_t>(total / esz), resp.dtype, rop,
+              *group);
+        }
         if (!entries.empty()) g->timeline.ActivityEnd(entries[0]->name);
         off = 0;
         for (size_t i = 0; i < resp.names.size(); ++i) {

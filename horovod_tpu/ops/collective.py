@@ -61,7 +61,12 @@ class ReduceOp:
 
 Average = ReduceOp("Average", 0)
 Sum = ReduceOp("Sum", 1)
-Adasum = ReduceOp("Adasum", 2)   # accepted; falls back to Average semantics
+# Real Adasum on the eager plane (scaled-projection butterfly in
+# native/cc/src/data_plane.cc; Maleki et al. 2020): identical gradients
+# combine to themselves, orthogonal ones add.  The SPMD plane raises —
+# a mesh-collective Adasum needs a different design than psum, and
+# silently substituting the mean would change training semantics.
+Adasum = ReduceOp("Adasum", 2)
 Min = ReduceOp("Min", 3)
 Max = ReduceOp("Max", 4)
 
@@ -324,7 +329,9 @@ def _eager_allreduce(x, op: ReduceOp, name: str, prescale_factor,
         out = arr.copy()
     else:
         out = rt.allreduce(name, arr, op.code, set_id=set_id)
-    if op is Average or op is Adasum:
+    # Adasum's result is the combined vector itself (the native butterfly
+    # already applied the projection coefficients) — no divide.
+    if op is Average:
         out = out / (set_size if set_size else basics.size())
     if postscale_factor != 1.0:
         out = out * postscale_factor
@@ -351,7 +358,7 @@ def _eager_allreduce_finish(tok, op: ReduceOp, postscale_factor,
     native, done = tok
     out = done if native is None else basics.runtime().allreduce_finish(
         native)
-    if op is Average or op is Adasum:
+    if op is Average:  # Adasum: combined vector as-is (see _eager_allreduce)
         out = out / (set_size if set_size else basics.size())
     if postscale_factor != 1.0:
         out = out * postscale_factor
@@ -404,7 +411,19 @@ def _eager_alltoall_finish(tok):
         native)
 
 
+def _check_reducescatter_op(op: ReduceOp) -> None:
+    """Choke point for EVERY reducescatter route (incl. the torch/TF
+    bindings that bypass :func:`reducescatter`): the native plane's ring
+    reduce phase would execute Adasum/Min/Max chunks as Sum — fail loudly
+    instead of silently substituting (same contract as the reference's
+    Sum/Average-only reducescatter)."""
+    if op is not Average and op is not Sum:
+        raise NotImplementedError(
+            f"reducescatter supports op=Average/Sum only (got {op})")
+
+
 def _eager_reducescatter_submit(x, op: ReduceOp, name: str, set_id=0):
+    _check_reducescatter_op(op)
     rt = basics.runtime()
     arr = np.asarray(x)
     if rt is None:
@@ -462,6 +481,7 @@ def _eager_alltoall(x, splits, name: str, set_id=0):
 
 def _eager_reducescatter(x, op: ReduceOp, name: str, set_id=0,
                          set_size=None):
+    _check_reducescatter_op(op)
     rt = basics.runtime()
     arr = np.asarray(x)
     if rt is None:
@@ -535,7 +555,13 @@ def allreduce(tensor, average=None, name=None, op=None,
     _reject_spmd_process_set(process_set, ax)
     if _axis_bound(ax):
         t = tensor * prescale_factor if prescale_factor != 1.0 else tensor
-        if rop is Average or rop is Adasum:
+        if rop is Adasum:
+            raise NotImplementedError(
+                "op=Adasum is implemented on the eager plane only (native "
+                "scaled-projection butterfly); inside an SPMD axis use "
+                "op=Average, or run the Adasum reduction through the "
+                "eager hvd.allreduce path")
+        if rop is Average:
             out = lax.pmean(t, ax)
         elif rop is Sum:
             out = lax.psum(t, ax)
@@ -596,9 +622,12 @@ def grouped_allreduce(tensors, average=None, name=None, op=None, axis_name=None)
         return []
     ax = _default_axis(axis_name)
     if _axis_bound(ax):
+        if rop is Adasum:
+            raise NotImplementedError(
+                "op=Adasum is implemented on the eager plane only; see "
+                "hvd.allreduce")
         from horovod_tpu.ops.fusion import fused_psum
-        return fused_psum(tensors, ax,
-                          mean=(rop is Average or rop is Adasum))
+        return fused_psum(tensors, ax, mean=rop is Average)
     if any(_is_traced(t) for t in tensors):
         return [_plain_jit_fallback(t, "grouped_allreduce") for t in tensors]
     return [allreduce(t, name=f"{_auto_name('grouped', name)}.{i}", op=rop)

@@ -47,9 +47,13 @@ def _allreduce_tree(grads, axis_name: str, compression=Compression.none,
     cleaves = [c[0] for c in compressed]
     ctxs = [c[1] for c in compressed]
     if collective._axis_bound(axis_name):
+        if op is collective.Adasum:
+            raise NotImplementedError(
+                "op=Adasum is implemented on the eager plane only; see "
+                "hvd.allreduce (ops/collective.py)")
         from horovod_tpu.ops.fusion import fused_psum
-        mean = op is collective.Average or op is collective.Adasum
-        reduced = fused_psum(cleaves, axis_name, mean=mean)
+        reduced = fused_psum(cleaves, axis_name,
+                             mean=op is collective.Average)
     elif cleaves and isinstance(cleaves[0], jax.core.Tracer):
         reduced = [collective._plain_jit_fallback(l, "DistributedOptimizer")
                    for l in cleaves]

@@ -84,18 +84,32 @@ def apply_config_file(args, parser) -> None:
 
 
 def runtime_env(info, rendezvous_addr: str, rendezvous_port: int,
-                extra: Dict[str, str]) -> Dict[str, str]:
+                extra: Dict[str, str],
+                multi_host: bool = False) -> Dict[str, str]:
     """Per-rank environment (reference gloo_run.py:211-254 env contract).
 
     When HOROVOD_NETWORK_INTERFACE is in the rank's env (from the
     ``--network-interface`` flag, the launcher's inherited env, or a
     per-host override), the launcher's generic per-host name is NOT
     injected: it would shadow the resolved interface address the runtime
-    advertises.  An explicit user HOROVOD_HOSTNAME still survives (it is
-    the advertise-only override, docs/running.md).
+    advertises.  An explicit user HOROVOD_HOSTNAME survives (it is the
+    advertise-only override, docs/running.md) — except on MULTI-host
+    jobs when it merely leaked in from the launcher's shell: one
+    job-wide advertise address would point every rank at one machine, so
+    the per-host name wins there (with a warning).
     """
     env = dict(os.environ)
     env.update(extra)
+    if multi_host and "HOROVOD_HOSTNAME" not in extra and \
+            os.environ.get("HOROVOD_HOSTNAME"):
+        if info.rank == 0:
+            import sys
+            print("hvdrun: ignoring HOROVOD_HOSTNAME="
+                  f"{os.environ['HOROVOD_HOSTNAME']} inherited from the "
+                  "launcher's environment: a single advertise address is "
+                  "wrong for a multi-host job (set it per host, or use "
+                  "--network-interface)", file=sys.stderr)
+        del env["HOROVOD_HOSTNAME"]
     env.update({
         "HOROVOD_RANK": str(info.rank),
         "HOROVOD_SIZE": str(info.size),

@@ -172,8 +172,10 @@ def run_command(args) -> int:
         jport = args.jax_coordinator_port or launch.find_free_port()
         extra_env["HOROVOD_JAX_DISTRIBUTED"] = "1"
         extra_env["HOROVOD_COORDINATOR_ADDR"] = f"{addr}:{jport}"
+    multi_host = len({i.hostname for i in infos}) > 1
     env_per_rank = [
-        config_parser.runtime_env(info, addr, port, extra_env)
+        config_parser.runtime_env(info, addr, port, extra_env,
+                                  multi_host=multi_host)
         for info in infos
     ]
     if args.verbose:

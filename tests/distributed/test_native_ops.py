@@ -45,6 +45,90 @@ def test_allreduce_dtypes(hvd, rank, size, dtype):
     np.testing.assert_allclose(out, np.full((8,), float(size)))
 
 
+def _adasum_pair(a, b):
+    """Oracle for the native scaled-projection combine (data_plane.cc
+    AdasumCombine; Maleki et al. 2020), lower position's vector first."""
+    dot = float(np.dot(a, b))
+    na = float(np.dot(a, a))
+    nb = float(np.dot(b, b))
+    ac = 1.0 - dot / (2.0 * na) if na > 0 else 1.0
+    bc = 1.0 - dot / (2.0 * nb) if nb > 0 else 1.0
+    return ac * a + bc * b
+
+
+def test_adasum_identical_is_identity(hvd, rank, size):
+    """adasum(g, g, ..., g) == g — the property that distinguishes real
+    Adasum from Sum/Average scaling games."""
+    x = np.linspace(1.0, 2.0, 64).astype(np.float32)
+    out = np.asarray(hvd.allreduce(x, op=hvd.Adasum, name="ad.ident"))
+    np.testing.assert_allclose(out, x, rtol=1e-6)
+
+
+def test_adasum_orthogonal_adds(hvd, rank, size):
+    """Orthogonal gradients combine to their sum (projections vanish)."""
+    x = np.zeros(size * 4, np.float32)
+    x[rank * 4:(rank + 1) * 4] = rank + 1.0
+    out = np.asarray(hvd.allreduce(x, op=hvd.Adasum, name="ad.orth"))
+    want = np.concatenate([np.full(4, r + 1.0, np.float32)
+                           for r in range(size)])
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def test_adasum_matches_oracle(hvd, rank, size):
+    """Random vectors vs the numpy butterfly oracle (2-rank CI matrix:
+    one pair combine; the >2-rank fold/butterfly order is gated by
+    tests/test_distributed.py::test_adasum_three_ranks)."""
+    if size != 2:
+        pytest.skip("oracle written for the 2-rank CI matrix")
+    vecs = [np.random.default_rng(100 + r).standard_normal(257)
+            .astype(np.float32) for r in range(2)]
+    out = np.asarray(hvd.allreduce(vecs[rank], op=hvd.Adasum,
+                                   name="ad.oracle"))
+    np.testing.assert_allclose(out, _adasum_pair(vecs[0], vecs[1]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_adasum_bf16(hvd, rank, size):
+    """16-bit tensors stage through f32 around the butterfly."""
+    import jax.numpy as jnp
+    x = jnp.asarray(np.ones(33, np.float32) * (1.0 if rank % 2 == 0
+                                               else 3.0), jnp.bfloat16)
+    out = np.asarray(hvd.allreduce(x, op=hvd.Adasum, name="ad.bf16"),
+                     dtype=np.float32)
+    # Parallel vectors a, 3a: dot = 3|a|^2, so
+    # ac = 1 - 3/2 = -1/2 and bc = 1 - 1/6 = 5/6 ->
+    # result = -a/2 + 5/6*3a = 2a.
+    if size == 2:
+        np.testing.assert_allclose(out, np.full(33, 2.0), rtol=1e-2)
+    else:
+        assert np.isfinite(out).all()
+
+
+def test_adasum_int_rejected(hvd, rank, size):
+    """Integer Adasum must fail loudly, not silently sum."""
+    with pytest.raises(Exception, match="[Aa]dasum"):
+        hvd.allreduce(np.ones(4, np.int32), op=hvd.Adasum, name="ad.int")
+
+
+def test_adasum_many_tensors_not_fused(hvd, rank, size):
+    """Several Adasum tensors in flight: the projection must stay
+    per-tensor (Fuse() excludes kAdasum), so each matches its own
+    single-tensor result."""
+    if size != 2:
+        pytest.skip("oracle written for the 2-rank CI matrix")
+    vecs = {i: [np.random.default_rng(1000 + 10 * i + r)
+                .standard_normal(50).astype(np.float32)
+                for r in range(2)] for i in range(6)}
+    handles = [hvd.allreduce_async(vecs[i][rank], op=hvd.Adasum,
+                                   name=f"ad.many.{i}")
+               for i in range(6)]
+    for i, h in enumerate(handles):
+        out = np.asarray(hvd.synchronize(h))
+        np.testing.assert_allclose(out, _adasum_pair(vecs[i][0],
+                                                     vecs[i][1]),
+                                   rtol=1e-5, atol=1e-6)
+
+
 def test_allreduce_prescale_postscale(hvd, rank, size):
     x = np.ones(4, np.float32)
     out = np.asarray(hvd.allreduce(x, op=hvd.Sum, name="t.scale",

@@ -38,6 +38,16 @@ def test_spmd_allreduce_average(hvd, mesh8):
                                np.mean(np.asarray(x), axis=0), rtol=1e-6)
 
 
+def test_spmd_allreduce_adasum_raises(hvd, mesh8):
+    """Adasum is an eager-plane op; the SPMD plane must fail loudly
+    instead of silently substituting the mean (docs/api.md)."""
+    x = jnp.ones((8, 4), jnp.float32)
+    f = shard(lambda t: hvd.allreduce(t, op=hvd.Adasum), mesh8,
+              P("data"), P())
+    with pytest.raises(NotImplementedError, match="Adasum"):
+        f(x)
+
+
 def test_spmd_allreduce_min_max(hvd, mesh8):
     x = jnp.asarray(np.random.RandomState(0).randn(8, 5), jnp.float32)
     fmin = shard(lambda t: hvd.allreduce(t, op=hvd.Min), mesh8, P("data"), P())

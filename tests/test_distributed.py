@@ -45,6 +45,43 @@ def test_native_ops_under_launcher(tmp_path):
     assert res.returncode == 0, res.stdout + res.stderr
 
 
+def test_adasum_three_ranks(tmp_path):
+    """Non-power-of-2 Adasum: rank 2 folds into rank 0 before the 2-rank
+    butterfly and receives the result back; every rank must hold the
+    oracle value bitwise-identically (native AdasumButterfly,
+    data_plane.cc)."""
+    script = textwrap.dedent("""\
+        import numpy as np
+        import horovod_tpu as hvd
+        hvd.init()
+        r, s = hvd.rank(), hvd.size()
+        assert s == 3
+        vecs = [np.random.default_rng(7 + i).standard_normal(129)
+                .astype(np.float32) for i in range(3)]
+
+        def pair(a, b):
+            dot = float(np.dot(a, b))
+            na = float(np.dot(a, a)); nb = float(np.dot(b, b))
+            ac = 1.0 - dot / (2.0 * na) if na > 0 else 1.0
+            bc = 1.0 - dot / (2.0 * nb) if nb > 0 else 1.0
+            return ac * a + bc * b
+
+        out = np.asarray(hvd.allreduce(vecs[r], op=hvd.Adasum,
+                                       name="ad3"))
+        # Fold order: extra rank 2 -> position 0, then the 0/1 butterfly.
+        want = pair(pair(vecs[0], vecs[2]), vecs[1])
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+        # Bitwise agreement across ranks.
+        allout = np.asarray(hvd.allgather(out[None], name="ad3.g"))
+        for rr in range(s):
+            np.testing.assert_array_equal(allout[rr], out)
+        print(f"rank {r}: adasum3 ok")
+    """)
+    res = _hvdrun([], script=script, np_=3, timeout=120, tmp_path=tmp_path)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert res.stdout.count("adasum3 ok") == 3
+
+
 def test_network_interface_pins_loopback(tmp_path):
     """--network-interface lo: both ranks bind AND advertise loopback's
     address; the job runs collectives normally (reference horovodrun

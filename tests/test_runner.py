@@ -109,6 +109,24 @@ def test_runtime_env():
          "HOROVOD_HOSTNAME": "10.0.0.7"})
     assert env["HOROVOD_HOSTNAME"] == "10.0.0.7"
 
+    # A HOROVOD_HOSTNAME that leaked in from the launcher's shell is
+    # ignored on MULTI-host jobs (one job-wide advertise address would
+    # point every rank at one machine); explicit extra still wins.
+    os.environ["HOROVOD_HOSTNAME"] = "stale-node"
+    try:
+        env = config_parser.runtime_env(info, "127.0.0.1", 1234, {},
+                                        multi_host=True)
+        assert env["HOROVOD_HOSTNAME"] == "localhost"
+        env = config_parser.runtime_env(info, "127.0.0.1", 1234, {},
+                                        multi_host=False)
+        assert env["HOROVOD_HOSTNAME"] == "stale-node"
+        env = config_parser.runtime_env(
+            info, "127.0.0.1", 1234, {"HOROVOD_HOSTNAME": "10.0.0.7"},
+            multi_host=True)
+        assert env["HOROVOD_HOSTNAME"] == "10.0.0.7"
+    finally:
+        del os.environ["HOROVOD_HOSTNAME"]
+
 
 def test_packaging_metadata():
     """pyproject must declare the hvdrun console script and ship the
