@@ -568,9 +568,16 @@ Response Controller::ConstructResponse(const std::string& key) {
         // their blocks destined for the joined rank would be dropped.
         return fail("Alltoall is not supported while any rank has joined "
                     "(tensor " + name + ").");
+      if (first.op_type == OpType::kReducescatter &&
+          static_cast<ReduceOp>(first.arg) == ReduceOp::kAdasum)
+        // The ring reduce phase would silently execute Adasum chunks as
+        // Sum; Adasum is an allreduce-only reduction (AdasumAllreduce,
+        // data_plane.cc) — fail loudly, mirroring the Python chokepoint
+        // (ops/collective.py _check_reducescatter_op).
+        return fail("Reducescatter does not support the Adasum reduction "
+                    "(tensor " + name + ").");
       if (any_joined &&
           static_cast<ReduceOp>(first.arg) != ReduceOp::kSum &&
-          static_cast<ReduceOp>(first.arg) != ReduceOp::kAdasum &&
           first.op_type == OpType::kReducescatter)
         return fail("Reducescatter with joined ranks supports only the Sum "
                     "reduction (tensor " + name + ").");

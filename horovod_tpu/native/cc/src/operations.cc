@@ -293,16 +293,13 @@ int64_t ExecuteResponse(const Response& resp) {
         if (!entries.empty())
           g->timeline.ActivityStart(entries[0]->name, "TCP_ALLREDUCE");
         if (rop == ReduceOp::kAdasum) {
-          // Fuse() keeps Adasum responses single-name, but a rank that
-          // holds none of the entries (joined) still lands here; the
-          // projection is per-TENSOR either way, so run it per name
-          // over the buffer slices.
-          size_t aoff = 0;
-          for (size_t i = 0; i < resp.names.size() && st.ok(); ++i) {
-            st = g->data_plane.AdasumAllreduce(
-                buf + aoff, resp.first_dims[i], resp.dtype, *group);
-            aoff += static_cast<size_t>(resp.first_dims[i]) * esz;
-          }
+          // Unreachable in practice — Fuse() keeps Adasum single-name
+          // and those route to the single-entry branch above; a rank
+          // with zero entries dispatches to ParticipateJoined, not
+          // here.  Executed defensively as one vector (== per-name for
+          // the only possible single-name layout).
+          st = g->data_plane.AdasumAllreduce(
+              buf, static_cast<int64_t>(total / esz), resp.dtype, *group);
         } else {
           st = g->data_plane.Allreduce(
               buf, static_cast<int64_t>(total / esz), resp.dtype, rop,
