@@ -198,36 +198,53 @@ def _watchdog_main():
     of nothing.  ``BENCH_TIMEOUT`` seconds (default 3600) bounds the
     child; ``BENCH_WATCHDOG=0`` runs inline (debugging).
     """
+    import signal
     import subprocess
+    import time
     timeout = float(os.environ.get("BENCH_TIMEOUT", "3600"))
     env = dict(os.environ)
     env["BENCH_CHILD"] = "1"
-    # Capture and relay the child's stdout: if the child printed its
-    # result line and THEN wedged (teardown hang), that line — not the
-    # fallback — is the artifact; two JSON lines would break the
-    # one-line contract.
-    captured = ""
+    # Capture and relay the child's STDOUT only (stderr stays inherited
+    # so sub-bench diagnostics and crash tracebacks remain visible): if
+    # the child printed its result line and THEN wedged (teardown hang),
+    # that line — not the fallback — is the artifact; two JSON lines
+    # would break the one-line contract.  start_new_session: on timeout
+    # the whole process GROUP is killed, so grandchildren (the eager
+    # bench's launcher ranks) cannot outlive the run holding ports or
+    # the tunnel's device claim.
+    t0 = time.monotonic()
+    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+                            env=env, stdout=subprocess.PIPE, text=True,
+                            start_new_session=True)
+    timed_out = False
     try:
-        res = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                             env=env, timeout=timeout,
-                             capture_output=True, text=True)
-        captured = res.stdout
-        rc = res.returncode
-    except subprocess.TimeoutExpired as e:
-        captured = (e.stdout.decode() if isinstance(e.stdout, bytes)
-                    else e.stdout) or ""
+        captured, _ = proc.communicate(timeout=timeout)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        captured, _ = proc.communicate()
         rc = 0
+    captured = captured or ""
     sys.stdout.write(captured)
     if '"metric"' not in captured:
+        elapsed = time.monotonic() - t0
+        reason = (f"TPU backend/tunnel did not respond within "
+                  f"{timeout:.0f}s" if timed_out else
+                  f"benchmark child exited rc={rc} after {elapsed:.0f}s "
+                  f"with no result (see stderr for the traceback)")
         print(json.dumps({
             "metric": "resnet50_synthetic_img_sec_per_chip",
             "value": 0.0, "unit": "img/sec/chip", "vs_baseline": 0.0,
-            "error": (f"benchmark produced no result within {timeout:.0f}s "
-                      "— TPU backend/tunnel did not respond (see "
-                      "BENCH_r04.json for the last good run: 2582 img/s, "
-                      "31.2% MFU resnet; 19.1k tok/s, 75.2% MFU lm)"),
+            "error": (f"{reason} — last good run in BENCH_r04.json: "
+                      "2582 img/s, 31.2% MFU resnet; 19.1k tok/s, "
+                      "75.2% MFU lm"),
         }))
-        return 0
+        # A hang is "reported successfully"; a crash stays a crash.
+        return 0 if timed_out else (rc or 1)
     return rc
 
 

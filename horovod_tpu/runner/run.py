@@ -48,6 +48,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rendezvous-port", type=int, default=0,
                    help="Fixed controller rendezvous port (default: pick "
                         "a free port).")
+    p.add_argument("--elastic-restarts", type=int, default=0,
+                   help="Relaunch the WHOLE job up to N times after a "
+                        "failure (full-restart elasticity: each attempt "
+                        "gets a fresh rendezvous; pair with "
+                        "hvd.checkpoint save/restore so training resumes "
+                        "from the latest step — docs/elastic.md).  Ranks "
+                        "see HOROVOD_RESTART_ATTEMPT=k.")
     p.add_argument("--network-interface", dest="network_interface",
                    default=None,
                    help="Comma-separated NIC name(s), in preference "
@@ -162,6 +169,27 @@ def run_command(args) -> int:
                          if not launch.is_local(i.hostname)})
         network.check_hosts_reachable(remote)
     addr = "127.0.0.1" if all_local else infos[0].hostname
+    restarts = max(0, getattr(args, "elastic_restarts", 0) or 0)
+    rc = 1
+    for attempt in range(restarts + 1):
+        if attempt > 0:
+            print(f"hvdrun: job failed (rc={rc}); elastic restart "
+                  f"{attempt}/{restarts} with a fresh rendezvous",
+                  file=sys.stderr, flush=True)
+        extra_env["HOROVOD_RESTART_ATTEMPT"] = str(attempt)
+        rc = _launch_once(args, infos, addr, extra_env)
+        if rc == 0:
+            return 0
+        if rc in (130, 143) or rc < 0:
+            # Signal-induced exit (Ctrl-C / scheduler SIGTERM handled by
+            # launch_job, or a signal reported as a negative code): the
+            # OPERATOR stopped the job — relaunching would make them
+            # race each fresh attempt with another Ctrl-C.
+            return rc
+    return rc
+
+
+def _launch_once(args, infos, addr, extra_env) -> int:
     port = args.rendezvous_port or launch.find_free_port()
     if getattr(args, "jax_distributed", False):
         # The jax.distributed coordinator runs INSIDE rank 0 (unlike the
