@@ -7,6 +7,9 @@ cd "$(dirname "$0")/.."
 echo "--- build native runtime"
 python -m horovod_tpu.native.build
 
+echo "--- Bayesian-optimizer convergence oracle (grid-search gate)"
+make -s -C horovod_tpu/native/cc unittest
+
 echo "--- capability report"
 python -m horovod_tpu.runner --check-build
 

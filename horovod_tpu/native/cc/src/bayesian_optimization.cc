@@ -48,9 +48,7 @@ std::vector<double> BayesianOptimizer::NextSample() {
   const double xi = 0.01 * std::abs(best_score_);
   std::vector<double> best_cand(dims_, 0.5);
   double best_ei = -1.0;
-  for (int c = 0; c < 256; ++c) {
-    std::vector<double> x(dims_);
-    for (int d = 0; d < dims_; ++d) x[d] = Rand01();
+  auto consider = [&](const std::vector<double>& x) {
     double mu, sigma;
     gp_.Predict(x, &mu, &sigma);
     double imp = mu - best_score_ - xi;
@@ -59,6 +57,32 @@ std::vector<double> BayesianOptimizer::NextSample() {
     if (ei > best_ei) {
       best_ei = ei;
       best_cand = x;
+    }
+  };
+  // Global exploration: uniform candidates, more of them in higher
+  // dimensions (the box volume the 5-D hierarchical space added).
+  const int n_global = 256 + 128 * (dims_ - 3 > 0 ? dims_ - 3 : 0);
+  for (int c = 0; c < n_global; ++c) {
+    std::vector<double> x(dims_);
+    for (int d = 0; d < dims_; ++d) x[d] = Rand01();
+    consider(x);
+  }
+  // Local refinement around the incumbent: the deterministic stand-in
+  // for the reference's L-BFGS restart on the EI surface
+  // (optim/bayesian_optimization.cc) — shrinking clamped perturbations
+  // of best_x_ let EI sharpen a known good region that uniform sampling
+  // rarely re-hits in 5-D.
+  // best_x_ can be empty if every observed score was NaN (a broken
+  // metric): skip refinement rather than index an empty vector.
+  if (best_x_.empty()) return best_cand;
+  for (double scale : {0.2, 0.07, 0.02}) {
+    for (int c = 0; c < 32; ++c) {
+      std::vector<double> x(dims_);
+      for (int d = 0; d < dims_; ++d) {
+        double v = best_x_[d] + scale * (2.0 * Rand01() - 1.0);
+        x[d] = v < 0.0 ? 0.0 : (v > 1.0 ? 1.0 : v);
+      }
+      consider(x);
     }
   }
   return best_cand;

@@ -173,18 +173,25 @@ def run_command(args) -> int:
     rc = 1
     for attempt in range(restarts + 1):
         if attempt > 0:
+            # Brief backoff so a persistently broken launch (host mid-
+            # reboot, dead binary) doesn't burn the whole restart budget
+            # in a second — the budget targets transient failures.
+            delay = min(2.0 ** attempt, 30.0)
             print(f"hvdrun: job failed (rc={rc}); elastic restart "
-                  f"{attempt}/{restarts} with a fresh rendezvous",
-                  file=sys.stderr, flush=True)
+                  f"{attempt}/{restarts} in {delay:.0f}s with a fresh "
+                  f"rendezvous", file=sys.stderr, flush=True)
+            import time
+            time.sleep(delay)
         extra_env["HOROVOD_RESTART_ATTEMPT"] = str(attempt)
         rc = _launch_once(args, infos, addr, extra_env)
         if rc == 0:
             return 0
-        if rc in (130, 143) or rc < 0:
-            # Signal-induced exit (Ctrl-C / scheduler SIGTERM handled by
-            # launch_job, or a signal reported as a negative code): the
-            # OPERATOR stopped the job — relaunching would make them
-            # race each fresh attempt with another Ctrl-C.
+        if rc in (130, 143):
+            # The OPERATOR stopped the job (launch_job normalizes its
+            # own SIGINT/SIGTERM handling to 130) — relaunching would
+            # race them with another Ctrl-C.  A NEGATIVE code is a rank
+            # killed by a signal (OOM SIGKILL, SIGSEGV): that is a
+            # crash, exactly what the restart budget is for.
             return rc
     return rc
 
