@@ -7,8 +7,9 @@ cd "$(dirname "$0")/.."
 echo "--- build native runtime"
 python -m horovod_tpu.native.build
 
-echo "--- Bayesian-optimizer convergence oracle (grid-search gate)"
-make -s -C horovod_tpu/native/cc unittest
+#  (The Bayesian-optimizer grid-search oracle gate runs inside the fast
+#   lane: tests/test_autotune.py::test_bayes_vs_grid_oracle -> make
+#   -C native/cc unittest.)
 
 echo "--- capability report"
 python -m horovod_tpu.runner --check-build

@@ -74,6 +74,7 @@ from horovod_tpu.ops.collective import (
     broadcast_object,
     reducescatter,
     alltoall,
+    alltoall_ragged,
     synchronize,
     poll,
     join,
@@ -110,7 +111,7 @@ __all__ = [
     "allgather", "allgather_async", "allgather_object",
     "broadcast", "broadcast_", "broadcast_async", "broadcast_async_",
     "broadcast_object",
-    "reducescatter", "alltoall",
+    "reducescatter", "alltoall", "alltoall_ragged",
     "synchronize", "poll", "join",
     # training
     "Compression", "checkpoint",

@@ -319,10 +319,24 @@ def synchronize(handle):
 # Eager execution (concrete arrays)
 # ---------------------------------------------------------------------------
 
+def _check_adasum_dtype(arr) -> None:
+    """Adasum's projection is defined for floating tensors only; validate
+    at the Python layer so the failure is identical at every world size
+    (the native plane re-checks, but a size-1 job short-circuits before
+    reaching it)."""
+    kind = getattr(arr.dtype, "kind", "")
+    if kind != "f" and "float" not in str(arr.dtype):  # bf16 has kind 'V'
+        raise NotImplementedError(
+            f"Adasum is defined for floating-point tensors only "
+            f"(got dtype {arr.dtype})")
+
+
 def _eager_allreduce(x, op: ReduceOp, name: str, prescale_factor,
                      postscale_factor, set_id=0, set_size=None):
     rt = basics.runtime()
     arr = np.asarray(x)
+    if op is Adasum:
+        _check_adasum_dtype(arr)
     if prescale_factor != 1.0:
         arr = arr * prescale_factor
     if rt is None:
@@ -346,6 +360,8 @@ def _eager_allreduce_submit(x, op: ReduceOp, name: str, prescale_factor,
                             set_id=0):
     rt = basics.runtime()
     arr = np.asarray(x)
+    if op is Adasum:
+        _check_adasum_dtype(arr)
     if prescale_factor != 1.0:
         arr = arr * prescale_factor
     if rt is None:
@@ -777,8 +793,10 @@ def alltoall(tensor, splits=None, name=None, axis_name=None,
     if _axis_bound(ax):
         if splits is not None:
             raise NotImplementedError(
-                "uneven splits are not supported in the SPMD plane; "
-                "pad to equal chunks (static shapes) or use the eager path")
+                "uneven splits under jit need a STATIC output capacity "
+                "(XLA shapes); use hvd.alltoall_ragged(tensor, splits, "
+                "output_size) which returns (padded output, received "
+                "counts), or the eager path outside jit")
         return lax.all_to_all(tensor, ax, split_axis=0, concat_axis=0,
                               tiled=True)
     if _is_traced(tensor):
@@ -797,6 +815,104 @@ def alltoall(tensor, splits=None, name=None, axis_name=None,
         # received row counts back (needed to slice the uneven output).
         return jnp.asarray(out), jnp.asarray(received)
     return jnp.asarray(out)
+
+
+def alltoall_ragged(tensor, splits, output_size: int, axis_name=None,
+                    use_primitive=None):
+    """Uneven (ragged) all-to-all INSIDE the SPMD plane — the MoE/EP
+    exchange with per-destination row counts, jit-compatible via a
+    STATIC output capacity (closes the sharp edge the plain
+    ``alltoall(splits=...)`` guard documents; later-Horovod has only the
+    eager equivalent, ``horovod/common/ops/...alltoall``).
+
+    ``tensor``: ``[N, ...]`` this shard's rows, grouped by destination
+    (rows for peer 0 first, then peer 1, ...).  ``splits``: ``[S]`` rows
+    to send to each peer (may be traced).  ``output_size``: static row
+    capacity of the result — the caller's bound on ``sum(received)``
+    (e.g. MoE capacity x experts); rows beyond it are DROPPED, matching
+    a capacity-factor router's semantics.  Returns ``(out, received)``:
+    ``out[output_size, ...]`` holds each source's rows concatenated in
+    source order (unwritten tail rows are zeros), ``received[S]`` is the
+    per-source row count each peer SENT (pre-drop; ``min`` it against
+    the remaining capacity to count what landed).
+
+    Routing follows the flash-kernel pattern: on a TPU mesh the XLA
+    ``ragged-all-to-all`` primitive moves exactly the ragged bytes; on
+    CPU/virtual meshes (where XLA has no such HLO) an exact dense twin —
+    pad-to-N regular all_to_all + scatter-compact — computes the same
+    answer, so tests and the dryrun certify the semantics everywhere.
+    """
+    ax = _default_axis(axis_name)
+    if not _axis_bound(ax):
+        raise ValueError(
+            "alltoall_ragged is the SPMD-plane API (call it inside "
+            "shard_map with the axis bound); the eager plane's "
+            "hvd.alltoall(tensor, splits=...) already supports uneven "
+            "splits directly")
+    size = lax.axis_size(ax)
+    me = lax.axis_index(ax)
+    sp = jnp.asarray(splits, jnp.int32)
+    n = tensor.shape[0]
+    trailing = tensor.shape[1:]
+
+    in_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                              jnp.cumsum(sp)[:-1].astype(jnp.int32)])
+    # ONE metadata collective serves both routes: m[s, d] = rows s -> d.
+    m = lax.all_gather(sp, ax, axis=0).astype(jnp.int32)   # [S, S]
+    recv = m[:, me]                                        # rows j -> me
+
+    primitive = (use_primitive if use_primitive is not None
+                 else _exec_on_tpu_spmd(tensor))
+    if primitive:
+        # Sender-side offsets into each RECEIVER's buffer: my block lands
+        # after every lower-ranked sender's contribution to that peer.
+        mask = (jnp.arange(size) < me)[:, None]
+        out_off = jnp.sum(m * mask, axis=0).astype(jnp.int32)
+        # Enforce the capacity-drop contract on the WIRE: clamp each
+        # block to the room left at its receiver (every rank derives the
+        # same clamps from the same gathered matrix), so the primitive
+        # never updates past the static buffer.  `recv` is still the
+        # PRE-clamp per-source count (callers min with capacity).
+        send_sz = jnp.clip(output_size - out_off, 0, sp)
+        off_at_me = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32),
+             jnp.cumsum(recv)[:-1].astype(jnp.int32)])
+        recv_sz = jnp.clip(output_size - off_at_me, 0, recv)
+        out = jnp.zeros((output_size,) + trailing, tensor.dtype)
+        out = lax.ragged_all_to_all(
+            tensor, out, in_off, send_sz,
+            jnp.minimum(out_off, output_size), recv_sz, axis_name=ax)
+        return out, recv
+
+    # Dense twin: pad each destination block to N rows (worst case: one
+    # peer gets everything), exchange, scatter-compact into the capacity
+    # buffer.  Moves S x the ragged bytes — fine for the CPU/test plane,
+    # which is why the TPU mesh takes the primitive above.
+    idx = jnp.arange(n)
+    cum = jnp.cumsum(sp)
+    dest = jnp.searchsorted(cum, idx, side="right").astype(jnp.int32)
+    slot = idx - in_off[jnp.clip(dest, 0, size - 1)]
+    valid_in = idx < cum[-1]
+    buf = jnp.zeros((size, n) + trailing, tensor.dtype)
+    # Rows beyond sum(splits) scatter to an out-of-bounds destination and
+    # are dropped (mode="drop") — never overwriting a real slot.
+    buf = buf.at[jnp.where(valid_in, dest, size), slot].set(
+        tensor, mode="drop")
+    ex = lax.all_to_all(buf, ax, split_axis=0, concat_axis=0)  # [S, n, ...]
+    cum_recv = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                jnp.cumsum(recv)[:-1].astype(jnp.int32)])
+    pos = cum_recv[:, None] + jnp.arange(n)[None, :]
+    valid = jnp.arange(n)[None, :] < recv[:, None]
+    pos = jnp.where(valid, pos, output_size)        # overflow/pad -> dump
+    out = jnp.zeros((output_size + 1,) + trailing, tensor.dtype)
+    out = out.at[pos.reshape(-1)].set(
+        ex.reshape((size * n,) + trailing), mode="drop")[:output_size]
+    return out, recv
+
+
+def _exec_on_tpu_spmd(x) -> bool:
+    from horovod_tpu.topology import exec_on_tpu
+    return exec_on_tpu(x)
 
 
 def barrier(name=None, process_set=None) -> None:

@@ -44,39 +44,12 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = float("-inf")
 
 
-_warned_no_abstract_device = False
-
-
 def _exec_on_tpu(x) -> bool:
-    """Whether the mesh actually EXECUTING this computation is TPU.
-
-    ``jax.default_backend()`` is the wrong question inside shard_map: on
-    a TPU host driving a CPU/virtual mesh it answers "tpu" and would
-    select the compiled Pallas kernel for a CPU computation.  The
-    abstract mesh attached to the tracer's sharding carries the real
-    device kind of the mesh the shard_map runs on."""
-    global _warned_no_abstract_device
-    try:
-        # abstract_device is None on eager/concrete arrays (normal: fall
-        # through to the backend answer, silently); it is internal
-        # surface, so a MISSING attribute means a JAX upgrade renamed it
-        # — say so once instead of silently reverting to the
-        # host-backend answer this helper exists to avoid.
-        ad = jax.typeof(x).sharding.mesh.abstract_device
-        if ad is not None and ad.device_kind is not None:
-            return "tpu" in str(ad.device_kind).lower()
-    except AttributeError:
-        if not _warned_no_abstract_device:
-            _warned_no_abstract_device = True
-            import logging
-            logging.getLogger(__name__).debug(
-                "AbstractMesh.abstract_device.device_kind unavailable on "
-                "this JAX; falling back to jax.default_backend() for the "
-                "flash kernel platform gate")
-    try:  # outside shard_map / no mesh info: fall back to the backend
-        return jax.default_backend() == "tpu"
-    except Exception:  # pragma: no cover
-        return False
+    """Executing-mesh platform answer — shared helper, see
+    :func:`horovod_tpu.topology.exec_on_tpu` (lives there because the
+    collective layer needs the same gate)."""
+    from horovod_tpu.topology import exec_on_tpu
+    return exec_on_tpu(x)
 
 
 def _interpret_default(x=None) -> bool:

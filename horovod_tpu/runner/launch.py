@@ -142,9 +142,11 @@ def launch_job(rank_infos: List[RankInfo], command: List[str],
              for info, env in zip(rank_infos, env_per_rank)]
 
     stop = threading.Event()
+    signalled = threading.Event()   # the OPERATOR stopped the job
 
     def handle_signal(signum, frame):
         del frame
+        signalled.set()
         stop.set()
         for p in procs:
             p.terminate()
@@ -191,14 +193,17 @@ def launch_job(rank_infos: List[RankInfo], command: List[str],
                     p.kill()
                 break
             time.sleep(0.05)
-        interrupted = stop.is_set() and exit_code == 0
         for p in procs:
             p.proc.wait()
             rc = p.proc.returncode
             if rc not in (0, None) and exit_code == 0:
                 exit_code = rc
-        if interrupted and exit_code == 0:
-            exit_code = 130   # job was signalled; never report success
+        if signalled.is_set():
+            # Operator stop: ALWAYS 130, even though the SIGTERMed ranks
+            # report -15 — callers (elastic restarts) distinguish "the
+            # operator stopped the job" from "a rank crashed" by this
+            # code, and success must never be reported either.
+            exit_code = 130
         return exit_code
     finally:
         signal.signal(signal.SIGINT, old_int)

@@ -187,11 +187,13 @@ def run_command(args) -> int:
         if rc == 0:
             return 0
         if rc in (130, 143):
-            # The OPERATOR stopped the job (launch_job normalizes its
-            # own SIGINT/SIGTERM handling to 130) — relaunching would
-            # race them with another Ctrl-C.  A NEGATIVE code is a rank
-            # killed by a signal (OOM SIGKILL, SIGSEGV): that is a
-            # crash, exactly what the restart budget is for.
+            # The OPERATOR stopped the job (launch_job returns 130
+            # whenever ITS OWN SIGINT/SIGTERM handler fired, regardless
+            # of the SIGTERMed ranks' -15s) — relaunching would race
+            # them with another Ctrl-C.  A NEGATIVE code here is a rank
+            # killed by a signal the launcher never received (OOM
+            # SIGKILL, SIGSEGV): a crash, exactly what the restart
+            # budget is for.
             return rc
     return rc
 

@@ -99,7 +99,42 @@ def test_elastic_restart_resumes_from_checkpoint(tmp_path):
     assert "elastic restart 1/2" in res.stderr + res.stdout
 
 
-def test_adasum_three_ranks(tmp_path):
+def test_operator_stop_does_not_elastic_restart(tmp_path):
+    """SIGTERM to the launcher = operator stop: launch_job returns 130
+    (even though the SIGTERMed ranks exit -15) and the elastic loop must
+    NOT relaunch — otherwise the operator races every fresh attempt."""
+    script = tmp_path / "spin.py"
+    script.write_text(textwrap.dedent("""\
+        import time
+        import horovod_tpu as hvd
+        hvd.init()
+        print("spinning", flush=True)
+        time.sleep(120)
+    """))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         "--elastic-restarts", "3", sys.executable, str(script)],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    # Wait until both ranks are up, then stop the job like an operator.
+    import signal as _signal
+    import time as _time
+    deadline = _time.time() + 60
+    up = 0
+    while up < 2 and _time.time() < deadline:
+        line = proc.stdout.readline()
+        if "spinning" in line:
+            up += 1
+    assert up == 2, "ranks never came up"
+    proc.send_signal(_signal.SIGTERM)
+    out = proc.stdout.read()
+    rc = proc.wait(timeout=60)
+    assert rc == 130, (rc, out)
+    assert "elastic restart" not in out, out
     """Non-power-of-2 Adasum: rank 2 folds into rank 0 before the 2-rank
     butterfly and receives the result back; every rank must hold the
     oracle value bitwise-identically (native AdasumButterfly,
