@@ -94,7 +94,7 @@ from horovod_tpu.parallel.data import (
     broadcast_variables,
 )
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"
 
 __all__ = [
     # lifecycle / topology
