@@ -30,6 +30,19 @@ def test_tf_allreduce_dtypes(tfhvd, rank, size):
         assert np.allclose(out.numpy(), sum(range(1, size + 1)))
 
 
+def test_tf_allreduce_adasum(tfhvd, rank, size):
+    """op=Adasum through the TF binding: the Adasum identity plus the
+    2-rank parallel-vectors case (see test_torch_binding)."""
+    x = tf.constant(np.linspace(1.0, 2.0, 8, dtype=np.float32))
+    out = tfhvd.allreduce(x, op=tfhvd.Adasum, name="tf.adasum.ident")
+    np.testing.assert_allclose(out.numpy(), x.numpy(), rtol=1e-5)
+    if size == 2:
+        y = x * (1.0 if rank == 0 else 3.0)
+        out = tfhvd.allreduce(y, op=tfhvd.Adasum, name="tf.adasum.par")
+        np.testing.assert_allclose(out.numpy(), 2.0 * x.numpy(),
+                                   rtol=1e-4)
+
+
 def test_tf_allreduce_fp16_compression(tfhvd, rank, size):
     x = tf.ones((8,)) * (rank + 1)
     out = tfhvd.allreduce(x, average=False, name="tf.fp16",

@@ -28,6 +28,21 @@ def test_torch_allreduce_inplace(thvd, rank, size):
     assert torch.allclose(x, torch.full((5,), float(sum(range(1, size + 1)))))
 
 
+def test_torch_allreduce_adasum(thvd, rank, size):
+    """op=Adasum reaches the native scaled-projection butterfly through
+    the torch binding: identical tensors combine to themselves (the
+    Adasum identity — a Sum or Average alias would return size*x or x
+    trivially too, so also check the 2-rank a,3a case)."""
+    x = torch.linspace(1.0, 2.0, 12)
+    out = thvd.allreduce(x, op=thvd.Adasum, name="tt.adasum.ident")
+    assert torch.allclose(out, x, rtol=1e-5)
+    if size == 2:
+        y = x * (1.0 if rank == 0 else 3.0)
+        out = thvd.allreduce(y, op=thvd.Adasum, name="tt.adasum.par")
+        # a, 3a -> (1-3/2)a + (1-1/6)3a = 2a
+        assert torch.allclose(out, 2.0 * x, rtol=1e-4)
+
+
 def test_torch_allreduce_fp16_compression(thvd, rank, size):
     x = torch.ones(8) * (rank + 1)
     out = thvd.allreduce(x, op=thvd.Sum, name="tt.fp16",
