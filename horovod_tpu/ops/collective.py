@@ -841,6 +841,13 @@ def alltoall_ragged(tensor, splits, output_size: int, axis_name=None,
     CPU/virtual meshes (where XLA has no such HLO) an exact dense twin —
     pad-to-N regular all_to_all + scatter-compact — computes the same
     answer, so tests and the dryrun certify the semantics everywhere.
+
+    Differentiation: the dense twin has full AD support with the
+    expected semantics (rows that land somewhere receive their
+    cotangent, dropped/slack rows receive zero — gated by
+    ``test_alltoall_ragged_gradient``); the primitive path's AD follows
+    jax's ``lax.ragged_all_to_all`` — pass ``use_primitive=False`` under
+    ``grad`` if your jax version lacks its transpose rule.
     """
     ax = _default_axis(axis_name)
     if not _axis_bound(ax):
