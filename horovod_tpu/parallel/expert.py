@@ -138,3 +138,82 @@ def load_balancing_loss(logits, axis_name: str = "expert"):
     frac = lax.pmean(hard.mean(0), axis_name)
     prob = lax.pmean(probs.mean(0), axis_name)
     return e * jnp.sum(frac * prob)
+
+
+def moe_layer_ragged(x, router_w, expert_fn: Callable, expert_params,
+                     axis_name: str = "expert",
+                     capacity_factor: float = 1.25):
+    """Top-1 MoE layer whose dispatch is the RAGGED exchange
+    (:func:`horovod_tpu.ops.collective.alltoall_ragged`) instead of the
+    dense ``[T, E, C]`` one-hot einsum of :func:`moe_layer`.
+
+    Same routing decision as ``moe_layer(router="top1")`` — argmax
+    expert, softmax gate — but tokens travel as exactly the routed rows
+    (sorted by destination, per-destination counts), so the dispatch
+    memory is O(T·D) instead of the one-hot's O(T·E·C), and the wire
+    moves only real tokens on TPU meshes (XLA ragged-all-to-all; an
+    exact dense twin runs on CPU/virtual meshes).
+
+    Capacity semantics differ from the dense layer at overflow: the
+    expert's buffer (``size · capacity`` rows) is granted to SOURCE
+    shards in rank order (lower ranks first), not per-source slices —
+    when nothing overflows the two layers agree exactly (gated by
+    ``test_moe_ragged_matches_dense``).  Dropped tokens contribute zero,
+    like the dense layer.
+
+    x: [T_local, D]; router_w: [D, E_total]; expert_params: this chip's
+    expert parameters; expert_fn(params, tokens[N, D]) -> [N, D]
+    (position-independent per row — it sees padded zero rows).
+    Returns [T_local, D].
+    """
+    from horovod_tpu.ops.collective import alltoall_ragged
+
+    size = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    t, d = x.shape
+    capacity = max(int(capacity_factor * t / size), 1)
+    buf = size * capacity                   # the expert's static buffer
+
+    logits = x @ router_w                                     # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    dest = jnp.argmax(probs, axis=-1)                         # [T]
+    gate = jnp.take_along_axis(probs, dest[:, None], axis=1)[:, 0]
+
+    # Sort my tokens by destination (stable: ties keep token order, the
+    # same FCFS the dense router's cumsum slots implement).
+    order = jnp.argsort(dest)                                 # [T]
+    splits = jnp.bincount(dest, length=size).astype(jnp.int32)
+    x_sorted = x[order]
+
+    out_buf, recv = alltoall_ragged(x_sorted, splits, buf,
+                                    axis_name=axis_name)
+    expert_out = expert_fn(expert_params, out_buf)            # [buf, D]
+
+    # Return trip: rows go back grouped by source, counts clamped to
+    # what actually landed (the capacity grant, in source-rank order).
+    off_at_me = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                 jnp.cumsum(recv)[:-1].astype(jnp.int32)])
+    landed = jnp.clip(buf - off_at_me, 0, recv)               # [S]
+    back, _ = alltoall_ragged(expert_out, landed, t,
+                              axis_name=axis_name)            # [T, D]
+
+    # Which of MY sorted rows survived their expert's buffer?  My block
+    # at expert j starts at sum_{k<me} M[k, j]; row i of the block
+    # survives iff start + i < buf.  Returned rows arrive grouped by
+    # expert in j order == my sorted order with dropped rows REMOVED,
+    # so scatter them back to the surviving sorted slots.
+    m = lax.all_gather(splits, axis_name, axis=0)             # [S, S]
+    start = jnp.sum(m * (jnp.arange(size) < me)[:, None], axis=0)  # [S]
+    in_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                              jnp.cumsum(splits)[:-1].astype(jnp.int32)])
+    idx = jnp.arange(t)
+    row_dest = dest[order]
+    pos_in_block = idx - in_off[row_dest]
+    survived = (start[row_dest] + pos_in_block < buf) & (idx < splits.sum())
+    # Position of each surviving sorted row within the returned stream.
+    ret_pos = jnp.cumsum(survived.astype(jnp.int32)) - 1
+    gathered = jnp.where(survived[:, None],
+                         back[jnp.clip(ret_pos, 0, t - 1)], 0.0)
+    # Back to token order, weighted by the gate.
+    y = jnp.zeros((t, d), x.dtype).at[order].set(gathered)
+    return y * gate[:, None].astype(x.dtype)
