@@ -142,7 +142,8 @@ def load_balancing_loss(logits, axis_name: str = "expert"):
 
 def moe_layer_ragged(x, router_w, expert_fn: Callable, expert_params,
                      axis_name: str = "expert",
-                     capacity_factor: float = 1.25):
+                     capacity_factor: float = 1.25,
+                     use_primitive=None):
     """Top-1 MoE layer whose dispatch is the RAGGED exchange
     (:func:`horovod_tpu.ops.collective.alltoall_ragged`) instead of the
     dense ``[T, E, C]`` one-hot einsum of :func:`moe_layer`.
@@ -164,6 +165,9 @@ def moe_layer_ragged(x, router_w, expert_fn: Callable, expert_params,
     x: [T_local, D]; router_w: [D, E_total]; expert_params: this chip's
     expert parameters; expert_fn(params, tokens[N, D]) -> [N, D]
     (position-independent per row — it sees padded zero rows).
+    ``use_primitive`` forwards to :func:`alltoall_ragged` (pass False
+    under ``grad`` on a jax whose ragged primitive lacks a transpose
+    rule — the dense twin differentiates everywhere).
     Returns [T_local, D].
     """
     from horovod_tpu.ops.collective import alltoall_ragged
@@ -186,7 +190,8 @@ def moe_layer_ragged(x, router_w, expert_fn: Callable, expert_params,
     x_sorted = x[order]
 
     out_buf, recv = alltoall_ragged(x_sorted, splits, buf,
-                                    axis_name=axis_name)
+                                    axis_name=axis_name,
+                                    use_primitive=use_primitive)
     expert_out = expert_fn(expert_params, out_buf)            # [buf, D]
 
     # Return trip: rows go back grouped by source, counts clamped to
@@ -195,7 +200,8 @@ def moe_layer_ragged(x, router_w, expert_fn: Callable, expert_params,
                                  jnp.cumsum(recv)[:-1].astype(jnp.int32)])
     landed = jnp.clip(buf - off_at_me, 0, recv)               # [S]
     back, _ = alltoall_ragged(expert_out, landed, t,
-                              axis_name=axis_name)            # [T, D]
+                              axis_name=axis_name,
+                              use_primitive=use_primitive)    # [T, D]
 
     # Which of MY sorted rows survived their expert's buffer?  My block
     # at expert j starts at sum_{k<me} M[k, j]; row i of the block
@@ -209,7 +215,7 @@ def moe_layer_ragged(x, router_w, expert_fn: Callable, expert_params,
     idx = jnp.arange(t)
     row_dest = dest[order]
     pos_in_block = idx - in_off[row_dest]
-    survived = (start[row_dest] + pos_in_block < buf) & (idx < splits.sum())
+    survived = start[row_dest] + pos_in_block < buf
     # Position of each surviving sorted row within the returned stream.
     ret_pos = jnp.cumsum(survived.astype(jnp.int32)) - 1
     gathered = jnp.where(survived[:, None],
