@@ -24,7 +24,8 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import horovod_tpu as hvd
-from horovod_tpu.parallel.expert import load_balancing_loss, moe_layer
+from horovod_tpu.parallel.expert import (load_balancing_loss, moe_layer,
+                                         moe_layer_ragged)
 from horovod_tpu.topology import build_mesh
 
 
@@ -52,6 +53,12 @@ def main():
     p.add_argument("--aux-weight", type=float, default=0.01)
     p.add_argument("--router", choices=("top1", "top2"), default="top1",
                    help="Switch top-1 or GShard top-2 routing")
+    p.add_argument("--dispatch", choices=("dense", "ragged"),
+                   default="dense",
+                   help="dense: one-hot [T,E,C] dispatch einsum; "
+                        "ragged: alltoall_ragged transport (top1 only - "
+                        "O(T*D) dispatch memory, real tokens on the "
+                        "wire)")
     p.add_argument("--capacity-factor", type=float, default=None,
                    help="expert capacity factor (default 1.25 for top1, "
                         "2.5 for top2 - top-2 emits twice the "
@@ -99,10 +106,18 @@ def main():
 
     def loss_fn(params, x, labels):
         logits_r = x @ params["router"]
-        y = moe_layer(x, params["router"],
-                      expert_fn, {"w1": params["w1"], "w2": params["w2"]},
-                      axis_name="expert", router=args.router,
-                      capacity_factor=cap_factor)
+        epar = {"w1": params["w1"], "w2": params["w2"]}
+        if args.dispatch == "ragged":
+            if args.router != "top1":
+                raise SystemExit("--dispatch ragged supports --router "
+                                 "top1 only")
+            y = moe_layer_ragged(x, params["router"], expert_fn, epar,
+                                 axis_name="expert",
+                                 capacity_factor=cap_factor)
+        else:
+            y = moe_layer(x, params["router"], expert_fn, epar,
+                          axis_name="expert", router=args.router,
+                          capacity_factor=cap_factor)
         out = (x + y) @ params["head"]
         ce = optax.softmax_cross_entropy_with_integer_labels(
             out, labels).mean()

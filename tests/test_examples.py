@@ -193,6 +193,18 @@ def test_jax_moe():
     assert "OK" in res.stdout
 
 
+def test_jax_moe_ragged_dispatch():
+    """The same example over the ragged transport (--dispatch ragged):
+    the training loop must learn identically well."""
+    res = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, "jax_moe.py"),
+         "--steps", "100", "--dispatch", "ragged"],
+        capture_output=True, text=True, timeout=420,
+        env=_example_env(xla_devices=8), cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "OK" in res.stdout
+
+
 @pytest.mark.slow
 def test_jax_lm_pretrain_dp_pp():
     """The LM example's --pp path: 2 data x 4 pipe stages, loss decreases."""
