@@ -70,6 +70,15 @@ def test_config_file_precedence(tmp_path):
     assert args.fusion_threshold_mb == 8.0
     assert args.cycle_time_ms == 7
 
+    # Round-5 flags ride the same YAML + arg->env machinery.
+    cfg.write_text("network-interface: eth2,eth3\n")
+    args = parser.parse_args(["-np", "2", "--config-file", str(cfg),
+                              "echo"])
+    config_parser.apply_config_file(args, parser)
+    assert args.network_interface == "eth2,eth3"
+    env = config_parser.env_from_args(args)
+    assert env["HOROVOD_NETWORK_INTERFACE"] == "eth2,eth3"
+
 
 def test_config_file_unknown_key(tmp_path):
     cfg = tmp_path / "cfg.yaml"
