@@ -180,10 +180,15 @@ def make_bench_state(model_name: str = "resnet50", batch_size: int = 64,
     # "s2d": space-to-depth input pipeline + exact 4x4/s1 stem
     # reparameterization (models/resnet.py:space_to_depth) — input arrives
     # packed [B, H/2, W/2, 12], a pure relayout done once host-side.
-    s2d = stem == "s2d" and model_name.startswith("resnet")
+    # "s2d_fused" additionally runs BN-apply+relu+maxpool as one fused
+    # pass (ops/fused_stem.py) — same packed input pipeline.
+    if stem not in ("conv7", "s2d", "s2d_fused"):
+        raise ValueError(f"stem={stem!r}: expected 'conv7', 's2d' or "
+                         f"'s2d_fused'")
+    s2d = stem in ("s2d", "s2d_fused") and model_name.startswith("resnet")
     extra = {}
     if s2d:
-        extra["stem"] = "s2d"
+        extra["stem"] = stem
     if remat and model_name.startswith("resnet"):
         extra["remat"] = remat
     model = get_model(model_name, num_classes=num_classes, **extra)
@@ -351,7 +356,7 @@ def run_synthetic_benchmark(model_name: str = "resnet50",
     return {
         "model": model_name,
         "batch_size_per_chip": batch_size,
-        "stem": "s2d" if s2d else "conv7",
+        "stem": stem if s2d else "conv7",
         "n_chips": n_chips,
         "img_sec_total": img_sec_mean,
         "img_sec_conf": img_sec_conf,
@@ -670,7 +675,7 @@ def _main():
                         help="trace one round and print the per-op/"
                              "per-layer device-time breakdown")
     parser.add_argument("--stem", default="conv7",
-                        choices=("conv7", "s2d"))
+                        choices=("conv7", "s2d", "s2d_fused"))
     args = parser.parse_args()
 
     kwargs = dict(image_size=args.image_size,

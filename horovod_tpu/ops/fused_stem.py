@@ -1,0 +1,141 @@
+"""Fused ResNet stem tail: ``maxpool3x3/s2(relu(x*scale + offset))``.
+
+The per-HLO profile (docs/benchmarks.md) shows the stem's BN-apply/relu
+output — a 411 MB bf16 tensor at 112² — materialized between the
+batch-norm and the max-pool.  This op computes the whole tail in one
+VMEM pass per batch element (Pallas kernel), eliminating that HBM
+round-trip; it is the "one untried idea" named in the roofline
+irreducibility analysis, bounded there at ~2 ms (~+2%) of the 99 ms
+step.
+
+Status: built and gated OFF by default (``ResNet(stem="s2d_fused")``
+opts in).  Correctness is proven everywhere — an exact lax twin runs on
+CPU/virtual meshes and in interpret mode, and the kernel matches
+``nn.max_pool(relu(bn))`` bitwise at f32 — but the ~2 ms claim is
+PENDING on-chip measurement (the build host's tunneled chip was down
+when this landed; see docs/benchmarks.md).
+
+Backward: a ``jax.custom_vjp`` whose bwd recomputes the cheap
+elementwise tail via the lax twin and lets XLA differentiate it — the
+forward saves only ``x``/``scale``/``offset`` (x is the conv output,
+already materialized), so the kernel's HBM saving is not paid back in
+residuals.
+
+Pooling identity used by the kernel (window 3, stride 2, pad 1, even H):
+``out[i] = max(y[2i-1], y[2i], y[2i+1])`` = ``max(odd[i-1], pair[i])``
+where ``pair[i] = max(y[2i], y[2i+1])`` and ``odd[i] = y[2i+1]`` — both
+obtained from a CONTIGUOUS [H/2, 2] reshape, so the kernel needs no
+strided slicing (Mosaic-friendly); same trick per axis.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+NEG = -jnp.inf
+
+
+def _pool_axis(y, axis):
+    """max(window 3, stride 2, pad 1) along ``axis`` (even length) via
+    the contiguous pair/odd identity above.  Shared by kernel and twin
+    so the arithmetic is identical."""
+    h = y.shape[axis]
+    new = y.shape[:axis] + (h // 2, 2) + y.shape[axis + 1:]
+    yr = y.reshape(new)
+    pair = yr.max(axis=axis + 1)                       # [.., h/2, ..]
+    odd = lax.index_in_dim(yr, 1, axis=axis + 1, keepdims=False)
+    shifted = jnp.concatenate(
+        [jnp.full(lax.slice_in_dim(odd, 0, 1, axis=axis).shape, NEG,
+                  y.dtype),
+         lax.slice_in_dim(odd, 0, h // 2 - 1, axis=axis)], axis=axis)
+    return jnp.maximum(shifted, pair)
+
+
+def _tail(x, scale, offset):
+    """The exact computation, in plain lax: relu(x*scale+offset) then
+    3x3/s2/pad1 maxpool over H and W.  x: [B, H, W, C]."""
+    y = jax.nn.relu(x * scale + offset)
+    y = _pool_axis(y, 1)
+    return _pool_axis(y, 2)
+
+
+def _kernel(x_ref, s_ref, b_ref, o_ref):
+    x = x_ref[0]                                       # [H, W, C]
+    y = jax.nn.relu(x * s_ref[...] + b_ref[...])
+    y = _pool_axis(y, 0)
+    y = _pool_axis(y, 1)
+    o_ref[0] = y
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def fused_bn_relu_maxpool(x, scale, offset):
+    """``maxpool3x3/s2/pad1(relu(x*scale + offset))`` in one fused pass.
+
+    x: ``[B, H, W, C]`` with even H, W; scale/offset: ``[C]`` (fold BN's
+    gamma/beta/mean/var into them).  Returns ``[B, H/2, W/2, C]``.
+    Kernel on TPU meshes, exact lax twin elsewhere (the flash-kernel
+    routing pattern, :func:`horovod_tpu.topology.exec_on_tpu`).
+    """
+    return _fwd(x, scale, offset)[0]
+
+
+def _use_kernel(x) -> bool:
+    import os
+    if os.environ.get("HOROVOD_FUSED_STEM_INTERPRET") == "1":
+        return True
+    from horovod_tpu.topology import exec_on_tpu
+    return exec_on_tpu(x)
+
+
+def _fwd(x, scale, offset):
+    b, h, w, c = x.shape
+    if h % 2 or w % 2:
+        raise ValueError(f"fused stem pool needs even H, W; got {(h, w)}")
+    # Residuals keep the PRE-cast scale/offset so backward cotangents
+    # come back in the caller's dtypes (f32 BN coefficients).
+    scale0, offset0 = scale, offset
+    scale = scale.astype(x.dtype)
+    offset = offset.astype(x.dtype)
+    if _use_kernel(x):
+        import os
+        interp = os.environ.get("HOROVOD_FUSED_STEM_INTERPRET") == "1"
+        out = pl.pallas_call(
+            _kernel,
+            grid=(b,),
+            in_specs=[
+                pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0)),
+                pl.BlockSpec((c,), lambda i: (0,)),
+                pl.BlockSpec((c,), lambda i: (0,)),
+            ],
+            out_specs=pl.BlockSpec((1, h // 2, w // 2, c),
+                                   lambda i: (i, 0, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((b, h // 2, w // 2, c),
+                                           x.dtype),
+            interpret=interp,
+        )(x, scale, offset)
+    else:
+        out = _tail(x, scale, offset)
+    return out, (x, scale0, offset0)
+
+
+def _bwd(res, g):
+    # Recompute the cheap elementwise+pool tail with the lax twin and
+    # differentiate THAT: x is the conv output (already materialized by
+    # the producer), so nothing extra is saved for backward.  The
+    # astype lives INSIDE the differentiated function so each cotangent
+    # arrives in its primal's dtype.
+    x, scale0, offset0 = res
+
+    def tail(x_, s_, b_):
+        return _tail(x_, s_.astype(x_.dtype), b_.astype(x_.dtype))
+
+    _, vjp = jax.vjp(tail, x, scale0, offset0)
+    return vjp(g)
+
+
+fused_bn_relu_maxpool.defvjp(_fwd, _bwd)

@@ -1,0 +1,136 @@
+"""Fused stem tail: exact equivalence against flax's maxpool(relu(bn))
+composition, twin and (interpreted) kernel routes, values and gradients.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.ops.fused_stem import _tail, fused_bn_relu_maxpool
+
+
+def _reference(x, scale, offset):
+    y = nn.relu(x * scale + offset)
+    return nn.max_pool(y, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+
+
+@pytest.mark.parametrize("shape", [(2, 8, 8, 4), (1, 12, 16, 8)])
+def test_twin_matches_flax(hvd, shape):
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    scale = jnp.asarray(rng.standard_normal(shape[-1]), jnp.float32)
+    offset = jnp.asarray(rng.standard_normal(shape[-1]), jnp.float32)
+    np.testing.assert_array_equal(np.asarray(_tail(x, scale, offset)),
+                                  np.asarray(_reference(x, scale, offset)))
+
+
+def test_fused_op_matches_flax(hvd):
+    """Public op on the twin route (CPU backend)."""
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((2, 16, 16, 8)), jnp.float32)
+    scale = jnp.asarray(rng.standard_normal(8), jnp.float32)
+    offset = jnp.asarray(rng.standard_normal(8), jnp.float32)
+    out = jax.jit(fused_bn_relu_maxpool)(x, scale, offset)
+    # jit may emit fma for x*scale+offset: equal to ~1 ulp, not bitwise.
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_reference(x, scale, offset)),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_kernel_interpret_matches_flax(hvd, monkeypatch):
+    """The Pallas kernel itself (interpret mode) against flax."""
+    monkeypatch.setenv("HOROVOD_FUSED_STEM_INTERPRET", "1")
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((2, 8, 8, 4)), jnp.float32)
+    scale = jnp.asarray(rng.standard_normal(4), jnp.float32)
+    offset = jnp.asarray(rng.standard_normal(4), jnp.float32)
+    out = fused_bn_relu_maxpool(x, scale, offset)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_reference(x, scale, offset)),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_gradients_match_flax(hvd):
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.standard_normal((2, 8, 8, 4)), jnp.float32)
+    scale = jnp.asarray(rng.standard_normal(4) + 1.0, jnp.float32)
+    offset = jnp.asarray(rng.standard_normal(4), jnp.float32)
+
+    def f_fused(x, s, b):
+        return (fused_bn_relu_maxpool(x, s, b) ** 2).sum()
+
+    def f_ref(x, s, b):
+        return (_reference(x, s, b) ** 2).sum()
+
+    gf = jax.grad(f_fused, argnums=(0, 1, 2))(x, scale, offset)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(x, scale, offset)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_odd_shapes_rejected(hvd):
+    with pytest.raises(ValueError, match="even"):
+        fused_bn_relu_maxpool(jnp.zeros((1, 7, 8, 4)), jnp.ones(4),
+                              jnp.zeros(4))
+
+
+def test_resnet_s2d_fused_matches_s2d(hvd):
+    """ResNet(stem="s2d_fused") == ResNet(stem="s2d") at bf16 tolerance:
+    same params/stats structure (checkpoints interchange), same forward
+    in train AND eval, same running-stat updates, same gradients."""
+    from horovod_tpu.models import resnet as rn
+
+    model_a = rn.ResNet(stage_sizes=[1, 1], block_cls=rn.BasicBlock,
+                        num_classes=5, num_filters=8, stem="s2d")
+    model_b = rn.ResNet(stage_sizes=[1, 1], block_cls=rn.BasicBlock,
+                        num_classes=5, num_filters=8, stem="s2d_fused")
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 16, 12),
+                          jnp.float32)
+    va = model_a.init(rng, x, train=False)
+    vb = model_b.init(rng, x, train=False)
+    # Identical pytree structure => checkpoints interchange.
+    assert (jax.tree_util.tree_structure(va) ==
+            jax.tree_util.tree_structure(vb))
+    # Same init values everywhere.
+    for la, lb in zip(jax.tree_util.tree_leaves(va),
+                      jax.tree_util.tree_leaves(vb)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+    out_a = model_a.apply(va, x, train=False)
+    out_b = model_b.apply(vb, x, train=False)
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b),
+                               rtol=2e-2, atol=2e-2)
+
+    # Train mode: outputs + updated batch stats agree.
+    out_a, mut_a = model_a.apply(va, x, train=True,
+                                 mutable=["batch_stats"])
+    out_b, mut_b = model_b.apply(vb, x, train=True,
+                                 mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b),
+                               rtol=2e-2, atol=2e-2)
+    sa = mut_a["batch_stats"]["norm_init"]
+    sb = mut_b["batch_stats"]["norm_init"]
+    np.testing.assert_allclose(np.asarray(sa["mean"]),
+                               np.asarray(sb["mean"]), rtol=1e-2,
+                               atol=1e-3)
+    np.testing.assert_allclose(np.asarray(sa["var"]),
+                               np.asarray(sb["var"]), rtol=1e-2,
+                               atol=1e-3)
+
+    # Gradients agree at bf16 tolerance.
+    def loss(params, model, variables):
+        out = model.apply({"params": params,
+                           "batch_stats": variables["batch_stats"]},
+                          x, train=True, mutable=["batch_stats"])[0]
+        return (out.astype(jnp.float32) ** 2).mean()
+
+    ga = jax.grad(loss)(va["params"], model_a, va)
+    gb = jax.grad(loss)(vb["params"], model_b, vb)
+    for la, lb in zip(jax.tree_util.tree_leaves(ga),
+                      jax.tree_util.tree_leaves(gb)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=5e-2, atol=5e-2)
