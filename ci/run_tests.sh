@@ -21,6 +21,12 @@ echo "--- slow lane (multi-minute end-to-end oracles; pyproject addopts
 --- deselects these by default, CI runs them explicitly)"
 python -m pytest tests/ -x -q -m slow
 
+echo "--- chaos lane (fault-injection harness; single host, subprocess
+--- ranks, each test bounded <=30s.  These also run in the fast lane —
+--- this explicit pass keeps the failure-path suite visible and green
+--- on its own)"
+JAX_PLATFORMS=cpu python -m pytest tests/ -x -q -m chaos
+
 echo "--- distributed op matrix under the launcher (the reference's
 --- 'pytest under horovodrun' trick, gen-pipeline.sh:120-190)"
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \

@@ -56,6 +56,42 @@ def _tree_broadcast(tree: Any, root_rank: int, name_prefix: str) -> Any:
     return jax.tree_util.tree_unflatten(treedef, out_leaves)
 
 
+def _valid_steps(ckpt_dir: str) -> list:
+    """Step numbers with a finalized checkpoint directory, ascending.
+
+    A rank 0 killed mid-save (exactly what elastic restarts recover
+    from) leaves orbax's temporary directory behind — the atomic-rename
+    commit never happened.  Those leftovers, and finalized step dirs
+    that lost their payload, are skipped with a warning: a restart must
+    resume from the newest INTACT checkpoint, not die on the debris of
+    the crash it is recovering from."""
+    try:
+        entries = os.listdir(ckpt_dir)
+    except OSError:
+        return []
+    steps = []
+    for entry in sorted(entries):
+        path = os.path.join(ckpt_dir, entry)
+        if not os.path.isdir(path):
+            continue
+        if not entry.isdigit():
+            if "tmp" in entry:
+                log.warning(
+                    "skipping half-written checkpoint %s (temporary "
+                    "directory left by an interrupted save)", path)
+            continue
+        try:
+            empty = not os.listdir(path)
+        except OSError:
+            empty = True
+        if empty:
+            log.warning("skipping corrupt checkpoint %s: directory is "
+                        "empty", path)
+            continue
+        steps.append(int(entry))
+    return sorted(steps)
+
+
 def save(ckpt_dir: str, state: Any, step: int = 0,
          max_to_keep: Optional[int] = None) -> Optional[str]:
     """Write ``state`` (a pytree) to ``ckpt_dir/<step>``; rank 0 only, all
@@ -90,14 +126,29 @@ def restore(ckpt_dir: str, state_template: Any,
     if basics.rank() == root_rank:
         import orbax.checkpoint as ocp
         ckpt_dir = os.path.abspath(ckpt_dir)
-        with ocp.CheckpointManager(ckpt_dir) as mgr:
-            use_step = step if step is not None else mgr.latest_step()
-            if use_step is not None:
-                state = mgr.restore(
-                    use_step, args=ocp.args.StandardRestore(state_template))
+        # Newest first; an explicitly pinned step is tried alone (falling
+        # back to a DIFFERENT step than the one asked for would be
+        # silently wrong).
+        candidates = ([step] if step is not None
+                      else list(reversed(_valid_steps(ckpt_dir))))
+        for use_step in candidates:
+            try:
+                with ocp.CheckpointManager(ckpt_dir) as mgr:
+                    state = mgr.restore(
+                        use_step,
+                        args=ocp.args.StandardRestore(state_template))
                 found[0] = 1
                 log.info("restored checkpoint step %s from %s",
                          use_step, ckpt_dir)
+                break
+            except Exception as e:  # noqa: BLE001 — skip-and-warn contract
+                state = state_template
+                log.warning(
+                    "skipping unrestorable checkpoint step %s in %s "
+                    "(%s: %s); %s", use_step, ckpt_dir,
+                    type(e).__name__, e,
+                    "trying the next older step" if step is None
+                    else "starting fresh")
     if basics.size() > 1:
         found = _c._eager_broadcast(found, root_rank,
                                     "hvd.checkpoint.restore.found")
@@ -108,10 +159,10 @@ def restore(ckpt_dir: str, state_template: Any,
 
 
 def latest_step(ckpt_dir: str) -> Optional[int]:
-    """Highest checkpoint step present in ``ckpt_dir`` (local read; no
-    collective)."""
-    import orbax.checkpoint as ocp
+    """Highest INTACT checkpoint step present in ``ckpt_dir`` (local
+    read; no collective).  Half-written or corrupt step directories are
+    skipped with a warning, never raised on — see :func:`_valid_steps`."""
     if not os.path.isdir(ckpt_dir):
         return None
-    with ocp.CheckpointManager(os.path.abspath(ckpt_dir)) as mgr:
-        return mgr.latest_step()
+    steps = _valid_steps(ckpt_dir)
+    return steps[-1] if steps else None

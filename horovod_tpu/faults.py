@@ -1,0 +1,296 @@
+"""Deterministic fault injection (the chaos harness).
+
+The paper's runtime is a *coordinator*: every rank's background thread
+negotiates readiness with every other before an op executes (reference
+``operations.cc:303-498``), which means every failure mode is a
+distributed hang or a torn job.  ``docs/fault_tolerance.md`` documents
+the recovery machinery; this module is how we *prove* it — faults are
+injected deterministically at named sites so each failure path has a
+regression test instead of a war story.
+
+Spec contract (``HOROVOD_FAULT_SPEC``)
+--------------------------------------
+A spec is ``;``-separated rules; a rule is ``,``-separated ``key=value``
+pairs::
+
+    HOROVOD_FAULT_SPEC="rank=1,site=allreduce,after=3,kind=crash"
+    HOROVOD_FAULT_SPEC="rank=*,site=rpc,kind=delay:0.5,count=2"
+    HOROVOD_FAULT_SPEC="rank=1,site=allreduce,kind=hang,attempt=0"
+
+Keys:
+
+``rank``     rank the fault applies to, or ``*`` for any context
+             (including the launcher, which has no rank).  Sites that
+             know a target rank (``spawn``) match against it; in-rank
+             sites match against ``HOROVOD_RANK``.
+``site``     injection-site name, or ``*``.  Shipped sites:
+             ``allreduce`` / ``allgather`` / ``broadcast`` /
+             ``alltoall`` / ``reducescatter`` / ``barrier`` (eager
+             collective entry, detail = tensor name),
+             ``native_submit`` / ``native_wait`` (the runtime enqueue /
+             completion wrappers), ``rpc`` (launcher/driver RPC dial,
+             detail = request kind), ``spawn`` (per-rank process
+             launch, fired in the launcher).
+``after``    number of matching passages to let through unharmed before
+             the first firing (default 0: fire on the first hit).
+``kind``     ``crash`` (SIGKILL self — the hard-failure simulation),
+             ``exit:N`` (``os._exit(N)``), ``hang`` (block forever),
+             ``delay:S`` (sleep S seconds, then continue),
+             ``error[:msg]`` (raise :class:`FaultInjected`).
+``count``    maximum number of firings (default: unlimited for
+             ``delay``/``error``; irrelevant for terminal kinds).
+``attempt``  only fire when ``HOROVOD_RESTART_ATTEMPT`` equals this
+             value — lets an elastic-restart test kill attempt 0 and
+             let attempt 1 run clean.
+
+Zero overhead when unset: every site funnels through :func:`inject`,
+which is a single global load + ``is None`` test when no spec is
+configured — no parsing, no locking, no matching.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+import time
+from typing import List, Optional
+
+ENV_VAR = "HOROVOD_FAULT_SPEC"
+
+_KINDS = ("crash", "exit", "hang", "delay", "error")
+
+SITES = (
+    "allreduce", "allgather", "broadcast", "alltoall", "reducescatter",
+    "barrier", "native_submit", "native_wait", "rpc", "spawn",
+)
+
+
+class FaultInjected(RuntimeError):
+    """Raised by a ``kind=error`` fault — a synthetic, attributable
+    failure for exercising error-propagation paths."""
+
+
+class FaultSpecError(ValueError):
+    """The HOROVOD_FAULT_SPEC grammar was violated.  Always raised at
+    parse time (first injection point or :func:`load`), never mid-job —
+    a chaos run with a typo'd spec must fail loudly, not run clean."""
+
+
+class FaultRule:
+    """One parsed rule plus its firing state (hit counting is per-rule
+    and thread-safe: eager ops fire from worker threads)."""
+
+    __slots__ = ("rank", "site", "after", "kind", "arg", "count",
+                 "attempt", "_hits", "_fired", "_lock")
+
+    def __init__(self, rank, site, after, kind, arg, count, attempt):
+        self.rank = rank          # int or None (= '*': any context)
+        self.site = site          # str or None (= '*')
+        self.after = after
+        self.kind = kind
+        self.arg = arg            # float (delay) / int (exit) / str (error)
+        self.count = count        # int or None (= unlimited)
+        self.attempt = attempt    # int or None (= any attempt)
+        self._hits = 0
+        self._fired = 0
+        self._lock = threading.Lock()
+
+    def __repr__(self):
+        rk = "*" if self.rank is None else self.rank
+        st = "*" if self.site is None else self.site
+        kd = self.kind if self.arg is None else f"{self.kind}:{self.arg}"
+        return (f"FaultRule(rank={rk}, site={st}, after={self.after}, "
+                f"kind={kd})")
+
+    # -- matching + arming -------------------------------------------------
+
+    def _matches(self, site: str, rank: Optional[int]) -> bool:
+        if self.site is not None and self.site != site:
+            return False
+        if self.rank is not None and self.rank != rank:
+            return False
+        if self.attempt is not None:
+            cur = int(os.environ.get("HOROVOD_RESTART_ATTEMPT", "0") or 0)
+            if self.attempt != cur:
+                return False
+        return True
+
+    def arm(self, site: str, rank: Optional[int]) -> bool:
+        """Count a passage through a matching site; True when the fault
+        should fire on this passage."""
+        if not self._matches(site, rank):
+            return False
+        with self._lock:
+            self._hits += 1
+            if self._hits <= self.after:
+                return False
+            if self.count is not None and self._fired >= self.count:
+                return False
+            self._fired += 1
+            return True
+
+    # -- execution ---------------------------------------------------------
+
+    def execute(self, site: str, detail: Optional[str],
+                rank: Optional[int]) -> None:
+        where = f"site={site}" + (f" ({detail})" if detail else "")
+        who = "launcher" if rank is None or rank < 0 else f"rank {rank}"
+        sys.stderr.write(
+            f"horovod_tpu.faults: firing kind={self.kind} at {where} "
+            f"[{who}, hit {self._hits}]\n")
+        sys.stderr.flush()
+        if self.kind == "crash":
+            os.kill(os.getpid(), signal.SIGKILL)
+            # SIGKILL is not instantaneous from the kernel's view; don't
+            # fall through and keep running the op meanwhile.
+            while True:  # pragma: no cover
+                time.sleep(1.0)
+        if self.kind == "exit":
+            os._exit(int(self.arg))
+        if self.kind == "hang":
+            while True:
+                time.sleep(3600.0)
+        if self.kind == "delay":
+            time.sleep(float(self.arg))
+            return
+        if self.kind == "error":
+            msg = self.arg or f"injected fault at {where}"
+            raise FaultInjected(msg)
+        raise AssertionError(f"unreachable kind {self.kind}")  # pragma: no cover
+
+
+def parse_spec(spec: str) -> List[FaultRule]:
+    """Parse a full HOROVOD_FAULT_SPEC string into rules; raises
+    :class:`FaultSpecError` on any grammar violation."""
+    rules: List[FaultRule] = []
+    for chunk in spec.split(";"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        rank = None
+        site = None
+        after = 0
+        kind = None
+        arg = None
+        count = None
+        attempt = None
+        for pair in chunk.split(","):
+            pair = pair.strip()
+            if not pair:
+                continue
+            if "=" not in pair:
+                raise FaultSpecError(
+                    f"fault spec entry {pair!r} is not key=value "
+                    f"(in rule {chunk!r})")
+            key, _, value = pair.partition("=")
+            key, value = key.strip(), value.strip()
+            try:
+                if key == "rank":
+                    rank = None if value == "*" else int(value)
+                elif key == "site":
+                    site = None if value == "*" else value
+                elif key == "after":
+                    after = int(value)
+                elif key == "count":
+                    count = int(value)
+                elif key == "attempt":
+                    attempt = int(value)
+                elif key == "kind":
+                    kind, _, kind_arg = value.partition(":")
+                    if kind not in _KINDS:
+                        raise FaultSpecError(
+                            f"unknown fault kind {kind!r}; valid kinds: "
+                            f"{', '.join(_KINDS)}")
+                    if kind == "delay":
+                        arg = float(kind_arg)
+                    elif kind == "exit":
+                        arg = int(kind_arg)
+                    elif kind == "error":
+                        arg = kind_arg or None
+                    elif kind_arg:
+                        raise FaultSpecError(
+                            f"kind {kind!r} takes no argument "
+                            f"(got {value!r})")
+                else:
+                    raise FaultSpecError(
+                        f"unknown fault spec key {key!r} (in rule "
+                        f"{chunk!r}); valid keys: rank, site, after, "
+                        f"kind, count, attempt")
+            except (TypeError, ValueError) as e:
+                if isinstance(e, FaultSpecError):
+                    raise
+                raise FaultSpecError(
+                    f"bad value for {key!r} in fault rule {chunk!r}: {e}")
+        if kind is None:
+            raise FaultSpecError(
+                f"fault rule {chunk!r} has no kind= (one of "
+                f"{', '.join(_KINDS)})")
+        if site is not None and site not in SITES:
+            raise FaultSpecError(
+                f"unknown fault site {site!r}; shipped sites: "
+                f"{', '.join(SITES)} (or '*')")
+        rules.append(FaultRule(rank, site, after, kind, arg, count, attempt))
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# Process-wide plan.  _UNSET means "env not read yet"; None means "read,
+# no faults configured" — the hot-path check in inject() is then a single
+# identity test.
+# ---------------------------------------------------------------------------
+
+_UNSET = object()
+_plan = _UNSET
+_load_lock = threading.Lock()
+
+
+def load() -> Optional[List[FaultRule]]:
+    """Read HOROVOD_FAULT_SPEC (idempotent; first injection point calls
+    this implicitly).  Returns the active rules or None."""
+    global _plan
+    with _load_lock:
+        if _plan is _UNSET:
+            spec = os.environ.get(ENV_VAR, "")
+            _plan = parse_spec(spec) or None if spec.strip() else None
+        return _plan
+
+
+def reset() -> None:
+    """Forget the cached plan so the next injection re-reads the env
+    (tests; a long-lived driver re-arming between jobs)."""
+    global _plan
+    with _load_lock:
+        _plan = _UNSET
+
+
+def active() -> bool:
+    return load() is not None
+
+
+def _context_rank(rank: Optional[int]) -> Optional[int]:
+    if rank is not None:
+        return rank
+    v = os.environ.get("HOROVOD_RANK")
+    return int(v) if v not in (None, "") else None
+
+
+def inject(site: str, detail: Optional[str] = None,
+           rank: Optional[int] = None) -> None:
+    """The injection point every site funnels through.
+
+    ``detail`` names the operand (tensor name, request kind, hostname)
+    for the firing log; ``rank`` overrides the context rank (used by
+    launcher-side sites that act on behalf of a target rank).  No-op —
+    one global load and an identity test — when no spec is set.
+    """
+    plan = _plan
+    if plan is _UNSET:
+        plan = load()
+    if plan is None:
+        return
+    ctx_rank = _context_rank(rank)
+    for rule in plan:
+        if rule.arm(site, ctx_rank):
+            rule.execute(site, detail, ctx_rank)

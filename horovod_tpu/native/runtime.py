@@ -12,13 +12,29 @@ from __future__ import annotations
 
 import ctypes
 import os
+import threading
+import time
 from typing import Optional
 
 import numpy as np
 
+from horovod_tpu import faults
 from horovod_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
+
+
+class EagerStallError(RuntimeError):
+    """An eager op outlived HOROVOD_EAGER_OP_TIMEOUT — the Python-boundary
+    mirror of the native stall watchdog (reference ``stall_inspector.cc``):
+    the message names the stuck tensor and the suspected missing ranks."""
+
+
+def _env_float(name: str, default: Optional[float]) -> Optional[float]:
+    v = os.environ.get(name)
+    if v in (None, ""):
+        return default
+    return float(v)
 
 _LIB_NAME = "libhorovod_tpu.so"
 
@@ -83,11 +99,23 @@ class Runtime:
         self.local_rank = local_rank
         self.local_size = local_size
         self._lib = None
-        # handle -> input buffer: the native thread reads the enqueued
-        # pointer asynchronously, so the array must stay referenced from
-        # enqueue until the wait completes.
+        # handle -> (input buffer, tensor name): the native thread reads
+        # the enqueued pointer asynchronously, so the array must stay
+        # referenced from enqueue until the wait completes; the name feeds
+        # the Python-side stall report.
         self._inflight: dict = {}
-        self._inflight_lock = __import__("threading").Lock()
+        self._stalled: list = []   # quarantined entries of timed-out ops
+        self._inflight_lock = threading.Lock()
+        # Eager-plane deadline (docs/fault_tolerance.md): unset -> waits
+        # stay unbounded-blocking (zero overhead) but a background
+        # watchdog logs a stall report for any op older than
+        # HOROVOD_EAGER_OP_WARN_SECONDS (default 60; 0 disables the
+        # watchdog); set -> the wait itself polls and RAISES
+        # EagerStallError after that many seconds.
+        self._op_timeout = _env_float("HOROVOD_EAGER_OP_TIMEOUT", None)
+        self._op_warn = _env_float("HOROVOD_EAGER_OP_WARN_SECONDS", 60.0)
+        self._watchdog_stop: Optional[threading.Event] = None
+        self._watchdog_thread: Optional[threading.Thread] = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -140,8 +168,19 @@ class Runtime:
                 f"native runtime init failed (rank {self.rank}): "
                 f"{lib.hvd_last_error().decode()}")
         self._lib = lib
+        if self._op_warn:
+            self._watchdog_stop = threading.Event()
+            self._watchdog_thread = threading.Thread(
+                target=self._watchdog, name="hvd-eager-watchdog",
+                daemon=True)
+            self._watchdog_thread.start()
 
     def stop(self) -> None:
+        if self._watchdog_stop is not None:
+            self._watchdog_stop.set()
+            self._watchdog_thread.join(timeout=5.0)
+            self._watchdog_stop = None
+            self._watchdog_thread = None
         if self._lib is not None:
             self._lib.hvd_shutdown()
             self._lib = None
@@ -160,6 +199,7 @@ class Runtime:
 
     def _submit(self, op: int, name: str, arr: np.ndarray, arg: int = 0,
                 splits=None, set_id: int = 0) -> int:
+        faults.inject("native_submit", name, rank=self.rank)
         arr = np.ascontiguousarray(arr)
         code = _DTYPE_CODES.get(arr.dtype)
         if code is None:
@@ -177,8 +217,77 @@ class Runtime:
         if h < 0:
             raise RuntimeError(self._lib.hvd_last_error().decode())
         with self._inflight_lock:
-            self._inflight[h] = arr
+            # [buffer, name, submit time, last warn time]
+            self._inflight[h] = [arr, name, time.monotonic(), 0.0]
         return h
+
+    def _op_name(self, h: int) -> str:
+        with self._inflight_lock:
+            entry = self._inflight.get(h)
+        return entry[1] if entry else f"<handle {h}>"
+
+    def _stall_report(self, name: str, elapsed: float) -> str:
+        """The Python-boundary mirror of the native stall inspector
+        (reference ``stall_inspector.cc:29-82``): this rank submitted the
+        op and its completion never arrived, so the suspects are exactly
+        the peers whose readiness the coordinator is still missing."""
+        suspects = [r for r in range(self.size) if r != self.rank]
+        return (
+            f"Stalled eager op '{name}': submitted by rank {self.rank} "
+            f"but not completed after {elapsed:.1f}s. One or more ranks "
+            f"likely never reached this collective — suspected missing "
+            f"ranks: {suspects} (every peer of rank {self.rank}; the "
+            f"coordinator's stall watchdog, HOROVOD_STALL_CHECK_TIME_"
+            f"SECONDS, reports the authoritative list on rank 0). "
+            f"Possible causes: a crashed or hung peer, a deadlocked "
+            f"submission order, or a network partition.")
+
+    def _watchdog(self) -> None:
+        """Background stall reporter for the default (no hard timeout)
+        configuration: any op inflight past HOROVOD_EAGER_OP_WARN_SECONDS
+        gets a warning naming it, repeated each interval — without adding
+        a single instruction to the op completion path."""
+        warn = self._op_warn
+        interval = min(warn, 5.0)
+        while not self._watchdog_stop.wait(interval):
+            now = time.monotonic()
+            reports = []
+            with self._inflight_lock:
+                for entry in self._inflight.values():
+                    _, name, t0, last = entry
+                    if now - t0 >= warn and now - last >= warn:
+                        entry[3] = now
+                        reports.append((name, now - t0))
+            for name, elapsed in reports:
+                log.warning("%s", self._stall_report(name, elapsed))
+
+    def _wait_bounded(self, h: int) -> int:
+        """hvd_wait with the eager-plane deadline.
+
+        Default (no HOROVOD_EAGER_OP_TIMEOUT): the plain blocking
+        hvd_wait, which releases the GIL — stall visibility comes from
+        the watchdog thread at zero completion-path cost.  With a hard
+        timeout: a poll loop with escalating sleep (brief spin for the
+        common sub-millisecond completion, then 1ms doubling to a 50ms
+        cap) that raises EagerStallError at the deadline."""
+        timeout = self._op_timeout
+        if timeout is None:
+            return self._lib.hvd_wait(h)
+        poll = self._lib.hvd_poll
+        for _ in range(200):          # spin: catches already-done ops
+            if poll(h):
+                return self._lib.hvd_wait(h)
+        start = time.monotonic()
+        deadline = start + timeout
+        sleep = 0.001
+        while not poll(h):
+            now = time.monotonic()
+            if now >= deadline:
+                name = self._op_name(h)
+                raise EagerStallError(self._stall_report(name, now - start))
+            time.sleep(min(sleep, max(deadline - now, 0.001)))
+            sleep = min(sleep * 2.0, 0.05)
+        return self._lib.hvd_wait(h)
 
     def _wait_read(self, h: int, dtype, trailing_shape,
                    read_splits: bool = False):
@@ -187,7 +296,22 @@ class Runtime:
         With ``read_splits`` returns ``(output, received_splits)`` —
         splits must be read BEFORE hvd_read_output, which releases the
         native table entry (c_api.h contract)."""
-        rc = self._lib.hvd_wait(h)
+        faults.inject("native_wait", self._op_name(h), rank=self.rank)
+        try:
+            rc = self._wait_bounded(h)
+        except EagerStallError:
+            # The op is STILL IN FLIGHT natively — the background thread
+            # may yet read the enqueued input pointer, so the buffer must
+            # outlive this error: quarantine the entry instead of freeing
+            # it (a bounded leak, paid only on a stall that is about to
+            # tear the job down).  The handle is deliberately NOT
+            # released: releasing a pending entry would race the native
+            # completion path.
+            with self._inflight_lock:
+                entry = self._inflight.pop(h, None)
+                if entry is not None:
+                    self._stalled.append(entry)
+            raise
         with self._inflight_lock:
             self._inflight.pop(h, None)
         if rc != 0:

@@ -40,7 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from horovod_tpu import basics
+from horovod_tpu import basics, faults
 from horovod_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -333,6 +333,7 @@ def _check_adasum_dtype(arr) -> None:
 
 def _eager_allreduce(x, op: ReduceOp, name: str, prescale_factor,
                      postscale_factor, set_id=0, set_size=None):
+    faults.inject("allreduce", name)
     rt = basics.runtime()
     arr = np.asarray(x)
     if op is Adasum:
@@ -358,6 +359,7 @@ def _eager_allreduce(x, op: ReduceOp, name: str, prescale_factor,
 
 def _eager_allreduce_submit(x, op: ReduceOp, name: str, prescale_factor,
                             set_id=0):
+    faults.inject("allreduce", name)
     rt = basics.runtime()
     arr = np.asarray(x)
     if op is Adasum:
@@ -382,6 +384,7 @@ def _eager_allreduce_finish(tok, op: ReduceOp, postscale_factor,
 
 
 def _eager_allgather_submit(x, name: str, set_id=0):
+    faults.inject("allgather", name)
     rt = basics.runtime()
     arr = np.asarray(x)
     if rt is None:
@@ -396,6 +399,7 @@ def _eager_allgather_finish(tok):
 
 
 def _eager_broadcast_submit(x, root_rank: int, name: str, set_id=0):
+    faults.inject("broadcast", name)
     rt = basics.runtime()
     arr = np.asarray(x)
     if rt is None:
@@ -413,6 +417,7 @@ def _eager_broadcast_finish(tok):
 
 
 def _eager_alltoall_submit(x, splits, name: str, set_id=0):
+    faults.inject("alltoall", name)
     rt = basics.runtime()
     if rt is None:
         return (None, _eager_alltoall(x, splits, name, set_id=set_id))
@@ -439,6 +444,7 @@ def _check_reducescatter_op(op: ReduceOp) -> None:
 
 
 def _eager_reducescatter_submit(x, op: ReduceOp, name: str, set_id=0):
+    faults.inject("reducescatter", name)
     _check_reducescatter_op(op)
     rt = basics.runtime()
     arr = np.asarray(x)
@@ -458,6 +464,7 @@ def _eager_reducescatter_finish(tok, op: ReduceOp, set_size=None):
 
 
 def _eager_allgather(x, name: str, set_id=0):
+    faults.inject("allgather", name)
     rt = basics.runtime()
     arr = np.asarray(x)
     if rt is None:
@@ -466,6 +473,7 @@ def _eager_allgather(x, name: str, set_id=0):
 
 
 def _eager_broadcast(x, root_rank: int, name: str, set_id=0):
+    faults.inject("broadcast", name)
     rt = basics.runtime()
     arr = np.asarray(x)
     if rt is None:
@@ -479,6 +487,7 @@ def _eager_broadcast(x, root_rank: int, name: str, set_id=0):
 def _eager_alltoall(x, splits, name: str, set_id=0):
     """Returns ``(output, received_splits)``; received_splits[r] = dim-0
     rows that came from rank r (later-Horovod alltoall contract)."""
+    faults.inject("alltoall", name)
     rt = basics.runtime()
     arr = np.asarray(x)
     if rt is None:
@@ -497,6 +506,7 @@ def _eager_alltoall(x, splits, name: str, set_id=0):
 
 def _eager_reducescatter(x, op: ReduceOp, name: str, set_id=0,
                          set_size=None):
+    faults.inject("reducescatter", name)
     _check_reducescatter_op(op)
     rt = basics.runtime()
     arr = np.asarray(x)
@@ -927,10 +937,12 @@ def barrier(name=None, process_set=None) -> None:
     the negotiation round itself is the barrier on the eager plane)."""
     basics._check_initialized()
     rt = basics.runtime()
+    nm = _auto_name("barrier", name)
+    faults.inject("barrier", nm)
     if rt is None:
         return
     set_id, _ = _set_args(process_set)
-    rt.barrier(_auto_name("barrier", name), set_id=set_id)
+    rt.barrier(nm, set_id=set_id)
 
 
 def join() -> int:

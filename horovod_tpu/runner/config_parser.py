@@ -38,6 +38,8 @@ _CONFIG_ARGS = {
     "autotune": "autotune",
     "autotune-log-file": "autotune_log_file",
     "verbose": "verbose",
+    "min-np": "min_np",
+    "blacklist-cooldown": "blacklist_cooldown",
     "log-level": "log_level",
     "log-hide-timestamp": "log_hide_timestamp",
     "network-interface": "network_interface",

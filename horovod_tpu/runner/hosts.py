@@ -7,8 +7,9 @@ assignment from ``host:slots`` pairs).
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
-from typing import List
+from typing import Callable, Dict, List, Optional, Tuple
 
 
 @dataclass
@@ -90,3 +91,58 @@ def allocate(hosts: List[HostSlots], np_: int) -> List[RankInfo]:
     for info in infos:
         info.cross_size = cross_size
     return infos
+
+
+class HostBlacklist:
+    """Launcher-side record of hosts demoted after rank failures.
+
+    Reference equivalent: ``run/elastic/discovery.py:30-77``
+    (``HostState.blacklist`` + ``HostManager`` pruning blacklisted hosts
+    from the working set).  Here the launcher owns the list: a host whose
+    rank crashed or that stopped answering probes is demoted, and the
+    next elastic restart attempt allocates around it.
+
+    ``cooldown`` is seconds until a demoted host becomes eligible again
+    (None = demoted for the life of the job); ``clock`` is a
+    monotonic-seconds callable, injectable so tests step time instead of
+    sleeping.
+    """
+
+    def __init__(self, cooldown: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self._cooldown = cooldown
+        self._clock = clock
+        self._entries: Dict[str, Tuple[float, str]] = {}
+
+    def demote(self, hostname: str, reason: str = "") -> None:
+        self._entries[hostname] = (self._clock(), reason)
+
+    def forgive(self, hostname: str) -> None:
+        self._entries.pop(hostname, None)
+
+    def is_blacklisted(self, hostname: str) -> bool:
+        entry = self._entries.get(hostname)
+        if entry is None:
+            return False
+        if (self._cooldown is not None and
+                self._clock() - entry[0] > self._cooldown):
+            # Cooldown elapsed: the host gets another chance.  If it is
+            # still broken the next failure re-demotes it.
+            del self._entries[hostname]
+            return False
+        return True
+
+    def filter(self, host_list: List[HostSlots]) -> List[HostSlots]:
+        """The usable subset of ``host_list``, preserving order."""
+        return [h for h in host_list if not self.is_blacklisted(h.hostname)]
+
+    def summary(self) -> str:
+        """Human-readable account of every active demotion, for the
+        fail-fast report when capacity drops below the floor."""
+        parts = []
+        for host in sorted(self._entries):
+            if not self.is_blacklisted(host):   # may expire an entry
+                continue
+            reason = self._entries[host][1]
+            parts.append(f"{host} ({reason})" if reason else host)
+        return ", ".join(parts) or "<none>"

@@ -19,7 +19,24 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from horovod_tpu import faults
 from horovod_tpu.runner.hosts import RankInfo
+
+# Seconds between SIGTERM fan-out and the SIGKILL hammer.  Tunable: ranks
+# flushing checkpoints or closing remote filesystems may need more than
+# the default 10 s; chaos tests want far less.
+DEFAULT_TERMINATE_GRACE_SECONDS = 10.0
+
+
+def _terminate_grace_seconds() -> float:
+    v = os.environ.get("HOROVOD_TERMINATE_GRACE_SECONDS", "")
+    try:
+        return float(v) if v else DEFAULT_TERMINATE_GRACE_SECONDS
+    except ValueError:
+        sys.stderr.write(
+            f"hvdrun: ignoring non-numeric HOROVOD_TERMINATE_GRACE_"
+            f"SECONDS={v!r}; using {DEFAULT_TERMINATE_GRACE_SECONDS}\n")
+        return DEFAULT_TERMINATE_GRACE_SECONDS
 
 
 def find_free_port() -> int:
@@ -45,8 +62,10 @@ class RankProcess:
         self.prefix_output = prefix_output
         self.proc: Optional[subprocess.Popen] = None
         self._pump: Optional[threading.Thread] = None
+        self.terminated_by_launcher = False
 
     def start(self) -> None:
+        faults.inject("spawn", self.info.hostname, rank=self.info.rank)
         self._stdin_secret = None   # set only on the ssh path
         if is_local(self.info.hostname):
             cmd = self.command
@@ -115,6 +134,9 @@ class RankProcess:
             sys.stdout.flush()
 
     def terminate(self) -> None:
+        # Mark BEFORE signalling: a -SIGTERM exit after this point is
+        # collateral teardown, not a failure of this rank.
+        self.terminated_by_launcher = True
         if self.proc is None or self.proc.poll() is not None:
             return
         try:
@@ -135,9 +157,16 @@ def launch_job(rank_infos: List[RankInfo], command: List[str],
                env_per_rank: List[Dict[str, str]],
                output_dir: Optional[str] = None,
                prefix_output: bool = True,
-               start_timeout: Optional[float] = None) -> int:
+               start_timeout: Optional[float] = None,
+               report: Optional[dict] = None) -> int:
     """Run all ranks; on any non-zero exit terminate the rest (reference
-    gloo_run.py:256-262).  Returns the job exit code."""
+    gloo_run.py:256-262).  Returns the job exit code.
+
+    ``report``, when given, is filled in place for the elastic caller:
+    ``report["failed"]`` = list of ``(rank, hostname, exit_code)`` for
+    every rank that exited non-zero on its own (operator-stop SIGTERMs
+    excluded — those are not host failures), ``report["signalled"]`` =
+    True when the launcher's own SIGINT/SIGTERM handler fired."""
     procs = [RankProcess(info, command, env, output_dir, prefix_output)
              for info, env in zip(rank_infos, env_per_rank)]
 
@@ -185,25 +214,46 @@ def launch_job(rank_infos: List[RankInfo], command: List[str],
                     stop.set()
                 break
             time.sleep(0.05)
-        # Grace period, then hard kill.
+        # Grace period (HOROVOD_TERMINATE_GRACE_SECONDS), then hard kill,
+        # logging which ranks needed the hammer — a rank that regularly
+        # outlives its grace is hiding a shutdown bug.
+        grace = _terminate_grace_seconds()
         t0 = time.monotonic()
         while any(p.proc.poll() is None for p in procs):
-            if time.monotonic() - t0 > 10:
+            if time.monotonic() - t0 > grace:
+                laggards = sorted(p.info.rank for p in procs
+                                  if p.proc.poll() is None)
+                sys.stderr.write(
+                    f"hvdrun: rank(s) {laggards} still running "
+                    f"{grace:g}s after SIGTERM; sending SIGKILL\n")
                 for p in procs:
                     p.kill()
                 break
             time.sleep(0.05)
+        failed = []
         for p in procs:
             p.proc.wait()
             rc = p.proc.returncode
             if rc not in (0, None) and exit_code == 0:
                 exit_code = rc
+            if rc not in (0, None) and not p.terminated_by_launcher:
+                # Genuine rank failure: it failed BEFORE the launcher
+                # began tearing the job down.  Anything after terminate()
+                # is collateral — including positive exit codes, since a
+                # SIGTERMed rank racing its peer's death often dies of
+                # "peer closed connection" instead of the signal, and
+                # blaming ITS host would demote a healthy machine.
+                failed.append((p.info.rank, p.info.hostname, rc))
         if signalled.is_set():
             # Operator stop: ALWAYS 130, even though the SIGTERMed ranks
             # report -15 — callers (elastic restarts) distinguish "the
             # operator stopped the job" from "a rank crashed" by this
             # code, and success must never be reported either.
             exit_code = 130
+            failed = []   # nothing to blame a host for
+        if report is not None:
+            report["failed"] = failed
+            report["signalled"] = signalled.is_set()
         return exit_code
     finally:
         signal.signal(signal.SIGINT, old_int)

@@ -46,6 +46,35 @@ def _default_ssh_builder(host: str) -> List[str]:
             "-o", "ConnectTimeout=10", host, "true"]
 
 
+def probe_hosts(
+        hosts: List[str],
+        ssh_builder: Callable[[str], List[str]] = _default_ssh_builder,
+        timeout: float = 30.0) -> Dict[str, bool]:
+    """Parallel ssh probe of every host; never raises, never caches.
+
+    This is the re-check the elastic restart loop runs between attempts:
+    a host that just dropped a rank may be mid-reboot, and the hour-long
+    success cache of :func:`check_hosts_reachable` would answer
+    "reachable" from before the failure — exactly the stale answer the
+    re-probe exists to avoid."""
+    results: Dict[str, bool] = {}
+
+    def probe(host: str) -> None:
+        try:
+            rc = subprocess.run(ssh_builder(host), timeout=timeout,
+                                capture_output=True).returncode
+            results[host] = rc == 0
+        except (OSError, subprocess.TimeoutExpired):
+            results[host] = False
+
+    threads = [threading.Thread(target=probe, args=(h,)) for h in hosts]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results
+
+
 def check_hosts_reachable(
         hosts: List[str],
         ssh_builder: Callable[[str], List[str]] = _default_ssh_builder,
@@ -64,21 +93,8 @@ def check_hosts_reachable(
     if not to_probe:
         return
 
-    results: Dict[str, bool] = {}
-
-    def probe(host: str) -> None:
-        try:
-            rc = subprocess.run(ssh_builder(host), timeout=timeout,
-                                capture_output=True).returncode
-            results[host] = rc == 0
-        except (OSError, subprocess.TimeoutExpired):
-            results[host] = False
-
-    threads = [threading.Thread(target=probe, args=(h,)) for h in to_probe]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
+    results = probe_hosts(to_probe, ssh_builder=ssh_builder,
+                          timeout=timeout)
 
     dead = sorted(h for h, ok in results.items() if not ok)
     if dead:

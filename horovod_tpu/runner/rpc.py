@@ -16,11 +16,15 @@ from __future__ import annotations
 import hashlib
 import hmac
 import pickle
+import random
 import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Any, Callable, Optional
+
+from horovod_tpu import faults
 
 
 class AuthError(RuntimeError):
@@ -101,10 +105,44 @@ class RpcServer:
         self._server.server_close()
 
 
+def connect_with_retry(addr: str, port: int, timeout: float = 30.0,
+                       retries: int = 4, base_delay: float = 0.2,
+                       max_delay: float = 3.0,
+                       sleep: Callable[[float], None] = time.sleep,
+                       rng: Callable[[], float] = random.random
+                       ) -> socket.socket:
+    """``socket.create_connection`` with jittered exponential backoff.
+
+    Retries CONNECTION ESTABLISHMENT only — never a request that may
+    already have been delivered — so it composes with non-idempotent
+    RPCs.  Backoff is ``min(max_delay, base_delay * 2**attempt)`` scaled
+    by a uniform [0.5, 1.5) jitter, so a herd of ranks re-dialing a
+    restarting driver doesn't re-arrive in lockstep (the failure mode
+    the reference's fixed-interval retry loops invite).  ``sleep``/
+    ``rng`` are injection hooks for tests."""
+    last_err: Optional[OSError] = None
+    for attempt in range(retries + 1):
+        try:
+            return socket.create_connection((addr, port), timeout=timeout)
+        except OSError as e:
+            last_err = e
+            if attempt >= retries:
+                break
+            delay = min(max_delay, base_delay * (2.0 ** attempt))
+            sleep(delay * (0.5 + rng()))
+    raise ConnectionError(
+        f"could not connect to {addr}:{port} after {retries + 1} "
+        f"attempts: {last_err}")
+
+
 def rpc_call(addr: str, port: int, request: Any, key: bytes,
-             timeout: float = 30.0) -> Any:
-    """One authenticated request/response round trip."""
-    with socket.create_connection((addr, port), timeout=timeout) as sock:
+             timeout: float = 30.0, retries: int = 4) -> Any:
+    """One authenticated request/response round trip.  The dial retries
+    with jittered backoff (``retries=0`` restores single-shot)."""
+    faults.inject("rpc", str(request.get("kind"))
+                  if isinstance(request, dict) else None)
+    with connect_with_retry(addr, port, timeout=timeout,
+                            retries=retries) as sock:
         _send_msg(sock, pickle.dumps(request), key)
         return pickle.loads(_recv_msg(sock, key))
 
@@ -146,21 +184,32 @@ def local_addresses() -> list:
 class KeepaliveMonitor:
     """Driver-side liveness bookkeeping: tasks ping periodically; a task
     silent past ``timeout`` is reported dead (the failure-detection half
-    of the reference's task services)."""
+    of the reference's task services).
 
-    def __init__(self, timeout: float = 60.0):
-        import time
-        self._time = time
+    ``clock`` is a monotonic-seconds callable, injectable so tests step
+    time instead of sleeping.  Call :meth:`forget` when a task finishes
+    cleanly — a completed task stops pinging and must not be mistaken
+    for a dead one."""
+
+    def __init__(self, timeout: float = 60.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
         self._timeout = timeout
         self._last: dict = {}
         self._lock = threading.Lock()
 
     def ping(self, task_id) -> None:
         with self._lock:
-            self._last[task_id] = self._time.monotonic()
+            self._last[task_id] = self._clock()
+
+    def forget(self, task_id) -> None:
+        """Stop tracking a task (it reported its result or was removed
+        from the job); silence from it is no longer a failure."""
+        with self._lock:
+            self._last.pop(task_id, None)
 
     def dead_tasks(self) -> list:
-        now = self._time.monotonic()
+        now = self._clock()
         with self._lock:
             return [t for t, ts in self._last.items()
                     if now - ts > self._timeout]

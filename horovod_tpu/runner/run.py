@@ -16,6 +16,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import time
 from typing import List
 
 import horovod_tpu
@@ -53,8 +54,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "failure (full-restart elasticity: each attempt "
                         "gets a fresh rendezvous; pair with "
                         "hvd.checkpoint save/restore so training resumes "
-                        "from the latest step — docs/elastic.md).  Ranks "
-                        "see HOROVOD_RESTART_ATTEMPT=k.")
+                        "from the latest step — docs/fault_tolerance.md). "
+                        "Ranks see HOROVOD_RESTART_ATTEMPT=k.")
+    p.add_argument("--min-np", dest="min_np", type=int, default=None,
+                   help="Smallest world size an elastic restart may run "
+                        "with.  When hosts are blacklisted after "
+                        "failures, restart attempts re-allocate ranks "
+                        "onto the surviving hosts and accept any world "
+                        "size >= this floor (default: -np, i.e. never "
+                        "shrink).")
+    p.add_argument("--blacklist-cooldown", dest="blacklist_cooldown",
+                   type=float, default=None,
+                   help="Seconds until a blacklisted host becomes "
+                        "eligible for re-allocation again (default: "
+                        "demoted for the life of the job).")
     p.add_argument("--network-interface", dest="network_interface",
                    default=None,
                    help="Comma-separated NIC name(s), in preference "
@@ -168,8 +181,17 @@ def run_command(args) -> int:
         remote = sorted({i.hostname for i in infos
                          if not launch.is_local(i.hostname)})
         network.check_hosts_reachable(remote)
+    # The rendezvous itself lives in THIS launcher process, so its
+    # address never changes across restart attempts even when rank 0 is
+    # re-allocated to a different host.
     addr = "127.0.0.1" if all_local else infos[0].hostname
     restarts = max(0, getattr(args, "elastic_restarts", 0) or 0)
+    min_np = getattr(args, "min_np", None) or np_
+    if min_np > np_:
+        raise ValueError(f"--min-np {min_np} exceeds the requested "
+                         f"world size -np {np_}")
+    blacklist = hosts.HostBlacklist(
+        cooldown=getattr(args, "blacklist_cooldown", None))
     rc = 1
     for attempt in range(restarts + 1):
         if attempt > 0:
@@ -180,10 +202,42 @@ def run_command(args) -> int:
             print(f"hvdrun: job failed (rc={rc}); elastic restart "
                   f"{attempt}/{restarts} in {delay:.0f}s with a fresh "
                   f"rendezvous", file=sys.stderr, flush=True)
-            import time
             time.sleep(delay)
+            # Re-probe surviving remote hosts RIGHT BEFORE the attempt —
+            # the pre-launch check's hour-long cache would answer from
+            # before the failure.  A host that stopped answering is
+            # demoted unconditionally: spawning a rank there can only
+            # hang the rendezvous.
+            from horovod_tpu.runner import network
+            candidates = sorted({
+                h.hostname for h in host_list
+                if not launch.is_local(h.hostname) and
+                not blacklist.is_blacklisted(h.hostname)})
+            if candidates:
+                for host, ok in sorted(
+                        network.probe_hosts(candidates).items()):
+                    if not ok:
+                        blacklist.demote(host, "unreachable over ssh")
+                        print(f"hvdrun: host {host} is unreachable; "
+                              f"blacklisting", file=sys.stderr, flush=True)
+        usable = blacklist.filter(host_list)
+        capacity = sum(h.slots for h in usable)
+        cur_np = min(np_, capacity)
+        if cur_np < min_np:
+            print(f"hvdrun: cannot continue: surviving hosts provide "
+                  f"{capacity} slot(s) but the job needs at least "
+                  f"{min_np} (--min-np). Blacklisted: "
+                  f"{blacklist.summary()}", file=sys.stderr, flush=True)
+            return rc or 1
+        if cur_np < np_:
+            print(f"hvdrun: restarting with a smaller world: "
+                  f"{cur_np}/{np_} ranks on surviving hosts "
+                  f"(blacklisted: {blacklist.summary()})",
+                  file=sys.stderr, flush=True)
+        infos = hosts.allocate(usable, cur_np)
         extra_env["HOROVOD_RESTART_ATTEMPT"] = str(attempt)
-        rc = _launch_once(args, infos, addr, extra_env)
+        report: dict = {}
+        rc = _launch_once(args, infos, addr, extra_env, report=report)
         if rc == 0:
             return 0
         if rc in (130, 143):
@@ -195,10 +249,42 @@ def run_command(args) -> int:
             # SIGKILL, SIGSEGV): a crash, exactly what the restart
             # budget is for.
             return rc
+        if attempt < restarts:
+            # Demotion only matters if another attempt will allocate;
+            # on the final failure it would just add noise to the report.
+            _demote_failed_hosts(blacklist, host_list,
+                                 report.get("failed", ()), min_np)
     return rc
 
 
-def _launch_once(args, infos, addr, extra_env) -> int:
+def _demote_failed_hosts(blacklist, host_list, failed, min_np) -> None:
+    """Soft demotion after rank failures: blame the host of each crashed
+    rank, but only while the surviving capacity still covers --min-np.
+    (A single-host job therefore never blacklists its only host — the
+    crash is a process problem, and relaunching in place is strictly
+    better than refusing to.)  Unreachability, by contrast, is a HARD
+    demotion in the re-probe above: a dead host can serve no world size.
+    """
+    for rank, hostname, code in failed:
+        if blacklist.is_blacklisted(hostname):
+            continue
+        remaining = sum(
+            h.slots for h in host_list
+            if h.hostname != hostname and
+            not blacklist.is_blacklisted(h.hostname))
+        if remaining >= min_np:
+            blacklist.demote(hostname,
+                             f"rank {rank} exited with code {code}")
+            print(f"hvdrun: blacklisting host {hostname} (rank {rank} "
+                  f"exited with code {code})", file=sys.stderr, flush=True)
+        else:
+            print(f"hvdrun: keeping host {hostname} despite rank {rank} "
+                  f"exiting with code {code}: demoting it would leave "
+                  f"{remaining} slot(s) < --min-np {min_np}",
+                  file=sys.stderr, flush=True)
+
+
+def _launch_once(args, infos, addr, extra_env, report=None) -> int:
     port = args.rendezvous_port or launch.find_free_port()
     if getattr(args, "jax_distributed", False):
         # The jax.distributed coordinator runs INSIDE rank 0 (unlike the
@@ -223,7 +309,8 @@ def _launch_once(args, infos, addr, extra_env) -> int:
     return launch.launch_job(
         infos, args.command, env_per_rank,
         output_dir=args.output_filename,
-        start_timeout=args.start_timeout)
+        start_timeout=args.start_timeout,
+        report=report)
 
 
 def main(argv: List[str] = None) -> int:

@@ -25,7 +25,8 @@ class JobDriver:
     and gathers per-rank results."""
 
     def __init__(self, num_proc: int, key: bytes,
-                 base_env: Optional[Dict[str, str]] = None):
+                 base_env: Optional[Dict[str, str]] = None,
+                 keepalive_timeout: float = 60.0):
         self.num_proc = num_proc
         self.key = key
         self.base_env = dict(base_env or {})
@@ -34,7 +35,7 @@ class JobDriver:
         self._failures: Dict[int, str] = {}
         self._env_maps: Optional[Dict[int, Dict[str, str]]] = None
         self._cv = threading.Condition()
-        self._monitor = rpc.KeepaliveMonitor()
+        self._monitor = rpc.KeepaliveMonitor(timeout=keepalive_timeout)
         self._server = rpc.RpcServer(key, self._handle)
 
     # -- wire ----------------------------------------------------------------
@@ -71,6 +72,9 @@ class JobDriver:
                 self._failures[idx] = str(req["error"])
             else:
                 self._results[idx] = req.get("value")
+            # A finished task stops pinging; without this it would read
+            # as dead the moment the keepalive timeout elapses.
+            self._monitor.forget(idx)
             with self._cv:
                 self._cv.notify_all()
             return {"ok": True}
@@ -125,8 +129,11 @@ class JobDriver:
 
     def wait_for_results(self, timeout: float = 600.0) -> List[Any]:
         """Block until every task reported; returns results in RANK order.
-        Raises on task failure or timeout (reference gloo_run kills the
-        job when any rank fails, gloo_run.py:256-262)."""
+        Raises on task failure, keepalive loss, or timeout (reference
+        gloo_run kills the job when any rank fails, gloo_run.py:256-262;
+        the keepalive check is the failure-detection half of the
+        reference's task services — without it a task whose executor
+        died takes the full ``timeout`` to surface)."""
         deadline = time.monotonic() + timeout
         with self._cv:
             while True:
@@ -136,6 +143,12 @@ class JobDriver:
                         f"task {idx} failed: {err}")
                 if len(self._results) == self.num_proc:
                     break
+                dead = sorted(self._monitor.dead_tasks())
+                if dead:
+                    raise RuntimeError(
+                        f"task(s) {dead} stopped sending keepalives "
+                        f"(executor lost?); failing the job instead of "
+                        f"waiting out the full {timeout}s timeout")
                 left = deadline - time.monotonic()
                 if left <= 0:
                     missing = sorted(set(range(self.num_proc)) -
@@ -158,11 +171,14 @@ class JobDriver:
 
 def run_task(index: int, driver_addr: str, driver_port: int, key: bytes,
              fn, args=(), kwargs=None, poll_interval: float = 0.3,
-             start_timeout: float = 600.0):
+             start_timeout: float = 600.0, ping_interval: float = 15.0):
     """Task-side protocol: register → await env → run ``fn`` → report.
 
     Runs inside a Spark executor (or a test thread).  Returns fn's result
-    so map-style callers can also collect through their own channel."""
+    so map-style callers can also collect through their own channel.
+    While ``fn`` runs, a background thread pings the driver every
+    ``ping_interval`` seconds so the driver's keepalive monitor can tell
+    a long-running task from a dead executor."""
     import os
     import socket
 
@@ -184,14 +200,35 @@ def run_task(index: int, driver_addr: str, driver_port: int, key: bytes,
         if time.monotonic() > deadline:
             raise TimeoutError("timed out waiting for rank assignment")
         time.sleep(poll_interval)
+    ping_stop = threading.Event()
+
+    def _ping_loop():
+        while not ping_stop.wait(ping_interval):
+            try:
+                rpc.rpc_call(driver_addr, driver_port,
+                             {"kind": "ping", "index": index}, key,
+                             retries=0)
+            except (OSError, rpc.AuthError):
+                # The driver decides liveness; a task never dies because
+                # one ping missed (the driver may be restarting).
+                pass
+
+    # Start the pinger BEFORE touching os.environ: in the threaded test
+    # simulation every task shares the process env, and thread startup
+    # latency between update and fn() would widen that documented race.
+    pinger = threading.Thread(target=_ping_loop, daemon=True,
+                              name=f"hvd-task-{index}-keepalive")
+    pinger.start()
     os.environ.update(env)
     try:
         value = fn(*args, **kwargs)
     except BaseException as e:  # noqa: BLE001 — reported, then re-raised
+        ping_stop.set()
         rpc.rpc_call(driver_addr, driver_port,
                      {"kind": "result", "index": index,
                       "error": f"{type(e).__name__}: {e}"}, key)
         raise
+    ping_stop.set()
     rpc.rpc_call(driver_addr, driver_port,
                  {"kind": "result", "index": index, "value": value}, key)
     return value

@@ -1,0 +1,105 @@
+"""Corrupt/half-written checkpoint tolerance (docs/fault_tolerance.md):
+a rank 0 killed mid-save — exactly what elastic restarts recover from —
+leaves orbax tmp-dir debris behind; latest_step/restore must skip it
+with a warning and fall back to the newest intact step, never raise."""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from horovod_tpu import basics, checkpoint
+
+
+@pytest.fixture(autouse=True)
+def _single_rank(monkeypatch):
+    """Run the rank-0 code path without a job: world of one, no eager
+    runtime (restore's broadcast is skipped at size 1)."""
+    monkeypatch.setattr(basics, "rank", lambda: 0)
+    monkeypatch.setattr(basics, "size", lambda: 1)
+    monkeypatch.setattr(basics, "runtime", lambda: None)
+
+
+@pytest.fixture
+def hvd_log(caplog, monkeypatch):
+    """The horovod_tpu logger does not propagate (it has its own stderr
+    handler); re-enable propagation so caplog sees the warnings."""
+    import logging
+    monkeypatch.setattr(logging.getLogger("horovod_tpu"),
+                        "propagate", True)
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+        yield caplog
+
+
+def _state(w, step):
+    return {"w": np.full(4, float(w), np.float32),
+            "step": np.asarray(step, np.int64)}
+
+
+def _seed_ckpts(ckpt):
+    checkpoint.save(str(ckpt), _state(1.0, 1), 1)
+    checkpoint.save(str(ckpt), _state(2.0, 2), 2)
+
+
+def test_latest_step_skips_tmp_and_empty_dirs(tmp_path, hvd_log):
+    ckpt = tmp_path / "ckpt"
+    _seed_ckpts(ckpt)
+    # Debris of a save killed mid-write: orbax's pre-commit tmp dir plus
+    # a finalized-looking step dir that lost its payload.
+    (ckpt / "3.orbax-checkpoint-tmp-1234").mkdir()
+    (ckpt / "4").mkdir()
+    assert checkpoint.latest_step(str(ckpt)) == 2
+    assert "half-written checkpoint" in hvd_log.text
+    assert "directory is empty" in hvd_log.text
+
+
+def test_latest_step_missing_dir():
+    assert checkpoint.latest_step("/nonexistent/ckpts") is None
+
+
+def test_restore_falls_back_to_newest_intact_step(tmp_path, hvd_log):
+    ckpt = tmp_path / "ckpt"
+    _seed_ckpts(ckpt)
+    # Corrupt step 2's payload but keep the dir non-empty, so only the
+    # actual orbax read (not the directory scan) can reject it.
+    for entry in os.listdir(ckpt / "2"):
+        p = ckpt / "2" / entry
+        shutil.rmtree(p) if p.is_dir() else p.unlink()
+    (ckpt / "2" / "_CHECKPOINT_METADATA").write_text("garbage")
+    out = checkpoint.restore(str(ckpt), _state(0.0, 0))
+    np.testing.assert_allclose(out["w"], np.full(4, 1.0))
+    assert int(out["step"]) == 1
+    assert "skipping unrestorable checkpoint step 2" in hvd_log.text
+
+
+def test_restore_all_corrupt_returns_template(tmp_path, hvd_log):
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    (ckpt / "5.orbax-checkpoint-tmp-99").mkdir()
+    out = checkpoint.restore(str(ckpt), _state(7.0, 0))
+    np.testing.assert_allclose(out["w"], np.full(4, 7.0))   # fresh start
+    assert "half-written checkpoint" in hvd_log.text
+
+
+def test_restore_pinned_corrupt_step_does_not_fall_back(tmp_path, hvd_log):
+    """An explicitly requested step never silently falls back to a
+    DIFFERENT step — it warns and starts fresh."""
+    ckpt = tmp_path / "ckpt"
+    _seed_ckpts(ckpt)
+    for entry in os.listdir(ckpt / "2"):
+        p = ckpt / "2" / entry
+        shutil.rmtree(p) if p.is_dir() else p.unlink()
+    (ckpt / "2" / "junk").write_text("garbage")
+    out = checkpoint.restore(str(ckpt), _state(0.0, 0), step=2)
+    np.testing.assert_allclose(out["w"], np.full(4, 0.0))   # template
+    assert "skipping unrestorable checkpoint step 2" in hvd_log.text
+    assert "starting fresh" in hvd_log.text
+
+
+def test_restore_intact_roundtrip(tmp_path):
+    ckpt = tmp_path / "ckpt"
+    _seed_ckpts(ckpt)
+    out = checkpoint.restore(str(ckpt), _state(0.0, 0))
+    np.testing.assert_allclose(out["w"], np.full(4, 2.0))
+    assert int(out["step"]) == 2

@@ -47,7 +47,7 @@ def test_native_ops_under_launcher(tmp_path):
 
 @pytest.mark.slow
 def test_elastic_restart_resumes_from_checkpoint(tmp_path):
-    """Elastic-lite end-to-end (docs/elastic.md): rank 1 dies mid-train
+    """Elastic-lite end-to-end (docs/fault_tolerance.md): rank 1 dies mid-train
     on attempt 0; hvdrun --elastic-restarts relaunches with a fresh
     rendezvous; the job resumes from the latest checkpoint and finishes
     with the exact state an uninterrupted run produces."""

@@ -1,0 +1,149 @@
+"""Unit tests for the chaos harness (horovod_tpu/faults.py): spec
+grammar, arming semantics (after/count/rank/site/attempt), the inject()
+fast path, and the process-terminal kinds via subprocess."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from horovod_tpu import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan(monkeypatch):
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    monkeypatch.delenv("HOROVOD_RANK", raising=False)
+    monkeypatch.delenv("HOROVOD_RESTART_ATTEMPT", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# -- grammar -----------------------------------------------------------------
+
+def test_parse_spec_full_rule():
+    (r,) = faults.parse_spec(
+        "rank=1,site=allreduce,after=3,kind=crash,count=2,attempt=0")
+    assert (r.rank, r.site, r.after, r.kind, r.count, r.attempt) == \
+        (1, "allreduce", 3, "crash", 2, 0)
+
+
+def test_parse_spec_defaults_and_wildcards():
+    (r,) = faults.parse_spec("rank=*,site=*,kind=delay:2.5")
+    assert r.rank is None and r.site is None and r.after == 0
+    assert r.kind == "delay" and r.arg == 2.5 and r.count is None
+
+
+def test_parse_spec_multiple_rules_and_kind_args():
+    rules = faults.parse_spec(
+        "site=rpc,kind=exit:7 ; rank=0,site=spawn,kind=error:boom;")
+    assert len(rules) == 2
+    assert rules[0].kind == "exit" and rules[0].arg == 7
+    assert rules[1].kind == "error" and rules[1].arg == "boom"
+
+
+@pytest.mark.parametrize("spec,match", [
+    ("kind=nosuch", "unknown fault kind"),
+    ("site=allreduce", "no kind="),
+    ("site=bogus,kind=crash", "unknown fault site"),
+    ("color=red,kind=crash", "unknown fault spec key"),
+    ("rank=two,kind=crash", "bad value for 'rank'"),
+    ("kind=delay:abc", "bad value for 'kind'"),
+    ("kind=crash:1", "takes no argument"),
+    ("rank 1,kind=crash", "not key=value"),
+])
+def test_parse_spec_errors(spec, match):
+    with pytest.raises(faults.FaultSpecError, match=match):
+        faults.parse_spec(spec)
+
+
+# -- arming ------------------------------------------------------------------
+
+def test_arm_after_and_count():
+    (r,) = faults.parse_spec("site=rpc,after=2,kind=delay:0,count=2")
+    fires = [r.arm("rpc", None) for _ in range(6)]
+    # passages 1,2 pass; 3,4 fire; 5,6 exhausted
+    assert fires == [False, False, True, True, False, False]
+
+
+def test_arm_rank_and_site_filters():
+    (r,) = faults.parse_spec("rank=1,site=allgather,kind=error")
+    assert not r.arm("allreduce", 1)     # wrong site
+    assert not r.arm("allgather", 0)     # wrong rank
+    assert not r.arm("allgather", None)  # no rank context
+    assert r.arm("allgather", 1)
+
+
+def test_arm_attempt_gate(monkeypatch):
+    (r,) = faults.parse_spec("site=rpc,kind=error,attempt=1")
+    assert not r.arm("rpc", None)                    # attempt defaults to 0
+    monkeypatch.setenv("HOROVOD_RESTART_ATTEMPT", "1")
+    assert r.arm("rpc", None)
+
+
+# -- inject() ----------------------------------------------------------------
+
+def test_inject_noop_without_spec():
+    faults.inject("allreduce", "t")   # must simply return
+    assert not faults.active()
+
+
+def test_inject_error_kind(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "site=barrier,kind=error:synthetic")
+    faults.reset()
+    with pytest.raises(faults.FaultInjected, match="synthetic"):
+        faults.inject("barrier", "b0")
+    faults.inject("allreduce", "t")   # other sites unaffected
+
+
+def test_inject_delay_kind(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "site=rpc,kind=delay:0.2,count=1")
+    faults.reset()
+    t0 = time.monotonic()
+    faults.inject("rpc")
+    assert time.monotonic() - t0 >= 0.2
+    t0 = time.monotonic()
+    faults.inject("rpc")              # count exhausted: no delay
+    assert time.monotonic() - t0 < 0.1
+
+
+def test_inject_rank_from_env(monkeypatch, capsys):
+    monkeypatch.setenv(faults.ENV_VAR, "rank=3,site=rpc,kind=error")
+    monkeypatch.setenv("HOROVOD_RANK", "3")
+    faults.reset()
+    with pytest.raises(faults.FaultInjected):
+        faults.inject("rpc", "register")
+    assert "rank 3" in capsys.readouterr().err
+
+
+def test_inject_bad_spec_fails_loudly(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "kind=typo")
+    faults.reset()
+    with pytest.raises(faults.FaultSpecError):
+        faults.inject("allreduce")
+
+
+def _run_inject(spec):
+    env = dict(os.environ, HOROVOD_FAULT_SPEC=spec, PYTHONPATH=REPO)
+    return subprocess.run(
+        [sys.executable, "-c",
+         "from horovod_tpu import faults; faults.inject('rpc')"],
+        env=env, capture_output=True, text=True, timeout=60)
+
+
+@pytest.mark.chaos
+def test_exit_kind_terminates_process():
+    res = _run_inject("site=rpc,kind=exit:7")
+    assert res.returncode == 7, res.stderr
+    assert "firing kind=exit" in res.stderr
+
+
+@pytest.mark.chaos
+def test_crash_kind_sigkills_process():
+    res = _run_inject("site=rpc,kind=crash")
+    assert res.returncode == -9, res.stderr

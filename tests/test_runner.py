@@ -179,3 +179,253 @@ def test_reachability_check(tmp_path):
         network.check_hosts_reachable(["good1", "bad1"],
                                       ssh_builder=fake_ssh,
                                       cache_path=cache)
+
+
+# -- robustness: blacklist / probe / report / grace / operator stop ----------
+
+def test_host_blacklist_cooldown_and_filter():
+    """Demotion, cooldown expiry (stepped clock, no sleeping), filter,
+    and the fail-fast summary."""
+    now = [100.0]
+    bl = hosts.HostBlacklist(cooldown=10.0, clock=lambda: now[0])
+    hl = hosts.parse_hosts("h1:2,h2:2")
+    bl.demote("h2", "rank 3 exited with code -9")
+    assert bl.is_blacklisted("h2") and not bl.is_blacklisted("h1")
+    assert [h.hostname for h in bl.filter(hl)] == ["h1"]
+    assert "h2 (rank 3 exited with code -9)" in bl.summary()
+    now[0] = 111.0   # past the cooldown: eligible again
+    assert not bl.is_blacklisted("h2")
+    assert [h.hostname for h in bl.filter(hl)] == ["h1", "h2"]
+    assert bl.summary() == "<none>"
+    # No cooldown = demoted for the life of the job.
+    bl2 = hosts.HostBlacklist(clock=lambda: now[0])
+    bl2.demote("h1")
+    now[0] = 1e9
+    assert bl2.is_blacklisted("h1")
+    bl2.forgive("h1")
+    assert not bl2.is_blacklisted("h1")
+
+
+def test_probe_hosts_non_raising():
+    """probe_hosts reports per-host reachability without raising or
+    caching — the elastic re-probe must see the CURRENT state."""
+    from horovod_tpu.runner import network
+    res = network.probe_hosts(
+        ["up1", "down1", "up2"],
+        ssh_builder=lambda h: ["true"] if h.startswith("up") else ["false"])
+    assert res == {"up1": True, "down1": False, "up2": True}
+
+
+def _rank_infos(n, hostname="localhost"):
+    return [hosts.RankInfo(rank=i, size=n, local_rank=i, local_size=n,
+                           cross_rank=0, cross_size=1, hostname=hostname)
+            for i in range(n)]
+
+
+def test_launch_job_report_and_terminate_grace(tmp_path, monkeypatch, capfd):
+    """One rank fails on its own, the other traps SIGTERM and lingers:
+    the report blames only the genuine failure, the configurable grace
+    elapses, and the hard kill names the laggard rank."""
+    import sys as _sys
+    from horovod_tpu.runner import launch
+    monkeypatch.setenv("HOROVOD_TERMINATE_GRACE_SECONDS", "0.5")
+    script = tmp_path / "rank.py"
+    script.write_text(textwrap.dedent("""\
+        import os, signal, sys, time
+        if os.environ["HOROVOD_RANK"] == "1":
+            sys.exit(3)
+        signal.signal(signal.SIGTERM, lambda s, f: None)   # linger
+        time.sleep(60)
+    """))
+    infos = _rank_infos(2)
+    envs = [dict(os.environ, HOROVOD_RANK=str(i)) for i in range(2)]
+    report = {}
+    rc = launch.launch_job(infos, [_sys.executable, str(script)], envs,
+                           report=report)
+    assert rc == 3
+    assert report["failed"] == [(1, "localhost", 3)]
+    assert report["signalled"] is False
+    err = capfd.readouterr().err
+    assert "rank 1 exited with code 3" in err
+    assert "rank(s) [0] still running 0.5s after SIGTERM; sending SIGKILL" \
+        in err
+
+
+def test_terminate_grace_env_parsing(monkeypatch, capsys):
+    from horovod_tpu.runner import launch
+    monkeypatch.setenv("HOROVOD_TERMINATE_GRACE_SECONDS", "2.5")
+    assert launch._terminate_grace_seconds() == 2.5
+    monkeypatch.setenv("HOROVOD_TERMINATE_GRACE_SECONDS", "soon")
+    assert launch._terminate_grace_seconds() == \
+        launch.DEFAULT_TERMINATE_GRACE_SECONDS
+    assert "non-numeric" in capsys.readouterr().err
+
+
+def test_launch_job_sigint_returns_130(tmp_path):
+    """Operator stop at the launch_job level: SIGINT to the supervising
+    process → every rank is torn down and the job reports 130, never the
+    ranks' own -15s (signal handlers only work in the main thread, so
+    this runs launch_job in a subprocess driver)."""
+    import signal
+    import subprocess
+    import sys as _sys
+    import time as _time
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rank = tmp_path / "rank.py"
+    rank.write_text("import time\nprint('up', flush=True)\n"
+                    "time.sleep(60)\n")
+    driver = tmp_path / "driver.py"
+    driver.write_text(textwrap.dedent(f"""\
+        import os, sys
+        sys.path.insert(0, {repo!r})
+        from horovod_tpu.runner import hosts, launch
+        infos = [hosts.RankInfo(rank=i, size=2, local_rank=i, local_size=2,
+                                cross_rank=0, cross_size=1,
+                                hostname="localhost") for i in range(2)]
+        envs = [dict(os.environ, HOROVOD_RANK=str(i)) for i in range(2)]
+        report = {{}}
+        rc = launch.launch_job(infos, [sys.executable, {str(rank)!r}], envs,
+                               report=report)
+        print(f"RC={{rc}} FAILED={{report['failed']}} "
+              f"SIG={{report['signalled']}}", flush=True)
+    """))
+    env = dict(os.environ, HOROVOD_TERMINATE_GRACE_SECONDS="3")
+    proc = subprocess.Popen([_sys.executable, str(driver)], env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    up = 0
+    deadline = _time.time() + 60
+    while up < 2 and _time.time() < deadline:
+        if "up" in proc.stdout.readline():
+            up += 1
+    assert up == 2, "ranks never came up"
+    proc.send_signal(signal.SIGINT)
+    out = proc.stdout.read()
+    proc.wait(timeout=60)
+    assert "RC=130" in out, out
+    assert "FAILED=[]" in out, out     # operator stop blames no host
+    assert "SIG=True" in out, out
+
+
+def _ns(**kw):
+    import argparse
+    base = dict(hostfile=None, hosts=None, np=None, elastic_restarts=0,
+                min_np=None, blacklist_cooldown=None)
+    base.update(kw)
+    return argparse.Namespace(**base)
+
+
+def test_run_command_operator_stop_preserves_restart_budget(monkeypatch):
+    """rc 130/143 (operator stop) must NOT burn a restart attempt —
+    relaunching would race the operator's Ctrl-C."""
+    from horovod_tpu.runner import run as run_mod
+    for stop_rc in (130, 143):
+        calls = []
+
+        def fake_launch(args, infos, addr, extra_env, report=None,
+                        _rc=stop_rc):
+            calls.append(len(infos))
+            if report is not None:
+                report["failed"] = []
+                report["signalled"] = True
+            return _rc
+
+        monkeypatch.setattr(run_mod, "_launch_once", fake_launch)
+        rc = run_mod.run_command(_ns(np=2, elastic_restarts=3))
+        assert rc == stop_rc
+        assert calls == [2], "operator stop must not trigger a relaunch"
+
+
+def test_run_command_blacklists_and_reallocates(monkeypatch, capsys):
+    """A crashed rank's host is demoted and the next attempt re-allocates
+    onto the survivors with a smaller world (>= --min-np)."""
+    from horovod_tpu.runner import network
+    from horovod_tpu.runner import run as run_mod
+    monkeypatch.setattr(run_mod.time, "sleep", lambda s: None)
+    monkeypatch.setattr(network, "check_hosts_reachable",
+                        lambda *a, **k: None)
+    probed = []
+
+    def fake_probe(hosts_, **kw):
+        probed.append(sorted(hosts_))
+        return {h: True for h in hosts_}
+
+    monkeypatch.setattr(network, "probe_hosts", fake_probe)
+    attempts = []
+
+    def fake_launch(args, infos, addr, extra_env, report=None):
+        attempts.append([(i.rank, i.hostname, i.size) for i in infos])
+        if len(attempts) == 1:
+            report["failed"] = [(1, "hostB", -9)]
+            report["signalled"] = False
+            return 1
+        report["failed"] = []
+        report["signalled"] = False
+        return 0
+
+    monkeypatch.setattr(run_mod, "_launch_once", fake_launch)
+    rc = run_mod.run_command(_ns(hosts="hostA:1,hostB:1", min_np=1,
+                                 elastic_restarts=2))
+    assert rc == 0
+    assert attempts[0] == [(0, "hostA", 2), (1, "hostB", 2)]
+    assert attempts[1] == [(0, "hostA", 1)]     # re-allocated, shrunk
+    assert probed == [["hostA"]]                # hostB already demoted
+    err = capsys.readouterr().err
+    assert "blacklisting host hostB" in err
+    assert "smaller world: 1/2" in err
+
+
+def test_run_command_min_np_fail_fast(monkeypatch, capsys):
+    """Hard demotion (unreachable host) below the --min-np floor fails
+    fast with a report naming the blacklisted hosts — no doomed attempt,
+    no hang."""
+    from horovod_tpu.runner import network
+    from horovod_tpu.runner import run as run_mod
+    monkeypatch.setattr(run_mod.time, "sleep", lambda s: None)
+    monkeypatch.setattr(network, "check_hosts_reachable",
+                        lambda *a, **k: None)
+    monkeypatch.setattr(
+        network, "probe_hosts",
+        lambda hosts_, **kw: {h: h != "hostB" for h in hosts_})
+    calls = []
+
+    def fake_launch(args, infos, addr, extra_env, report=None):
+        calls.append(1)
+        report["failed"] = []      # e.g. rendezvous died: nobody to blame
+        report["signalled"] = False
+        return 1
+
+    monkeypatch.setattr(run_mod, "_launch_once", fake_launch)
+    rc = run_mod.run_command(_ns(hosts="hostA:1,hostB:1", min_np=2,
+                                 elastic_restarts=3))
+    assert rc == 1
+    assert len(calls) == 1         # attempt 1+ cannot satisfy the floor
+    err = capsys.readouterr().err
+    assert "cannot continue" in err and "--min-np" in err
+    assert "hostB (unreachable over ssh)" in err
+
+
+def test_run_command_single_host_never_self_blacklists(monkeypatch):
+    """Crash-based demotion is soft: a 1-host job keeps its only host
+    (relaunching in place beats refusing to run) and the restart budget
+    still applies."""
+    from horovod_tpu.runner import run as run_mod
+    monkeypatch.setattr(run_mod.time, "sleep", lambda s: None)
+    attempts = []
+
+    def fake_launch(args, infos, addr, extra_env, report=None):
+        attempts.append([i.hostname for i in infos])
+        report["failed"] = [(1, "localhost", -9)]
+        report["signalled"] = False
+        return 1 if len(attempts) == 1 else 0
+
+    monkeypatch.setattr(run_mod, "_launch_once", fake_launch)
+    rc = run_mod.run_command(_ns(np=2, elastic_restarts=2))
+    assert rc == 0
+    assert attempts == [["localhost"] * 2, ["localhost"] * 2]
+
+
+def test_run_command_min_np_validation():
+    from horovod_tpu.runner import run as run_mod
+    with pytest.raises(ValueError, match="min-np"):
+        run_mod.run_command(_ns(np=2, min_np=4))
