@@ -59,6 +59,16 @@ JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
   python -m horovod_tpu.runner -np 2 \
   python tests/distributed/stall_check_np2.py
 
+echo "--- telemetry gate (2 ranks): per-rank + merged metrics JSON with
+--- nonzero collective counters (docs/metrics.md)"
+METRICS_DIR="$(mktemp -d)"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  HOROVOD_METRICS_FILE="$METRICS_DIR/metrics.json" \
+  python -m horovod_tpu.runner -np 2 \
+  python tests/distributed/metrics_workload_np2.py
+python tools/check_metrics.py "$METRICS_DIR/metrics.json" 2
+rm -rf "$METRICS_DIR"
+
 echo "--- TSAN build + smoke (races inside libhorovod_tpu.so fail CI)"
 make -C horovod_tpu/native/cc tsan
 rm -f /tmp/tsan_ci.*

@@ -85,6 +85,8 @@ from horovod_tpu.ops.collective import (
 )
 from horovod_tpu.ops.compression import Compression
 from horovod_tpu import checkpoint  # noqa: F401  (hvd.checkpoint.save/restore)
+from horovod_tpu import telemetry  # noqa: F401  (hvd.telemetry.counter/...)
+from horovod_tpu.telemetry import metrics_snapshot
 from horovod_tpu.parallel.data import (
     DistributedOptimizer,
     DistributedGradientTape,
@@ -113,6 +115,8 @@ __all__ = [
     "broadcast_object",
     "reducescatter", "alltoall", "alltoall_ragged",
     "synchronize", "poll", "join",
+    # observability
+    "telemetry", "metrics_snapshot",
     # training
     "Compression", "checkpoint",
     "DistributedOptimizer", "DistributedGradientTape", "make_training_step",

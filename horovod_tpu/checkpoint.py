@@ -29,7 +29,7 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
-from horovod_tpu import basics
+from horovod_tpu import basics, telemetry
 from horovod_tpu.ops import collective as _c
 from horovod_tpu.utils.logging import get_logger
 
@@ -102,11 +102,19 @@ def save(ckpt_dir: str, state: Any, step: int = 0,
     if basics.rank() == 0:
         import orbax.checkpoint as ocp
         ckpt_dir = os.path.abspath(ckpt_dir)
+        t0 = telemetry.clock()
         with ocp.CheckpointManager(
                 ckpt_dir,
                 options=ocp.CheckpointManagerOptions(
                     max_to_keep=max_to_keep)) as mgr:
             mgr.save(step, args=ocp.args.StandardSave(state))
+        if telemetry.enabled():
+            telemetry.counter("hvd_checkpoint_saves_total",
+                              "Checkpoints written by rank 0").inc()
+            telemetry.histogram(
+                "hvd_checkpoint_save_seconds",
+                "Wall time of a rank-0 checkpoint save").observe(
+                telemetry.clock() - t0)
         path = os.path.join(ckpt_dir, str(step))
         log.info("checkpoint step %d written to %s", step, path)
     if basics.size() > 1:
@@ -123,6 +131,7 @@ def restore(ckpt_dir: str, state_template: Any,
     structure/shapes/dtypes (pass the freshly-initialized state)."""
     state = state_template
     found = np.zeros(1, np.int32)
+    t0 = telemetry.clock()
     if basics.rank() == root_rank:
         import orbax.checkpoint as ocp
         ckpt_dir = os.path.abspath(ckpt_dir)
@@ -155,6 +164,15 @@ def restore(ckpt_dir: str, state_template: Any,
         if int(found[0]):
             state = _tree_broadcast(state, root_rank,
                                     "hvd.checkpoint.restore")
+    if telemetry.enabled():
+        telemetry.counter(
+            "hvd_checkpoint_restores_total",
+            "Checkpoint restore attempts (including broadcast)",
+            found=str(bool(int(found[0])))).inc()
+        telemetry.histogram(
+            "hvd_checkpoint_restore_seconds",
+            "Wall time of restore + cross-rank broadcast").observe(
+            telemetry.clock() - t0)
     return state
 
 

@@ -18,10 +18,15 @@ from typing import Optional
 
 import numpy as np
 
-from horovod_tpu import faults
+from horovod_tpu import faults, telemetry
 from horovod_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
+
+# hvd_enqueue op code -> metric label (matches the op-type comment on the
+# hvd_enqueue binding below).
+_OP_NAMES = {0: "allreduce", 1: "allgather", 2: "broadcast", 3: "alltoall",
+             4: "reducescatter", 5: "barrier", 6: "join", 7: "process_set"}
 
 
 class EagerStallError(RuntimeError):
@@ -200,6 +205,7 @@ class Runtime:
     def _submit(self, op: int, name: str, arr: np.ndarray, arg: int = 0,
                 splits=None, set_id: int = 0) -> int:
         faults.inject("native_submit", name, rank=self.rank)
+        t_submit = time.monotonic()
         arr = np.ascontiguousarray(arr)
         code = _DTYPE_CODES.get(arr.dtype)
         if code is None:
@@ -216,9 +222,17 @@ class Runtime:
             shape, arr.ndim, code, arg, csplits, nsplits, set_id)
         if h < 0:
             raise RuntimeError(self._lib.hvd_last_error().decode())
+        t_enqueued = time.monotonic()
         with self._inflight_lock:
-            # [buffer, name, submit time, last warn time]
-            self._inflight[h] = [arr, name, time.monotonic(), 0.0]
+            # [buffer, name, submit time, last warn time, op kind, nbytes]
+            self._inflight[h] = [arr, name, t_enqueued, 0.0,
+                                 _OP_NAMES.get(op, str(op)), arr.nbytes]
+        tl = telemetry.timeline()
+        if tl is not None:
+            tl.span(name, f"SUBMIT_{_OP_NAMES.get(op, str(op)).upper()}",
+                    t_submit, t_enqueued,
+                    args={"op": _OP_NAMES.get(op, str(op)),
+                          "bytes": int(arr.nbytes)})
         return h
 
     def _op_name(self, h: int) -> str:
@@ -254,11 +268,16 @@ class Runtime:
             reports = []
             with self._inflight_lock:
                 for entry in self._inflight.values():
-                    _, name, t0, last = entry
+                    name, t0, last = entry[1], entry[2], entry[3]
                     if now - t0 >= warn and now - last >= warn:
                         entry[3] = now
                         reports.append((name, now - t0))
             for name, elapsed in reports:
+                if telemetry.enabled():
+                    telemetry.counter(
+                        "hvd_eager_stall_warnings_total",
+                        "Watchdog warnings for eager ops inflight past "
+                        "HOROVOD_EAGER_OP_WARN_SECONDS").inc()
                 log.warning("%s", self._stall_report(name, elapsed))
 
     def _wait_bounded(self, h: int) -> int:
@@ -297,6 +316,7 @@ class Runtime:
         splits must be read BEFORE hvd_read_output, which releases the
         native table entry (c_api.h contract)."""
         faults.inject("native_wait", self._op_name(h), rank=self.rank)
+        t_wait = time.monotonic()
         try:
             rc = self._wait_bounded(h)
         except EagerStallError:
@@ -311,13 +331,42 @@ class Runtime:
                 entry = self._inflight.pop(h, None)
                 if entry is not None:
                     self._stalled.append(entry)
+            if telemetry.enabled():
+                telemetry.counter(
+                    "hvd_eager_stalls_total",
+                    "Eager ops that raised EagerStallError at the "
+                    "HOROVOD_EAGER_OP_TIMEOUT deadline",
+                    op=entry[4] if entry else "unknown").inc()
             raise
         with self._inflight_lock:
-            self._inflight.pop(h, None)
+            entry = self._inflight.pop(h, None)
+        t_done = time.monotonic()
+        op_kind = entry[4] if entry else "unknown"
         if rc != 0:
+            if telemetry.enabled():
+                telemetry.counter(
+                    "hvd_eager_op_errors_total",
+                    "Eager ops completed with a native error status",
+                    op=op_kind).inc()
             err = self._lib.hvd_last_error().decode()
             self._lib.hvd_release(h)   # drop the native table entry
             raise RuntimeError(err)
+        if entry is not None:
+            name, t0, nbytes = entry[1], entry[2], entry[5]
+            telemetry.observe_op(op_kind, max(t_done - t0, 1e-9), nbytes)
+            if telemetry.enabled():
+                telemetry.histogram(
+                    "hvd_native_wait_seconds",
+                    "Time blocked in hvd_wait on the native runtime",
+                    bounds=telemetry.DEFAULT_TIME_BUCKETS,
+                    op=op_kind).observe(max(t_done - t_wait, 0.0))
+            tl = telemetry.timeline()
+            if tl is not None:
+                tl.span(name, f"WAIT_{op_kind.upper()}", t_wait, t_done)
+                tl.instant(name, "FINISH", t_done, args={"op": op_kind})
+            log.trace("eager %s '%s' done: %.3f ms (%d bytes, wait "
+                      "%.3f ms)", op_kind, name, (t_done - t0) * 1e3,
+                      nbytes, (t_done - t_wait) * 1e3)
         received = None
         if read_splits:
             recv = (ctypes.c_longlong * self.size)()

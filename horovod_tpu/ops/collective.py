@@ -40,10 +40,37 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from horovod_tpu import basics, faults
+from horovod_tpu import basics, faults, telemetry
 from horovod_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Telemetry for the 1-process local fast path.  Multi-process eager ops are
+# recorded at the native-runtime choke point (native/runtime.py::_wait_read),
+# which every route — sync, async, split submit/finish — flows through; the
+# rt-is-None branches below bypass the runtime entirely, so they record
+# here.  The two sites are mutually exclusive: nothing is double-counted.
+# ---------------------------------------------------------------------------
+
+def _tstart() -> float:
+    """Timestamp ops only when some telemetry consumer exists — the
+    disabled path must not even read the clock."""
+    if telemetry.enabled() or telemetry.timeline() is not None:
+        return telemetry.clock()
+    return 0.0
+
+
+def _record_local(kind: str, name: str, arr, t0: float) -> None:
+    if not t0:
+        return
+    t1 = telemetry.clock()
+    nbytes = int(arr.nbytes)
+    telemetry.observe_op(kind, max(t1 - t0, 1e-9), nbytes)
+    tl = telemetry.timeline()
+    if tl is not None:
+        tl.record_op(name, kind, t0, t1, t1, nbytes)
 
 
 # ---------------------------------------------------------------------------
@@ -257,13 +284,19 @@ class HandleManager:
             h = _Handle(self._next, name)
             self._next += 1
             self._handles[h.id] = h
-            return h
+        telemetry.gauge("hvd_eager_handle_queue_depth",
+                        "Async eager handles allocated and not yet "
+                        "completed").inc()
+        return h
 
     def complete(self, h: _Handle, result=None, error: Optional[Exception] = None):
         with self._lock:
             h.result = result
             h.error = error
             self._inflight_names.discard(h.name)
+        telemetry.gauge("hvd_eager_handle_queue_depth",
+                        "Async eager handles allocated and not yet "
+                        "completed").dec()
         h.event.set()
 
     def get(self, hid) -> _Handle:
@@ -334,6 +367,7 @@ def _check_adasum_dtype(arr) -> None:
 def _eager_allreduce(x, op: ReduceOp, name: str, prescale_factor,
                      postscale_factor, set_id=0, set_size=None):
     faults.inject("allreduce", name)
+    t0 = _tstart()
     rt = basics.runtime()
     arr = np.asarray(x)
     if op is Adasum:
@@ -342,6 +376,7 @@ def _eager_allreduce(x, op: ReduceOp, name: str, prescale_factor,
         arr = arr * prescale_factor
     if rt is None:
         out = arr.copy()
+        _record_local("allreduce", name, arr, t0)
     else:
         out = rt.allreduce(name, arr, op.code, set_id=set_id)
     # Adasum's result is the combined vector itself (the native butterfly
@@ -360,6 +395,7 @@ def _eager_allreduce(x, op: ReduceOp, name: str, prescale_factor,
 def _eager_allreduce_submit(x, op: ReduceOp, name: str, prescale_factor,
                             set_id=0):
     faults.inject("allreduce", name)
+    t0 = _tstart()
     rt = basics.runtime()
     arr = np.asarray(x)
     if op is Adasum:
@@ -367,6 +403,7 @@ def _eager_allreduce_submit(x, op: ReduceOp, name: str, prescale_factor,
     if prescale_factor != 1.0:
         arr = arr * prescale_factor
     if rt is None:
+        _record_local("allreduce", name, arr, t0)
         return (None, arr.copy())
     return (rt.allreduce_submit(name, arr, op.code, set_id=set_id), None)
 
@@ -385,9 +422,11 @@ def _eager_allreduce_finish(tok, op: ReduceOp, postscale_factor,
 
 def _eager_allgather_submit(x, name: str, set_id=0):
     faults.inject("allgather", name)
+    t0 = _tstart()
     rt = basics.runtime()
     arr = np.asarray(x)
     if rt is None:
+        _record_local("allgather", name, arr, t0)
         return (None, arr.copy())
     return (rt.allgather_submit(name, arr, set_id=set_id), None)
 
@@ -400,12 +439,14 @@ def _eager_allgather_finish(tok):
 
 def _eager_broadcast_submit(x, root_rank: int, name: str, set_id=0):
     faults.inject("broadcast", name)
+    t0 = _tstart()
     rt = basics.runtime()
     arr = np.asarray(x)
     if rt is None:
         if root_rank != 0:
             raise ValueError(
                 f"broadcast root_rank {root_rank} out of range for size 1")
+        _record_local("broadcast", name, arr, t0)
         return (None, arr.copy())
     return (rt.broadcast_submit(name, arr, root_rank, set_id=set_id), None)
 
@@ -445,10 +486,12 @@ def _check_reducescatter_op(op: ReduceOp) -> None:
 
 def _eager_reducescatter_submit(x, op: ReduceOp, name: str, set_id=0):
     faults.inject("reducescatter", name)
+    t0 = _tstart()
     _check_reducescatter_op(op)
     rt = basics.runtime()
     arr = np.asarray(x)
     if rt is None:
+        _record_local("reducescatter", name, arr, t0)
         return (None, arr.copy())
     return (rt.reducescatter_submit(name, arr, op.code, set_id=set_id),
             None)
@@ -465,21 +508,25 @@ def _eager_reducescatter_finish(tok, op: ReduceOp, set_size=None):
 
 def _eager_allgather(x, name: str, set_id=0):
     faults.inject("allgather", name)
+    t0 = _tstart()
     rt = basics.runtime()
     arr = np.asarray(x)
     if rt is None:
+        _record_local("allgather", name, arr, t0)
         return arr.copy()
     return rt.allgather(name, arr, set_id=set_id)
 
 
 def _eager_broadcast(x, root_rank: int, name: str, set_id=0):
     faults.inject("broadcast", name)
+    t0 = _tstart()
     rt = basics.runtime()
     arr = np.asarray(x)
     if rt is None:
         if root_rank != 0:
             raise ValueError(
                 f"broadcast root_rank {root_rank} out of range for size 1")
+        _record_local("broadcast", name, arr, t0)
         return arr.copy()
     return rt.broadcast(name, arr, root_rank, set_id=set_id)
 
@@ -488,6 +535,7 @@ def _eager_alltoall(x, splits, name: str, set_id=0):
     """Returns ``(output, received_splits)``; received_splits[r] = dim-0
     rows that came from rank r (later-Horovod alltoall contract)."""
     faults.inject("alltoall", name)
+    t0 = _tstart()
     rt = basics.runtime()
     arr = np.asarray(x)
     if rt is None:
@@ -500,6 +548,7 @@ def _eager_alltoall(x, splits, name: str, set_id=0):
                 raise ValueError(
                     f"alltoall splits {sp.tolist()} do not match first "
                     f"dimension {rows} for size-1 job")
+        _record_local("alltoall", name, arr, t0)
         return arr.copy(), np.array([rows], np.int64)
     return rt.alltoall(name, arr, splits, set_id=set_id)
 
@@ -507,10 +556,12 @@ def _eager_alltoall(x, splits, name: str, set_id=0):
 def _eager_reducescatter(x, op: ReduceOp, name: str, set_id=0,
                          set_size=None):
     faults.inject("reducescatter", name)
+    t0 = _tstart()
     _check_reducescatter_op(op)
     rt = basics.runtime()
     arr = np.asarray(x)
     if rt is None:
+        _record_local("reducescatter", name, arr, t0)
         return (arr / (set_size or basics.size()) if op is Average
                 else arr.copy())
     out = rt.reducescatter(name, arr, op.code, set_id=set_id)

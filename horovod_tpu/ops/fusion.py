@@ -26,6 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from horovod_tpu import telemetry
+
 # Reference default: 64 MB (operations.cc:379); same env knob name.
 DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024
 
@@ -85,6 +87,28 @@ def fused_psum(tensors: Sequence[jax.Array], axis_name: str,
         return []
     threshold = fusion_threshold_bytes() if threshold is None else threshold
     buckets = _bucket_leaves(tensors, threshold)
+    if telemetry.enabled():
+        # Bucketing happens at TRACE time (shapes are static under jit),
+        # so these count fusion DECISIONS, not per-step traffic — the
+        # per-step wire volume is trace counts x bucket bytes.
+        telemetry.counter(
+            "hvd_fusion_requests_total",
+            "fused_psum calls (trace-time bucketing decisions)").inc()
+        telemetry.counter(
+            "hvd_fusion_buckets_total",
+            "Fusion buckets produced across all fused_psum calls").inc(
+            len(buckets))
+        telemetry.counter(
+            "hvd_fusion_tensors_total",
+            "Tensors routed through fused_psum").inc(len(tensors))
+        hist = telemetry.histogram(
+            "hvd_fusion_bucket_bytes",
+            "Per-bucket payload size produced by the fusion walk",
+            bounds=telemetry.DEFAULT_BYTE_BUCKETS)
+        for bucket in buckets:
+            hist.observe(float(sum(
+                int(np.prod(tensors[i].shape)) * tensors[i].dtype.itemsize
+                for i in bucket)))
     out: List = [None] * len(tensors)
     for bucket in buckets:
         if len(bucket) == 1:

@@ -33,6 +33,7 @@ _CONFIG_ARGS = {
     "cache-capacity": "cache_capacity",
     "timeline-filename": "timeline_filename",
     "timeline-mark-cycles": "timeline_mark_cycles",
+    "metrics-file": "metrics_file",
     "stall-check-time-seconds": "stall_check_time_seconds",
     "stall-shutdown-time-seconds": "stall_shutdown_time_seconds",
     "autotune": "autotune",

@@ -11,6 +11,8 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
+from horovod_tpu import telemetry
+
 
 @dataclass
 class HostSlots:
@@ -115,6 +117,9 @@ class HostBlacklist:
         self._entries: Dict[str, Tuple[float, str]] = {}
 
     def demote(self, hostname: str, reason: str = "") -> None:
+        telemetry.counter(
+            "hvd_blacklisted_hosts_total",
+            "Host demotions recorded by the launcher blacklist").inc()
         self._entries[hostname] = (self._clock(), reason)
 
     def forgive(self, hostname: str) -> None:

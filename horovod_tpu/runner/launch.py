@@ -19,7 +19,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from horovod_tpu import faults
+from horovod_tpu import faults, telemetry
 from horovod_tpu.runner.hosts import RankInfo
 
 # Seconds between SIGTERM fan-out and the SIGKILL hammer.  Tunable: ranks
@@ -226,6 +226,10 @@ def launch_job(rank_infos: List[RankInfo], command: List[str],
                 sys.stderr.write(
                     f"hvdrun: rank(s) {laggards} still running "
                     f"{grace:g}s after SIGTERM; sending SIGKILL\n")
+                telemetry.counter(
+                    "hvd_hard_killed_ranks_total",
+                    "Ranks that outlived the SIGTERM grace period and "
+                    "took a SIGKILL").inc(len(laggards))
                 for p in procs:
                     p.kill()
                 break
@@ -251,6 +255,11 @@ def launch_job(rank_infos: List[RankInfo], command: List[str],
             # code, and success must never be reported either.
             exit_code = 130
             failed = []   # nothing to blame a host for
+        if failed:
+            telemetry.counter(
+                "hvd_rank_failures_total",
+                "Ranks that exited non-zero before launcher teardown "
+                "began").inc(len(failed))
         if report is not None:
             report["failed"] = failed
             report["signalled"] = signalled.is_set()

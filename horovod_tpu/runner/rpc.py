@@ -24,7 +24,7 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
-from horovod_tpu import faults
+from horovod_tpu import faults, telemetry
 
 
 class AuthError(RuntimeError):
@@ -128,8 +128,15 @@ def connect_with_retry(addr: str, port: int, timeout: float = 30.0,
             last_err = e
             if attempt >= retries:
                 break
+            telemetry.counter(
+                "hvd_rpc_connect_retries_total",
+                "RPC dial attempts that failed and were retried with "
+                "backoff").inc()
             delay = min(max_delay, base_delay * (2.0 ** attempt))
             sleep(delay * (0.5 + rng()))
+    telemetry.counter(
+        "hvd_rpc_connect_failures_total",
+        "RPC dials that exhausted every retry").inc()
     raise ConnectionError(
         f"could not connect to {addr}:{port} after {retries + 1} "
         f"attempts: {last_err}")
@@ -141,6 +148,11 @@ def rpc_call(addr: str, port: int, request: Any, key: bytes,
     with jittered backoff (``retries=0`` restores single-shot)."""
     faults.inject("rpc", str(request.get("kind"))
                   if isinstance(request, dict) else None)
+    kind = (str(request.get("kind")) if isinstance(request, dict)
+            else "raw")
+    telemetry.counter("hvd_rpc_calls_total",
+                      "Authenticated RPC round trips issued",
+                      kind=kind).inc()
     with connect_with_retry(addr, port, timeout=timeout,
                             retries=retries) as sock:
         _send_msg(sock, pickle.dumps(request), key)
@@ -196,23 +208,36 @@ class KeepaliveMonitor:
         self._clock = clock
         self._timeout = timeout
         self._last: dict = {}
+        self._reported_dead: set = set()
         self._lock = threading.Lock()
 
     def ping(self, task_id) -> None:
         with self._lock:
             self._last[task_id] = self._clock()
+            # A task that pings again was a network blip, not a loss.
+            self._reported_dead.discard(task_id)
 
     def forget(self, task_id) -> None:
         """Stop tracking a task (it reported its result or was removed
         from the job); silence from it is no longer a failure."""
         with self._lock:
             self._last.pop(task_id, None)
+            self._reported_dead.discard(task_id)
 
     def dead_tasks(self) -> list:
         now = self._clock()
         with self._lock:
-            return [t for t, ts in self._last.items()
+            dead = [t for t, ts in self._last.items()
                     if now - ts > self._timeout]
+            fresh = [t for t in dead if t not in self._reported_dead]
+            self._reported_dead.update(fresh)
+        if fresh:
+            # Counted once per silence episode, not per poll.
+            telemetry.counter(
+                "hvd_rpc_keepalive_losses_total",
+                "Tasks whose keepalive pings went silent past the "
+                "timeout").inc(len(fresh))
+        return dead
 
 
 def find_free_port(bind: str = "") -> int:

@@ -14,12 +14,15 @@ typical layout (each process drives all local chips through SPMD).
 from __future__ import annotations
 
 import argparse
+import json
 import os
+import socket
 import sys
 import time
 from typing import List
 
 import horovod_tpu
+from horovod_tpu import telemetry
 from horovod_tpu.runner import config_parser, hosts, launch
 
 
@@ -104,6 +107,14 @@ def build_parser() -> argparse.ArgumentParser:
     timeline.add_argument("--timeline-filename", default=None)
     timeline.add_argument("--timeline-mark-cycles", action="store_true",
                           default=False)
+
+    metrics = p.add_argument_group("metrics")
+    metrics.add_argument("--metrics-file", dest="metrics_file", default=None,
+                         help="Write a merged cross-rank metrics summary "
+                              "here after the job; each rank also dumps "
+                              "its own <base>.rank<k>.json. Defaults to "
+                              "HOROVOD_METRICS_FILE when set "
+                              "(docs/metrics.md).")
 
     stall = p.add_argument_group("stall detection")
     stall.add_argument("--stall-check-time-seconds", type=float, default=None)
@@ -192,69 +203,180 @@ def run_command(args) -> int:
                          f"world size -np {np_}")
     blacklist = hosts.HostBlacklist(
         cooldown=getattr(args, "blacklist_cooldown", None))
+    metrics_file = (getattr(args, "metrics_file", None) or
+                    os.environ.get("HOROVOD_METRICS_FILE", "").strip() or
+                    None)
+    collector = None
+    if metrics_file:
+        # The launcher writes the MERGED summary to this path itself, so
+        # its own at-exit dump must not clobber it (each rank gets an
+        # explicit <base>.rank<k>.json injected in _launch_once).
+        os.environ.pop("HOROVOD_METRICS_FILE", None)
+        telemetry.configure(enabled_flag=True)
+        collector = _MetricsCollector(extra_env["HOROVOD_SECRET_KEY"])
     rc = 1
-    for attempt in range(restarts + 1):
-        if attempt > 0:
-            # Brief backoff so a persistently broken launch (host mid-
-            # reboot, dead binary) doesn't burn the whole restart budget
-            # in a second — the budget targets transient failures.
-            delay = min(2.0 ** attempt, 30.0)
-            print(f"hvdrun: job failed (rc={rc}); elastic restart "
-                  f"{attempt}/{restarts} in {delay:.0f}s with a fresh "
-                  f"rendezvous", file=sys.stderr, flush=True)
-            time.sleep(delay)
-            # Re-probe surviving remote hosts RIGHT BEFORE the attempt —
-            # the pre-launch check's hour-long cache would answer from
-            # before the failure.  A host that stopped answering is
-            # demoted unconditionally: spawning a rank there can only
-            # hang the rendezvous.
-            from horovod_tpu.runner import network
-            candidates = sorted({
-                h.hostname for h in host_list
-                if not launch.is_local(h.hostname) and
-                not blacklist.is_blacklisted(h.hostname)})
-            if candidates:
-                for host, ok in sorted(
-                        network.probe_hosts(candidates).items()):
-                    if not ok:
-                        blacklist.demote(host, "unreachable over ssh")
-                        print(f"hvdrun: host {host} is unreachable; "
-                              f"blacklisting", file=sys.stderr, flush=True)
-        usable = blacklist.filter(host_list)
-        capacity = sum(h.slots for h in usable)
-        cur_np = min(np_, capacity)
-        if cur_np < min_np:
-            print(f"hvdrun: cannot continue: surviving hosts provide "
-                  f"{capacity} slot(s) but the job needs at least "
-                  f"{min_np} (--min-np). Blacklisted: "
-                  f"{blacklist.summary()}", file=sys.stderr, flush=True)
-            return rc or 1
-        if cur_np < np_:
-            print(f"hvdrun: restarting with a smaller world: "
-                  f"{cur_np}/{np_} ranks on surviving hosts "
-                  f"(blacklisted: {blacklist.summary()})",
-                  file=sys.stderr, flush=True)
-        infos = hosts.allocate(usable, cur_np)
-        extra_env["HOROVOD_RESTART_ATTEMPT"] = str(attempt)
-        report: dict = {}
-        rc = _launch_once(args, infos, addr, extra_env, report=report)
-        if rc == 0:
-            return 0
-        if rc in (130, 143):
-            # The OPERATOR stopped the job (launch_job returns 130
-            # whenever ITS OWN SIGINT/SIGTERM handler fired, regardless
-            # of the SIGTERMed ranks' -15s) — relaunching would race
-            # them with another Ctrl-C.  A NEGATIVE code here is a rank
-            # killed by a signal the launcher never received (OOM
-            # SIGKILL, SIGSEGV): a crash, exactly what the restart
-            # budget is for.
-            return rc
-        if attempt < restarts:
-            # Demotion only matters if another attempt will allocate;
-            # on the final failure it would just add noise to the report.
-            _demote_failed_hosts(blacklist, host_list,
-                                 report.get("failed", ()), min_np)
-    return rc
+    try:
+        for attempt in range(restarts + 1):
+            if attempt > 0:
+                telemetry.counter(
+                    "hvd_elastic_restarts_total",
+                    "Whole-job elastic restart attempts").inc()
+                # Brief backoff so a persistently broken launch (host mid-
+                # reboot, dead binary) doesn't burn the whole restart
+                # budget in a second — the budget targets transient
+                # failures.
+                delay = min(2.0 ** attempt, 30.0)
+                print(f"hvdrun: job failed (rc={rc}); elastic restart "
+                      f"{attempt}/{restarts} in {delay:.0f}s with a fresh "
+                      f"rendezvous", file=sys.stderr, flush=True)
+                time.sleep(delay)
+                # Re-probe surviving remote hosts RIGHT BEFORE the
+                # attempt — the pre-launch check's hour-long cache would
+                # answer from before the failure.  A host that stopped
+                # answering is demoted unconditionally: spawning a rank
+                # there can only hang the rendezvous.
+                from horovod_tpu.runner import network
+                candidates = sorted({
+                    h.hostname for h in host_list
+                    if not launch.is_local(h.hostname) and
+                    not blacklist.is_blacklisted(h.hostname)})
+                if candidates:
+                    for host, ok in sorted(
+                            network.probe_hosts(candidates).items()):
+                        if not ok:
+                            blacklist.demote(host, "unreachable over ssh")
+                            print(f"hvdrun: host {host} is unreachable; "
+                                  f"blacklisting", file=sys.stderr,
+                                  flush=True)
+            usable = blacklist.filter(host_list)
+            capacity = sum(h.slots for h in usable)
+            cur_np = min(np_, capacity)
+            if cur_np < min_np:
+                print(f"hvdrun: cannot continue: surviving hosts provide "
+                      f"{capacity} slot(s) but the job needs at least "
+                      f"{min_np} (--min-np). Blacklisted: "
+                      f"{blacklist.summary()}", file=sys.stderr, flush=True)
+                return rc or 1
+            if cur_np < np_:
+                print(f"hvdrun: restarting with a smaller world: "
+                      f"{cur_np}/{np_} ranks on surviving hosts "
+                      f"(blacklisted: {blacklist.summary()})",
+                      file=sys.stderr, flush=True)
+            infos = hosts.allocate(usable, cur_np)
+            extra_env["HOROVOD_RESTART_ATTEMPT"] = str(attempt)
+            report: dict = {}
+            # Metrics kwargs only when active: callers (and tests) that
+            # stub _launch_once with the historical 5-arg signature stay
+            # compatible on the metrics-off path.
+            mkw = ({"metrics_file": metrics_file, "collector": collector}
+                   if collector is not None else {})
+            rc = _launch_once(args, infos, addr, extra_env, report=report,
+                              **mkw)
+            if rc == 0:
+                return 0
+            if rc in (130, 143):
+                # The OPERATOR stopped the job (launch_job returns 130
+                # whenever ITS OWN SIGINT/SIGTERM handler fired,
+                # regardless of the SIGTERMed ranks' -15s) — relaunching
+                # would race them with another Ctrl-C.  A NEGATIVE code
+                # here is a rank killed by a signal the launcher never
+                # received (OOM SIGKILL, SIGSEGV): a crash, exactly what
+                # the restart budget is for.
+                return rc
+            if attempt < restarts:
+                # Demotion only matters if another attempt will allocate;
+                # on the final failure it would just add noise to the
+                # report.
+                _demote_failed_hosts(blacklist, host_list,
+                                     report.get("failed", ()), min_np)
+        return rc
+    finally:
+        if collector is not None:
+            try:
+                _write_metrics_summary(metrics_file, collector, np_, rc)
+            except OSError as e:
+                print(f"hvdrun: could not write metrics summary to "
+                      f"{metrics_file}: {e}", file=sys.stderr, flush=True)
+            collector.shutdown()
+
+
+class _MetricsCollector:
+    """Launcher-side sink for the ranks' at-exit metrics reports.
+
+    Rides the existing authenticated RPC plane (``runner/rpc.py``): each
+    rank's telemetry exit hook pushes its ``horovod_tpu.metrics.v1``
+    document to ``HOROVOD_METRICS_RPC``, and the launcher merges the
+    collected reports (falling back to the ranks' JSON files for any
+    rank whose push never arrived — SIGKILLed ranks don't push).
+    Reports are keyed by rank, so an elastic restart's fresh attempt
+    simply overwrites the previous attempt's rows."""
+
+    def __init__(self, secret: str):
+        from horovod_tpu.runner import rpc
+        self.reports: dict = {}
+        self._server = rpc.RpcServer(rpc.job_key_bytes(secret),
+                                     self._handle)
+
+    def _handle(self, req):
+        if isinstance(req, dict) and req.get("kind") == "metrics_report":
+            report = req.get("report")
+            if isinstance(report, dict):
+                self.reports[str(report.get("rank", "?"))] = report
+                return {"ok": True}
+        return {"ok": False}
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+
+
+def _per_rank_metrics_path(base: str, rank: int) -> str:
+    root, ext = os.path.splitext(base)
+    return f"{root}.rank{rank}{ext or '.json'}"
+
+
+def _write_metrics_summary(path: str, collector: "_MetricsCollector",
+                           world_size: int, exit_code: int) -> None:
+    """Merge the per-rank reports into one attributed summary document
+    (``horovod_tpu.metrics.summary.v1``) at the ``--metrics-file`` path."""
+    from horovod_tpu.telemetry import aggregate
+    ranks = dict(collector.reports)
+    for rank in range(world_size):
+        if str(rank) in ranks:
+            continue
+        try:
+            with open(_per_rank_metrics_path(path, rank)) as f:
+                ranks[str(rank)] = json.load(f)
+        except (OSError, ValueError):
+            pass  # rank died before dumping; it is simply absent
+    snapshots = {k: r.get("metrics") or {} for k, r in ranks.items()}
+    snapshots["launcher"] = telemetry.metrics_snapshot()
+    doc = {
+        "schema": "horovod_tpu.metrics.summary.v1",
+        "world_size": world_size,
+        "exit_code": exit_code,
+        "launcher": {
+            "host": socket.gethostname(),
+            "pid": os.getpid(),
+            "metrics": telemetry.metrics_snapshot(),
+        },
+        "ranks": ranks,
+        "merged": aggregate.merge_snapshots(snapshots),
+    }
+    dirname = os.path.dirname(os.path.abspath(path))
+    os.makedirs(dirname, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    missing = sorted(r for r in range(world_size) if str(r) not in ranks)
+    print(f"hvdrun: metrics summary ({len(ranks)}/{world_size} ranks"
+          + (f"; missing {missing}" if missing else "")
+          + f") written to {path}", file=sys.stderr, flush=True)
 
 
 def _demote_failed_hosts(blacklist, host_list, failed, min_np) -> None:
@@ -284,7 +406,8 @@ def _demote_failed_hosts(blacklist, host_list, failed, min_np) -> None:
                   file=sys.stderr, flush=True)
 
 
-def _launch_once(args, infos, addr, extra_env, report=None) -> int:
+def _launch_once(args, infos, addr, extra_env, report=None,
+                 metrics_file=None, collector=None) -> int:
     port = args.rendezvous_port or launch.find_free_port()
     if getattr(args, "jax_distributed", False):
         # The jax.distributed coordinator runs INSIDE rank 0 (unlike the
@@ -301,6 +424,14 @@ def _launch_once(args, infos, addr, extra_env, report=None) -> int:
                                   multi_host=multi_host)
         for info in infos
     ]
+    if metrics_file and collector is not None:
+        # Per-rank dump paths are assigned HERE (not left to the ranks'
+        # own per_rank_path de-confliction) so the launcher knows exactly
+        # which files to fall back to when a rank's RPC push never lands.
+        for info, env in zip(infos, env_per_rank):
+            env["HOROVOD_METRICS_FILE"] = _per_rank_metrics_path(
+                metrics_file, info.rank)
+            env["HOROVOD_METRICS_RPC"] = f"{addr}:{collector.port}"
     if args.verbose:
         for info in infos:
             print(f"hvdrun: rank {info.rank} -> {info.hostname} "

@@ -1,0 +1,89 @@
+"""Cross-rank metric aggregation for the launcher's merged summary.
+
+The launcher collects one ``MetricsRegistry.snapshot()`` per rank (over
+the RPC plane, falling back to the ranks' ``HOROVOD_METRICS_FILE`` JSON
+dumps) and merges them into a single per-rank-attributed document:
+
+* counters: summed across ranks;
+* histograms: bucket-wise sums (every rank shares the fixed bounds —
+  the registry forbids dynamic buckets exactly for this), plus summed
+  ``sum``/``count``;
+* gauges: point-in-time values don't sum meaningfully across ranks, so
+  the merge keeps ``min``/``max``/``mean``.
+
+The merged document never discards the per-rank snapshots — operators
+debugging a skewed rank need the attribution, not just the totals.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+def _merge_values(kind: str, entries: List[dict]) -> dict:
+    """Merge same-labels children from several ranks into one entry."""
+    out: dict = {"labels": entries[0]["labels"]}
+    if kind == "histogram":
+        buckets: Dict[str, int] = {}
+        for e in entries:
+            for bound, n in e.get("buckets", {}).items():
+                buckets[bound] = buckets.get(bound, 0) + n
+        out["sum"] = sum(e.get("sum", 0.0) for e in entries)
+        out["count"] = sum(e.get("count", 0) for e in entries)
+        out["buckets"] = buckets
+    elif kind == "gauge":
+        vals = [e.get("value", 0.0) for e in entries]
+        out["min"] = min(vals)
+        out["max"] = max(vals)
+        out["mean"] = sum(vals) / len(vals)
+    else:
+        out["value"] = sum(e.get("value", 0.0) for e in entries)
+    return out
+
+
+def merge_snapshots(snapshots: Dict[str, dict]) -> dict:
+    """Merge ``{rank_label: snapshot}`` into one aggregate snapshot.
+
+    ``rank_label`` keys are informational ("0", "1", "launcher", ...);
+    the result has the same shape as a single registry snapshot, with
+    gauge entries replaced by min/max/mean summaries.
+    """
+    merged: Dict[str, dict] = {}
+    collation: Dict[str, Dict[tuple, List[dict]]] = {}
+    kinds: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
+    for snap in snapshots.values():
+        if not isinstance(snap, dict):
+            continue
+        for name, fam in snap.items():
+            kinds.setdefault(name, fam.get("type", "counter"))
+            helps.setdefault(name, fam.get("help", ""))
+            by_labels = collation.setdefault(name, {})
+            for entry in fam.get("values", []):
+                key = tuple(sorted(entry.get("labels", {}).items()))
+                by_labels.setdefault(key, []).append(entry)
+    for name in sorted(collation):
+        merged[name] = {
+            "type": kinds[name],
+            "help": helps[name],
+            "values": [_merge_values(kinds[name], entries)
+                       for _, entries in sorted(collation[name].items())],
+        }
+    return merged
+
+
+def counter_total(snapshot: dict, name: str,
+                  labels: Optional[Dict[str, str]] = None) -> float:
+    """Sum of a counter family's values, optionally filtered to entries
+    whose labels include every pair in ``labels`` (validation helper for
+    tests and the CI telemetry gate)."""
+    fam = snapshot.get(name)
+    if not fam:
+        return 0.0
+    total = 0.0
+    for entry in fam.get("values", []):
+        got = entry.get("labels", {})
+        if labels and any(got.get(k) != v for k, v in labels.items()):
+            continue
+        total += entry.get("value", 0.0)
+    return total

@@ -12,8 +12,25 @@ import logging
 import os
 import sys
 
+# A real TRACE severity below DEBUG (reference logging.h has TRACE as its
+# lowest level; stock python does not).  High-frequency telemetry lines —
+# per-op completions in the native wait path, per-call RPC records — go
+# through ``log.trace`` so HOROVOD_LOG_LEVEL=debug stays readable while
+# HOROVOD_LOG_LEVEL=trace turns on the firehose.
+TRACE = 5
+logging.addLevelName(TRACE, "TRACE")
+
+
+def _trace(self, msg, *args, **kwargs):
+    if self.isEnabledFor(TRACE):
+        self._log(TRACE, msg, args, **kwargs)
+
+
+if not hasattr(logging.Logger, "trace"):
+    logging.Logger.trace = _trace
+
 _LEVELS = {
-    "trace": logging.DEBUG,   # python has no TRACE; map to DEBUG
+    "trace": TRACE,
     "debug": logging.DEBUG,
     "info": logging.INFO,
     "warning": logging.WARNING,

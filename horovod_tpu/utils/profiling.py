@@ -118,6 +118,15 @@ def print_profile(trace_file: str, hlo_text: Optional[str] = None,
     optimized HLO is supplied), normalized per step."""
     durs = device_op_durations(trace_file)
     total = sum(us for us, _ in durs.values())
+    if total == 0:
+        # Every op row had zero/absent duration (e.g. a trace captured
+        # before any step ran, or a backend emitting bare markers) — the
+        # percentage columns below would divide by zero.
+        print(f"device time: 0.00 ms/step — trace {trace_file} contains "
+              f"no timed device ops ({len(durs)} op rows, all with zero "
+              f"duration); capture the trace around at least one "
+              f"executed step")
+        return
     print(f"device time: {total / steps / 1e3:.2f} ms/step "
           f"({len(durs)} distinct ops)")
     print("-- by fusion category --")

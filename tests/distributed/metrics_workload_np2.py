@@ -1,0 +1,40 @@
+"""Telemetry gate workload (run: hvdrun -np 2 with HOROVOD_METRICS_FILE,
+see ci/run_tests.sh and tools/check_metrics.py).
+
+Drives a handful of named eager collectives so every rank's registry
+holds nonzero allreduce counters and latency histograms, then exits
+cleanly — the at-exit exporter pushes the snapshot to the launcher's
+collector and dumps the per-rank JSON.  The launcher merges both into
+the --metrics-file summary, which tools/check_metrics.py validates.
+"""
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import telemetry
+
+hvd.init()
+rank, size = hvd.rank(), hvd.size()
+assert size == 2, f"this workload expects -np 2, got size={size}"
+assert telemetry.enabled(), \
+    "telemetry must be enabled by the launcher-injected env"
+
+for step in range(5):
+    out = hvd.allreduce(np.full(16, float(rank + 1), np.float32),
+                        average=False, name=f"metrics.step{step}")
+    want = float(sum(r + 1 for r in range(size)))
+    assert np.asarray(out).tolist() == [want] * 16, \
+        f"step {step}: expected {want}, got {np.asarray(out)[:4]}"
+
+gathered = hvd.allgather(np.full(4, float(rank), np.float32),
+                         name="metrics.gather")
+assert np.asarray(gathered).shape == (4 * size,)
+
+snap = hvd.metrics_snapshot()
+from horovod_tpu.telemetry import aggregate
+n_allreduce = aggregate.counter_total(snap, "hvd_eager_ops_total",
+                                      {"op": "allreduce"})
+assert n_allreduce >= 5, \
+    f"rank {rank}: expected >=5 allreduce ops recorded, got {n_allreduce}"
+
+print(f"METRICS_WORKLOAD_OK rank={rank} allreduce_ops={int(n_allreduce)}",
+      flush=True)

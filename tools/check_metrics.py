@@ -1,0 +1,99 @@
+"""Validate the artifacts of a metrics-enabled hvdrun job.
+
+Usage::
+
+    python tools/check_metrics.py <metrics_summary.json> [world_size]
+
+Checks (shared by the CI telemetry gate in ci/run_tests.sh and by
+tests/test_telemetry.py's launcher end-to-end test):
+
+* the merged summary exists, is valid JSON, and carries the
+  ``horovod_tpu.metrics.summary.v1`` schema tag;
+* every rank 0..world_size-1 is present in ``ranks`` with a
+  ``horovod_tpu.metrics.v1`` per-rank document, and its standalone
+  ``<base>.rank<k>.json`` dump parses too;
+* the merged ``hvd_eager_ops_total{op="allreduce"}`` counter is nonzero
+  and the matching latency histogram recorded as many observations;
+* per-rank allreduce counters are each nonzero (a rank silently doing
+  no collectives is exactly the regression this gate exists to catch).
+
+Exits 0 and prints ``METRICS_CHECK_OK`` on success; raises on failure.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def _counter_total(snapshot: dict, name: str, labels=None) -> float:
+    total = 0.0
+    for entry in snapshot.get(name, {}).get("values", []):
+        got = entry.get("labels", {})
+        if labels and any(got.get(k) != v for k, v in labels.items()):
+            continue
+        total += entry.get("value", 0.0)
+    return total
+
+
+def _histogram_count(snapshot: dict, name: str, labels=None) -> int:
+    total = 0
+    for entry in snapshot.get(name, {}).get("values", []):
+        got = entry.get("labels", {})
+        if labels and any(got.get(k) != v for k, v in labels.items()):
+            continue
+        total += int(entry.get("count", 0))
+    return total
+
+
+def check(summary_path: str, world_size: int = 2) -> dict:
+    with open(summary_path) as f:
+        doc = json.load(f)
+    assert doc.get("schema") == "horovod_tpu.metrics.summary.v1", \
+        f"bad summary schema: {doc.get('schema')!r}"
+    assert doc.get("world_size") == world_size, \
+        f"summary world_size {doc.get('world_size')} != {world_size}"
+
+    root, ext = os.path.splitext(summary_path)
+    allreduce = {"op": "allreduce"}
+    for rank in range(world_size):
+        rank_doc = doc.get("ranks", {}).get(str(rank))
+        assert rank_doc is not None, f"rank {rank} missing from summary"
+        assert rank_doc.get("schema") == "horovod_tpu.metrics.v1", \
+            f"rank {rank}: bad per-rank schema {rank_doc.get('schema')!r}"
+        assert rank_doc.get("rank") == rank
+        n = _counter_total(rank_doc.get("metrics", {}),
+                           "hvd_eager_ops_total", allreduce)
+        assert n > 0, f"rank {rank}: zero allreduce ops recorded"
+        # The standalone per-rank dump must exist and parse on its own.
+        per_rank = f"{root}.rank{rank}{ext or '.json'}"
+        with open(per_rank) as f:
+            standalone = json.load(f)
+        assert standalone.get("schema") == "horovod_tpu.metrics.v1", \
+            f"{per_rank}: bad schema {standalone.get('schema')!r}"
+
+    merged = doc.get("merged", {})
+    n_ops = _counter_total(merged, "hvd_eager_ops_total", allreduce)
+    assert n_ops > 0, "merged allreduce counter is zero"
+    n_lat = _histogram_count(merged, "hvd_eager_op_seconds", allreduce)
+    assert n_lat == n_ops, \
+        f"latency histogram count {n_lat} != op counter {n_ops}"
+    n_bytes = _counter_total(merged, "hvd_eager_bytes_total", allreduce)
+    assert n_bytes > 0, "merged allreduce byte counter is zero"
+    return {"allreduce_ops": n_ops, "allreduce_bytes": n_bytes}
+
+
+def main(argv) -> int:
+    if not argv:
+        print(__doc__)
+        return 2
+    world_size = int(argv[1]) if len(argv) > 1 else 2
+    totals = check(argv[0], world_size)
+    print(f"METRICS_CHECK_OK {argv[0]}: "
+          f"allreduce_ops={totals['allreduce_ops']:.0f} "
+          f"bytes={totals['allreduce_bytes']:.0f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
