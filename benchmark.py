@@ -1,0 +1,18 @@
+#!/usr/bin/env python
+"""Repo-root entry point for the synthetic benchmark harness.
+
+``python benchmark.py --shard-optimizer`` (etc.) forwards to
+:mod:`horovod_tpu.benchmark` — same flags, same harness; this shim just
+makes the canonical invocation work from a source checkout without
+``python -m``.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from horovod_tpu.benchmark import _main  # noqa: E402
+
+if __name__ == "__main__":
+    _main()

@@ -69,6 +69,17 @@ JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
 python tools/check_metrics.py "$METRICS_DIR/metrics.json" 2
 rm -rf "$METRICS_DIR"
 
+echo "--- ZeRO-1 gate (2 ranks x 8-device virtual mesh): sharded-update
+--- trajectory == replicated, 1/8 per-rank state, merged telemetry shows
+--- hvd_fusion_* + hvd_zero_* (docs/performance.md)"
+ZERO_METRICS_DIR="$(mktemp -d)"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  HOROVOD_METRICS_FILE="$ZERO_METRICS_DIR/metrics.json" \
+  python -m horovod_tpu.runner -np 2 \
+  python tests/distributed/zero_workload_np2.py
+python tools/check_metrics.py "$ZERO_METRICS_DIR/metrics.json" 2
+rm -rf "$ZERO_METRICS_DIR"
+
 echo "--- TSAN build + smoke (races inside libhorovod_tpu.so fail CI)"
 make -C horovod_tpu/native/cc tsan
 rm -f /tmp/tsan_ci.*

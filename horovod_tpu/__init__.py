@@ -28,6 +28,7 @@ Quick start (single host, all local TPU chips)::
     step = hvd.make_training_step(loss_fn, optimizer, mesh)
 """
 
+from horovod_tpu import _jax_compat  # noqa: F401  (must run before SPMD imports)
 from horovod_tpu import basics as _basics
 from horovod_tpu.basics import (
     init,
@@ -95,6 +96,7 @@ from horovod_tpu.parallel.data import (
     broadcast_optimizer_state,
     broadcast_variables,
 )
+from horovod_tpu.parallel.zero import sharded_optimizer
 
 __version__ = "0.5.0"
 
@@ -120,5 +122,6 @@ __all__ = [
     # training
     "Compression", "checkpoint",
     "DistributedOptimizer", "DistributedGradientTape", "make_training_step",
+    "sharded_optimizer",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_variables",
 ]

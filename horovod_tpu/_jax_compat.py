@@ -1,0 +1,78 @@
+"""Compatibility bridge for older JAX releases.
+
+The SPMD plane is written against the current JAX surface — top-level
+``jax.shard_map`` with the ``check_vma`` knob, ``lax.axis_size``,
+``lax.pcast`` / ``jax.typeof`` for varying-manual-axes introspection.
+Older jaxlibs (the jax_graft image pins 0.4.x) ship the same machinery
+under ``jax.experimental.shard_map`` with ``check_rep`` and no vma
+tracking at all.  This module installs faithful aliases for whatever is
+missing, ONCE, at ``import horovod_tpu`` time:
+
+* ``jax.shard_map``   -> ``jax.experimental.shard_map.shard_map``.
+  ``check_vma`` is accepted and dropped (mapped to ``check_rep=False``):
+  0.4.x's replication checker predates several collectives we emit
+  (``psum_scatter``, ``all_to_all`` variants) and rejects valid
+  programs, and vma checking simply does not exist there.  On a JAX
+  that already has ``jax.shard_map`` nothing is touched and the real
+  vma checker runs.
+* ``lax.axis_size``   -> ``lax.psum(1, axis)``, which constant-folds to
+  a static int inside ``shard_map`` on every JAX we support (verified
+  on 0.4.37).
+* ``lax.pcast``       -> identity.  Without vma tracking there is
+  nothing to cast; call sites that compute the missing-axes set get
+  ``{}`` from the guarded ``jax.typeof`` probes and never reach it,
+  so this alias only protects direct callers.
+* ``jax.typeof``      -> ``jax.core.get_aval``.  The returned aval has
+  no ``.vma`` attribute, so the vma-introspecting call sites (which all
+  guard with ``AttributeError``) take their documented no-vma fallback
+  instead of dying on the missing function itself.
+
+Everything here is additive — attributes are installed only when
+absent — so running under a current JAX is a no-op.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import lax
+
+
+def _install() -> None:
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        @functools.wraps(_shard_map)
+        def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                      check_vma=None, **kwargs):
+            del check_vma  # no vma tracking on this JAX; see module docstring
+            kwargs.setdefault("check_rep", False)
+            return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kwargs)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(lax, "axis_size"):
+        def axis_size(axis_name):
+            # psum of the literal 1 constant-folds to a static python int
+            # (the axis sizes are known at trace time).
+            return lax.psum(1, axis_name)
+
+        lax.axis_size = axis_size
+
+    if not hasattr(lax, "pcast"):
+        def pcast(x, axis_name, *, to=None):
+            del axis_name, to
+            return x
+
+        lax.pcast = pcast
+
+    if not hasattr(jax, "typeof"):
+        def typeof(x):
+            return jax.core.get_aval(x)
+
+        jax.typeof = typeof
+
+
+_install()

@@ -368,6 +368,53 @@ def run_synthetic_benchmark(model_name: str = "resnet50",
     }
 
 
+def _device_memory_report(verbose: bool = True) -> list:
+    """Per-device live/peak HBM bytes from ``device.memory_stats()``.
+
+    The PJRT CPU backend reports no memory stats — entries carry ``None``
+    there (the benchmark still runs; only the numbers are TPU-only)."""
+    rows = []
+    for d in jax.local_devices():
+        try:
+            ms = d.memory_stats() or {}
+        except Exception:
+            ms = {}
+        rows.append({
+            "device": str(d),
+            "bytes_in_use": ms.get("bytes_in_use"),
+            "peak_bytes_in_use": ms.get("peak_bytes_in_use"),
+        })
+    if verbose:
+        for r in rows:
+            if r["bytes_in_use"] is None:
+                print(f"  {r['device']}: memory_stats unavailable "
+                      f"(CPU backend)", flush=True)
+            else:
+                peak = r["peak_bytes_in_use"]
+                peak_s = (f", peak {peak / 2**20:,.1f} MiB"
+                          if peak is not None else "")
+                print(f"  {r['device']}: live "
+                      f"{r['bytes_in_use'] / 2**20:,.1f} MiB{peak_s}",
+                      flush=True)
+    return rows
+
+
+def _tree_bytes_per_device(tree) -> Optional[int]:
+    """Bytes one device holds for ``tree``: per-leaf, the first addressable
+    shard's size (a ``P()`` leaf contributes its full size, a ``P(ax)``
+    leaf 1/N — exactly the ZeRO memory story the benchmark reports)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        try:
+            total += leaf.addressable_shards[0].data.nbytes
+        except (AttributeError, IndexError):
+            try:
+                total += leaf.nbytes
+            except AttributeError:
+                return None
+    return total
+
+
 def lm_train_flops(cfg, global_bs: int) -> float:
     """Analytic GLOBAL FLOPs of one LM training step — the standard MFU
     accounting (PaLM appendix-B convention): ``6·N·tokens`` for every
@@ -391,6 +438,7 @@ def run_lm_benchmark(d_model: int = 2048, n_layers: int = 8,
                      num_warmup_batches: int = 2,
                      num_batches_per_iter: int = 8, num_iters: int = 5,
                      learning_rate: float = 1e-4, mesh=None,
+                     shard_optimizer: bool = False,
                      verbose: bool = True) -> dict:
     """Transformer-LM synthetic training benchmark (single chip by
     default) — the compute-bound counterpart to the ResNet harness:
@@ -401,12 +449,19 @@ def run_lm_benchmark(d_model: int = 2048, n_layers: int = 8,
     MFU here uses the ANALYTIC model-FLOPs count (:func:`lm_train_flops`)
     — XLA's cost analysis cannot see inside the Pallas flash kernel, and
     counting remat recompute would inflate the number; the dict carries
-    the raw cost-analysis figure too so the two can be compared."""
+    the raw cost-analysis figure too so the two can be compared.
+
+    ``shard_optimizer=True`` runs the ZeRO-1 sharded-update lane
+    (:mod:`horovod_tpu.parallel.zero`; defaults the mesh to ALL devices —
+    sharding the update on one chip buys nothing) and reports per-device
+    live-memory bytes next to MFU, since memory headroom is half the
+    point of sharding the optimizer state."""
     from horovod_tpu.models import transformer as tfm
 
     if mesh is None:
-        mesh = build_mesh(axes=("data",), shape=(1,),
-                          devices=jax.devices()[:1])
+        devices = jax.devices() if shard_optimizer else jax.devices()[:1]
+        mesh = build_mesh(axes=("data",), shape=(len(devices),),
+                          devices=devices)
     n_chips = mesh_size(mesh)
     global_bs = batch_size * n_chips
     on_cpu = mesh.devices.ravel()[0].platform == "cpu"
@@ -427,13 +482,15 @@ def run_lm_benchmark(d_model: int = 2048, n_layers: int = 8,
     steps_per_call = max(num_batches_per_iter, 1)
     step, specs, opt_specs = tfm.make_train_step(
         cfg, optimizer, mesh, data_axis="data", attention=attention,
-        remat=remat, steps_per_call=steps_per_call)
+        remat=remat, steps_per_call=steps_per_call,
+        shard_optimizer=shard_optimizer)
 
     params = tfm.init_params(jax.random.PRNGKey(0), cfg)
     params = jax.device_put(params, jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s), specs))
+    init_state = step.init if shard_optimizer else optimizer.init
     opt_state = jax.device_put(
-        optimizer.init(params), jax.tree_util.tree_map(
+        init_state(params), jax.tree_util.tree_map(
             lambda s: NamedSharding(mesh, s), opt_specs,
             is_leaf=lambda x: isinstance(x, P)))
 
@@ -459,7 +516,8 @@ def run_lm_benchmark(d_model: int = 2048, n_layers: int = 8,
     if verbose:
         print(f"LM: d_model={d_model} n_layers={n_layers} d_ff="
               f"{cfg.d_ff} vocab={vocab_size} T={seq_len} "
-              f"batch={global_bs} attention={attention} remat={remat}",
+              f"batch={global_bs} attention={attention} remat={remat} "
+              f"shard_optimizer={shard_optimizer} chips={n_chips}",
               flush=True)
         print(f"Analytic {flops_per_step / 1e12:.2f} TFLOP/step "
               f"({flops_per_step / (global_bs * seq_len) / 1e6:.1f} "
@@ -487,22 +545,33 @@ def run_lm_benchmark(d_model: int = 2048, n_layers: int = 8,
     tflops_per_chip = flops_per_step * steps_per_sec / n_chips / 1e12
     peak = device_peak_tflops(mesh.devices.ravel()[0])
     mfu = tflops_per_chip / peak if peak else None
+    opt_bytes = _tree_bytes_per_device(opt_state)
     if verbose:
         mfu_s = f", MFU {mfu * 100:.1f}%" if mfu is not None else ""
         print(f"{tok_sec_mean / n_chips:,.0f} tok/sec/chip, "
               f"{tflops_per_chip:.1f} TFLOP/s per chip{mfu_s}",
               flush=True)
+        if opt_bytes is not None:
+            print(f"Optimizer state per device: {opt_bytes / 2**20:,.1f} "
+                  f"MiB" + (" (ZeRO-1 sharded 1/%d)" % n_chips
+                            if shard_optimizer else " (replicated)"),
+                  flush=True)
+        print("Per-device memory:", flush=True)
+    memory = _device_memory_report(verbose=verbose)
     return {
         "d_model": d_model, "n_layers": n_layers, "d_ff": cfg.d_ff,
         "n_heads": n_heads, "vocab_size": vocab_size,
         "seq_len": seq_len, "batch_size": global_bs,
         "attention": attention, "remat": remat,
+        "shard_optimizer": shard_optimizer, "n_chips": n_chips,
         "tok_sec_per_chip": tok_sec_mean / n_chips,
         "tok_sec_conf": float(1.96 * np.std(tok_secs)) / n_chips,
         "flops_per_step_analytic": flops_per_step,
         "flops_per_step_xla": xla_flops,
         "tflops_per_chip": tflops_per_chip,
         "mfu": mfu,
+        "opt_state_bytes_per_device": opt_bytes,
+        "memory": memory,
         "loss": float(np.asarray(loss)),
     }
 
@@ -676,13 +745,50 @@ def _main():
                              "per-layer device-time breakdown")
     parser.add_argument("--stem", default="conv7",
                         choices=("conv7", "s2d", "s2d_fused"))
+    parser.add_argument("--lm", action="store_true",
+                        help="run the transformer-LM lane instead of the "
+                             "ResNet harness")
+    parser.add_argument("--shard-optimizer", action="store_true",
+                        help="LM lane with the ZeRO-1 sharded update over "
+                             "all devices (reports MFU + per-device "
+                             "live-memory bytes)")
+    parser.add_argument("--d-model", type=int, default=None)
+    parser.add_argument("--n-layers", type=int, default=None)
+    parser.add_argument("--seq-len", type=int, default=None)
+    parser.add_argument("--vocab-size", type=int, default=None)
     args = parser.parse_args()
 
     kwargs = dict(image_size=args.image_size,
                   num_warmup_batches=args.num_warmup_batches,
                   num_batches_per_iter=args.num_batches_per_iter,
                   num_iters=args.num_iters)
-    if args.profile:
+    if args.lm or args.shard_optimizer:
+        lm_kwargs = dict(num_warmup_batches=args.num_warmup_batches,
+                         num_batches_per_iter=args.num_batches_per_iter,
+                         num_iters=args.num_iters,
+                         shard_optimizer=args.shard_optimizer)
+        if jax.devices()[0].platform == "cpu":
+            # CPU run = plumbing smoke (MFU needs real chips): downsize to
+            # a config the interpreter finishes in seconds, dense
+            # attention (no Pallas on CPU).
+            lm_kwargs.update(d_model=128, n_layers=2, n_heads=4,
+                             d_ff=256, vocab_size=512, seq_len=64,
+                             batch_size=2, attention="dense",
+                             num_batches_per_iter=min(
+                                 args.num_batches_per_iter, 2),
+                             num_iters=min(args.num_iters, 3))
+        for k, v in (("d_model", args.d_model),
+                     ("n_layers", args.n_layers),
+                     ("seq_len", args.seq_len),
+                     ("vocab_size", args.vocab_size)):
+            if v is not None:
+                lm_kwargs[k] = v
+        # --batch-size is the ResNet knob (default 64); the LM lane keeps
+        # its own default of 8/chip unless the flag was set explicitly.
+        bs = lm_kwargs.pop("batch_size",
+                           args.batch_size if args.batch_size != 64 else 8)
+        run_lm_benchmark(batch_size=bs, **lm_kwargs)
+    elif args.profile:
         run_profile(args.model, args.batch_size, args.image_size,
                     steps=args.num_batches_per_iter, stem=args.stem)
     elif args.efficiency:

@@ -56,6 +56,35 @@ def _tree_broadcast(tree: Any, root_rank: int, name_prefix: str) -> Any:
     return jax.tree_util.tree_unflatten(treedef, out_leaves)
 
 
+def _gather_zero(state: Any) -> Any:
+    """Replace every ZeRO-1 sharded optimizer state in ``state`` with the
+    equivalent REPLICATED optax state (full per-leaf pytree).
+
+    Checkpoints are written in this layout, so they are independent of the
+    mesh the run happened to use: a 8-way-sharded run's checkpoint restores
+    into a 32-way (or replicated) run unchanged."""
+    from horovod_tpu.parallel import zero
+    return jax.tree_util.tree_map(
+        lambda x: zero.gather_full_state(x) if zero.is_zero_state(x) else x,
+        state, is_leaf=zero.is_zero_state)
+
+
+def _scatter_zero(state: Any, template: Any) -> Any:
+    """Inverse of :func:`_gather_zero` on restore: wherever ``template``
+    holds a ZeRO-1 sharded state, re-shard the restored replicated-layout
+    subtree into the template's flat-bucket layout (the template — the
+    freshly ``init``-ed state — supplies the bucketing plan for THIS
+    mesh, which may differ from the mesh that saved)."""
+    from horovod_tpu.parallel import zero
+    leaves = jax.tree_util.tree_leaves(template, is_leaf=zero.is_zero_state)
+    if not any(zero.is_zero_state(l) for l in leaves):
+        return state
+    return jax.tree_util.tree_map(
+        lambda t, s: zero.scatter_full_state(s, like=t)
+        if zero.is_zero_state(t) else s,
+        template, state, is_leaf=zero.is_zero_state)
+
+
 def _valid_steps(ckpt_dir: str) -> list:
     """Step numbers with a finalized checkpoint directory, ascending.
 
@@ -97,10 +126,16 @@ def save(ckpt_dir: str, state: Any, step: int = 0,
     """Write ``state`` (a pytree) to ``ckpt_dir/<step>``; rank 0 only, all
     ranks barrier afterwards so no rank races ahead and reads a
     half-written checkpoint.  Returns the checkpoint path on rank 0,
-    None elsewhere."""
+    None elsewhere.
+
+    ZeRO-1 sharded optimizer states (``shard_optimizer=True`` /
+    ``hvd.sharded_optimizer``) are gathered to the replicated per-leaf
+    layout before writing, so checkpoints stay layout-independent — see
+    :func:`_gather_zero`."""
     path = None
     if basics.rank() == 0:
         import orbax.checkpoint as ocp
+        state = _gather_zero(state)
         ckpt_dir = os.path.abspath(ckpt_dir)
         t0 = telemetry.clock()
         with ocp.CheckpointManager(
@@ -128,8 +163,18 @@ def restore(ckpt_dir: str, state_template: Any,
             step: Optional[int] = None, root_rank: int = 0) -> Any:
     """Restore the latest (or ``step``-th) checkpoint on ``root_rank`` and
     broadcast it to every rank.  ``state_template`` supplies the pytree
-    structure/shapes/dtypes (pass the freshly-initialized state)."""
-    state = state_template
+    structure/shapes/dtypes (pass the freshly-initialized state).
+
+    ZeRO-1 sharded optimizer states in the template are restored from the
+    checkpoint's replicated per-leaf layout and re-sharded into the
+    template's flat-bucket layout for THIS mesh (see :func:`_scatter_zero`)
+    — a checkpoint saved N-way-sharded (or replicated) restores into any
+    mesh size.  Re-place the result (``step.state_shardings`` /
+    ``jax.device_put``) before training."""
+    # Restore + broadcast run in the layout-independent replicated format;
+    # conversion back to the sharded layout happens once at the end.
+    portable_template = _gather_zero(state_template)
+    state = portable_template
     found = np.zeros(1, np.int32)
     t0 = telemetry.clock()
     if basics.rank() == root_rank:
@@ -145,13 +190,13 @@ def restore(ckpt_dir: str, state_template: Any,
                 with ocp.CheckpointManager(ckpt_dir) as mgr:
                     state = mgr.restore(
                         use_step,
-                        args=ocp.args.StandardRestore(state_template))
+                        args=ocp.args.StandardRestore(portable_template))
                 found[0] = 1
                 log.info("restored checkpoint step %s from %s",
                          use_step, ckpt_dir)
                 break
             except Exception as e:  # noqa: BLE001 — skip-and-warn contract
-                state = state_template
+                state = portable_template
                 log.warning(
                     "skipping unrestorable checkpoint step %s in %s "
                     "(%s: %s); %s", use_step, ckpt_dir,
@@ -164,6 +209,7 @@ def restore(ckpt_dir: str, state_template: Any,
         if int(found[0]):
             state = _tree_broadcast(state, root_rank,
                                     "hvd.checkpoint.restore")
+    state = _scatter_zero(state, state_template)
     if telemetry.enabled():
         telemetry.counter(
             "hvd_checkpoint_restores_total",

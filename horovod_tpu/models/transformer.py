@@ -18,6 +18,7 @@ bf16 matmuls / fp32 params+softmax, MXU-friendly dims.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional, Tuple
 
 import jax
@@ -293,7 +294,8 @@ def make_train_step(cfg: TransformerConfig, optimizer, mesh,
                     donate: bool = True,
                     packed: bool = False,
                     remat: str = "none",
-                    steps_per_call: int = 1):
+                    steps_per_call: int = 1,
+                    shard_optimizer: bool = False):
     """Jitted SPMD training step over dp x tp x sp.
 
     Returns ``step(params, opt_state, tokens, labels) ->
@@ -309,20 +311,44 @@ def make_train_step(cfg: TransformerConfig, optimizer, mesh,
     the benchmark's dispatch-amortization shape (the ResNet harness's
     rationale at ``benchmark.make_train_step``; not for real training,
     which wants a fresh batch per step).
+
+    ``shard_optimizer=True`` runs the ZeRO-1 sharded update
+    (:mod:`horovod_tpu.parallel.zero`): reduce-scatter gradients over the
+    data axis, optimizer step on this rank's 1/N flat shard, all-gather
+    the updates.  Pure data parallelism only (params must be replicated,
+    so ``model_axis``/``seq_axis`` must be ``None``).  The returned step
+    additionally carries ``step.init`` (build the sharded-layout state
+    from params) and ``step.optimizer`` (the ``ShardedOptimizer``).
     """
     from horovod_tpu.ops.fusion import fused_pytree_mean
 
     specs = param_specs(cfg, model_axis)
     grad_axes = tuple(a for a in (data_axis, seq_axis) if a)
 
+    zopt = None
+    if shard_optimizer:
+        if model_axis or seq_axis:
+            raise NotImplementedError(
+                "shard_optimizer=True composes with pure data parallelism "
+                "only (ZeRO-1 slices replicated params); got "
+                f"model_axis={model_axis!r}, seq_axis={seq_axis!r}")
+        from horovod_tpu.parallel import zero
+        zopt = zero.sharded_optimizer(
+            optimizer, data_axis, axis_size=int(mesh.shape[data_axis]))
+
     def _one_step(params, opt_state, tokens, labels, segment_ids=None):
         loss, grads = jax.value_and_grad(loss_fn)(
             params, tokens, labels, cfg, model_axis, seq_axis, attention,
             segment_ids, remat)
-        # DP gradient averaging (fused psum) over data (+seq) axes; TP/f-op
-        # already settled the model axis.
-        grads = fused_pytree_mean(grads, grad_axes)
-        updates, new_opt = optimizer.update(grads, opt_state, params)
+        if zopt is not None:
+            # ZeRO-1: the mean happens on the reduce-scattered 1/N shard
+            # inside the sharded update — no separate fused pmean pass.
+            updates, new_opt = zopt.update(grads, opt_state, params)
+        else:
+            # DP gradient averaging (fused psum) over data (+seq) axes;
+            # TP/f-op already settled the model axis.
+            grads = fused_pytree_mean(grads, grad_axes)
+            updates, new_opt = optimizer.update(grads, opt_state, params)
         new_params = jax.tree_util.tree_map(lambda p, u: p + u, params,
                                             updates)
         return new_params, new_opt, lax.pmean(loss, grad_axes)
@@ -343,11 +369,17 @@ def make_train_step(cfg: TransformerConfig, optimizer, mesh,
     # param's spec; everything else (step counters, empty states) is
     # replicated.  tree_map_params aligns by optimizer structure, so
     # distinct params that happen to share a shape cannot be confused.
+    # In sharded mode the param-like leaves are flat bucket vectors
+    # partitioned 1/N over the data axis instead.
     import optax
-    opt_state_shapes = jax.eval_shape(optimizer.init, init_abstract(cfg))
-    opt_specs = optax.tree_map_params(
-        optimizer, lambda _leaf, spec: spec, opt_state_shapes, specs,
-        transform_non_params=lambda _leaf: P())
+    if zopt is not None:
+        opt_state_shapes = jax.eval_shape(zopt.init, init_abstract(cfg))
+        opt_specs = zopt.state_specs(opt_state_shapes)
+    else:
+        opt_state_shapes = jax.eval_shape(optimizer.init, init_abstract(cfg))
+        opt_specs = optax.tree_map_params(
+            optimizer, lambda _leaf, spec: spec, opt_state_shapes, specs,
+            transform_non_params=lambda _leaf: P())
 
     data_spec = P(data_axis, seq_axis) if seq_axis else P(data_axis)
     in_specs = (specs, opt_specs, data_spec, data_spec)
@@ -357,9 +389,22 @@ def make_train_step(cfg: TransformerConfig, optimizer, mesh,
         _step, mesh=mesh,
         in_specs=in_specs,
         out_specs=(specs, opt_specs, P()),
-        check_vma=True)
-    return jax.jit(step, donate_argnums=(0, 1) if donate else ()), specs, \
-        opt_specs
+        # The ZeRO path's axis_index-dependent slicing + psum_scatter do
+        # not type under the vma checker; the plain path keeps it on.
+        check_vma=zopt is None)
+    jitted = jax.jit(step, donate_argnums=(0, 1) if donate else ())
+    if zopt is not None:
+        @functools.wraps(jitted)
+        def wrapped(*a, **kw):
+            return jitted(*a, **kw)
+        wrapped.lower = jitted.lower
+        wrapped.jitted = jitted
+        wrapped.init = zopt.init
+        wrapped.optimizer = zopt
+        wrapped.state_shardings = functools.partial(zopt.state_shardings,
+                                                    mesh)
+        return wrapped, specs, opt_specs
+    return jitted, specs, opt_specs
 
 
 def init_abstract(cfg: TransformerConfig):

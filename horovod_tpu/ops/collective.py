@@ -690,24 +690,42 @@ def allreduce_async_(tensor, average=None, name=None, op=None, **kw):
     return allreduce_async(tensor, average=average, name=name, op=op, **kw)
 
 
-def grouped_allreduce(tensors, average=None, name=None, op=None, axis_name=None):
+def grouped_allreduce(tensors, average=None, name=None, op=None, axis_name=None,
+                      prescale_factor=1.0, postscale_factor=1.0,
+                      process_set=None):
     """Reduce a list of tensors as one logical request.  SPMD plane: a single
     fused ``psum`` over the flattened concatenation (the moral equivalent of
-    the fusion buffer, reference ``fusion_buffer_manager.{h,cc}``)."""
+    the fusion buffer, reference ``fusion_buffer_manager.{h,cc}``).
+
+    ``prescale_factor``/``postscale_factor``/``process_set`` follow
+    :func:`allreduce`: scaling is applied inside the fused path (once per
+    flat bucket, around the wire reduction); process sets are an eager-plane
+    concept and are rejected inside an SPMD axis exactly like ``allreduce``.
+    """
     rop = _resolve_op(op, average)
     if not tensors:
         return []
     ax = _default_axis(axis_name)
+    _reject_spmd_process_set(process_set, ax)
     if _axis_bound(ax):
         if rop is Adasum:
             raise NotImplementedError(
                 "op=Adasum is implemented on the eager plane only; see "
                 "hvd.allreduce")
         from horovod_tpu.ops.fusion import fused_psum
-        return fused_psum(tensors, ax, mean=rop is Average)
+        return fused_psum(tensors, ax, mean=rop is Average,
+                          prescale_factor=prescale_factor,
+                          postscale_factor=postscale_factor)
     if any(_is_traced(t) for t in tensors):
-        return [_plain_jit_fallback(t, "grouped_allreduce") for t in tensors]
-    return [allreduce(t, name=f"{_auto_name('grouped', name)}.{i}", op=rop)
+        out = [_plain_jit_fallback(t, "grouped_allreduce") for t in tensors]
+        scale = prescale_factor * postscale_factor
+        if scale != 1.0:
+            out = [t * scale for t in out]
+        return out
+    return [allreduce(t, name=f"{_auto_name('grouped', name)}.{i}", op=rop,
+                      prescale_factor=prescale_factor,
+                      postscale_factor=postscale_factor,
+                      process_set=process_set)
             for i, t in enumerate(tensors)]
 
 

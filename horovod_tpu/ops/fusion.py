@@ -14,12 +14,23 @@ are static at trace time, fusion here is *ahead-of-time bucketing* of a
 gradient pytree: group leaves by dtype into buckets up to the threshold,
 concatenate into one flat vector per bucket, one ``psum`` per bucket,
 then split back.  No runtime buffer management is needed — XLA owns memory.
+
+Fusion v2 adds the sharded-update wire format (ZeRO-1, Rajbhandari et al.
+SC'20; Xu et al. 2020 automatic weight-update sharding): the same bucketing
+walk, but each flat bucket is padded to an axis-size multiple and
+**reduce-scattered** (``lax.psum_scatter``) so every rank keeps only its
+1/N shard — same ring wire bytes as an allreduce's reduce-scatter phase —
+and re-materialized later with ``lax.all_gather`` + unpad/split
+(:func:`fused_all_gather`).  :mod:`horovod_tpu.parallel.zero` builds the
+sharded optimizer update on top of exactly this pair.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import os
-from typing import List, Sequence
+import re
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -27,14 +38,55 @@ import numpy as np
 from jax import lax
 
 from horovod_tpu import telemetry
+from horovod_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
 
 # Reference default: 64 MB (operations.cc:379); same env knob name.
 DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024
 
+_SIZE_SUFFIXES = {
+    "": 1, "b": 1,
+    "k": 1024, "kb": 1024, "kib": 1024,
+    "m": 1024 ** 2, "mb": 1024 ** 2, "mib": 1024 ** 2,
+    "g": 1024 ** 3, "gb": 1024 ** 3, "gib": 1024 ** 3,
+}
+
+_warned_bad_threshold = False
+
+
+def parse_size_bytes(value: str) -> Optional[int]:
+    """``"64mb"`` / ``"32MiB"`` / ``"67108864"`` -> bytes, or None when the
+    string is not a size.  Decimal multipliers are intentionally absent:
+    Horovod's knob has always been binary (64 MB == 2**26)."""
+    m = re.fullmatch(r"\s*(\d+(?:\.\d+)?)\s*([a-zA-Z]*)\s*", str(value))
+    if not m:
+        return None
+    mult = _SIZE_SUFFIXES.get(m.group(2).lower())
+    if mult is None:
+        return None
+    return int(float(m.group(1)) * mult)
+
 
 def fusion_threshold_bytes() -> int:
+    """The fusion bucket limit from ``HOROVOD_FUSION_THRESHOLD`` (bytes, or
+    with a ``kb``/``mb``/``MiB``-style binary suffix).  An unparseable value
+    falls back to the 64 MB default with a one-time warning — a typo in an
+    env var must not surface as a ``ValueError`` deep inside a jit trace."""
+    global _warned_bad_threshold
     v = os.environ.get("HOROVOD_FUSION_THRESHOLD")
-    return int(v) if v else DEFAULT_FUSION_THRESHOLD
+    if not v:
+        return DEFAULT_FUSION_THRESHOLD
+    parsed = parse_size_bytes(v)
+    if parsed is None:
+        if not _warned_bad_threshold:
+            _warned_bad_threshold = True
+            log.warning(
+                "HOROVOD_FUSION_THRESHOLD=%r is not a byte size (expected "
+                "e.g. 67108864, 64mb or 32MiB); using the default %d bytes",
+                v, DEFAULT_FUSION_THRESHOLD)
+        return DEFAULT_FUSION_THRESHOLD
+    return parsed
 
 
 def _vma_key(leaf):
@@ -76,60 +128,250 @@ def _bucket_leaves(leaves, threshold: int):
     return buckets
 
 
-def fused_psum(tensors: Sequence[jax.Array], axis_name: str,
-               mean: bool = True, threshold: int | None = None):
+def _record_buckets(kind: str, tensors, buckets, pad_bytes: int = 0):
+    """Trace-time fusion telemetry.  Bucketing happens when the step is
+    TRACED (shapes are static under jit), so these count fusion DECISIONS,
+    not per-step traffic — per-step wire volume is trace counts x bucket
+    bytes."""
+    if not telemetry.enabled():
+        return
+    telemetry.counter(
+        "hvd_fusion_requests_total",
+        "Fusion walks (trace-time bucketing decisions)", kind=kind).inc()
+    telemetry.counter(
+        "hvd_fusion_buckets_total",
+        "Fusion buckets produced across all fusion walks", kind=kind).inc(
+        len(buckets))
+    telemetry.counter(
+        "hvd_fusion_tensors_total",
+        "Tensors routed through the fusion walks", kind=kind).inc(
+        len(tensors))
+    hist = telemetry.histogram(
+        "hvd_fusion_bucket_bytes",
+        "Per-bucket payload size produced by the fusion walk",
+        bounds=telemetry.DEFAULT_BYTE_BUCKETS)
+    for bucket in buckets:
+        hist.observe(float(sum(
+            int(np.prod(tensors[i].shape)) * tensors[i].dtype.itemsize
+            for i in bucket)))
+    if pad_bytes:
+        telemetry.counter(
+            "hvd_fusion_pad_bytes_total",
+            "Bytes of axis-size padding added to reduce-scatter buckets "
+            "(padding waste)", kind=kind).inc(pad_bytes)
+
+
+def fused_psum(tensors: Sequence[jax.Array], axis_name,
+               mean: bool = True, threshold: int | None = None,
+               prescale_factor: float = 1.0, postscale_factor: float = 1.0):
     """Allreduce a list of (traced) tensors with bucketed fusion.
 
-    Returns reduced tensors in the original order.
+    Returns reduced tensors in the original order.  ``prescale_factor`` /
+    ``postscale_factor`` are applied to the flat bucket around the wire
+    reduction (one multiply per bucket, not per leaf) — the fused rendition
+    of ``allreduce``'s scaling knobs.
     """
     tensors = list(tensors)
     if not tensors:
         return []
     threshold = fusion_threshold_bytes() if threshold is None else threshold
     buckets = _bucket_leaves(tensors, threshold)
-    if telemetry.enabled():
-        # Bucketing happens at TRACE time (shapes are static under jit),
-        # so these count fusion DECISIONS, not per-step traffic — the
-        # per-step wire volume is trace counts x bucket bytes.
-        telemetry.counter(
-            "hvd_fusion_requests_total",
-            "fused_psum calls (trace-time bucketing decisions)").inc()
-        telemetry.counter(
-            "hvd_fusion_buckets_total",
-            "Fusion buckets produced across all fused_psum calls").inc(
-            len(buckets))
-        telemetry.counter(
-            "hvd_fusion_tensors_total",
-            "Tensors routed through fused_psum").inc(len(tensors))
-        hist = telemetry.histogram(
-            "hvd_fusion_bucket_bytes",
-            "Per-bucket payload size produced by the fusion walk",
-            bounds=telemetry.DEFAULT_BYTE_BUCKETS)
-        for bucket in buckets:
-            hist.observe(float(sum(
-                int(np.prod(tensors[i].shape)) * tensors[i].dtype.itemsize
-                for i in bucket)))
+    _record_buckets("psum", tensors, buckets)
+    reduce = lax.pmean if mean else lax.psum
     out: List = [None] * len(tensors)
     for bucket in buckets:
         if len(bucket) == 1:
             i = bucket[0]
-            r = lax.pmean(tensors[i], axis_name) if mean \
-                else lax.psum(tensors[i], axis_name)
+            t = tensors[i]
+            if prescale_factor != 1.0:
+                t = t * prescale_factor
+            r = reduce(t, axis_name)
+            if postscale_factor != 1.0:
+                r = r * postscale_factor
             out[i] = r
             continue
+        # One 1-D reshape per leaf, one concat, one reduce, ONE split at
+        # precomputed offsets — K reshapes instead of K dynamic-slice-shaped
+        # gathers in the emitted trace.
+        sizes = [int(np.prod(tensors[i].shape)) for i in bucket]
+        offsets = np.cumsum(sizes[:-1]).tolist()
         flat = jnp.concatenate([tensors[i].reshape(-1) for i in bucket])
-        red = lax.pmean(flat, axis_name) if mean else lax.psum(flat, axis_name)
-        off = 0
-        for i in bucket:
-            n = int(np.prod(tensors[i].shape))
-            out[i] = red[off:off + n].reshape(tensors[i].shape)
-            off += n
+        if prescale_factor != 1.0:
+            flat = flat * prescale_factor
+        red = reduce(flat, axis_name)
+        if postscale_factor != 1.0:
+            red = red * postscale_factor
+        for i, part in zip(bucket, jnp.split(red, offsets)):
+            out[i] = part.reshape(tensors[i].shape)
     return out
 
 
-def fused_pytree_mean(tree, axis_name: str, threshold: int | None = None):
+def fused_pytree_mean(tree, axis_name, threshold: int | None = None):
     """Average a gradient pytree across ``axis_name`` with fusion — the core
     of :class:`horovod_tpu.parallel.data.DistributedOptimizer`'s jit path."""
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     reduced = fused_psum(leaves, axis_name, mean=True, threshold=threshold)
     return jax.tree_util.tree_unflatten(treedef, reduced)
+
+
+# ---------------------------------------------------------------------------
+# Fusion v2: the reduce-scatter / all-gather pair (the sharded-update wire
+# format).  A ring allreduce IS reduce-scatter + all-gather; splitting the
+# two phases apart lets the optimizer update run on the 1/N shard in
+# between (ZeRO-1) for the same total wire bytes.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ReduceScatterPlan:
+    """Static (hashable) description of one fusion walk over a fixed leaf
+    list, including the per-bucket padding to an axis-size multiple.
+
+    Built once at trace (or setup) time from leaf shapes; the plan is what
+    makes ``fused_reduce_scatter`` -> ``fused_all_gather`` a lossless round
+    trip, and what :mod:`horovod_tpu.parallel.zero` uses to keep gradient
+    shards, parameter shards and optimizer-state shards aligned.
+    """
+    buckets: Tuple[Tuple[int, ...], ...]       # leaf indices per bucket
+    shapes: Tuple[Tuple[int, ...], ...]        # per-leaf shapes
+    dtypes: Tuple[str, ...]                    # per-leaf dtype names
+    axis_size: int
+
+    # -- static geometry ---------------------------------------------------
+    def leaf_size(self, i: int) -> int:
+        return int(np.prod(self.shapes[i]))
+
+    def bucket_size(self, b: int) -> int:
+        """Unpadded element count of bucket ``b``."""
+        return sum(self.leaf_size(i) for i in self.buckets[b])
+
+    def padded_size(self, b: int) -> int:
+        """Bucket size rounded up to a multiple of ``axis_size``."""
+        n, a = self.bucket_size(b), self.axis_size
+        return -(-n // a) * a if n else a  # empty bucket still scatters
+
+    def shard_size(self, b: int) -> int:
+        return self.padded_size(b) // self.axis_size
+
+    def pad_elems(self, b: int) -> int:
+        return self.padded_size(b) - self.bucket_size(b)
+
+    def bucket_dtype(self, b: int):
+        return jnp.dtype(self.dtypes[self.buckets[b][0]])
+
+    @property
+    def n_leaves(self) -> int:
+        return len(self.shapes)
+
+    def total_pad_bytes(self) -> int:
+        return sum(self.pad_elems(b) * self.bucket_dtype(b).itemsize
+                   for b in range(len(self.buckets)))
+
+    # -- flat-buffer plumbing ---------------------------------------------
+    def concat(self, leaves) -> List[jax.Array]:
+        """Leaves -> one padded 1-D buffer per bucket (trace-safe)."""
+        if len(leaves) != self.n_leaves:
+            raise ValueError(f"plan describes {self.n_leaves} leaves, got "
+                             f"{len(leaves)}")
+        flats = []
+        for b, bucket in enumerate(self.buckets):
+            parts = [leaves[i].reshape(-1) for i in bucket]
+            pad = self.pad_elems(b)
+            if pad or not parts:
+                parts.append(jnp.zeros((pad if parts else self.padded_size(b),),
+                                       self.bucket_dtype(b)))
+            flats.append(parts[0] if len(parts) == 1
+                         else jnp.concatenate(parts))
+        return flats
+
+    def split(self, flats) -> List[jax.Array]:
+        """Padded per-bucket 1-D buffers -> leaves in ORIGINAL order."""
+        if len(flats) != len(self.buckets):
+            raise ValueError(f"plan has {len(self.buckets)} buckets, got "
+                             f"{len(flats)} buffers")
+        out: List = [None] * self.n_leaves
+        for b, bucket in enumerate(self.buckets):
+            flat = flats[b][:self.bucket_size(b)]
+            sizes = [self.leaf_size(i) for i in bucket]
+            offsets = np.cumsum(sizes[:-1]).tolist()
+            for i, part in zip(bucket, jnp.split(flat, offsets)):
+                out[i] = part.reshape(self.shapes[i])
+        return out
+
+    def shard_slice(self, b: int, flat, index):
+        """This rank's shard of bucket ``b``'s full padded buffer (``index``
+        may be a traced ``lax.axis_index``)."""
+        s = self.shard_size(b)
+        return lax.dynamic_slice_in_dim(flat, index * s, s, axis=0)
+
+
+def _resolve_axis_size(axis_name, axis_size: Optional[int]) -> int:
+    if axis_size is not None:
+        return int(axis_size)
+    names = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    return int(np.prod([lax.axis_size(a) for a in names]))
+
+
+def make_reduce_scatter_plan(leaves, axis_size: int,
+                             threshold: int | None = None
+                             ) -> ReduceScatterPlan:
+    """Run the fusion bucketing walk over ``leaves`` (arrays or
+    ShapeDtypeStructs) and freeze it, with per-bucket padding geometry for
+    an ``axis_size``-way reduce-scatter."""
+    leaves = list(leaves)
+    threshold = fusion_threshold_bytes() if threshold is None else threshold
+    buckets = _bucket_leaves(leaves, threshold)
+    return ReduceScatterPlan(
+        buckets=tuple(tuple(b) for b in buckets),
+        shapes=tuple(tuple(int(d) for d in l.shape) for l in leaves),
+        dtypes=tuple(str(jnp.dtype(l.dtype)) for l in leaves),
+        axis_size=int(axis_size))
+
+
+def fused_reduce_scatter(tensors: Sequence[jax.Array], axis_name,
+                         mean: bool = True, threshold: int | None = None,
+                         plan: Optional[ReduceScatterPlan] = None,
+                         axis_size: Optional[int] = None):
+    """Reduce-scatter a list of (traced) tensors with bucketed fusion.
+
+    Each dtype/vma-homogeneous bucket is flattened, padded to an axis-size
+    multiple and ``lax.psum_scatter``-ed, so the caller keeps only this
+    rank's ``1/axis_size`` shard of each bucket — half of a ring allreduce,
+    wire-byte-wise.  Returns ``(shards, plan)``; feed both to
+    :func:`fused_all_gather` to re-materialize the full tensors (the other
+    half), or run a sharded optimizer update in between
+    (:mod:`horovod_tpu.parallel.zero`).
+
+    ``mean=True`` divides by the axis size (applied on the 1/N shard, where
+    it is N-times cheaper than on the full buffer).
+    """
+    tensors = list(tensors)
+    if plan is None:
+        n = _resolve_axis_size(axis_name, axis_size)
+        plan = make_reduce_scatter_plan(tensors, n, threshold)
+    if not tensors:
+        return [], plan
+    _record_buckets("reduce_scatter", tensors, plan.buckets,
+                    pad_bytes=plan.total_pad_bytes())
+    shards = []
+    inv = 1.0 / plan.axis_size
+    for b, flat in enumerate(plan.concat(tensors)):
+        shard = lax.psum_scatter(flat, axis_name, scatter_dimension=0,
+                                 tiled=True)
+        if mean:
+            shard = shard * jnp.asarray(inv, shard.dtype)
+        shards.append(shard)
+    return shards, plan
+
+
+def fused_all_gather(shards: Sequence[jax.Array],
+                     plan: ReduceScatterPlan, axis_name):
+    """Inverse of :func:`fused_reduce_scatter`: all-gather every bucket's
+    per-rank shard back to the full padded buffer, strip the padding and
+    split back into tensors in the ORIGINAL leaf order."""
+    shards = list(shards)
+    if len(shards) != len(plan.buckets):
+        raise ValueError(f"plan has {len(plan.buckets)} buckets, got "
+                         f"{len(shards)} shards")
+    flats = [lax.all_gather(s, axis_name, axis=0, tiled=True)
+             for s in shards]
+    return plan.split(flats)

@@ -92,7 +92,9 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          compression=Compression.none,
                          backward_passes_per_step: int = 1,
                          op=collective.Average,
-                         axis_name: str = "data") -> optax.GradientTransformation:
+                         axis_name: str = "data",
+                         sharded_update: bool = False,
+                         mesh: Optional[Mesh] = None) -> optax.GradientTransformation:
     """Wrap an optax optimizer so gradients are averaged across all workers
     before the update — API parity with reference
     ``hvd.DistributedOptimizer`` (``tensorflow/__init__.py:230-320``,
@@ -102,8 +104,34 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     signature parity; gradient accumulation in JAX is expressed by the caller
     (e.g. ``optax.MultiSteps``) and is composed automatically when
     ``backward_passes_per_step > 1``.
+
+    ``sharded_update=True`` returns the ZeRO-1 wrapper instead
+    (:mod:`horovod_tpu.parallel.zero`): gradients are reduce-scattered, the
+    optimizer steps only this rank's 1/N flat shard, and updates are
+    all-gathered — same wire bytes, N-times less update compute and
+    optimizer-state memory.  SPMD-plane only (``update`` must run inside
+    ``shard_map``); pass ``mesh`` (or call under ``hvd.init``'s mesh) so the
+    shard count is known at ``init``.
     """
     del named_parameters
+    if sharded_update:
+        from horovod_tpu.parallel import zero
+        if compression is not Compression.none:
+            raise NotImplementedError(
+                "sharded_update=True does not compose with gradient "
+                "compression: the wire format is flat reduce-scatter "
+                "buckets (see docs/performance.md)")
+        if backward_passes_per_step > 1:
+            raise NotImplementedError(
+                "sharded_update=True does not compose with "
+                "backward_passes_per_step>1; accumulate with "
+                "optax.MultiSteps around the loss instead")
+        if op not in (collective.Average, collective.Sum):
+            raise NotImplementedError(
+                f"sharded_update=True supports op=Average or op=Sum, "
+                f"got {op!r}")
+        return zero.sharded_optimizer(optimizer, axis_name, mesh=mesh,
+                                      mean=op is collective.Average)
     chain = optax.chain(
         distributed_gradients(compression=compression, axis_name=axis_name,
                               op=op),
@@ -197,7 +225,8 @@ def make_training_step(loss_fn: Callable,
                        mesh: Mesh,
                        axis_name: Optional[str] = None,
                        donate: bool = True,
-                       compression=Compression.none):
+                       compression=Compression.none,
+                       shard_optimizer: bool = False):
     """Build the flagship SPMD training step.
 
     ``loss_fn(params, batch) -> scalar loss``.  The returned
@@ -206,8 +235,19 @@ def make_training_step(loss_fn: Callable,
     replicated; gradients are averaged with fused ``pmean`` — the whole
     Horovod DP recipe (shard data / replicate model / allreduce grads /
     identical update) as one compiled program.
+
+    ``shard_optimizer=True`` swaps the allreduce-then-replicated-update for
+    the ZeRO-1 sharded update (:mod:`horovod_tpu.parallel.zero`):
+    reduce-scatter gradients, step the optimizer on this rank's 1/N flat
+    shard, all-gather the updates.  Same wire bytes, trajectory identical up
+    to float reduction order, optimizer state sharded 1/N per device.  Use
+    ``step.init(params)`` in both modes — in sharded mode it returns the
+    flat-bucket state, placed 1/N per device on first ``step(...)`` call.
     """
     ax = axis_name or data_axis(mesh)
+    if shard_optimizer:
+        return _make_sharded_training_step(loss_fn, optimizer, mesh, ax,
+                                           donate, compression)
     dist_opt = optax.chain(
         distributed_gradients(compression=compression, axis_name=ax),
         optimizer)
@@ -238,4 +278,49 @@ def make_training_step(loss_fn: Callable,
 
     step.init = dist_opt.init
     step.jitted = jitted   # AOT access (.lower/.compile) when needed
+    return step
+
+
+def _make_sharded_training_step(loss_fn, optimizer, mesh, ax, donate,
+                                compression):
+    """The ZeRO-1 variant of :func:`make_training_step`.
+
+    The opt-state in/out specs depend on the wrapped optimizer's state
+    STRUCTURE, which is only known once a concrete state exists, so the
+    ``shard_map`` is built lazily on the first call and cached (one build
+    per state treedef — the treedef is fixed for a given optimizer)."""
+    from horovod_tpu.parallel import zero
+    if compression is not Compression.none:
+        raise NotImplementedError(
+            "shard_optimizer=True does not compose with gradient "
+            "compression: the wire format is flat reduce-scatter buckets "
+            "(see docs/performance.md)")
+    zopt = zero.sharded_optimizer(optimizer, ax, mesh=mesh)
+
+    def _step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, new_opt_state = zopt.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt_state, lax.pmean(loss, ax)
+
+    cache = {}
+
+    def _build(opt_state):
+        opt_specs = zopt.state_specs(opt_state)
+        smapped = jax.shard_map(
+            _step, mesh=mesh,
+            in_specs=(P(), opt_specs, P(ax)),
+            out_specs=(P(), opt_specs, P()),
+            check_vma=False)
+        return jax.jit(smapped, donate_argnums=(0, 1) if donate else ())
+
+    def step(params, opt_state, batch):
+        if step.jitted is None:
+            step.jitted = cache["fn"] = _build(opt_state)
+        return step.jitted(params, opt_state, batch)
+
+    step.init = zopt.init
+    step.optimizer = zopt            # the ShardedOptimizer (specs, gather)
+    step.jitted = None               # built on first call (state-dependent)
+    step.state_shardings = functools.partial(zopt.state_shardings, mesh)
     return step

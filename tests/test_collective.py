@@ -119,6 +119,58 @@ def test_spmd_grouped_allreduce_matches_individual(hvd, mesh8):
                                    np.mean(np.asarray(x), 0), rtol=1e-5)
 
 
+def test_spmd_grouped_allreduce_scaling_parity(hvd, mesh8):
+    """grouped_allreduce honors prescale/postscale exactly like allreduce
+    (the scaling rides the fused flat bucket)."""
+    rs = np.random.RandomState(4)
+    xs = [jnp.asarray(rs.randn(8, n), jnp.float32) for n in (3, 5, 7)]
+
+    def grouped(*ts):
+        return tuple(hvd.grouped_allreduce(
+            list(ts), op=hvd.Sum, prescale_factor=0.5,
+            postscale_factor=3.0))
+
+    def individual(*ts):
+        return tuple(hvd.allreduce(t, op=hvd.Sum, prescale_factor=0.5,
+                                   postscale_factor=3.0) for t in ts)
+
+    f = shard(grouped, mesh8, (P("data"),) * 3, (P(),) * 3)
+    g = shard(individual, mesh8, (P("data"),) * 3, (P(),) * 3)
+    for got, want in zip(f(*xs), g(*xs)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5)
+
+
+def test_spmd_grouped_allreduce_rejects_process_set(hvd, mesh8):
+    """Non-global process sets are an eager-plane concept; the SPMD path
+    must reject them loudly, exactly like allreduce
+    (``_reject_spmd_process_set``)."""
+    ps = collective.ProcessSet([0], set_id=7)
+    x = jnp.ones((8, 2), jnp.float32)
+
+    def body(t):
+        return hvd.grouped_allreduce([t], process_set=ps)[0]
+
+    f = shard(body, mesh8, P("data"), P())
+    with pytest.raises(ValueError, match="process_set"):
+        f(x)
+    # ... and the global set passes through untouched (same as allreduce).
+    g = shard(lambda t: hvd.grouped_allreduce(
+        [t], process_set=collective.global_process_set)[0],
+        mesh8, P("data"), P())
+    np.testing.assert_allclose(np.asarray(g(x)).reshape(-1),
+                               np.ones(2), rtol=1e-6)
+
+
+def test_eager_grouped_allreduce_scaling(hvd):
+    """Eager (no axis) path: scaling forwards to per-tensor allreduce."""
+    xs = [jnp.asarray([2.0, 4.0]), jnp.asarray([[1.0], [3.0]])]
+    outs = hvd.grouped_allreduce(xs, op=hvd.Sum, prescale_factor=2.0,
+                                 postscale_factor=0.5)
+    for x, o in zip(xs, outs):
+        np.testing.assert_allclose(np.asarray(o), np.asarray(x), rtol=1e-6)
+
+
 def test_spmd_allreduce_grad(hvd, mesh8):
     """Gradient of allreduce-mean is mean of cotangent (reference
     test_tensorflow.py:385-460 grad checks)."""

@@ -1,0 +1,247 @@
+"""Fusion v1/v2 invariants over the 8-device SPMD mesh.
+
+Property-style checks of the bucketing walk (order preservation, dtype
+homogeneity, threshold) and of the fusion v2 reduce-scatter/all-gather
+pair (padding geometry, exact round trip) — the contracts
+:mod:`horovod_tpu.parallel.zero` builds the sharded optimizer on.
+"""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.ops import fusion
+
+
+def shard(f, mesh, in_specs, out_specs):
+    return jax.jit(jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False))
+
+
+def _leaves(seed=0):
+    """A deliberately awkward leaf list: mixed dtypes, shapes whose sizes
+    are NOT multiples of 8, interleaved so bucketing must reorder."""
+    rng = np.random.RandomState(seed)
+    return [
+        jnp.asarray(rng.randn(3, 5), jnp.float32),       # 15 elems
+        jnp.asarray(rng.randn(7), jnp.bfloat16),         # 7
+        jnp.asarray(rng.randn(2, 2, 3), jnp.float32),    # 12
+        jnp.asarray(rng.randn(1), jnp.float32),          # 1
+        jnp.asarray(rng.randn(9), jnp.bfloat16),         # 9
+        jnp.asarray(rng.randn(4, 4), jnp.float32),       # 16
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Threshold parsing (satellite: env hardening)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("text,expected", [
+    ("67108864", 64 * 1024 * 1024),
+    ("64mb", 64 * 1024 * 1024),
+    ("64MB", 64 * 1024 * 1024),
+    ("32MiB", 32 * 1024 * 1024),
+    ("2kb", 2048),
+    ("1.5k", 1536),
+    ("8g", 8 * 1024 ** 3),
+    ("  16 m ", 16 * 1024 ** 2),
+    ("0", 0),
+])
+def test_parse_size_bytes(text, expected):
+    assert fusion.parse_size_bytes(text) == expected
+
+
+@pytest.mark.parametrize("text", ["64 parsecs", "mb", "-3", "1e6", ""])
+def test_parse_size_bytes_rejects_garbage(text):
+    assert fusion.parse_size_bytes(text) is None
+
+
+def test_threshold_env_suffix(monkeypatch):
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "32MiB")
+    assert fusion.fusion_threshold_bytes() == 32 * 1024 * 1024
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "1024")
+    assert fusion.fusion_threshold_bytes() == 1024
+
+
+def test_threshold_env_garbage_falls_back_with_one_warning(monkeypatch):
+    """A typo'd env var must degrade to the default with a single warning,
+    never raise mid-trace.  (The package logger has propagate=False, so
+    capture with a handler attached directly to it, not caplog.)"""
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "sixty-four megs")
+    monkeypatch.setattr(fusion, "_warned_bad_threshold", False)
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    handler = Capture(level=logging.WARNING)
+    logger = logging.getLogger("horovod_tpu.ops.fusion")
+    logger.addHandler(handler)
+    try:
+        assert fusion.fusion_threshold_bytes() == \
+            fusion.DEFAULT_FUSION_THRESHOLD
+        assert fusion.fusion_threshold_bytes() == \
+            fusion.DEFAULT_FUSION_THRESHOLD
+    finally:
+        logger.removeHandler(handler)
+    warnings = [r for r in records
+                if "HOROVOD_FUSION_THRESHOLD" in r.getMessage()]
+    assert len(warnings) == 1  # one-time, not per call
+
+
+# ---------------------------------------------------------------------------
+# Bucketing invariants
+# ---------------------------------------------------------------------------
+
+def test_bucketing_preserves_every_leaf_once():
+    leaves = _leaves()
+    buckets = fusion._bucket_leaves(leaves, threshold=1 << 20)
+    flat = [i for b in buckets for i in b]
+    assert sorted(flat) == list(range(len(leaves)))
+
+
+def test_bucketing_never_mixes_dtypes():
+    leaves = _leaves()
+    for bucket in fusion._bucket_leaves(leaves, threshold=1 << 20):
+        dtypes = {str(leaves[i].dtype) for i in bucket}
+        assert len(dtypes) == 1
+
+
+def test_bucketing_respects_threshold():
+    leaves = _leaves()
+    threshold = 40  # bytes: forces multi-leaf f32 buckets to split
+    for bucket in fusion._bucket_leaves(leaves, threshold):
+        nbytes = sum(int(np.prod(leaves[i].shape)) * leaves[i].dtype.itemsize
+                     for i in bucket)
+        # A single leaf may exceed the threshold (it cannot be split);
+        # multi-leaf buckets must not.
+        if len(bucket) > 1:
+            assert nbytes <= threshold
+
+
+def test_bucketing_stable_within_key():
+    """Leaves of one dtype keep their relative order inside the walk, so
+    split/concat round-trips are deterministic."""
+    leaves = _leaves()
+    for bucket in fusion._bucket_leaves(leaves, threshold=1 << 20):
+        assert list(bucket) == sorted(bucket)
+
+
+def test_fused_psum_restores_original_order(hvd, mesh8):
+    """The output list lines up index-for-index with the input despite the
+    dtype-sorted walk in between."""
+    leaves = _leaves()
+    specs = tuple(P() for _ in leaves)
+    f = shard(lambda *ts: tuple(
+        fusion.fused_psum(list(ts), "data", mean=False)),
+        mesh8, specs, specs)
+    out = f(*leaves)
+    for got, want in zip(out, leaves):
+        assert got.shape == want.shape and got.dtype == want.dtype
+        np.testing.assert_allclose(
+            np.asarray(got, np.float64), 8.0 * np.asarray(want, np.float64),
+            rtol=1e-2)  # bf16 leaves dominate the tolerance
+
+
+# ---------------------------------------------------------------------------
+# Fusion v2: plan geometry + exact round trip
+# ---------------------------------------------------------------------------
+
+def test_plan_padding_geometry():
+    plan = fusion.make_reduce_scatter_plan(_leaves(), axis_size=8)
+    assert plan.n_leaves == len(_leaves())
+    for b in range(len(plan.buckets)):
+        assert plan.padded_size(b) % 8 == 0
+        assert plan.padded_size(b) - plan.bucket_size(b) == plan.pad_elems(b)
+        assert 0 <= plan.pad_elems(b) < 8
+        assert plan.shard_size(b) * 8 == plan.padded_size(b)
+    assert plan.total_pad_bytes() == sum(
+        plan.pad_elems(b) * plan.bucket_dtype(b).itemsize
+        for b in range(len(plan.buckets)))
+
+
+def test_plan_concat_split_round_trip_eager():
+    """concat -> split is the identity on the host, padding included."""
+    leaves = _leaves()
+    plan = fusion.make_reduce_scatter_plan(leaves, axis_size=8)
+    flats = plan.concat(leaves)
+    for b, flat in enumerate(flats):
+        assert flat.shape == (plan.padded_size(b),)
+    back = plan.split(flats)
+    for got, want in zip(back, leaves):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.parametrize("mean", [False, True])
+def test_reduce_scatter_all_gather_round_trip(hvd, mesh8, mean):
+    """fused_reduce_scatter -> fused_all_gather == the fused allreduce,
+    exactly (same dtypes, same order, padding stripped)."""
+    leaves = _leaves()
+    specs = tuple(P() for _ in leaves)
+
+    def rs_ag(*ts):
+        shards, plan = fusion.fused_reduce_scatter(list(ts), "data",
+                                                   mean=mean)
+        return tuple(fusion.fused_all_gather(shards, plan, "data"))
+
+    f = shard(rs_ag, mesh8, specs, specs)
+    g = shard(lambda *ts: tuple(fusion.fused_psum(
+        list(ts), "data", mean=mean)), mesh8, specs, specs)
+    got, want = f(*leaves), g(*leaves)
+    for a, b in zip(got, want):
+        assert a.shape == b.shape and a.dtype == b.dtype
+        np.testing.assert_allclose(np.asarray(a, np.float64),
+                                   np.asarray(b, np.float64),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_reduce_scatter_shard_shapes(hvd, mesh8):
+    """Each rank's shard is exactly padded_size/8 elements of the bucket
+    dtype."""
+    leaves = _leaves()
+    plan = fusion.make_reduce_scatter_plan(leaves, axis_size=8)
+    specs = tuple(P() for _ in leaves)
+
+    def rs(*ts):
+        shards, _ = fusion.fused_reduce_scatter(list(ts), "data", plan=plan)
+        return tuple(shards)
+
+    out_specs = tuple(P("data") for _ in plan.buckets)
+    f = shard(rs, mesh8, specs, out_specs)
+    shards = f(*leaves)
+    assert len(shards) == len(plan.buckets)
+    for b, s in enumerate(shards):
+        # out_spec P("data") re-concatenates the 8 shards: global shape is
+        # the full padded bucket, per-device shards are 1/8 of it.
+        assert s.shape == (plan.padded_size(b),)
+        assert s.addressable_shards[0].data.shape == (plan.shard_size(b),)
+        assert s.dtype == plan.bucket_dtype(b)
+
+
+def test_shard_slice_matches_scatter(hvd, mesh8):
+    """plan.shard_slice(b, full, axis_index) slices exactly the segment
+    psum_scatter deals to that rank — the alignment the ZeRO parameter
+    shards rely on."""
+    leaves = [jnp.asarray(np.random.RandomState(3).randn(21), jnp.float32)]
+    plan = fusion.make_reduce_scatter_plan(leaves, axis_size=8)
+
+    def f(t):
+        shards, _ = fusion.fused_reduce_scatter([t], "data", mean=False,
+                                                plan=plan)
+        full = plan.concat([t])[0] * 8.0  # == psum of the replicated leaf
+        idx = jax.lax.axis_index("data")
+        return shards[0] - plan.shard_slice(0, full, idx)
+
+    g = shard(f, mesh8, (P(),), P("data"))
+    np.testing.assert_allclose(np.asarray(g(leaves[0])), 0.0, atol=1e-5)
+
+
+def test_empty_tensor_list(hvd, mesh8):
+    assert fusion.fused_psum([], "data") == []
+    shards, plan = fusion.fused_reduce_scatter([], "data", axis_size=8)
+    assert shards == [] and plan.n_leaves == 0
