@@ -80,6 +80,22 @@ JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
 python tools/check_metrics.py "$ZERO_METRICS_DIR/metrics.json" 2
 rm -rf "$ZERO_METRICS_DIR"
 
+echo "--- self-healing gate (2 ranks x 8-device virtual mesh): guarded
+--- step + coordinated NaN rollback + divergence-sentinel heal + async
+--- checkpoint, merged telemetry shows hvd_guard_* / hvd_rollback_* /
+--- hvd_sentinel_* (docs/fault_tolerance.md)"
+RESILIENCE_METRICS_DIR="$(mktemp -d)"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  HOROVOD_METRICS_FILE="$RESILIENCE_METRICS_DIR/metrics.json" \
+  python -m horovod_tpu.runner -np 2 \
+  python tests/distributed/resilience_workload_np2.py
+python tools/check_metrics.py "$RESILIENCE_METRICS_DIR/metrics.json" 2
+rm -rf "$RESILIENCE_METRICS_DIR"
+
+echo "--- step-guard overhead (BENCH json; target < 2% on real chips —
+--- on the CPU smoke this only proves the lane runs end to end)"
+JAX_PLATFORMS=cpu python -m horovod_tpu.benchmark --step-guard
+
 echo "--- TSAN build + smoke (races inside libhorovod_tpu.so fail CI)"
 make -C horovod_tpu/native/cc tsan
 rm -f /tmp/tsan_ci.*

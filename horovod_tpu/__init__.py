@@ -97,6 +97,8 @@ from horovod_tpu.parallel.data import (
     broadcast_variables,
 )
 from horovod_tpu.parallel.zero import sharded_optimizer
+from horovod_tpu import resilience  # noqa: F401  (hvd.resilience.StepGuard/...)
+from horovod_tpu.resilience import StepGuard
 
 __version__ = "0.5.0"
 
@@ -124,4 +126,6 @@ __all__ = [
     "DistributedOptimizer", "DistributedGradientTape", "make_training_step",
     "sharded_optimizer",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_variables",
+    # resilience
+    "resilience", "StepGuard",
 ]

@@ -127,13 +127,23 @@ def make_train_step(model, optimizer, mesh, axis_name: Optional[str] = None,
 
         (loss, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
-        # The Horovod step: average gradients across the mesh (fused psum —
-        # reference fusion_buffer_manager + NCCLAllreduce, here one bf16-safe
-        # bucketed pmean riding ICI).
-        grads = fused_pytree_mean(grads, ax)
-        updates, new_opt_state = optimizer.update(grads, opt_state, params)
-        new_params = optax.apply_updates(params, updates)
-        return new_params, new_stats, new_opt_state, lax.pmean(loss, ax)
+
+        def do_update():
+            # The Horovod step: average gradients across the mesh (fused
+            # psum — reference fusion_buffer_manager + NCCLAllreduce,
+            # here one bf16-safe bucketed pmean riding ICI).
+            g = fused_pytree_mean(grads, ax)
+            updates, new_opt_state = optimizer.update(g, opt_state,
+                                                      params)
+            return (optax.apply_updates(params, updates), new_stats,
+                    new_opt_state)
+
+        from horovod_tpu import resilience
+        ((new_params, out_stats, new_opt_state),
+         mean_loss) = resilience.apply_step_guard(
+            do_update, loss=loss, grads=grads,
+            old_state=(params, batch_stats, opt_state), axes=(ax,))
+        return new_params, out_stats, new_opt_state, mean_loss
 
     if steps_per_call > 1:
         def _loop(params, batch_stats, opt_state, images, labels):
@@ -726,6 +736,54 @@ def run_profile(model_name: str = "resnet50", batch_size: int = 64,
     profiling.print_profile(trace, compiled.as_text(), steps=steps)
 
 
+def run_step_guard_benchmark(model_name: str = "resnet50",
+                             batch_size: int = 64,
+                             verbose: bool = True,
+                             **kwargs) -> dict:
+    """Measure the step-guard overhead (docs/fault_tolerance.md): run the
+    synthetic benchmark twice — once with ``HOROVOD_STEP_GUARD`` unset
+    (baseline) and once with policy ``skip`` (the in-graph finiteness
+    psum + per-leaf select compiled into the step) — and report the
+    throughput delta.  The policy is read at trace time, so each run
+    builds and compiles a fresh step.  Target: < 2% step time.
+
+    Prints one BENCH JSON line
+    (``{"metric": "step_guard_overhead_pct", ...}``) and returns the same
+    dict."""
+    import json
+
+    prev = os.environ.pop("HOROVOD_STEP_GUARD", None)
+    try:
+        base = run_synthetic_benchmark(model_name, batch_size,
+                                       verbose=False, **kwargs)
+        os.environ["HOROVOD_STEP_GUARD"] = "skip"
+        guarded = run_synthetic_benchmark(model_name, batch_size,
+                                          verbose=False, **kwargs)
+    finally:
+        if prev is None:
+            os.environ.pop("HOROVOD_STEP_GUARD", None)
+        else:
+            os.environ["HOROVOD_STEP_GUARD"] = prev
+    overhead_pct = ((base["img_sec_total"] - guarded["img_sec_total"])
+                    / base["img_sec_total"] * 100.0)
+    result = {
+        "metric": "step_guard_overhead_pct",
+        "value": round(overhead_pct, 3),
+        "unit": "%",
+        "target_pct": 2.0,
+        "model": model_name,
+        "baseline_img_sec": round(base["img_sec_total"], 1),
+        "guarded_img_sec": round(guarded["img_sec_total"], 1),
+    }
+    if verbose:
+        print(f"Step guard overhead: {overhead_pct:.2f}% "
+              f"({base['img_sec_total']:.1f} -> "
+              f"{guarded['img_sec_total']:.1f} img/sec; target < 2%)",
+              flush=True)
+    print("BENCH " + json.dumps(result), flush=True)
+    return result
+
+
 def _main():
     import argparse
     parser = argparse.ArgumentParser(
@@ -748,6 +806,10 @@ def _main():
     parser.add_argument("--lm", action="store_true",
                         help="run the transformer-LM lane instead of the "
                              "ResNet harness")
+    parser.add_argument("--step-guard", action="store_true",
+                        help="measure the NaN/Inf step-guard overhead: "
+                             "baseline vs HOROVOD_STEP_GUARD=skip "
+                             "(target < 2%% step time)")
     parser.add_argument("--shard-optimizer", action="store_true",
                         help="LM lane with the ZeRO-1 sharded update over "
                              "all devices (reports MFU + per-device "
@@ -788,6 +850,20 @@ def _main():
         bs = lm_kwargs.pop("batch_size",
                            args.batch_size if args.batch_size != 64 else 8)
         run_lm_benchmark(batch_size=bs, **lm_kwargs)
+    elif args.step_guard:
+        sg_kwargs = dict(kwargs, stem=args.stem)
+        model, bs = args.model, args.batch_size
+        if jax.devices()[0].platform == "cpu":
+            # CPU run = plumbing smoke: the lane compiles the step TWICE
+            # (baseline + guarded), so downsize to finish in seconds.
+            model = "resnet18" if args.model == "resnet50" else args.model
+            bs = min(bs, 4)
+            sg_kwargs.update(image_size=min(args.image_size, 64),
+                             num_warmup_batches=1,
+                             num_batches_per_iter=min(
+                                 args.num_batches_per_iter, 2),
+                             num_iters=min(args.num_iters, 3))
+        run_step_guard_benchmark(model, bs, **sg_kwargs)
     elif args.profile:
         run_profile(args.model, args.batch_size, args.image_size,
                     steps=args.num_batches_per_iter, stem=args.stem)

@@ -23,7 +23,9 @@ diverge — the same consistency guarantee the reference gets from
 
 from __future__ import annotations
 
+import atexit
 import os
+import threading
 from typing import Any, Optional
 
 import jax
@@ -123,40 +125,175 @@ def _valid_steps(ckpt_dir: str) -> list:
 
 def save(ckpt_dir: str, state: Any, step: int = 0,
          max_to_keep: Optional[int] = None) -> Optional[str]:
-    """Write ``state`` (a pytree) to ``ckpt_dir/<step>``; rank 0 only, all
-    ranks barrier afterwards so no rank races ahead and reads a
-    half-written checkpoint.  Returns the checkpoint path on rank 0,
-    None elsewhere.
+    """Write ``state`` (a pytree) to ``ckpt_dir/<step>``; rank 0 writes,
+    every other rank waits on a success-flag broadcast so no rank races
+    ahead and reads a half-written checkpoint.  Returns the checkpoint
+    path on rank 0 when the write succeeded, None elsewhere / on failure.
+
+    The flag broadcast *replaces* the old barrier and fixes its deadlock:
+    if rank 0's orbax write raises, peers used to wait forever in
+    ``rt.barrier`` — now the exception is caught, counted
+    (``hvd_checkpoint_save_failures_total``), broadcast as ``ok=0``, and
+    everyone continues (degrade, don't deadlock — the next save retries).
 
     ZeRO-1 sharded optimizer states (``shard_optimizer=True`` /
     ``hvd.sharded_optimizer``) are gathered to the replicated per-leaf
     layout before writing, so checkpoints stay layout-independent — see
-    :func:`_gather_zero`."""
+    :func:`_gather_zero`.  Any in-flight :func:`save_async` write is
+    drained first."""
+    wait_for_async_save()
     path = None
+    ok = np.zeros(1, np.int32)
     if basics.rank() == 0:
-        import orbax.checkpoint as ocp
-        state = _gather_zero(state)
-        ckpt_dir = os.path.abspath(ckpt_dir)
-        t0 = telemetry.clock()
-        with ocp.CheckpointManager(
-                ckpt_dir,
-                options=ocp.CheckpointManagerOptions(
-                    max_to_keep=max_to_keep)) as mgr:
-            mgr.save(step, args=ocp.args.StandardSave(state))
-        if telemetry.enabled():
-            telemetry.counter("hvd_checkpoint_saves_total",
-                              "Checkpoints written by rank 0").inc()
-            telemetry.histogram(
-                "hvd_checkpoint_save_seconds",
-                "Wall time of a rank-0 checkpoint save").observe(
-                telemetry.clock() - t0)
-        path = os.path.join(ckpt_dir, str(step))
-        log.info("checkpoint step %d written to %s", step, path)
+        try:
+            import orbax.checkpoint as ocp
+            state = _gather_zero(state)
+            ckpt_dir = os.path.abspath(ckpt_dir)
+            t0 = telemetry.clock()
+            with ocp.CheckpointManager(
+                    ckpt_dir,
+                    options=ocp.CheckpointManagerOptions(
+                        max_to_keep=max_to_keep)) as mgr:
+                mgr.save(step, args=ocp.args.StandardSave(state))
+            if telemetry.enabled():
+                telemetry.counter("hvd_checkpoint_saves_total",
+                                  "Checkpoints written by rank 0").inc()
+                telemetry.histogram(
+                    "hvd_checkpoint_save_seconds",
+                    "Wall time of a rank-0 checkpoint save").observe(
+                    telemetry.clock() - t0)
+            path = os.path.join(ckpt_dir, str(step))
+            ok[0] = 1
+            log.info("checkpoint step %d written to %s", step, path)
+        except Exception as e:  # noqa: BLE001 — degrade, don't deadlock
+            log.error("checkpoint save step %d to %s FAILED (%s: %s); "
+                      "continuing without a checkpoint", step, ckpt_dir,
+                      type(e).__name__, e)
+            if telemetry.enabled():
+                telemetry.counter(
+                    "hvd_checkpoint_save_failures_total",
+                    "rank-0 checkpoint writes that raised").inc()
     if basics.size() > 1:
-        rt = basics.runtime()
-        if rt is not None:
-            rt.barrier(f"hvd.checkpoint.save.{step}")
-    return path
+        ok = _c._eager_broadcast(ok, 0, f"hvd.checkpoint.save.ok.{step}")
+    return path if int(np.asarray(ok)[0]) else None
+
+
+class _AsyncSave:
+    """One in-flight background checkpoint write (rank 0 only)."""
+
+    __slots__ = ("thread", "step", "path", "error")
+
+    def __init__(self, step: int):
+        self.thread = None
+        self.step = step
+        self.path = None
+        self.error = None
+
+
+_async_lock = threading.Lock()
+_async_current: Optional[_AsyncSave] = None
+_async_atexit_registered = False
+
+
+def save_async(ckpt_dir: str, state: Any, step: int = 0,
+               max_to_keep: Optional[int] = None) -> Optional[str]:
+    """CheckFreq-style asynchronous save: snapshot ``state`` to host
+    memory *now* (the only part that blocks the step — a device pull),
+    then write it with orbax on a background thread.  Returns the
+    eventual checkpoint path on rank 0, None elsewhere.
+
+    At most one write is in flight: a previous one is drained first
+    (:func:`wait_for_async_save` — also registered atexit, so a job that
+    exits right after ``save_async`` never loses the checkpoint).  No
+    cross-rank barrier or flag is needed, unlike :func:`save`: only
+    rank 0 touches the directory, readers are protected by orbax's
+    atomic rename plus :func:`_valid_steps`' intact-directory filter,
+    and a background failure is logged + counted
+    (``hvd_ckpt_async_failures_total``) when drained, never raised."""
+    global _async_current, _async_atexit_registered
+    wait_for_async_save()
+    if basics.rank() != 0:
+        return None
+    t0 = telemetry.clock()
+    state = _gather_zero(state)
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    for leaf in leaves:
+        copy_async = getattr(leaf, "copy_to_host_async", None)
+        if copy_async is not None:
+            copy_async()
+    snapshot = jax.tree_util.tree_unflatten(
+        treedef, [np.asarray(leaf) for leaf in leaves])
+    if telemetry.enabled():
+        telemetry.histogram(
+            "hvd_ckpt_async_snapshot_seconds",
+            "device->host snapshot time per async save (the only part "
+            "that blocks the step)").observe(telemetry.clock() - t0)
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    record = _AsyncSave(step)
+
+    def _write():
+        t1 = telemetry.clock()
+        try:
+            import orbax.checkpoint as ocp
+            with ocp.CheckpointManager(
+                    ckpt_dir,
+                    options=ocp.CheckpointManagerOptions(
+                        max_to_keep=max_to_keep)) as mgr:
+                mgr.save(step, args=ocp.args.StandardSave(snapshot))
+            record.path = os.path.join(ckpt_dir, str(step))
+            if telemetry.enabled():
+                telemetry.counter(
+                    "hvd_ckpt_async_saves_total",
+                    "background checkpoint writes completed").inc()
+                telemetry.histogram(
+                    "hvd_ckpt_async_write_seconds",
+                    "background orbax write time per async save").observe(
+                    telemetry.clock() - t1)
+            log.info("async checkpoint step %d written to %s", step,
+                     record.path)
+        except Exception as e:  # noqa: BLE001 — reported at drain time
+            record.error = e
+            if telemetry.enabled():
+                telemetry.counter(
+                    "hvd_ckpt_async_failures_total",
+                    "background checkpoint writes that raised").inc()
+
+    record.thread = threading.Thread(
+        target=_write, name=f"hvd-ckpt-async-{step}", daemon=True)
+    with _async_lock:
+        _async_current = record
+        if not _async_atexit_registered:
+            atexit.register(wait_for_async_save)
+            _async_atexit_registered = True
+    record.thread.start()
+    return os.path.join(ckpt_dir, str(step))
+
+
+def wait_for_async_save(timeout: Optional[float] = None) -> Optional[str]:
+    """Drain the in-flight :func:`save_async` write, if any.  Returns
+    the written path, or None (no write in flight / it failed / timed
+    out).  A background failure is logged here — log-and-continue, the
+    deadlock-free degradation contract of :func:`save`."""
+    global _async_current
+    with _async_lock:
+        record, _async_current = _async_current, None
+    if record is None or record.thread is None:
+        return None
+    record.thread.join(timeout)
+    if record.thread.is_alive():
+        # Put it back: still running, someone may drain it later.
+        with _async_lock:
+            if _async_current is None:
+                _async_current = record
+        log.warning("async checkpoint step %d still writing after "
+                    "%.1fs wait", record.step, timeout or 0.0)
+        return None
+    if record.error is not None:
+        log.error("async checkpoint save step %d FAILED (%s: %s); "
+                  "continuing without it", record.step,
+                  type(record.error).__name__, record.error)
+        return None
+    return record.path
 
 
 def restore(ckpt_dir: str, state_template: Any,

@@ -36,9 +36,22 @@ Keys:
 ``kind``     ``crash`` (SIGKILL self — the hard-failure simulation),
              ``exit:N`` (``os._exit(N)``), ``hang`` (block forever),
              ``delay:S`` (sleep S seconds, then continue),
-             ``error[:msg]`` (raise :class:`FaultInjected`).
+             ``error[:msg]`` (raise :class:`FaultInjected`),
+             ``nan`` (poison the next matching collective's *output*
+             with NaNs — the silent-failure simulation; float outputs
+             only, anything else passes through with a stderr note),
+             ``corrupt[:N]`` (flip N bytes — default 1 — of the output
+             tensor at deterministic positions: the bit-flip /
+             divergence simulation).
 ``count``    maximum number of firings (default: unlimited for
-             ``delay``/``error``; irrelevant for terminal kinds).
+             ``delay``/``error``/``nan``/``corrupt`` — chaos tests that
+             want a single bad step should say ``count=1``; irrelevant
+             for terminal kinds).
+
+The value kinds (``nan``/``corrupt``) do not fire at :func:`inject`
+(the *entry* hook) — they fire at :func:`corrupt_output`, which the
+eager collectives call on each op's result, because poisoning must
+happen after the real collective ran.
 ``attempt``  only fire when ``HOROVOD_RESTART_ATTEMPT`` equals this
              value — lets an elastic-restart test kill attempt 0 and
              let attempt 1 run clean.
@@ -57,9 +70,15 @@ import threading
 import time
 from typing import List, Optional
 
+import numpy as np
+
 ENV_VAR = "HOROVOD_FAULT_SPEC"
 
-_KINDS = ("crash", "exit", "hang", "delay", "error")
+_KINDS = ("crash", "exit", "hang", "delay", "error", "nan", "corrupt")
+
+# Kinds that mutate an op's *output value* instead of disrupting control
+# flow; they fire at corrupt_output(), never at inject().
+VALUE_KINDS = ("nan", "corrupt")
 
 SITES = (
     "allreduce", "allgather", "broadcast", "alltoall", "reducescatter",
@@ -133,14 +152,18 @@ class FaultRule:
 
     # -- execution ---------------------------------------------------------
 
-    def execute(self, site: str, detail: Optional[str],
-                rank: Optional[int]) -> None:
+    def _announce(self, site: str, detail: Optional[str],
+                  rank: Optional[int], note: str = "") -> None:
         where = f"site={site}" + (f" ({detail})" if detail else "")
         who = "launcher" if rank is None or rank < 0 else f"rank {rank}"
         sys.stderr.write(
             f"horovod_tpu.faults: firing kind={self.kind} at {where} "
-            f"[{who}, hit {self._hits}]\n")
+            f"[{who}, hit {self._hits}]{note}\n")
         sys.stderr.flush()
+
+    def execute(self, site: str, detail: Optional[str],
+                rank: Optional[int]) -> None:
+        self._announce(site, detail, rank)
         if self.kind == "crash":
             os.kill(os.getpid(), signal.SIGKILL)
             # SIGKILL is not instantaneous from the kernel's view; don't
@@ -156,9 +179,41 @@ class FaultRule:
             time.sleep(float(self.arg))
             return
         if self.kind == "error":
+            where = f"site={site}" + (f" ({detail})" if detail else "")
             msg = self.arg or f"injected fault at {where}"
             raise FaultInjected(msg)
         raise AssertionError(f"unreachable kind {self.kind}")  # pragma: no cover
+
+    def poison(self, site: str, out, detail: Optional[str],
+               rank: Optional[int]):
+        """Apply a value fault (``nan``/``corrupt``) to an op's output.
+        Always mutates a fresh copy — the runtime may alias ``out`` with
+        fusion buffers it reuses."""
+        arr = np.array(out, copy=True)
+        if self.kind == "nan":
+            if arr.dtype.kind in ("f", "c"):
+                self._announce(site, detail, rank)
+                arr.fill(np.nan)
+                return arr
+            self._announce(site, detail, rank,
+                           note=f" (dtype {arr.dtype} has no NaN; "
+                                f"output unchanged)")
+            return out
+        if self.kind == "corrupt":
+            flat = np.ascontiguousarray(arr).reshape(-1).view(np.uint8)
+            if flat.size == 0:
+                self._announce(site, detail, rank,
+                               note=" (empty tensor; output unchanged)")
+                return out
+            n = min(int(self.arg) if self.arg else 1, flat.size)
+            positions = np.unique(
+                np.linspace(0, flat.size - 1, num=n).astype(np.int64))
+            self._announce(site, detail, rank,
+                           note=f" (flipping {positions.size} byte(s))")
+            flat[positions] ^= 0xFF
+            return arr
+        raise AssertionError(  # pragma: no cover
+            f"poison called for non-value kind {self.kind}")
 
 
 def parse_spec(spec: str) -> List[FaultRule]:
@@ -209,6 +264,11 @@ def parse_spec(spec: str) -> List[FaultRule]:
                         arg = int(kind_arg)
                     elif kind == "error":
                         arg = kind_arg or None
+                    elif kind == "corrupt":
+                        arg = int(kind_arg) if kind_arg else None
+                        if arg is not None and arg < 1:
+                            raise FaultSpecError(
+                                f"kind corrupt:{arg} must flip >= 1 byte")
                     elif kind_arg:
                         raise FaultSpecError(
                             f"kind {kind!r} takes no argument "
@@ -283,7 +343,9 @@ def inject(site: str, detail: Optional[str] = None,
     ``detail`` names the operand (tensor name, request kind, hostname)
     for the firing log; ``rank`` overrides the context rank (used by
     launcher-side sites that act on behalf of a target rank).  No-op —
-    one global load and an identity test — when no spec is set.
+    one global load and an identity test — when no spec is set.  Value
+    kinds (``nan``/``corrupt``) are skipped here; they fire at
+    :func:`corrupt_output`.
     """
     plan = _plan
     if plan is _UNSET:
@@ -292,5 +354,28 @@ def inject(site: str, detail: Optional[str] = None,
         return
     ctx_rank = _context_rank(rank)
     for rule in plan:
+        if rule.kind in VALUE_KINDS:
+            continue
         if rule.arm(site, ctx_rank):
             rule.execute(site, detail, ctx_rank)
+
+
+def corrupt_output(site: str, out, detail: Optional[str] = None,
+                   rank: Optional[int] = None):
+    """The *output* injection point: eager collectives pass each op's
+    result through here just before returning it.  Value-kind rules
+    (``nan``/``corrupt``) poison a copy; everything else is ignored.
+    Same zero-overhead contract as :func:`inject` when no spec is set.
+    """
+    plan = _plan
+    if plan is _UNSET:
+        plan = load()
+    if plan is None:
+        return out
+    ctx_rank = _context_rank(rank)
+    for rule in plan:
+        if rule.kind not in VALUE_KINDS:
+            continue
+        if rule.arm(site, ctx_rank):
+            out = rule.poison(site, out, detail, ctx_rank)
+    return out

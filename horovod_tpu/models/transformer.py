@@ -337,21 +337,34 @@ def make_train_step(cfg: TransformerConfig, optimizer, mesh,
             optimizer, data_axis, axis_size=int(mesh.shape[data_axis]))
 
     def _one_step(params, opt_state, tokens, labels, segment_ids=None):
+        from horovod_tpu import resilience
         loss, grads = jax.value_and_grad(loss_fn)(
             params, tokens, labels, cfg, model_axis, seq_axis, attention,
             segment_ids, remat)
-        if zopt is not None:
-            # ZeRO-1: the mean happens on the reduce-scattered 1/N shard
-            # inside the sharded update — no separate fused pmean pass.
-            updates, new_opt = zopt.update(grads, opt_state, params)
-        else:
-            # DP gradient averaging (fused psum) over data (+seq) axes;
-            # TP/f-op already settled the model axis.
-            grads = fused_pytree_mean(grads, grad_axes)
-            updates, new_opt = optimizer.update(grads, opt_state, params)
-        new_params = jax.tree_util.tree_map(lambda p, u: p + u, params,
-                                            updates)
-        return new_params, new_opt, lax.pmean(loss, grad_axes)
+
+        def do_update():
+            if zopt is not None:
+                # ZeRO-1: the mean happens on the reduce-scattered 1/N
+                # shard inside the sharded update — no separate fused
+                # pmean pass.
+                updates, new_opt = zopt.update(grads, opt_state, params)
+            else:
+                # DP gradient averaging (fused psum) over data (+seq)
+                # axes; TP/f-op already settled the model axis.
+                g = fused_pytree_mean(grads, grad_axes)
+                updates, new_opt = optimizer.update(g, opt_state, params)
+            new_params = jax.tree_util.tree_map(lambda p, u: p + u,
+                                                params, updates)
+            return new_params, new_opt
+
+        (new_params, new_opt), mean_loss = resilience.apply_step_guard(
+            do_update, loss=loss, grads=grads,
+            old_state=(params, opt_state), axes=grad_axes,
+            # agreement must also settle the TP axis: model-sharded
+            # leaves would otherwise disagree on the select.
+            agree_axes=tuple(a for a in (data_axis, seq_axis, model_axis)
+                             if a))
+        return new_params, new_opt, mean_loss
 
     if steps_per_call > 1:
         def _step(params, opt_state, tokens, labels, segment_ids=None):

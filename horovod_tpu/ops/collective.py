@@ -385,7 +385,7 @@ def _eager_allreduce(x, op: ReduceOp, name: str, prescale_factor,
         out = out / (set_size if set_size else basics.size())
     if postscale_factor != 1.0:
         out = out * postscale_factor
-    return out
+    return faults.corrupt_output("allreduce", out, name)
 
 
 # --- split submit/finish pairs (graph-async bindings: submit is the
@@ -417,7 +417,7 @@ def _eager_allreduce_finish(tok, op: ReduceOp, postscale_factor,
         out = out / (set_size if set_size else basics.size())
     if postscale_factor != 1.0:
         out = out * postscale_factor
-    return out
+    return faults.corrupt_output("allreduce", out)
 
 
 def _eager_allgather_submit(x, name: str, set_id=0):
@@ -433,8 +433,9 @@ def _eager_allgather_submit(x, name: str, set_id=0):
 
 def _eager_allgather_finish(tok):
     native, done = tok
-    return done if native is None else basics.runtime().allgather_finish(
+    out = done if native is None else basics.runtime().allgather_finish(
         native)
+    return faults.corrupt_output("allgather", out)
 
 
 def _eager_broadcast_submit(x, root_rank: int, name: str, set_id=0):
@@ -453,8 +454,9 @@ def _eager_broadcast_submit(x, root_rank: int, name: str, set_id=0):
 
 def _eager_broadcast_finish(tok):
     native, done = tok
-    return done if native is None else basics.runtime().broadcast_finish(
+    out = done if native is None else basics.runtime().broadcast_finish(
         native)
+    return faults.corrupt_output("broadcast", out)
 
 
 def _eager_alltoall_submit(x, splits, name: str, set_id=0):
@@ -469,8 +471,10 @@ def _eager_alltoall_submit(x, splits, name: str, set_id=0):
 def _eager_alltoall_finish(tok):
     """Returns (output, received_splits)."""
     native, done = tok
-    return done if native is None else basics.runtime().alltoall_finish(
-        native)
+    if native is None:
+        return done  # local path already went through corrupt_output
+    out, received = basics.runtime().alltoall_finish(native)
+    return faults.corrupt_output("alltoall", out), received
 
 
 def _check_reducescatter_op(op: ReduceOp) -> None:
@@ -503,7 +507,7 @@ def _eager_reducescatter_finish(tok, op: ReduceOp, set_size=None):
            else basics.runtime().reducescatter_finish(native))
     if op is Average:
         out = out / (set_size or basics.size())
-    return out
+    return faults.corrupt_output("reducescatter", out)
 
 
 def _eager_allgather(x, name: str, set_id=0):
@@ -513,8 +517,9 @@ def _eager_allgather(x, name: str, set_id=0):
     arr = np.asarray(x)
     if rt is None:
         _record_local("allgather", name, arr, t0)
-        return arr.copy()
-    return rt.allgather(name, arr, set_id=set_id)
+        return faults.corrupt_output("allgather", arr.copy(), name)
+    return faults.corrupt_output(
+        "allgather", rt.allgather(name, arr, set_id=set_id), name)
 
 
 def _eager_broadcast(x, root_rank: int, name: str, set_id=0):
@@ -527,8 +532,10 @@ def _eager_broadcast(x, root_rank: int, name: str, set_id=0):
             raise ValueError(
                 f"broadcast root_rank {root_rank} out of range for size 1")
         _record_local("broadcast", name, arr, t0)
-        return arr.copy()
-    return rt.broadcast(name, arr, root_rank, set_id=set_id)
+        return faults.corrupt_output("broadcast", arr.copy(), name)
+    return faults.corrupt_output(
+        "broadcast", rt.broadcast(name, arr, root_rank, set_id=set_id),
+        name)
 
 
 def _eager_alltoall(x, splits, name: str, set_id=0):
@@ -549,8 +556,10 @@ def _eager_alltoall(x, splits, name: str, set_id=0):
                     f"alltoall splits {sp.tolist()} do not match first "
                     f"dimension {rows} for size-1 job")
         _record_local("alltoall", name, arr, t0)
-        return arr.copy(), np.array([rows], np.int64)
-    return rt.alltoall(name, arr, splits, set_id=set_id)
+        return (faults.corrupt_output("alltoall", arr.copy(), name),
+                np.array([rows], np.int64))
+    out, received = rt.alltoall(name, arr, splits, set_id=set_id)
+    return faults.corrupt_output("alltoall", out, name), received
 
 
 def _eager_reducescatter(x, op: ReduceOp, name: str, set_id=0,
@@ -562,12 +571,13 @@ def _eager_reducescatter(x, op: ReduceOp, name: str, set_id=0,
     arr = np.asarray(x)
     if rt is None:
         _record_local("reducescatter", name, arr, t0)
-        return (arr / (set_size or basics.size()) if op is Average
-                else arr.copy())
+        out = (arr / (set_size or basics.size()) if op is Average
+               else arr.copy())
+        return faults.corrupt_output("reducescatter", out, name)
     out = rt.reducescatter(name, arr, op.code, set_id=set_id)
     if op is Average:
         out = out / (set_size or basics.size())
-    return out
+    return faults.corrupt_output("reducescatter", out, name)
 
 
 _executor = None

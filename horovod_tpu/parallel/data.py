@@ -253,12 +253,22 @@ def make_training_step(loss_fn: Callable,
         optimizer)
 
     def _step(params, opt_state, batch):
+        from horovod_tpu import resilience
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-        updates, new_opt_state = dist_opt.update(grads, opt_state, params)
-        new_params = optax.apply_updates(params, updates)
-        # loss is per-shard; report the global mean like the reference's
-        # MetricAverageCallback (_keras/callbacks.py:46-72).
-        return new_params, new_opt_state, lax.pmean(loss, ax)
+
+        def do_update():
+            updates, new_opt_state = dist_opt.update(grads, opt_state,
+                                                     params)
+            return optax.apply_updates(params, updates), new_opt_state
+
+        # loss is per-shard; the guard reports the global mean like the
+        # reference's MetricAverageCallback (_keras/callbacks.py:46-72)
+        # and, when HOROVOD_STEP_GUARD is set, keeps the old state on a
+        # non-finite step (the mean loss comes back NaN as the signal).
+        (new_params, new_opt_state), mean_loss = resilience.apply_step_guard(
+            do_update, loss=loss, grads=grads,
+            old_state=(params, opt_state), axes=(ax,))
+        return new_params, new_opt_state, mean_loss
 
     replicated = P()
     sharded_batch = P(ax)
@@ -298,10 +308,17 @@ def _make_sharded_training_step(loss_fn, optimizer, mesh, ax, donate,
     zopt = zero.sharded_optimizer(optimizer, ax, mesh=mesh)
 
     def _step(params, opt_state, batch):
+        from horovod_tpu import resilience
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-        updates, new_opt_state = zopt.update(grads, opt_state, params)
-        new_params = optax.apply_updates(params, updates)
-        return new_params, new_opt_state, lax.pmean(loss, ax)
+
+        def do_update():
+            updates, new_opt_state = zopt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_opt_state
+
+        (new_params, new_opt_state), mean_loss = resilience.apply_step_guard(
+            do_update, loss=loss, grads=grads,
+            old_state=(params, opt_state), axes=(ax,))
+        return new_params, new_opt_state, mean_loss
 
     cache = {}
 

@@ -273,6 +273,28 @@ def gather_full_state(state: ZeroShardedState):
     return _map_param_subtrees(state.optimizer, expand, state.inner)
 
 
+def local_state_digest(state: ZeroShardedState) -> int:
+    """Cheap deterministic digest of THIS process's optimizer-state
+    bytes: crc32 chained over each inner leaf's addressable shards in
+    device order.  The divergence sentinel (``horovod_tpu.resilience``)
+    allreduces this per rank — under ZeRO-1 the state only exists as
+    shards, and digesting the local bytes avoids gathering the full
+    buckets just to hash them."""
+    import zlib
+    crc = 0
+    for leaf in jax.tree_util.tree_leaves(state.inner):
+        shards = getattr(leaf, "addressable_shards", None)
+        if not shards:
+            arr = np.ascontiguousarray(np.asarray(leaf))
+            crc = zlib.crc32(arr.tobytes(), crc)
+            continue
+        for shard in sorted(shards,
+                            key=lambda s: getattr(s.device, "id", 0)):
+            arr = np.ascontiguousarray(np.asarray(shard.data))
+            crc = zlib.crc32(arr.tobytes(), crc)
+    return crc
+
+
 def scatter_full_state(full_state, like: ZeroShardedState
                        ) -> ZeroShardedState:
     """Inverse of :func:`gather_full_state`: re-shard a replicated-layout

@@ -1,0 +1,607 @@
+"""Self-healing training loop: step guard, divergence sentinel, rollback.
+
+PR 1's fault tolerance handles *loud* failures (crashes, hangs,
+blacklisting, restart-from-disk).  This module is the defense-in-depth
+layer for *silent* ones — NaN bursts, replica divergence / bit flips —
+so a bad step costs one step, not a relaunch (the in-memory-snapshot
+recovery idea of Gemini, SOSP'23, on top of CheckFreq's, FAST'21,
+iteration-boundary checkpointing):
+
+* **Step guard** (in-graph, wired into ``parallel/data.py``,
+  ``models/transformer.py`` and the benchmark's step builder): every
+  jitted step checks loss + grads for NaN/Inf with a global ``is_finite``
+  psum.  Collectives may not sit inside a ``lax.cond`` branch under SPMD,
+  so the "conditional skip" is realized as an unconditional update
+  followed by a per-leaf ``jnp.where(ok, new, old)`` select — XLA fuses
+  the select, and the optimizer update it may waste ran on garbage
+  anyway.  A bad step returns the *old* state and a NaN mean loss (the
+  host-visible signal).  Policy via ``HOROVOD_STEP_GUARD``:
+  ``off | skip | rollback | abort``.
+
+* **Last-known-good rollback** (:class:`LastKnownGood`,
+  :class:`StepGuard`): a host-side, double-buffered snapshot of the last
+  *validated* ``params/opt_state/step``.  The pull to host happens off
+  the critical path (``copy_to_host_async`` first, staged into a standby
+  buffer, committed only after the bytes validate finite), and
+  :meth:`StepGuard.after_step` restores it in-process on a NaN burst —
+  every rank coordinates on a global ok flag first, so they roll back
+  together or not at all.
+
+* **Divergence sentinel**: every ``HOROVOD_SENTINEL_INTERVAL`` steps,
+  allreduce a cheap per-rank digest (chained crc32, exact in float64) of
+  params and optimizer state (the local shard bytes under ZeRO-1) with
+  ``Min`` and ``Max`` and compare min == max.  On mismatch, an allgather
+  names the diverging rank(s) (minority digest vs the modal one), and
+  policy ``rollback`` heals in-process by re-broadcasting state from the
+  lowest healthy rank — a diverged rank's *own* snapshots are
+  finite-but-wrong, so rollback alone cannot heal divergence.
+
+* **Preemption protocol**: :func:`install_preemption_handler` turns
+  SIGTERM into a request flag; :func:`maybe_save_and_exit` performs a
+  coordinated checkpoint at the next step boundary and exits with
+  :data:`PREEMPTION_RC` (75, ``EX_TEMPFAIL``), which the launcher treats
+  as preemption — no blacklist, no backoff, immediate reschedule
+  (``runner/launch.py`` / ``runner/run.py``).
+
+Env knobs: ``HOROVOD_STEP_GUARD`` (policy), ``HOROVOD_SENTINEL_INTERVAL``
+(0 = off), ``HOROVOD_LKG_INTERVAL`` (snapshot every N validated steps,
+default 1), ``HOROVOD_GUARD_NAN_BURST`` (consecutive bad steps before a
+rollback fires, default 1).  Everything emits ``hvd_guard_*`` /
+``hvd_rollback_*`` / ``hvd_sentinel_*`` telemetry (``docs/metrics.md``)
+and is chaos-testable via the ``nan`` / ``corrupt`` fault kinds
+(``faults.py``).  See ``docs/fault_tolerance.md``.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import signal
+import sys
+import threading
+import zlib
+from typing import Any, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from horovod_tpu import basics, telemetry
+from horovod_tpu.ops import collective as _c
+from horovod_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+# Distinct exit code for "preempted, please reschedule me" — 75 is BSD
+# EX_TEMPFAIL ("temporary failure, user is invited to retry"), far from
+# the launcher's operator-stop codes (130/143) and from any shell/signal
+# encoding (128+N).
+PREEMPTION_RC = 75
+
+GUARD_POLICIES = ("off", "skip", "rollback", "abort")
+
+_POLICY_VAR = "HOROVOD_STEP_GUARD"
+_SENTINEL_VAR = "HOROVOD_SENTINEL_INTERVAL"
+_LKG_VAR = "HOROVOD_LKG_INTERVAL"
+_BURST_VAR = "HOROVOD_GUARD_NAN_BURST"
+
+
+class GuardAbort(RuntimeError):
+    """Raised by :meth:`StepGuard.after_step` under policy ``abort``."""
+
+
+class DivergenceError(RuntimeError):
+    """Raised by the sentinel when replicas diverge and the policy does
+    not heal (anything but ``rollback``).  Carries ``.ranks``."""
+
+    def __init__(self, message: str, ranks: Sequence[int]):
+        super().__init__(message)
+        self.ranks = tuple(ranks)
+
+
+def guard_policy() -> str:
+    """The step-guard policy from ``HOROVOD_STEP_GUARD`` (default
+    ``off``).  Read at *trace* time by :func:`apply_step_guard` — set it
+    before building the training step."""
+    value = os.environ.get(_POLICY_VAR, "off").strip().lower() or "off"
+    if value not in GUARD_POLICIES:
+        raise ValueError(
+            f"{_POLICY_VAR}={value!r}: expected one of "
+            f"{', '.join(GUARD_POLICIES)}")
+    return value
+
+
+def _env_interval(var: str, default: int, minimum: int = 0) -> int:
+    raw = os.environ.get(var, "")
+    if not raw.strip():
+        return default
+    try:
+        value = int(raw)
+    except ValueError:
+        raise ValueError(f"{var}={raw!r} is not an integer")
+    if value < minimum:
+        raise ValueError(f"{var}={value} must be >= {minimum}")
+    return value
+
+
+# ---------------------------------------------------------------------------
+# In-graph step guard
+# ---------------------------------------------------------------------------
+
+def all_finite(axes, loss, *trees):
+    """In-graph global finiteness flag: True iff ``loss`` and every
+    inexact leaf of ``trees`` is finite on **every** shard of ``axes``.
+    The local flag is an int32 min over leaves; the global agreement is
+    ``psum(flag) == psum(1)`` (the product of the axis sizes), so all
+    shards compute the same boolean."""
+    flags = []
+    for leaf in jax.tree_util.tree_leaves((loss,) + tuple(trees)):
+        arr = jnp.asarray(leaf)
+        if jnp.issubdtype(arr.dtype, jnp.inexact):
+            flags.append(jnp.all(jnp.isfinite(arr)).astype(jnp.int32))
+    local = (functools.reduce(jnp.minimum, flags) if flags
+             else jnp.int32(1))
+    axes = tuple(a for a in (axes or ()) if a)
+    if not axes:
+        return local == 1
+    return lax.psum(local, axes) == lax.psum(jnp.int32(1), axes)
+
+
+def apply_step_guard(do_update, *, loss, grads, old_state, axes=(),
+                     agree_axes=None):
+    """Wrap one optimizer update with the NaN/Inf step guard.
+
+    ``do_update()`` (a closure over ``grads``) must return a new state
+    pytree congruent with ``old_state``.  Returns ``(state, mean_loss)``
+    where ``mean_loss = pmean(loss, axes)``.  Under policy ``off`` this
+    is exactly ``(do_update(), pmean(loss))`` — zero overhead.  Under any
+    other policy the update runs unconditionally and the guard selects
+    per leaf between new and old state (collectives cannot live inside a
+    ``lax.cond`` branch under SPMD — the select *is* the skip), and a bad
+    step's mean loss is poisoned to NaN so the host can see it
+    (:meth:`StepGuard.after_step` keys off exactly that).
+
+    ``agree_axes`` (default: ``axes``) is where the finiteness verdict is
+    psummed — pass *every* mesh axis the state is sharded over (e.g. the
+    tensor-parallel model axis on top of the data axes), so all shards
+    select the same branch.
+
+    The policy is read at trace time: build the step *after* setting
+    ``HOROVOD_STEP_GUARD``.
+    """
+    axes = tuple(a for a in (axes or ()) if a)
+    agree_axes = (axes if agree_axes is None
+                  else tuple(a for a in agree_axes if a))
+    mean_loss = lax.pmean(loss, axes) if axes else loss
+    policy = guard_policy()
+    if policy == "off":
+        return do_update(), mean_loss
+    if telemetry.enabled():  # trace-time: counts guarded step *traces*
+        telemetry.counter(
+            "hvd_guard_traces_total",
+            "training-step traces built with the step guard enabled",
+            policy=policy).inc()
+    ok = all_finite(agree_axes, loss, grads)
+    new_state = do_update()
+    guarded = jax.tree_util.tree_map(
+        lambda new, old: jnp.where(ok, new, old), new_state, old_state)
+    bad = jnp.asarray(jnp.nan, dtype=jnp.result_type(mean_loss))
+    return guarded, jnp.where(ok, mean_loss, bad)
+
+
+# ---------------------------------------------------------------------------
+# Host-side helpers
+# ---------------------------------------------------------------------------
+
+def _host_finite(arr: np.ndarray) -> bool:
+    """Finiteness of host bytes; ml_dtypes kinds (bf16 is 'V' to numpy)
+    go through a float32 cast."""
+    kind = getattr(arr.dtype, "kind", "")
+    if kind in ("f", "c"):
+        return bool(np.isfinite(arr).all())
+    if kind == "V":  # bfloat16 & friends
+        return bool(np.isfinite(np.asarray(arr, np.float32)).all())
+    return True
+
+
+def _pull_to_host(leaves):
+    """Device->host for a list of leaves, overlapping the transfers:
+    issue every async copy first, then materialize."""
+    for leaf in leaves:
+        copy_async = getattr(leaf, "copy_to_host_async", None)
+        if copy_async is not None:
+            copy_async()
+    return [np.asarray(leaf) for leaf in leaves]
+
+
+def _leaf_sharding(leaf):
+    if isinstance(leaf, jax.Array):
+        try:
+            return leaf.sharding
+        except Exception:  # pragma: no cover - deleted/donated buffers
+            return None
+    return None
+
+
+def tree_digest(tree) -> int:
+    """Cheap deterministic digest of a pytree: crc32 chained over the
+    host bytes of every leaf in tree-flatten order.  crc32 < 2**32 is
+    exactly representable in float64, so digests survive a float
+    allreduce bit-exactly."""
+    crc = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        crc = zlib.crc32(arr.tobytes(), crc)
+    return crc
+
+
+def _divergent_ranks(digests) -> list:
+    """Name the diverging rank(s): rows of ``digests`` (one per rank)
+    that differ from the modal row.  Ties break to the smallest row, so
+    every rank computes the same answer from the same allgathered
+    array."""
+    rows = [tuple(np.asarray(row).ravel().tolist()) for row in digests]
+    counts = {}
+    for row in rows:
+        counts[row] = counts.get(row, 0) + 1
+    top = max(counts.values())
+    modal = min(row for row, n in counts.items() if n == top)
+    return [i for i, row in enumerate(rows) if row != modal]
+
+
+class LastKnownGood:
+    """Double-buffered host snapshot of the last validated training
+    state.  :meth:`stage` pulls to the standby buffer and validates the
+    bytes (nearly free — they are already on the host); :meth:`commit`
+    flips it in only after the *global* verdict is in, so a poisoned or
+    torn snapshot can never replace a good one.  Requires the state to
+    be fully addressable from this process (true for this repo's
+    per-process device meshes)."""
+
+    def __init__(self):
+        self._committed = None  # (step, treedef, host leaves, shardings)
+        self._staged = None
+
+    @property
+    def available(self) -> bool:
+        return self._committed is not None
+
+    @property
+    def step(self) -> Optional[int]:
+        return self._committed[0] if self._committed else None
+
+    def stage(self, params, opt_state, step: int) -> bool:
+        """Pull ``(params, opt_state)`` into the standby buffer.  Returns
+        False — and stages nothing — when the pulled bytes contain
+        NaN/Inf (the live state is already poisoned)."""
+        t0 = telemetry.clock()
+        leaves, treedef = jax.tree_util.tree_flatten((params, opt_state))
+        shardings = [_leaf_sharding(l) for l in leaves]
+        host = _pull_to_host(leaves)
+        ok = all(_host_finite(h) for h in host)
+        if ok:
+            self._staged = (int(step), treedef, host, shardings)
+        else:
+            self._staged = None
+            if telemetry.enabled():
+                telemetry.counter(
+                    "hvd_rollback_snapshot_rejected_total",
+                    "staged snapshots rejected for non-finite bytes").inc()
+        if telemetry.enabled():
+            telemetry.histogram(
+                "hvd_rollback_snapshot_seconds",
+                "host pull + validation time per staged snapshot",
+            ).observe(telemetry.clock() - t0)
+        return ok
+
+    def commit(self) -> None:
+        if self._staged is None:
+            return
+        self._committed, self._staged = self._staged, None
+        if telemetry.enabled():
+            telemetry.counter(
+                "hvd_rollback_snapshots_total",
+                "last-known-good snapshots committed").inc()
+
+    def discard_stage(self) -> None:
+        self._staged = None
+
+    def restore(self) -> Tuple[Any, Any, int]:
+        """Fresh device copies of the committed snapshot as
+        ``(params, opt_state, step)``.  Explicit copies (``device_put``
+        with the captured shardings) so the restored arrays never alias
+        the host buffers — safe to feed straight back into a donating
+        jitted step."""
+        if self._committed is None:
+            raise RuntimeError("no last-known-good snapshot available")
+        step, treedef, host, shardings = self._committed
+        leaves = [jax.device_put(h, s) if s is not None else jnp.array(h)
+                  for h, s in zip(host, shardings)]
+        params, opt_state = jax.tree_util.tree_unflatten(treedef, leaves)
+        if telemetry.enabled():
+            telemetry.counter(
+                "hvd_rollback_restores_total",
+                "in-process restores from last-known-good").inc()
+        return params, opt_state, step
+
+
+class GuardEvent(NamedTuple):
+    """What :meth:`StepGuard.after_step` did.  ``action`` is one of
+    ``ok | skip | rollback | heal``; ``step`` is the step the returned
+    state corresponds to (the last-known-good step after a rollback)."""
+    action: str
+    step: int
+
+
+class StepGuard:
+    """Host-side coordinator for the in-graph guard: validates each
+    step's outcome across ranks, maintains the last-known-good snapshot,
+    runs the divergence sentinel, and decides skip/rollback/abort.
+
+    Usage::
+
+        guard = hvd.StepGuard()            # reads HOROVOD_STEP_GUARD etc.
+        for step in range(n):
+            params, opt_state, loss = train_step(params, opt_state, batch)
+            params, opt_state, ev = guard.after_step(
+                params, opt_state, step, loss)
+
+    ``loss`` is the step's returned mean loss — NaN marks a guarded-bad
+    step (see :func:`apply_step_guard`).  All ranks must call
+    ``after_step`` for every step: the verdict is coordinated with an
+    eager-plane ``Min`` allreduce of the local ok flag, so either every
+    rank rolls back or none does (a NaN burst can hit one rank's shard
+    only, but state must stay replicated)."""
+
+    def __init__(self, policy: Optional[str] = None,
+                 sentinel_interval: Optional[int] = None,
+                 snapshot_interval: Optional[int] = None,
+                 nan_burst: Optional[int] = None):
+        self.policy = guard_policy() if policy is None else policy
+        if self.policy not in GUARD_POLICIES:
+            raise ValueError(
+                f"policy {self.policy!r}: expected one of "
+                f"{', '.join(GUARD_POLICIES)}")
+        self.sentinel_interval = (
+            _env_interval(_SENTINEL_VAR, 0)
+            if sentinel_interval is None else int(sentinel_interval))
+        self.snapshot_interval = (
+            _env_interval(_LKG_VAR, 1, minimum=1)
+            if snapshot_interval is None else max(1, int(snapshot_interval)))
+        self.nan_burst = (
+            _env_interval(_BURST_VAR, 1, minimum=1)
+            if nan_burst is None else max(1, int(nan_burst)))
+        self.lkg = LastKnownGood()
+        self._bad_streak = 0
+        self._warned_no_lkg = False
+
+    # -- coordination -----------------------------------------------------
+
+    @staticmethod
+    def _global_ok(local_ok: bool) -> bool:
+        """Min-allreduce of the local verdict over the eager plane: the
+        step is good only if it is good on *every* rank."""
+        if basics.size() <= 1:
+            return local_ok
+        flag = np.array([1.0 if local_ok else 0.0], np.float32)
+        out = _c._eager_allreduce(
+            flag, _c.Min, "hvd.resilience.guard.ok", 1.0, 1.0)
+        return bool(np.asarray(out)[0] >= 0.5)
+
+    # -- sentinel ---------------------------------------------------------
+
+    def _digests(self, params, opt_state) -> np.ndarray:
+        opt_digest = None
+        try:
+            from horovod_tpu.parallel import zero
+            if isinstance(opt_state, zero.ZeroShardedState):
+                opt_digest = zero.local_state_digest(opt_state)
+        except ImportError:  # pragma: no cover
+            pass
+        if opt_digest is None:
+            opt_digest = tree_digest(opt_state)
+        return np.array([float(tree_digest(params)), float(opt_digest)],
+                        np.float64)
+
+    def _sentinel(self, params, opt_state, step: int):
+        """min/max digest agreement; on mismatch, name the diverging
+        rank(s) and heal (policy ``rollback``) or raise."""
+        if telemetry.enabled():
+            telemetry.counter(
+                "hvd_sentinel_checks_total",
+                "divergence sentinel digest comparisons").inc()
+        digest = self._digests(params, opt_state)
+        lo = _c._eager_allreduce(
+            digest, _c.Min, "hvd.resilience.sentinel.min", 1.0, 1.0)
+        hi = _c._eager_allreduce(
+            digest, _c.Max, "hvd.resilience.sentinel.max", 1.0, 1.0)
+        if np.array_equal(np.asarray(lo), np.asarray(hi)):
+            return params, opt_state, None
+        gathered = _c._eager_allgather(
+            digest.reshape(1, -1), "hvd.resilience.sentinel.digests")
+        bad_ranks = _divergent_ranks(np.asarray(gathered))
+        if telemetry.enabled():
+            telemetry.counter(
+                "hvd_sentinel_divergence_total",
+                "sentinel checks that found diverged replicas").inc()
+        message = (f"divergence sentinel at step {step}: replica digests "
+                   f"disagree; diverging rank(s): {bad_ranks}")
+        if self.policy != "rollback":
+            log.error("%s", message)
+            raise DivergenceError(message, bad_ranks)
+        source = min(r for r in range(basics.size()) if r not in bad_ranks)
+        log.error("%s — healing by re-broadcasting state from rank %d",
+                  message, source)
+        params, opt_state = _broadcast_state(params, opt_state, source)
+        if telemetry.enabled():
+            telemetry.counter(
+                "hvd_sentinel_heals_total",
+                "in-process divergence heals (state re-broadcast)").inc()
+        return params, opt_state, GuardEvent("heal", step)
+
+    # -- the step boundary -------------------------------------------------
+
+    def after_step(self, params, opt_state, step: int, loss):
+        """Validate one completed step.  Returns
+        ``(params, opt_state, GuardEvent)`` — possibly the restored
+        last-known-good state.  Must be called on every rank."""
+        if self.policy == "off" and self.sentinel_interval == 0:
+            return params, opt_state, GuardEvent("ok", step)
+        if telemetry.enabled():
+            telemetry.counter(
+                "hvd_guard_checks_total",
+                "host-side step-boundary guard evaluations").inc()
+
+        local_ok = bool(np.isfinite(np.asarray(loss, np.float64)).all())
+        staged = False
+        if (local_ok and self.policy == "rollback"
+                and step % self.snapshot_interval == 0):
+            staged = self.lkg.stage(params, opt_state, step)
+            local_ok = staged  # a rejected pull means the state is bad
+        ok = self._global_ok(local_ok)
+
+        if ok:
+            if staged:
+                self.lkg.commit()
+            self._bad_streak = 0
+            if (self.sentinel_interval > 0 and step > 0
+                    and step % self.sentinel_interval == 0
+                    and basics.size() > 1):
+                params, opt_state, event = self._sentinel(
+                    params, opt_state, step)
+                if event is not None:
+                    return params, opt_state, event
+            return params, opt_state, GuardEvent("ok", step)
+
+        # Bad step (on at least one rank — all ranks agree it was bad).
+        self.lkg.discard_stage()
+        self._bad_streak += 1
+        if telemetry.enabled():
+            telemetry.counter(
+                "hvd_guard_nonfinite_steps_total",
+                "steps rejected by the guard (non-finite loss/grads)").inc()
+        if self.policy == "abort":
+            raise GuardAbort(
+                f"step guard: non-finite loss/grads at step {step} "
+                f"(policy abort)")
+        if (self.policy == "rollback"
+                and self._bad_streak >= self.nan_burst):
+            if self.lkg.available:
+                params, opt_state, good_step = self.lkg.restore()
+                self._bad_streak = 0
+                log.warning(
+                    "step guard: non-finite step %d — rolled back to "
+                    "last-known-good step %d", step, good_step)
+                return params, opt_state, GuardEvent("rollback", good_step)
+            if not self._warned_no_lkg:
+                self._warned_no_lkg = True
+                log.warning(
+                    "step guard: rollback requested at step %d but no "
+                    "last-known-good snapshot exists yet — skipping "
+                    "instead", step)
+        if telemetry.enabled():
+            telemetry.counter(
+                "hvd_guard_skipped_steps_total",
+                "bad steps skipped (old state kept)").inc()
+        log.warning("step guard: non-finite step %d skipped "
+                    "(streak %d)", step, self._bad_streak)
+        return params, opt_state, GuardEvent("skip", step)
+
+
+def _broadcast_state(params, opt_state, root_rank: int):
+    """Re-broadcast ``(params, opt_state)`` from ``root_rank`` over the
+    eager plane, re-placing each leaf with its original sharding —
+    the divergence heal (a diverged rank's own snapshots are
+    finite-but-wrong, so only a healthy rank's live state can heal
+    it)."""
+    leaves, treedef = jax.tree_util.tree_flatten((params, opt_state))
+    out = []
+    for i, leaf in enumerate(leaves):
+        sharding = _leaf_sharding(leaf)
+        host = np.ascontiguousarray(np.asarray(leaf))
+        healed = _c._eager_broadcast(
+            host, root_rank, f"hvd.resilience.heal.{i}")
+        healed = np.asarray(healed, dtype=host.dtype)
+        out.append(jax.device_put(healed, sharding)
+                   if sharding is not None else jnp.array(healed))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# Preemption protocol
+# ---------------------------------------------------------------------------
+
+_preempt_event = threading.Event()
+_handler_lock = threading.Lock()
+_handler_installed = False
+
+
+def install_preemption_handler(signum: int = signal.SIGTERM) -> None:
+    """Turn ``signum`` (default SIGTERM — what schedulers send on
+    preemption) into a deferred request: the handler only sets a flag;
+    the training loop acts on it at the next step boundary via
+    :func:`maybe_save_and_exit`.  Idempotent; main thread only (signal
+    module constraint)."""
+    global _handler_installed
+    with _handler_lock:
+        if _handler_installed:
+            return
+
+        def _on_signal(sig, frame):  # noqa: ARG001
+            _preempt_event.set()
+            if telemetry.enabled():
+                telemetry.counter(
+                    "hvd_preempt_requests_total",
+                    "preemption signals received").inc()
+
+        signal.signal(signum, _on_signal)
+        _handler_installed = True
+        log.debug("preemption handler installed for signal %d", signum)
+
+
+def preemption_requested() -> bool:
+    return _preempt_event.is_set()
+
+
+def request_preemption() -> None:
+    """Programmatic equivalent of receiving the preemption signal (used
+    by tests and embedding frameworks with their own signal plumbing)."""
+    _preempt_event.set()
+
+
+def exit_preempted() -> "None":
+    """Exit with :data:`PREEMPTION_RC` via ``sys.exit`` so atexit hooks
+    (telemetry dumps, async-checkpoint drain) still run."""
+    log.warning("exiting with preemption rc %d (reschedule, do not "
+                "blacklist)", PREEMPTION_RC)
+    sys.exit(PREEMPTION_RC)
+
+
+def maybe_save_and_exit(ckpt_dir: str, state, step: int) -> bool:
+    """Call at every step boundary.  No-op (returns False) unless a
+    preemption was requested; then every rank performs the coordinated
+    synchronous save (the signal is delivered process-group-wide, so all
+    ranks reach this together), drains any in-flight async write first,
+    and exits with :data:`PREEMPTION_RC`."""
+    if not _preempt_event.is_set():
+        return False
+    from horovod_tpu import checkpoint
+    log.warning("preemption requested — coordinated save at step %d "
+                "to %s", step, ckpt_dir)
+    checkpoint.wait_for_async_save()
+    checkpoint.save(ckpt_dir, state, step=step)
+    if telemetry.enabled():
+        telemetry.counter(
+            "hvd_preempt_saves_total",
+            "coordinated preemption saves completed").inc()
+    exit_preempted()
+    return True  # pragma: no cover — sys.exit above
+
+
+def _reset_for_tests() -> None:
+    """Clear module state (preemption flag + handler marker)."""
+    global _handler_installed
+    _preempt_event.clear()
+    with _handler_lock:
+        _handler_installed = False

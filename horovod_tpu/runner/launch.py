@@ -20,6 +20,7 @@ import time
 from typing import Dict, List, Optional
 
 from horovod_tpu import faults, telemetry
+from horovod_tpu.resilience import PREEMPTION_RC
 from horovod_tpu.runner.hosts import RankInfo
 
 # Seconds between SIGTERM fan-out and the SIGKILL hammer.  Tunable: ranks
@@ -206,9 +207,16 @@ def launch_job(rank_infos: List[RankInfo], command: List[str],
                 running.discard(i)
                 if rc != 0:
                     exit_code = rc
-                    sys.stderr.write(
-                        f"hvdrun: rank {procs[i].info.rank} exited with "
-                        f"code {rc}; terminating remaining ranks.\n")
+                    if rc == PREEMPTION_RC:
+                        sys.stderr.write(
+                            f"hvdrun: rank {procs[i].info.rank} exited "
+                            f"with preemption code {rc}; terminating "
+                            f"remaining ranks for reschedule.\n")
+                    else:
+                        sys.stderr.write(
+                            f"hvdrun: rank {procs[i].info.rank} exited "
+                            f"with code {rc}; terminating remaining "
+                            f"ranks.\n")
                     for j in sorted(running):
                         procs[j].terminate()
                     stop.set()
@@ -235,12 +243,19 @@ def launch_job(rank_infos: List[RankInfo], command: List[str],
                 break
             time.sleep(0.05)
         failed = []
+        preempted = []
         for p in procs:
             p.proc.wait()
             rc = p.proc.returncode
             if rc not in (0, None) and exit_code == 0:
                 exit_code = rc
             if rc not in (0, None) and not p.terminated_by_launcher:
+                if rc == PREEMPTION_RC:
+                    # A preempted rank is not a failure and not its
+                    # host's fault: no blame, no blacklist — the elastic
+                    # caller reschedules immediately (runner/run.py).
+                    preempted.append((p.info.rank, p.info.hostname, rc))
+                    continue
                 # Genuine rank failure: it failed BEFORE the launcher
                 # began tearing the job down.  Anything after terminate()
                 # is collateral — including positive exit codes, since a
@@ -254,14 +269,21 @@ def launch_job(rank_infos: List[RankInfo], command: List[str],
             # operator stopped the job" from "a rank crashed" by this
             # code, and success must never be reported either.
             exit_code = 130
-            failed = []   # nothing to blame a host for
+            failed = []     # nothing to blame a host for
+            preempted = []
         if failed:
             telemetry.counter(
                 "hvd_rank_failures_total",
                 "Ranks that exited non-zero before launcher teardown "
                 "began").inc(len(failed))
+        if preempted:
+            telemetry.counter(
+                "hvd_preempted_ranks_total",
+                "Ranks that exited with the preemption code (saved and "
+                "asked for a reschedule)").inc(len(preempted))
         if report is not None:
             report["failed"] = failed
+            report["preempted"] = preempted
             report["signalled"] = signalled.is_set()
         return exit_code
     finally:

@@ -23,6 +23,7 @@ from typing import List
 
 import horovod_tpu
 from horovod_tpu import telemetry
+from horovod_tpu.resilience import PREEMPTION_RC
 from horovod_tpu.runner import config_parser, hosts, launch
 
 
@@ -221,15 +222,31 @@ def run_command(args) -> int:
                 telemetry.counter(
                     "hvd_elastic_restarts_total",
                     "Whole-job elastic restart attempts").inc()
-                # Brief backoff so a persistently broken launch (host mid-
-                # reboot, dead binary) doesn't burn the whole restart
-                # budget in a second — the budget targets transient
-                # failures.
-                delay = min(2.0 ** attempt, 30.0)
-                print(f"hvdrun: job failed (rc={rc}); elastic restart "
-                      f"{attempt}/{restarts} in {delay:.0f}s with a fresh "
-                      f"rendezvous", file=sys.stderr, flush=True)
-                time.sleep(delay)
+                if rc == PREEMPTION_RC:
+                    # Preemption: the ranks checkpointed and asked to be
+                    # rescheduled — no backoff (the host is healthy, the
+                    # scheduler is just reclaiming it) and nothing gets
+                    # blacklisted below (launch_job already keeps
+                    # preempted ranks out of report["failed"]).
+                    telemetry.counter(
+                        "hvd_preemptions_total",
+                        "Whole-job reschedules after rank preemption "
+                        "(coordinated save + rc "
+                        f"{PREEMPTION_RC})").inc()
+                    print(f"hvdrun: job preempted (rc={rc}); immediate "
+                          f"reschedule {attempt}/{restarts} with a fresh "
+                          f"rendezvous", file=sys.stderr, flush=True)
+                else:
+                    # Brief backoff so a persistently broken launch (host
+                    # mid-reboot, dead binary) doesn't burn the whole
+                    # restart budget in a second — the budget targets
+                    # transient failures.
+                    delay = min(2.0 ** attempt, 30.0)
+                    print(f"hvdrun: job failed (rc={rc}); elastic "
+                          f"restart {attempt}/{restarts} in {delay:.0f}s "
+                          f"with a fresh rendezvous",
+                          file=sys.stderr, flush=True)
+                    time.sleep(delay)
                 # Re-probe surviving remote hosts RIGHT BEFORE the
                 # attempt — the pre-launch check's hour-long cache would
                 # answer from before the failure.  A host that stopped
@@ -388,6 +405,11 @@ def _demote_failed_hosts(blacklist, host_list, failed, min_np) -> None:
     demotion in the re-probe above: a dead host can serve no world size.
     """
     for rank, hostname, code in failed:
+        if code == PREEMPTION_RC:
+            # Defense in depth: launch_job already files preempted ranks
+            # under report["preempted"], but a preemption must never
+            # blacklist a host even if one leaks through here.
+            continue
         if blacklist.is_blacklisted(hostname):
             continue
         remaining = sum(

@@ -1,0 +1,135 @@
+"""Self-healing gate workload (run: hvdrun -np 2 with
+HOROVOD_METRICS_FILE, see ci/run_tests.sh).
+
+Each rank builds its own virtual 8-device CPU mesh and drives the full
+resilience stack end-to-end (docs/fault_tolerance.md):
+
+1. guarded jitted training (HOROVOD_STEP_GUARD compiled into the step)
+   with a host-side :class:`StepGuard` validating every boundary;
+2. a rank-local NaN batch on rank 1 — the in-graph guard keeps rank 1's
+   old state, and the *coordinated* verdict (eager Min over local ok
+   flags) forces BOTH ranks to roll back to the same last-known-good
+   snapshot, keeping state replicated;
+3. a deliberate rank-1 parameter perturbation — the divergence sentinel
+   catches the digest mismatch at its next interval and heals in-process
+   by re-broadcasting state, after which the replicas agree bit-exactly;
+4. an async checkpoint (snapshot-to-host + background orbax write) that
+   drains cleanly.
+
+The merged telemetry summary must then show the ``hvd_guard_*`` /
+``hvd_rollback_*`` / ``hvd_sentinel_*`` / ``hvd_ckpt_async_*`` counters
+this workload exists to gate (docs/metrics.md).
+"""
+import os
+import shutil
+import tempfile
+
+# Per-rank virtual mesh: must precede any JAX backend initialization.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+# Compile the in-graph guard into the training step (read at trace time).
+os.environ["HOROVOD_STEP_GUARD"] = "skip"
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu import checkpoint, resilience, telemetry  # noqa: E402
+from horovod_tpu.telemetry import aggregate  # noqa: E402
+
+hvd.init()
+rank, size = hvd.rank(), hvd.size()
+assert size == 2, f"this workload expects -np 2, got size={size}"
+assert telemetry.enabled(), \
+    "telemetry must be enabled by the launcher-injected env"
+
+mesh = hvd.mesh()
+assert len(mesh.devices.ravel()) == 8, mesh
+
+
+def loss_fn(p, batch):
+    x, y = batch
+    return jnp.mean((x @ p["w"] - y) ** 2)
+
+
+rs = np.random.RandomState(0)   # identical on both ranks
+params = {"w": jnp.asarray(rs.randn(4, 2), jnp.float32)}
+x = jnp.asarray(rs.randn(16, 4), jnp.float32)
+y = jnp.asarray(rs.randn(16, 2), jnp.float32)
+
+step = hvd.make_training_step(loss_fn, optax.sgd(0.05), mesh, donate=False)
+opt_state = step.init(params)
+guard = resilience.StepGuard(policy="rollback", nan_burst=1,
+                             snapshot_interval=1, sentinel_interval=2)
+
+
+def digests_agree():
+    d = np.array([float(resilience.tree_digest((params, opt_state)))],
+                 np.float64)
+    lo = np.asarray(hvd.allreduce(d, op=hvd.Min, name="gate.digest.min"))
+    hi = np.asarray(hvd.allreduce(d, op=hvd.Max, name="gate.digest.max"))
+    return bool(lo[0] == hi[0])
+
+
+# -- 1. clean guarded steps (sentinel fires at step 2) -----------------------
+for i in range(4):
+    params, opt_state, loss = step(params, opt_state, (x, y))
+    params, opt_state, ev = guard.after_step(params, opt_state, i,
+                                             float(loss))
+    assert ev.action == "ok", f"rank {rank} step {i}: {ev}"
+assert guard.lkg.step == 3
+
+# -- 2. rank-local NaN -> coordinated rollback on BOTH ranks -----------------
+x_mine = x.at[0, 0].set(jnp.nan) if rank == 1 else x
+params, opt_state, loss = step(params, opt_state, (x_mine, y))
+if rank == 1:
+    assert np.isnan(float(loss)), "in-graph guard must poison the loss"
+else:
+    assert np.isfinite(float(loss))
+params, opt_state, ev = guard.after_step(params, opt_state, 4, float(loss))
+assert ev.action == "rollback" and ev.step == 3, \
+    f"rank {rank}: expected coordinated rollback to 3, got {ev}"
+assert digests_agree(), f"rank {rank}: replicas differ after rollback"
+
+params, opt_state, ev = guard.after_step(params, opt_state, 5, 0.1)
+assert ev.action == "ok"
+
+# -- 3. deliberate divergence -> sentinel heal at its interval ---------------
+if rank == 1:
+    params = {"w": params["w"] + jnp.float32(1e-3)}
+params, opt_state, ev = guard.after_step(params, opt_state, 6, 0.1)
+assert ev.action == "heal", f"rank {rank}: expected sentinel heal, got {ev}"
+assert digests_agree(), f"rank {rank}: replicas differ after heal"
+
+# -- 4. async checkpoint drains cleanly --------------------------------------
+ckpt_dir = tempfile.mkdtemp(prefix="hvd_resilience_gate_")
+try:
+    checkpoint.save_async(ckpt_dir, {"w": params["w"]}, step=6)
+    written = checkpoint.wait_for_async_save()
+    if rank == 0:
+        assert written is not None, "rank 0 async save failed"
+        assert checkpoint.latest_step(ckpt_dir) == 6
+finally:
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+snap = hvd.metrics_snapshot()
+n_checks = aggregate.counter_total(snap, "hvd_guard_checks_total")
+n_bad = aggregate.counter_total(snap, "hvd_guard_nonfinite_steps_total")
+n_restore = aggregate.counter_total(snap, "hvd_rollback_restores_total")
+n_sentinel = aggregate.counter_total(snap, "hvd_sentinel_checks_total")
+n_heal = aggregate.counter_total(snap, "hvd_sentinel_heals_total")
+assert n_checks >= 7, f"rank {rank}: guard checks {n_checks}"
+assert n_bad >= 1, f"rank {rank}: no nonfinite step recorded"
+assert n_restore >= 1, f"rank {rank}: no rollback restore recorded"
+assert n_sentinel >= 1, f"rank {rank}: sentinel never ran"
+assert n_heal >= 1, f"rank {rank}: no sentinel heal recorded"
+if rank == 0:
+    n_async = aggregate.counter_total(snap, "hvd_ckpt_async_saves_total")
+    assert n_async >= 1, "rank 0: no async checkpoint write recorded"
+
+print(f"RESILIENCE_WORKLOAD_OK rank={rank} guard_checks={int(n_checks)} "
+      f"rollbacks={int(n_restore)} heals={int(n_heal)}", flush=True)

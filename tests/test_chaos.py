@@ -148,6 +148,162 @@ def test_chaos_hang_trips_eager_deadline(tmp_path):
     assert "NO_STALL" not in res.stdout, out
 
 
+def test_chaos_nan_injection_rolls_back_and_converges(tmp_path):
+    """The self-healing acceptance scenario (ISSUE 4): a nan fault
+    poisons rank 1's grad-allreduce output at step 2; the StepGuard's
+    coordinated verdict (eager Min over the local ok flags) makes BOTH
+    ranks roll back to the last-known-good snapshot — rank 0's copy was
+    finite, but state must stay replicated — and training resumes
+    in-process to convergence.  No relaunch, no elastic restart.
+
+    Hit counting: each guarded step costs rank 1 two allreduce passages
+    (the grad op, then the guard's ok flag), so grad ops are the ODD
+    hits.  after=4 fires on hit 5 = step 2's grad allreduce — an even
+    `after` can never land on the coordination flag (poisoning the flag
+    would give rank-divergent verdicts)."""
+    script = tmp_path / "heal.py"
+    script.write_text(textwrap.dedent("""\
+        import numpy as np
+        import horovod_tpu as hvd
+        from horovod_tpu import resilience
+
+        hvd.init()
+        rank = hvd.rank()
+        assert hvd.size() == 2
+        guard = resilience.StepGuard(policy="rollback", nan_burst=1,
+                                     snapshot_interval=1,
+                                     sentinel_interval=0)
+        TARGET, LR, TOTAL = 3.0, 0.2, 12
+        w = {"w": np.zeros(4, np.float32)}
+        rollbacks = 0
+        for step in range(TOTAL):
+            grad = 2.0 * (w["w"] - TARGET)
+            g = np.asarray(hvd.allreduce(grad, name=f"heal.g.{step}"))
+            w = {"w": (w["w"] - LR * g).astype(np.float32)}
+            loss = float(np.mean((w["w"] - TARGET) ** 2))
+            w, _, ev = guard.after_step(w, {}, step, loss)
+            w = {"w": np.asarray(w["w"], np.float32)}
+            if ev.action == "rollback":
+                rollbacks += 1
+                print(f"ROLLBACK rank={rank} at={step} to={ev.step}",
+                      flush=True)
+        assert rollbacks == 1, f"rank {rank}: {rollbacks} rollbacks"
+        assert np.isfinite(w["w"]).all()
+        err = float(np.abs(w["w"] - TARGET).max())
+        assert err < 0.05, f"rank {rank}: did not converge, err={err}"
+        print(f"HEAL_OK rank={rank} final={w['w'][0]:.6f}", flush=True)
+    """))
+    res = _hvdrun(
+        ["-np", "2", sys.executable, str(script)],
+        env={"HOROVOD_FAULT_SPEC":
+                 "rank=1,site=allreduce,after=4,kind=nan,count=1"})
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out
+    assert "firing kind=nan" in out, out
+    assert "ROLLBACK rank=0 at=2 to=1" in res.stdout, out
+    assert "ROLLBACK rank=1 at=2 to=1" in res.stdout, out
+    assert "HEAL_OK rank=0" in res.stdout, out
+    assert "HEAL_OK rank=1" in res.stdout, out
+    assert "elastic restart" not in res.stderr, out
+
+
+def test_chaos_preemption_saves_and_reschedules(tmp_path):
+    """SIGTERM-as-preemption: both ranks get SIGTERM mid-training
+    (self-delivered at the same step — a scheduler signals the whole
+    allocation), the handler defers it to the next step boundary where
+    maybe_save_and_exit performs the coordinated save and exits with
+    rc 75.  The launcher treats 75 as preemption: immediate reschedule,
+    NO blacklist, NO backoff — and the fresh attempt resumes from the
+    preemption checkpoint with the full world."""
+    ckpt = tmp_path / "ckpt"
+    script = tmp_path / "preempt.py"
+    script.write_text(textwrap.dedent(f"""\
+        import os
+        import signal
+        import numpy as np
+        import horovod_tpu as hvd
+        from horovod_tpu import checkpoint, resilience
+
+        hvd.init()
+        rank, size = hvd.rank(), hvd.size()
+        attempt = os.environ.get("HOROVOD_RESTART_ATTEMPT", "0")
+        CKPT = {str(ckpt)!r}
+        TOTAL = 6
+        resilience.install_preemption_handler()
+
+        state = {{"w": np.zeros(4, np.float32),
+                  "step": np.zeros((), np.int64)}}
+        state = checkpoint.restore(CKPT, state)
+        start = int(state["step"])
+        if attempt == "1":
+            # The preemption at step 3's boundary saved steps 0-2; the
+            # reschedule must resume there with the FULL world — a
+            # preempted host is healthy, not blacklisted.
+            assert start == 3, f"expected resume from step 3, got {{start}}"
+            assert size == 2, f"expected full world of 2, got {{size}}"
+        for step in range(start, TOTAL):
+            g = np.full(4, float(step), np.float32)
+            state["w"] = state["w"] + np.asarray(
+                hvd.allreduce(g, name=f"preempt.{{step}}"))
+            state["step"] = np.asarray(step + 1, np.int64)
+            if attempt == "0" and step + 1 == 3:
+                os.kill(os.getpid(), signal.SIGTERM)
+            resilience.maybe_save_and_exit(CKPT, state, step + 1)
+
+        want = sum(range(TOTAL))
+        np.testing.assert_allclose(state["w"], np.full(4, float(want)),
+                                   rtol=1e-6)
+        print(f"PREEMPT_OK attempt={{attempt}} rank={{rank}} "
+              f"size={{size}}", flush=True)
+    """))
+    res = _hvdrun(
+        ["-np", "2", "--elastic-restarts", "1",
+         sys.executable, str(script)],
+        # Rank 1 may still be inside the coordinated orbax save when
+        # rank 0's exit starts the teardown; give it headroom.
+        env={"HOROVOD_TERMINATE_GRACE_SECONDS": "15"})
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out
+    assert "PREEMPT_OK attempt=1 rank=0 size=2" in res.stdout, out
+    assert "exited with preemption code 75" in res.stderr, out
+    assert "job preempted (rc=75); immediate reschedule" in res.stderr, out
+    assert "blacklisting host" not in res.stderr, out
+
+
+def test_chaos_rank0_save_failure_degrades_not_deadlocks(tmp_path):
+    """The satellite deadlock fix: rank 0's orbax write raises (the
+    checkpoint path is an existing FILE), and instead of stranding rank 1
+    in a barrier forever, the success-flag broadcast tells everyone the
+    save failed — save() returns None on all ranks and the job keeps
+    training.  Bounded wall-clock IS the assertion."""
+    blocker = tmp_path / "ckpt"
+    blocker.write_text("not a directory")
+    script = tmp_path / "degrade.py"
+    script.write_text(textwrap.dedent(f"""\
+        import numpy as np
+        import horovod_tpu as hvd
+        from horovod_tpu import checkpoint
+
+        hvd.init()
+        rank = hvd.rank()
+        state = {{"w": np.ones(4, np.float32)}}
+        path = checkpoint.save({str(blocker)!r}, state, step=1)
+        assert path is None, f"rank {{rank}}: expected degraded save"
+        # The job is still coordinated after the failed save:
+        out = np.asarray(hvd.allreduce(np.full(2, float(rank + 1),
+                                               np.float32),
+                                       average=False, name="after.save"))
+        assert out.tolist() == [3.0, 3.0], out
+        print(f"DEGRADE_OK rank={{rank}}", flush=True)
+    """))
+    res = _hvdrun(["-np", "2", sys.executable, str(script)], timeout=120)
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out
+    assert "DEGRADE_OK rank=0" in res.stdout, out
+    assert "DEGRADE_OK rank=1" in res.stdout, out
+    assert "FAILED" in out, out           # the loud log, not an exception
+
+
 def test_chaos_spec_typo_fails_loudly(tmp_path):
     """A typo'd HOROVOD_FAULT_SPEC must fail the rank at the first
     injection point with FaultSpecError — a chaos run that silently

@@ -1,0 +1,480 @@
+"""Unit tests for the self-healing loop (horovod_tpu/resilience.py):
+guard policy plumbing, in-graph finiteness select, last-known-good
+snapshot/rollback, divergence-rank naming, the nan/corrupt value faults,
+checkpoint save degradation + async saves, and the preemption protocol.
+Multi-rank coordination (global ok flag, sentinel heal, preemption
+reschedule) is covered end-to-end in test_chaos.py and
+tests/distributed/resilience_workload_np2.py."""
+
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from horovod_tpu import faults, resilience
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for var in ("HOROVOD_STEP_GUARD", "HOROVOD_SENTINEL_INTERVAL",
+                "HOROVOD_LKG_INTERVAL", "HOROVOD_GUARD_NAN_BURST",
+                faults.ENV_VAR):
+        monkeypatch.delenv(var, raising=False)
+    faults.reset()
+    resilience._reset_for_tests()
+    yield
+    faults.reset()
+    resilience._reset_for_tests()
+
+
+# -- policy plumbing ---------------------------------------------------------
+
+def test_guard_policy_default_off():
+    assert resilience.guard_policy() == "off"
+
+
+def test_guard_policy_normalizes_case(monkeypatch):
+    monkeypatch.setenv("HOROVOD_STEP_GUARD", " Rollback ")
+    assert resilience.guard_policy() == "rollback"
+
+
+def test_guard_policy_invalid_lists_choices(monkeypatch):
+    monkeypatch.setenv("HOROVOD_STEP_GUARD", "skipp")
+    with pytest.raises(ValueError, match="off, skip, rollback, abort"):
+        resilience.guard_policy()
+
+
+def test_env_interval_validation(monkeypatch):
+    monkeypatch.setenv("HOROVOD_SENTINEL_INTERVAL", "ten")
+    with pytest.raises(ValueError, match="not an integer"):
+        resilience._env_interval("HOROVOD_SENTINEL_INTERVAL", 0)
+    monkeypatch.setenv("HOROVOD_SENTINEL_INTERVAL", "-1")
+    with pytest.raises(ValueError, match=">= 0"):
+        resilience._env_interval("HOROVOD_SENTINEL_INTERVAL", 0)
+
+
+# -- in-graph guard ----------------------------------------------------------
+
+def test_all_finite_local():
+    good = {"w": jnp.ones(3), "i": jnp.arange(3)}   # ints don't count
+    bad = {"w": jnp.array([1.0, jnp.nan, 2.0])}
+    assert bool(resilience.all_finite((), jnp.float32(0.5), good))
+    assert not bool(resilience.all_finite((), jnp.float32(0.5), bad))
+    assert not bool(resilience.all_finite((), jnp.float32(jnp.inf), good))
+    # integer-only trees are vacuously finite
+    assert bool(resilience.all_finite((), jnp.int32(1), {"i": jnp.arange(3)}))
+
+
+def test_apply_step_guard_off_is_transparent():
+    old = {"w": jnp.zeros(2)}
+    new = {"w": jnp.ones(2)}
+    state, loss = resilience.apply_step_guard(
+        lambda: new, loss=jnp.float32(1.5), grads=old, old_state=old)
+    assert state is new
+    assert float(loss) == 1.5
+
+
+def test_apply_step_guard_skip_selects_old_state(monkeypatch):
+    monkeypatch.setenv("HOROVOD_STEP_GUARD", "skip")
+    old = {"w": jnp.arange(4.0)}
+    new = {"w": jnp.arange(4.0) + 1.0}
+
+    state, loss = resilience.apply_step_guard(
+        lambda: new, loss=jnp.float32(jnp.nan), grads=old, old_state=old)
+    np.testing.assert_array_equal(np.asarray(state["w"]),
+                                  np.asarray(old["w"]))
+    assert np.isnan(float(loss))
+
+    # non-finite *grads* with a finite loss must also trip the guard
+    bad_grads = {"w": jnp.array([1.0, jnp.inf, 0.0, 0.0])}
+    state, loss = resilience.apply_step_guard(
+        lambda: new, loss=jnp.float32(0.5), grads=bad_grads, old_state=old)
+    np.testing.assert_array_equal(np.asarray(state["w"]),
+                                  np.asarray(old["w"]))
+    assert np.isnan(float(loss))
+
+    # and a clean step passes through
+    state, loss = resilience.apply_step_guard(
+        lambda: new, loss=jnp.float32(0.5), grads=old, old_state=old)
+    np.testing.assert_array_equal(np.asarray(state["w"]),
+                                  np.asarray(new["w"]))
+    assert float(loss) == 0.5
+
+
+def _linreg_loss(params, batch):
+    x, y = batch
+    pred = x @ params["w"]
+    return jnp.mean((pred - y) ** 2)
+
+
+def test_training_step_guard_skips_poisoned_batch(hvd, mesh8, monkeypatch):
+    """The wired-in guard (parallel/data.py): a NaN batch returns the old
+    params bit-exactly and a NaN mean loss; the next clean batch trains.
+    No relaunch, no re-init — the step is self-healing in-graph."""
+    monkeypatch.setenv("HOROVOD_STEP_GUARD", "skip")
+    rs = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rs.randn(4, 2), jnp.float32)}
+    x = jnp.asarray(rs.randn(16, 4), jnp.float32)
+    y = jnp.asarray(rs.randn(16, 2), jnp.float32)
+
+    step = hvd.make_training_step(_linreg_loss, optax.sgd(0.1), mesh8,
+                                  donate=False)
+    opt_state = step.init(params)
+
+    x_bad = x.at[3, 1].set(jnp.nan)
+    p1, o1, loss = step(params, opt_state, (x_bad, y))
+    assert np.isnan(float(loss))
+    np.testing.assert_array_equal(np.asarray(p1["w"]),
+                                  np.asarray(params["w"]))
+
+    p2, o2, loss = step(p1, o1, (x, y))
+    assert np.isfinite(float(loss))
+    assert not np.array_equal(np.asarray(p2["w"]), np.asarray(p1["w"]))
+
+
+def test_training_step_guard_off_by_default(hvd, mesh8):
+    """Without HOROVOD_STEP_GUARD a NaN batch propagates into params —
+    the pre-PR behavior, proving the guard is opt-in and zero-overhead."""
+    rs = np.random.RandomState(1)
+    params = {"w": jnp.asarray(rs.randn(4, 2), jnp.float32)}
+    x = jnp.asarray(rs.randn(16, 4), jnp.float32).at[0, 0].set(jnp.nan)
+    y = jnp.asarray(rs.randn(16, 2), jnp.float32)
+
+    step = hvd.make_training_step(_linreg_loss, optax.sgd(0.1), mesh8,
+                                  donate=False)
+    opt_state = step.init(params)
+    p1, _, loss = step(params, opt_state, (x, y))
+    assert np.isnan(float(loss))
+    assert np.isnan(np.asarray(p1["w"])).any()
+
+
+# -- last-known-good ---------------------------------------------------------
+
+def test_lkg_stage_commit_restore_bit_identical(hvd):
+    lkg = resilience.LastKnownGood()
+    assert not lkg.available and lkg.step is None
+    params = {"w": jnp.asarray(np.random.RandomState(2).randn(8, 3),
+                               jnp.float32)}
+    opt = {"m": jnp.zeros((8, 3), jnp.float32), "count": jnp.int32(7)}
+
+    assert lkg.stage(params, opt, step=5)
+    lkg.commit()
+    assert lkg.available and lkg.step == 5
+
+    r_params, r_opt, r_step = lkg.restore()
+    assert r_step == 5
+    np.testing.assert_array_equal(np.asarray(r_params["w"]),
+                                  np.asarray(params["w"]))
+    np.testing.assert_array_equal(np.asarray(r_opt["m"]),
+                                  np.asarray(opt["m"]))
+    assert int(r_opt["count"]) == 7
+    # restore() hands back fresh device arrays, never the host buffers
+    assert r_params["w"] is not params["w"]
+
+
+def test_lkg_rejects_poisoned_snapshot(hvd):
+    lkg = resilience.LastKnownGood()
+    good = {"w": jnp.ones(4, jnp.float32)}
+    bad = {"w": jnp.array([1.0, jnp.nan, 0.0, 0.0], jnp.float32)}
+
+    assert lkg.stage(good, {}, step=1)
+    lkg.commit()
+    # a poisoned pull must not replace the committed snapshot
+    assert not lkg.stage(bad, {}, step=2)
+    lkg.commit()   # commits nothing — stage was rejected
+    assert lkg.step == 1
+    r_params, _, _ = lkg.restore()
+    np.testing.assert_array_equal(np.asarray(r_params["w"]),
+                                  np.asarray(good["w"]))
+
+
+def test_lkg_restore_without_snapshot_raises():
+    with pytest.raises(RuntimeError, match="no last-known-good"):
+        resilience.LastKnownGood().restore()
+
+
+# -- StepGuard (single-rank coordination) ------------------------------------
+
+def test_step_guard_ok_path_commits_snapshot(hvd):
+    guard = resilience.StepGuard(policy="rollback", snapshot_interval=1)
+    params = {"w": jnp.arange(4.0)}
+    opt = {"m": jnp.zeros(4)}
+    p, o, ev = guard.after_step(params, opt, 0, 0.25)
+    assert ev.action == "ok" and ev.step == 0
+    assert guard.lkg.available and guard.lkg.step == 0
+
+
+def test_step_guard_skip_policy(hvd):
+    guard = resilience.StepGuard(policy="skip")
+    params = {"w": jnp.arange(4.0)}
+    p, o, ev = guard.after_step(params, {}, 3, float("nan"))
+    assert ev.action == "skip"
+    assert p is params   # skip keeps the (guard-selected old) state as-is
+
+
+def test_step_guard_abort_policy(hvd):
+    guard = resilience.StepGuard(policy="abort")
+    with pytest.raises(resilience.GuardAbort, match="step 4"):
+        guard.after_step({"w": jnp.zeros(2)}, {}, 4, float("nan"))
+
+
+def test_step_guard_rollback_after_nan_burst(hvd):
+    guard = resilience.StepGuard(policy="rollback", nan_burst=2,
+                                 snapshot_interval=1)
+    good = {"w": jnp.arange(4.0)}
+    opt = {"m": jnp.zeros(4)}
+    _, _, ev = guard.after_step(good, opt, 0, 0.5)
+    assert ev.action == "ok"
+
+    live = {"w": jnp.arange(4.0) + 9.0}   # whatever the guard kept live
+    _, _, ev = guard.after_step(live, opt, 1, float("nan"))
+    assert ev.action == "skip"            # streak 1 < burst 2
+
+    p, o, ev = guard.after_step(live, opt, 2, float("nan"))
+    assert ev.action == "rollback" and ev.step == 0
+    np.testing.assert_array_equal(np.asarray(p["w"]), np.asarray(good["w"]))
+    assert guard._bad_streak == 0         # rollback resets the burst
+
+
+def test_step_guard_rollback_without_snapshot_degrades_to_skip(hvd):
+    guard = resilience.StepGuard(policy="rollback", nan_burst=1)
+    p, o, ev = guard.after_step({"w": jnp.zeros(2)}, {}, 0, float("nan"))
+    assert ev.action == "skip"            # nothing to roll back to yet
+
+
+def test_step_guard_off_is_free(hvd):
+    guard = resilience.StepGuard(policy="off")
+    params = {"w": jnp.zeros(2)}
+    p, o, ev = guard.after_step(params, {}, 0, float("nan"))
+    assert ev.action == "ok" and p is params
+
+
+def test_step_guard_env_construction(hvd, monkeypatch):
+    monkeypatch.setenv("HOROVOD_STEP_GUARD", "rollback")
+    monkeypatch.setenv("HOROVOD_SENTINEL_INTERVAL", "50")
+    monkeypatch.setenv("HOROVOD_GUARD_NAN_BURST", "3")
+    guard = resilience.StepGuard()
+    assert guard.policy == "rollback"
+    assert guard.sentinel_interval == 50
+    assert guard.nan_burst == 3
+
+
+# -- divergence naming -------------------------------------------------------
+
+def test_divergent_ranks_names_minority():
+    d = np.array([[1.0, 2.0], [1.0, 2.0], [9.0, 2.0], [1.0, 2.0]])
+    assert resilience._divergent_ranks(d) == [2]
+
+
+def test_divergent_ranks_tie_breaks_to_smallest_row():
+    d = np.array([[5.0], [5.0], [1.0], [1.0]])
+    # 2-2 tie: the smaller digest row (1.0) is "modal", rows 0,1 diverge
+    assert resilience._divergent_ranks(d) == [0, 1]
+
+
+def test_divergent_ranks_all_agree():
+    d = np.array([[3.0], [3.0], [3.0]])
+    assert resilience._divergent_ranks(d) == []
+
+
+def test_tree_digest_deterministic_and_sensitive():
+    t = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+         "b": np.float64(1.5)}
+    d1 = resilience.tree_digest(t)
+    assert d1 == resilience.tree_digest(t)
+    t2 = {"a": t["a"].copy(), "b": np.float64(1.5)}
+    t2["a"][1, 2] = np.nextafter(t2["a"][1, 2], np.float32(np.inf))
+    # a single-ULP change flips the crc
+    assert resilience.tree_digest(t2) != d1
+    assert 0 <= d1 < 2 ** 32           # survives a float64 allreduce exactly
+
+
+def test_zero_local_state_digest(hvd, mesh8):
+    """The ZeRO-1 digest covers the local shard bytes and is stable."""
+    from horovod_tpu.parallel import zero
+    params = {"w": jnp.asarray(np.random.RandomState(3).randn(64),
+                               jnp.float32)}
+    zopt = zero.sharded_optimizer(optax.adam(1e-2), "data", mesh=mesh8)
+    state = zopt.init(params)
+    d1 = zero.local_state_digest(state)
+    assert d1 == zero.local_state_digest(state)
+    assert 0 <= d1 < 2 ** 32
+
+
+# -- value faults (nan / corrupt) --------------------------------------------
+
+def test_parse_corrupt_kind_arg():
+    (r,) = faults.parse_spec("site=allreduce,kind=corrupt:3")
+    assert r.kind == "corrupt" and r.arg == 3
+    (r,) = faults.parse_spec("site=allreduce,kind=corrupt")
+    assert r.arg is None
+    with pytest.raises(faults.FaultSpecError, match=">= 1 byte"):
+        faults.parse_spec("site=allreduce,kind=corrupt:0")
+    with pytest.raises(faults.FaultSpecError, match="takes no argument"):
+        faults.parse_spec("site=allreduce,kind=nan:1")
+
+
+def test_value_kinds_skip_inject(monkeypatch):
+    """nan/corrupt never fire at the entry hook — and entry passages must
+    not consume their hit budget either."""
+    monkeypatch.setenv(faults.ENV_VAR,
+                       "site=allreduce,kind=nan,count=1")
+    faults.reset()
+    for _ in range(5):
+        faults.inject("allreduce", "t")   # must not fire nor arm
+    out = faults.corrupt_output("allreduce", np.ones(4, np.float32), "t")
+    assert np.isnan(out).all()            # budget still intact
+
+
+def test_corrupt_output_nan(monkeypatch, capsys):
+    monkeypatch.setenv(faults.ENV_VAR,
+                       "site=allreduce,kind=nan,count=1")
+    faults.reset()
+    src = np.ones(4, np.float32)
+    out = faults.corrupt_output("allreduce", src, "grads.0")
+    assert np.isnan(out).all()
+    assert np.all(src == 1.0)             # input never mutated in place
+    assert "firing kind=nan" in capsys.readouterr().err
+    # count exhausted: passthrough
+    out2 = faults.corrupt_output("allreduce", src, "grads.0")
+    assert np.all(out2 == 1.0)
+
+
+def test_corrupt_output_nan_int_dtype_passthrough(monkeypatch, capsys):
+    monkeypatch.setenv(faults.ENV_VAR, "site=allgather,kind=nan")
+    faults.reset()
+    src = np.arange(4, dtype=np.int32)
+    out = faults.corrupt_output("allgather", src)
+    np.testing.assert_array_equal(out, src)
+    assert "output unchanged" in capsys.readouterr().err
+
+
+def test_corrupt_output_bit_flips(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR,
+                       "site=allreduce,kind=corrupt:2,count=1")
+    faults.reset()
+    src = np.zeros(8, np.float32)
+    out = faults.corrupt_output("allreduce", src)
+    assert np.all(src == 0.0)
+    diff = (out.view(np.uint8) != src.view(np.uint8)).sum()
+    assert diff == 2                      # exactly N deterministic flips
+    out2 = faults.corrupt_output("allreduce", src)
+    np.testing.assert_array_equal(out2, src)
+
+
+def test_corrupt_output_respects_site_and_rank(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "rank=1,site=allreduce,kind=nan")
+    monkeypatch.setenv("HOROVOD_RANK", "0")
+    faults.reset()
+    src = np.ones(2, np.float32)
+    assert np.all(faults.corrupt_output("allreduce", src) == 1.0)
+    monkeypatch.setenv("HOROVOD_RANK", "1")
+    assert np.all(faults.corrupt_output("broadcast", src) == 1.0)
+    assert np.isnan(faults.corrupt_output("allreduce", src)).all()
+
+
+def test_eager_allreduce_routes_through_corrupt_output(hvd, monkeypatch):
+    """The wiring: a nan rule poisons a real eager allreduce's output."""
+    monkeypatch.setenv(faults.ENV_VAR,
+                       "site=allreduce,kind=nan,count=1")
+    faults.reset()
+    out = hvd.allreduce(np.ones(4, np.float32), name="poisoned.t")
+    assert np.isnan(np.asarray(out)).all()
+    out = hvd.allreduce(np.ones(4, np.float32), name="clean.t")
+    assert np.all(np.asarray(out) == 1.0)
+
+
+# -- checkpoint degradation + async ------------------------------------------
+
+def test_save_failure_returns_none_not_raise(hvd, tmp_path):
+    from horovod_tpu import checkpoint
+    blocker = tmp_path / "ckpt"
+    blocker.write_text("not a directory")   # orbax must fail on this
+    state = {"w": np.ones(4, np.float32)}
+    assert checkpoint.save(str(blocker), state, step=1) is None
+
+
+def test_save_async_roundtrip(hvd, tmp_path):
+    from horovod_tpu import checkpoint
+    ckpt = tmp_path / "ckpt"
+    state = {"w": jnp.asarray(np.random.RandomState(4).randn(8),
+                              jnp.float32),
+             "step": jnp.int64(3)}
+    promised = checkpoint.save_async(str(ckpt), state, step=3)
+    written = checkpoint.wait_for_async_save()
+    assert written == promised
+    assert checkpoint.latest_step(str(ckpt)) == 3
+    restored = checkpoint.restore(
+        str(ckpt), {"w": np.zeros(8, np.float32),
+                    "step": np.zeros((), np.int64)})
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.asarray(state["w"]))
+    assert int(restored["step"]) == 3
+
+
+def test_save_async_failure_surfaces_at_drain(hvd, tmp_path):
+    from horovod_tpu import checkpoint
+    blocker = tmp_path / "ckpt"
+    blocker.write_text("not a directory")
+    checkpoint.save_async(str(blocker), {"w": np.ones(2, np.float32)},
+                          step=1)
+    assert checkpoint.wait_for_async_save() is None   # logged, not raised
+    assert checkpoint.wait_for_async_save() is None   # drain is idempotent
+
+
+def test_sync_save_drains_async_first(hvd, tmp_path):
+    from horovod_tpu import checkpoint
+    ckpt = tmp_path / "ckpt"
+    checkpoint.save_async(str(ckpt), {"w": np.ones(2, np.float32)}, step=1)
+    path = checkpoint.save(str(ckpt), {"w": np.full(2, 2.0, np.float32)},
+                           step=2)
+    assert path is not None
+    assert checkpoint.latest_step(str(ckpt)) == 2
+    assert 1 in checkpoint._valid_steps(str(ckpt))
+
+
+# -- preemption protocol -----------------------------------------------------
+
+def test_preemption_rc_is_distinct():
+    assert resilience.PREEMPTION_RC == 75
+    assert resilience.PREEMPTION_RC not in (0, 1, 130, 143)
+
+
+def test_preemption_request_flag():
+    assert not resilience.preemption_requested()
+    resilience.request_preemption()
+    assert resilience.preemption_requested()
+    resilience._reset_for_tests()
+    assert not resilience.preemption_requested()
+
+
+def test_install_preemption_handler_defers_signal():
+    old = signal.getsignal(signal.SIGUSR1)
+    try:
+        resilience.install_preemption_handler(signal.SIGUSR1)
+        assert not resilience.preemption_requested()
+        os.kill(os.getpid(), signal.SIGUSR1)   # delivered synchronously
+        assert resilience.preemption_requested()
+    finally:
+        signal.signal(signal.SIGUSR1, old)
+
+
+def test_maybe_save_and_exit_noop_without_request(tmp_path):
+    assert resilience.maybe_save_and_exit(
+        str(tmp_path / "ckpt"), {"w": np.zeros(2)}, step=0) is False
+    assert not (tmp_path / "ckpt").exists()
+
+
+def test_maybe_save_and_exit_saves_then_exits_75(hvd, tmp_path):
+    from horovod_tpu import checkpoint
+    ckpt = tmp_path / "ckpt"
+    state = {"w": np.full(4, 3.0, np.float32)}
+    resilience.request_preemption()
+    with pytest.raises(SystemExit) as exc:
+        resilience.maybe_save_and_exit(str(ckpt), state, step=7)
+    assert exc.value.code == resilience.PREEMPTION_RC
+    assert checkpoint.latest_step(str(ckpt)) == 7
