@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Single-host stand-in for ssh in the CI elastic gates: 127.0.1.1 routes
+# to loopback but is not classified local, so the second rank rides this
+# "ssh" path and its host is genuinely blacklistable by the launcher.
+# probe form: ssh -o ... -o ConnectTimeout=10 <host> true
+# spawn form: ssh -o ... <host> <remote-command>
+exec bash -c "${@: -1}"

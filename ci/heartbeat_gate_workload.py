@@ -1,0 +1,19 @@
+"""Heartbeat-deadline gate workload (run: hvdrun -np 2
+--elastic-restarts 1 --min-np 1 --heartbeat-interval 0.2 with a
+heartbeat_drop fault on rank 1 — see ci/run_tests.sh).
+
+Attempt 0 parks both ranks in a 600s sleep, so nothing but the
+launcher's health plane can end it: rank 1's heartbeats go quiet (the
+chaos fault suppresses them after the first few), the watchdog SIGKILLs
+it at the heartbeat deadline, and the elastic restart relaunches on the
+surviving host.  Attempt 1 just reports in and exits 0.
+"""
+import os
+import time
+
+import horovod_tpu as hvd
+
+hvd.init()
+if os.environ.get("HOROVOD_RESTART_ATTEMPT", "0") == "0":
+    time.sleep(600)   # only the health plane can end this attempt
+print(f"HB_OK attempt=1 rank={hvd.rank()} size={hvd.size()}", flush=True)

@@ -92,6 +92,44 @@ JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
 python tools/check_metrics.py "$RESILIENCE_METRICS_DIR/metrics.json" 2
 rm -rf "$RESILIENCE_METRICS_DIR"
 
+echo "--- warm-restart gate (2 ranks, elastic): rank 1 SIGKILLed after
+--- committing step 4 while the disk checkpoint holds step 1; the np=1
+--- relaunch must recover from the PEER SPILL at the committed step (no
+--- orbax read), apply the 2->1 continuity policy, and converge — the
+--- workload asserts all of it, the merged telemetry must show
+--- hvd_warm_restart_* (docs/fault_tolerance.md)"
+WARM_DIR="$(mktemp -d)"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  HOROVOD_METRICS_FILE="$WARM_DIR/metrics.json" \
+  HOROVOD_SSH_CMD="ci/fake_ssh.sh" \
+  WARM_GATE_CKPT="$WARM_DIR/ckpt" \
+  HOROVOD_TERMINATE_GRACE_SECONDS=3 \
+  python -m horovod_tpu.runner -np 2 -H localhost:1,127.0.1.1:1 \
+  --elastic-restarts 2 --min-np 1 \
+  python tests/distributed/warm_restart_np2.py \
+  | tee "$WARM_DIR/out.log"
+grep -q "WARM_OK attempt=1 rank=0 size=1 source=spill committed=4" \
+  "$WARM_DIR/out.log"
+rm -rf "$WARM_DIR"
+
+echo "--- heartbeat gate (2 ranks): rank 1's heartbeats chaos-dropped;
+--- the health plane must SIGKILL it at the heartbeat deadline and
+--- elastic-restart on the surviving host — without the watchdog this
+--- lane cannot finish (workers sleep 600s)"
+HB_DIR="$(mktemp -d)"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  HOROVOD_SSH_CMD="ci/fake_ssh.sh" \
+  HOROVOD_TERMINATE_GRACE_SECONDS=3 \
+  HOROVOD_FAULT_SPEC="rank=1,site=heartbeat,after=3,kind=heartbeat_drop,attempt=0" \
+  timeout 150 \
+  python -m horovod_tpu.runner -np 2 -H localhost:1,127.0.1.1:1 \
+  --elastic-restarts 1 --min-np 1 --heartbeat-interval 0.2 \
+  python ci/heartbeat_gate_workload.py \
+  2> "$HB_DIR/err.log" | tee "$HB_DIR/out.log"
+grep -q "HB_OK attempt=1 rank=0 size=1" "$HB_DIR/out.log"
+grep -q "health plane: rank 1 sent no heartbeat" "$HB_DIR/err.log"
+rm -rf "$HB_DIR"
+
 echo "--- step-guard overhead (BENCH json; target < 2% on real chips —
 --- on the CPU smoke this only proves the lane runs end to end)"
 JAX_PLATFORMS=cpu python -m horovod_tpu.benchmark --step-guard

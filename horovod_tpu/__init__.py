@@ -96,9 +96,14 @@ from horovod_tpu.parallel.data import (
     broadcast_optimizer_state,
     broadcast_variables,
 )
-from horovod_tpu.parallel.zero import sharded_optimizer
+from horovod_tpu.parallel.data import (
+    elastic_shard,
+    elastic_continuity,
+    elastic_transition,
+)
+from horovod_tpu.parallel.zero import sharded_optimizer, reshard_state
 from horovod_tpu import resilience  # noqa: F401  (hvd.resilience.StepGuard/...)
-from horovod_tpu.resilience import StepGuard
+from horovod_tpu.resilience import StepGuard, warm_restore, report_progress
 
 __version__ = "0.5.0"
 
@@ -124,8 +129,10 @@ __all__ = [
     # training
     "Compression", "checkpoint",
     "DistributedOptimizer", "DistributedGradientTape", "make_training_step",
-    "sharded_optimizer",
+    "sharded_optimizer", "reshard_state",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_variables",
+    # elastic continuity
+    "elastic_shard", "elastic_continuity", "elastic_transition",
     # resilience
-    "resilience", "StepGuard",
+    "resilience", "StepGuard", "warm_restore", "report_progress",
 ]

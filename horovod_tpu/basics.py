@@ -190,10 +190,20 @@ def init(comm=None, ranks: Optional[Sequence[int]] = None) -> None:
                   "devices=%d", _state.rank, _state.size, _state.local_rank,
                   _state.local_size, len(jax.local_devices()))
 
+    if os.environ.get("HOROVOD_HEALTH_RPC"):
+        # The hvdrun health plane is listening: start pushing heartbeats
+        # as soon as the worker has a rank (lazy import keeps resilience
+        # out of the minimal init path).
+        from horovod_tpu import resilience
+        resilience.start_heartbeat(rank=_state.rank)
+
 
 def shutdown() -> None:
     """Shut down horovod_tpu (reference ``basics.py:63-67`` →
     ``horovod_shutdown``, ``operations.cc:624-629``)."""
+    if os.environ.get("HOROVOD_HEALTH_RPC"):
+        from horovod_tpu import resilience
+        resilience.stop_heartbeat()
     with _state.lock:
         if not _state.initialized:
             return

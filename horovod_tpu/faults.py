@@ -42,16 +42,27 @@ Keys:
              only, anything else passes through with a stderr note),
              ``corrupt[:N]`` (flip N bytes — default 1 — of the output
              tensor at deterministic positions: the bit-flip /
-             divergence simulation).
+             divergence simulation),
+             ``heartbeat_drop[:N]`` (suppress the next N heartbeat
+             sends — default unlimited — simulating a worker whose
+             health plane went quiet while the process lives),
+             ``spill_corrupt[:N]`` (truncate the just-written warm-
+             restart spill file to N bytes — default half its size —
+             the torn-write simulation the CRC check must reject).
 ``count``    maximum number of firings (default: unlimited for
-             ``delay``/``error``/``nan``/``corrupt`` — chaos tests that
+             ``delay``/``error``/``nan``/``corrupt``/
+             ``heartbeat_drop``/``spill_corrupt`` — chaos tests that
              want a single bad step should say ``count=1``; irrelevant
              for terminal kinds).
 
 The value kinds (``nan``/``corrupt``) do not fire at :func:`inject`
 (the *entry* hook) — they fire at :func:`corrupt_output`, which the
 eager collectives call on each op's result, because poisoning must
-happen after the real collective ran.
+happen after the real collective ran.  Likewise the plane kinds
+(``heartbeat_drop``/``spill_corrupt``) fire only at their dedicated
+hooks — :func:`drop_heartbeat` in the heartbeat sender (site
+``heartbeat``) and :func:`mangle_spill` in the spill writer (site
+``spill``) — never at :func:`inject`.
 ``attempt``  only fire when ``HOROVOD_RESTART_ATTEMPT`` equals this
              value — lets an elastic-restart test kill attempt 0 and
              let attempt 1 run clean.
@@ -74,15 +85,22 @@ import numpy as np
 
 ENV_VAR = "HOROVOD_FAULT_SPEC"
 
-_KINDS = ("crash", "exit", "hang", "delay", "error", "nan", "corrupt")
+_KINDS = ("crash", "exit", "hang", "delay", "error", "nan", "corrupt",
+          "heartbeat_drop", "spill_corrupt")
 
 # Kinds that mutate an op's *output value* instead of disrupting control
 # flow; they fire at corrupt_output(), never at inject().
 VALUE_KINDS = ("nan", "corrupt")
 
+# Kinds owned by the health/recovery planes; they fire at their dedicated
+# hooks (drop_heartbeat / mangle_spill), never at inject() or
+# corrupt_output().
+PLANE_KINDS = ("heartbeat_drop", "spill_corrupt")
+
 SITES = (
     "allreduce", "allgather", "broadcast", "alltoall", "reducescatter",
     "barrier", "native_submit", "native_wait", "rpc", "spawn",
+    "heartbeat", "spill",
 )
 
 
@@ -269,6 +287,18 @@ def parse_spec(spec: str) -> List[FaultRule]:
                         if arg is not None and arg < 1:
                             raise FaultSpecError(
                                 f"kind corrupt:{arg} must flip >= 1 byte")
+                    elif kind == "heartbeat_drop":
+                        arg = int(kind_arg) if kind_arg else None
+                        if arg is not None and arg < 1:
+                            raise FaultSpecError(
+                                f"kind heartbeat_drop:{arg} must drop "
+                                f">= 1 heartbeat")
+                    elif kind == "spill_corrupt":
+                        arg = int(kind_arg) if kind_arg else None
+                        if arg is not None and arg < 0:
+                            raise FaultSpecError(
+                                f"kind spill_corrupt:{arg} must keep "
+                                f">= 0 bytes")
                     elif kind_arg:
                         raise FaultSpecError(
                             f"kind {kind!r} takes no argument "
@@ -287,6 +317,9 @@ def parse_spec(spec: str) -> List[FaultRule]:
             raise FaultSpecError(
                 f"fault rule {chunk!r} has no kind= (one of "
                 f"{', '.join(_KINDS)})")
+        # heartbeat_drop:N is shorthand for count=N (N intervals).
+        if kind == "heartbeat_drop" and count is None and arg is not None:
+            count = arg
         if site is not None and site not in SITES:
             raise FaultSpecError(
                 f"unknown fault site {site!r}; shipped sites: "
@@ -354,7 +387,7 @@ def inject(site: str, detail: Optional[str] = None,
         return
     ctx_rank = _context_rank(rank)
     for rule in plan:
-        if rule.kind in VALUE_KINDS:
+        if rule.kind in VALUE_KINDS or rule.kind in PLANE_KINDS:
             continue
         if rule.arm(site, ctx_rank):
             rule.execute(site, detail, ctx_rank)
@@ -379,3 +412,56 @@ def corrupt_output(site: str, out, detail: Optional[str] = None,
         if rule.arm(site, ctx_rank):
             out = rule.poison(site, out, detail, ctx_rank)
     return out
+
+
+def drop_heartbeat(rank: Optional[int] = None) -> bool:
+    """Heartbeat-sender hook: True when an armed ``heartbeat_drop`` rule
+    says this heartbeat must be suppressed (the sender skips the RPC but
+    keeps its cadence, so the launcher sees exactly N missing intervals).
+    Same zero-overhead contract as :func:`inject` when no spec is set."""
+    plan = _plan
+    if plan is _UNSET:
+        plan = load()
+    if plan is None:
+        return False
+    ctx_rank = _context_rank(rank)
+    dropped = False
+    for rule in plan:
+        if rule.kind != "heartbeat_drop":
+            continue
+        if rule.arm("heartbeat", ctx_rank):
+            rule._announce("heartbeat", None, ctx_rank,
+                           note=" (heartbeat suppressed)")
+            dropped = True
+    return dropped
+
+
+def mangle_spill(path: str, rank: Optional[int] = None) -> bool:
+    """Spill-writer hook: truncates the just-written warm-restart spill
+    file when an armed ``spill_corrupt`` rule fires (the torn-write
+    simulation — the loader's CRC/length validation must reject the
+    result).  Returns True when the file was mangled."""
+    plan = _plan
+    if plan is _UNSET:
+        plan = load()
+    if plan is None:
+        return False
+    ctx_rank = _context_rank(rank)
+    mangled = False
+    for rule in plan:
+        if rule.kind != "spill_corrupt":
+            continue
+        if rule.arm("spill", ctx_rank):
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            keep = int(rule.arg) if rule.arg is not None else size // 2
+            keep = max(0, min(keep, size))
+            with open(path, "r+b") as f:
+                f.truncate(keep)
+            rule._announce(
+                "spill", os.path.basename(path), ctx_rank,
+                note=f" (truncated {size} -> {keep} bytes)")
+            mangled = True
+    return mangled

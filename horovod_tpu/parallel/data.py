@@ -341,3 +341,94 @@ def _make_sharded_training_step(loss_fn, optimizer, mesh, ax, donate,
     step.jitted = None               # built on first call (state-dependent)
     step.state_shardings = functools.partial(zopt.state_shardings, mesh)
     return step
+
+
+# ---------------------------------------------------------------------------
+# Elastic world-size-change continuity (warm restart, layer 3)
+# ---------------------------------------------------------------------------
+
+ELASTIC_BATCH_POLICY_VAR = "HOROVOD_ELASTIC_BATCH_POLICY"
+ELASTIC_BATCH_POLICIES = ("lr_scale", "accumulate")
+_ELASTIC_PREV_SIZE_VAR = "HOROVOD_ELASTIC_PREV_SIZE"
+
+
+def elastic_shard(num_items: int, global_step: int, world_size: int,
+                  rank: int, seed: int = 0) -> np.ndarray:
+    """Deterministic data-shard reassignment after a world-size change.
+
+    Every rank computes the same seeded permutation of
+    ``[0, num_items)`` from ``(global_step, world_size, seed)`` and
+    takes the strided slice ``rank::world_size`` — no coordination
+    needed; any two ranks derive the identical full assignment, so a
+    shrink or grow re-partitions the remaining work without duplicating
+    or dropping an example.  Re-deriving from the *recovered* committed
+    step means a warm-restarted world picks up exactly where the old one
+    left off."""
+    if world_size < 1:
+        raise ValueError(f"world_size={world_size} must be >= 1")
+    if not 0 <= rank < world_size:
+        raise ValueError(
+            f"rank={rank} out of range for world_size={world_size}")
+    mix = (int(global_step) * 1000003 + int(world_size) * 7919
+           + int(seed)) % (2 ** 32)
+    perm = np.random.RandomState(mix).permutation(int(num_items))
+    return perm[rank::world_size]
+
+
+def elastic_continuity(prev_size: int, new_size: int,
+                       policy: Optional[str] = None):
+    """Global-batch semantics across a world-size change.
+
+    Returns ``(lr_scale, accum_steps)`` for the new world, per
+    ``policy`` (default from ``HOROVOD_ELASTIC_BATCH_POLICY``, falling
+    back to ``lr_scale``):
+
+    * ``lr_scale`` — keep the per-rank batch; the global batch changes
+      by ``new/prev``, so scale the learning rate linearly (the
+      Goyal et al. 2017 rule): ``(new/prev, 1)``.
+    * ``accumulate`` — preserve the global batch by accumulating
+      ``ceil(prev/new)`` micro-steps per update (``optax.MultiSteps``);
+      when ``prev`` is not a multiple of ``new`` the effective batch
+      overshoots by ``new*accum/prev``, and the returned ``lr_scale``
+      carries that residual so LR-per-example stays constant:
+      ``(new*accum/prev, accum)``.
+    """
+    if prev_size < 1 or new_size < 1:
+        raise ValueError(
+            f"sizes must be >= 1 (prev={prev_size}, new={new_size})")
+    if policy is None:
+        import os
+        policy = (os.environ.get(ELASTIC_BATCH_POLICY_VAR, "")
+                  .strip().lower() or "lr_scale")
+    if policy not in ELASTIC_BATCH_POLICIES:
+        raise ValueError(
+            f"{ELASTIC_BATCH_POLICY_VAR}={policy!r}: expected one of "
+            f"{', '.join(ELASTIC_BATCH_POLICIES)}")
+    if policy == "lr_scale" or new_size >= prev_size:
+        return float(new_size) / float(prev_size), 1
+    accum = -(-prev_size // new_size)  # ceil
+    return float(new_size * accum) / float(prev_size), accum
+
+
+def elastic_transition(new_size: Optional[int] = None,
+                       policy: Optional[str] = None):
+    """The launcher-facing wrapper: reads the previous attempt's world
+    size (``HOROVOD_ELASTIC_PREV_SIZE``, injected by ``hvdrun`` on every
+    elastic restart) and returns ``(prev_size, lr_scale, accum_steps)``.
+    Identity — ``(new_size, 1.0, 1)`` — on a first launch or when the
+    size did not change."""
+    import os
+    if new_size is None:
+        new_size = basics.size()
+    raw = os.environ.get(_ELASTIC_PREV_SIZE_VAR, "").strip()
+    if not raw:
+        return new_size, 1.0, 1
+    try:
+        prev = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{_ELASTIC_PREV_SIZE_VAR}={raw!r} is not an integer")
+    if prev < 1 or prev == new_size:
+        return new_size, 1.0, 1
+    lr_scale, accum = elastic_continuity(prev, new_size, policy)
+    return prev, lr_scale, accum

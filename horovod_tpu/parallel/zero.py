@@ -310,3 +310,21 @@ def scatter_full_state(full_state, like: ZeroShardedState
 
     new_inner = _map_param_subtrees(like.optimizer, collapse, full_state)
     return ZeroShardedState(new_inner, plan, like.treedef, like.optimizer)
+
+
+def reshard_state(state: ZeroShardedState, like: ZeroShardedState
+                  ) -> ZeroShardedState:
+    """Re-bucket a sharded state for a DIFFERENT axis size: round-trip
+    through the portable layout (``gather_full_state`` then
+    ``scatter_full_state`` against ``like``'s plan).  This is the
+    world-size-change path of an elastic warm restart — a state sharded
+    for the old N becomes ``like``'s layout for the new N, bit-exactly
+    (the element-wise moments are only re-arranged, never recomputed).
+    ``like`` is the freshly ``init``-ed state on the new mesh; place the
+    result with :meth:`ShardedOptimizer.state_shardings` before
+    training."""
+    if telemetry.enabled():
+        telemetry.counter(
+            "hvd_zero_reshards_total",
+            "ZeRO-1 states re-bucketed for a different axis size").inc()
+    return scatter_full_state(gather_full_state(state), like=like)

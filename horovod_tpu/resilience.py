@@ -43,12 +43,32 @@ iteration-boundary checkpointing):
   as preemption — no blacklist, no backoff, immediate reschedule
   (``runner/launch.py`` / ``runner/run.py``).
 
+* **Warm restart** (PR 5): every Nth :class:`LastKnownGood` commit is
+  also spilled to a host-local file in ``HOROVOD_SPILL_DIR`` (a per-job
+  scratch dir the launcher keeps stable across elastic restarts), in a
+  CRC-framed, torn-write-tolerant format.  After an elastic restart,
+  :func:`warm_restore` runs the recovery ladder: surviving ranks load
+  their spill, elect the freshest committed step with an eager ``Max``
+  allreduce (lowest rank holding it wins), re-broadcast that state to
+  the new world — falling back to the disk checkpoint, then fresh init,
+  only when no survivor holds a valid spill.  The spill stores the
+  *portable* (replicated optax) optimizer layout, so a ZeRO-1 run
+  re-shards for the new world size on the way in.  A heartbeat sender
+  (:func:`start_heartbeat`, auto-started by ``hvd.init()`` when the
+  launcher injected ``HOROVOD_HEALTH_RPC``) reports
+  ``(global_step, last_progress_ts)`` so the launcher can tell *dead*
+  from *hung* workers.
+
 Env knobs: ``HOROVOD_STEP_GUARD`` (policy), ``HOROVOD_SENTINEL_INTERVAL``
 (0 = off), ``HOROVOD_LKG_INTERVAL`` (snapshot every N validated steps,
 default 1), ``HOROVOD_GUARD_NAN_BURST`` (consecutive bad steps before a
-rollback fires, default 1).  Everything emits ``hvd_guard_*`` /
-``hvd_rollback_*`` / ``hvd_sentinel_*`` telemetry (``docs/metrics.md``)
-and is chaos-testable via the ``nan`` / ``corrupt`` fault kinds
+rollback fires, default 1), ``HOROVOD_SPILL_DIR`` /
+``HOROVOD_SPILL_INTERVAL`` (warm-restart spill), ``HOROVOD_HEALTH_RPC``
+/ ``HOROVOD_HEARTBEAT_INTERVAL`` (heartbeats).  Everything emits
+``hvd_guard_*`` / ``hvd_rollback_*`` / ``hvd_sentinel_*`` /
+``hvd_warm_restart_*`` / ``hvd_heartbeat_*`` telemetry
+(``docs/metrics.md``) and is chaos-testable via the ``nan`` /
+``corrupt`` / ``heartbeat_drop`` / ``spill_corrupt`` fault kinds
 (``faults.py``).  See ``docs/fault_tolerance.md``.
 """
 
@@ -56,18 +76,20 @@ from __future__ import annotations
 
 import functools
 import os
+import pickle
 import signal
+import struct
 import sys
 import threading
 import zlib
-from typing import Any, NamedTuple, Optional, Sequence, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from horovod_tpu import basics, telemetry
+from horovod_tpu import basics, faults, telemetry
 from horovod_tpu.ops import collective as _c
 from horovod_tpu.utils.logging import get_logger
 
@@ -85,6 +107,10 @@ _POLICY_VAR = "HOROVOD_STEP_GUARD"
 _SENTINEL_VAR = "HOROVOD_SENTINEL_INTERVAL"
 _LKG_VAR = "HOROVOD_LKG_INTERVAL"
 _BURST_VAR = "HOROVOD_GUARD_NAN_BURST"
+_SPILL_DIR_VAR = "HOROVOD_SPILL_DIR"
+_SPILL_INTERVAL_VAR = "HOROVOD_SPILL_INTERVAL"
+_HEALTH_RPC_VAR = "HOROVOD_HEALTH_RPC"
+_HEARTBEAT_INTERVAL_VAR = "HOROVOD_HEARTBEAT_INTERVAL"
 
 
 class GuardAbort(RuntimeError):
@@ -375,6 +401,17 @@ class StepGuard:
         self.lkg = LastKnownGood()
         self._bad_streak = 0
         self._warned_no_lkg = False
+        # Warm-restart spill: every Nth commit is persisted host-locally
+        # so a restarted world can recover the committed step from a
+        # surviving peer instead of the (older) disk checkpoint.
+        self._spill_dir = spill_dir()
+        self.spill_interval = _env_interval(_SPILL_INTERVAL_VAR, 1,
+                                            minimum=1)
+        # Training loops may stash small host state here (RNG key, data
+        # cursor) — it rides along in each spill and comes back from
+        # warm_restore().
+        self.spill_extra: Dict[str, Any] = {}
+        self._commits = 0
 
     # -- coordination -----------------------------------------------------
 
@@ -446,6 +483,7 @@ class StepGuard:
         """Validate one completed step.  Returns
         ``(params, opt_state, GuardEvent)`` — possibly the restored
         last-known-good state.  Must be called on every rank."""
+        report_progress(step)  # feeds the heartbeat health plane
         if self.policy == "off" and self.sentinel_interval == 0:
             return params, opt_state, GuardEvent("ok", step)
         if telemetry.enabled():
@@ -464,6 +502,10 @@ class StepGuard:
         if ok:
             if staged:
                 self.lkg.commit()
+                if self._spill_dir:
+                    self._commits += 1
+                    if self._commits % self.spill_interval == 0:
+                        self._spill(params, opt_state, step)
             self._bad_streak = 0
             if (self.sentinel_interval > 0 and step > 0
                     and step % self.sentinel_interval == 0
@@ -508,6 +550,24 @@ class StepGuard:
                     "(streak %d)", step, self._bad_streak)
         return params, opt_state, GuardEvent("skip", step)
 
+    # -- warm-restart spill ------------------------------------------------
+
+    def _spill(self, params, opt_state, step: int) -> None:
+        """Persist the just-committed state host-locally.  Failures
+        degrade (log + counter) — a broken scratch disk must not take
+        down a healthy training loop."""
+        try:
+            write_spill(self._spill_dir, params, opt_state, step,
+                        extra=self.spill_extra)
+        except Exception as e:  # noqa: BLE001 — degrade, don't die
+            log.warning("warm-restart spill at step %d FAILED (%s: %s); "
+                        "continuing without it", step,
+                        type(e).__name__, e)
+            if telemetry.enabled():
+                telemetry.counter(
+                    "hvd_warm_restart_spill_failures_total",
+                    "spill writes that raised (degraded, not fatal)").inc()
+
 
 def _broadcast_state(params, opt_state, root_rank: int):
     """Re-broadcast ``(params, opt_state)`` from ``root_rank`` over the
@@ -526,6 +586,458 @@ def _broadcast_state(params, opt_state, root_rank: int):
         out.append(jax.device_put(healed, sharding)
                    if sharding is not None else jnp.array(healed))
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# Warm restart: host-local spill files + peer-recovery election
+# ---------------------------------------------------------------------------
+
+SPILL_MAGIC = b"HVDSPILL"
+SPILL_VERSION = 1
+# magic, version, step, world_size, rank, payload_len, payload_crc32
+_SPILL_HEADER = struct.Struct("!8sIqIIQI")
+
+
+def spill_dir() -> Optional[str]:
+    """The per-job host-local scratch dir (``HOROVOD_SPILL_DIR``,
+    injected by the launcher and stable across elastic restarts), or
+    None when warm restart is not configured."""
+    return os.environ.get(_SPILL_DIR_VAR, "").strip() or None
+
+
+def _spill_path(directory: str, rank: int) -> str:
+    return os.path.join(directory, f"rank{int(rank)}.spill")
+
+
+def write_spill(directory: str, params, opt_state, step: int, *,
+                extra: Optional[Dict[str, Any]] = None,
+                rank: Optional[int] = None,
+                world_size: Optional[int] = None) -> str:
+    """Persist a committed training state to a host-local spill file.
+
+    The optimizer state is converted to the *portable* (replicated
+    optax) layout first — under ZeRO-1 each rank's shard alone could
+    never reconstruct the full state after a peer died, and the portable
+    layout is what lets :func:`warm_restore` re-shard for a different
+    world size through ``gather_full_state``/``scatter_full_state``.
+
+    Torn-write tolerance: bytes go to a temp file (flushed + fsynced)
+    and land via ``os.replace``; the header frames the payload with its
+    length and crc32 so :func:`read_spill` rejects anything short or
+    mangled instead of loading garbage."""
+    rank = basics.rank() if rank is None else int(rank)
+    world_size = basics.size() if world_size is None else int(world_size)
+    from horovod_tpu import checkpoint as _ckpt
+    portable_opt = _ckpt._gather_zero(opt_state)
+    t0 = telemetry.clock()
+    # np.array(..., order="C") rather than ascontiguousarray: the latter
+    # promotes 0-d leaves (optax's step count) to shape (1,), which would
+    # poison the layout-signature agreement check on restore.
+    payload = {
+        "params": [np.array(np.asarray(l), order="C")
+                   for l in jax.tree_util.tree_leaves(params)],
+        "opt": [np.array(np.asarray(l), order="C")
+                for l in jax.tree_util.tree_leaves(portable_opt)],
+        "extra": dict(extra or {}),
+    }
+    os.makedirs(directory, exist_ok=True)
+    blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+    header = _SPILL_HEADER.pack(SPILL_MAGIC, SPILL_VERSION, int(step),
+                                world_size, rank, len(blob),
+                                zlib.crc32(blob))
+    path = _spill_path(directory, rank)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(header)
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    faults.mangle_spill(path, rank)
+    if telemetry.enabled():
+        telemetry.counter(
+            "hvd_warm_restart_spills_total",
+            "warm-restart spill files written").inc()
+        telemetry.histogram(
+            "hvd_warm_restart_spill_seconds",
+            "host serialization + fsync time per spill").observe(
+            telemetry.clock() - t0)
+    log.debug("spilled step %d (%d bytes) to %s", step, len(blob), path)
+    return path
+
+
+def read_spill(path: str) -> Optional[Dict[str, Any]]:
+    """Load + validate one spill file.  Returns the record (``step`` /
+    ``world_size`` / ``rank`` / ``params`` / ``opt`` / ``extra``) or
+    None — a missing, torn, or corrupt file is rejected with a warning
+    and a counter, never raised on: the recovery ladder just moves to
+    the next rung."""
+
+    def _reject(why: str) -> None:
+        log.warning("rejecting spill %s: %s", path, why)
+        if telemetry.enabled():
+            telemetry.counter(
+                "hvd_warm_restart_spill_rejected_total",
+                "spill files rejected by validation (torn write / CRC / "
+                "version mismatch)").inc()
+        return None
+
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError:
+        return None
+    if len(raw) < _SPILL_HEADER.size:
+        return _reject(f"short header ({len(raw)} bytes)")
+    magic, version, step, world, rank, plen, crc = \
+        _SPILL_HEADER.unpack_from(raw)
+    if magic != SPILL_MAGIC:
+        return _reject("bad magic")
+    if version != SPILL_VERSION:
+        return _reject(f"unsupported version {version}")
+    blob = raw[_SPILL_HEADER.size:]
+    if len(blob) != plen:
+        return _reject(f"torn payload ({len(blob)}/{plen} bytes)")
+    if zlib.crc32(blob) != crc:
+        return _reject("payload crc mismatch")
+    try:
+        payload = pickle.loads(blob)
+    except Exception as e:  # noqa: BLE001 — reject-and-continue contract
+        return _reject(f"unpicklable payload ({type(e).__name__}: {e})")
+    return {"step": int(step), "world_size": int(world),
+            "rank": int(rank), "path": path, **payload}
+
+
+def best_local_spill(directory: str) -> Optional[Dict[str, Any]]:
+    """The valid spill with the highest committed step on THIS host's
+    scratch dir.  All ``*.spill`` files are scanned (not just this
+    rank's): after a shrink the ranks renumber, and a host that ran two
+    ranks may now run one — whichever surviving file is freshest
+    wins."""
+    try:
+        entries = sorted(os.listdir(directory))
+    except OSError:
+        return None
+    best = None
+    for entry in entries:
+        if not entry.endswith(".spill"):
+            continue
+        rec = read_spill(os.path.join(directory, entry))
+        if rec is not None and (best is None or rec["step"] > best["step"]):
+            best = rec
+    return best
+
+
+def _layout_signature(leaves) -> int:
+    """crc32 over the (shape, dtype) of each leaf in order — cheap
+    agreement check that a spilled state is congruent with the live
+    template before any bytes go over the wire."""
+    crc = 0
+    for leaf in leaves:
+        shape = tuple(np.shape(leaf))
+        try:
+            dtype = np.dtype(getattr(leaf, "dtype", None) or
+                             np.result_type(leaf))
+        except TypeError:
+            dtype = np.dtype(object)
+        crc = zlib.crc32(f"{shape}:{dtype.str};".encode(), crc)
+    return crc
+
+
+def _peer_recover(params, opt_state, local: Optional[Dict[str, Any]],
+                  local_step: int, best: int):
+    """Elect the spill source and re-broadcast its state to the world.
+
+    Source = the LOWEST rank whose local spill holds the elected step
+    ``best`` (eager ``Min`` allreduce over candidate ranks).  Before any
+    state moves, the source's layout signature is broadcast and every
+    rank checks it against its own live template — a globally
+    coordinated ``Min`` verdict, so either everyone accepts the spill or
+    everyone falls to the next ladder rung together.  Returns
+    ``(params, opt_state, extra)`` or None on signature mismatch."""
+    size, me = basics.size(), basics.rank()
+    from horovod_tpu import checkpoint as _ckpt
+    portable_opt = _ckpt._gather_zero(opt_state)
+    p_leaves, p_def = jax.tree_util.tree_flatten(params)
+    o_leaves, o_def = jax.tree_util.tree_flatten(portable_opt)
+    template_sig = _layout_signature(p_leaves + o_leaves)
+
+    if size > 1:
+        cand = float(me) if (local is not None and local_step == best) \
+            else float(size)
+        src = int(np.asarray(_c._eager_allreduce(
+            np.array([cand], np.float64), _c.Min,
+            "hvd.resilience.warm.src", 1.0, 1.0))[0])
+    else:
+        src = 0
+    i_am_src = me == src
+
+    spill_sig = (_layout_signature(local["params"] + local["opt"])
+                 if i_am_src else 0)
+    sig = np.array([float(spill_sig)], np.float64)
+    if size > 1:
+        sig = _c._eager_broadcast(sig, src, "hvd.resilience.warm.sig")
+    sig_ok = float(np.asarray(sig)[0]) == float(template_sig)
+    if size > 1:
+        sig_ok = StepGuard._global_ok(sig_ok)
+    if not sig_ok:
+        log.warning(
+            "warm restart: spill at step %d (rank %d) does not match the "
+            "live state layout — falling back down the recovery ladder",
+            best, src)
+        if telemetry.enabled():
+            telemetry.counter(
+                "hvd_warm_restart_layout_mismatch_total",
+                "peer recoveries abandoned because the spilled layout "
+                "disagreed with the live template").inc()
+        return None
+
+    spilled = (local["params"] + local["opt"]) if i_am_src else None
+    out_leaves = []
+    for i, leaf in enumerate(p_leaves + o_leaves):
+        tmpl = np.asarray(leaf)
+        host = (np.ascontiguousarray(np.asarray(spilled[i],
+                                                dtype=tmpl.dtype))
+                if i_am_src else np.ascontiguousarray(tmpl))
+        if size > 1:
+            host = _c._eager_broadcast(
+                host, src, f"hvd.resilience.warm.state.{i}")
+        got = np.asarray(host, dtype=tmpl.dtype).reshape(tmpl.shape)
+        sharding = _leaf_sharding(leaf)
+        out_leaves.append(jax.device_put(got, sharding)
+                          if sharding is not None else jnp.asarray(got))
+    n_p = len(p_leaves)
+    new_params = jax.tree_util.tree_unflatten(p_def, out_leaves[:n_p])
+    new_portable = jax.tree_util.tree_unflatten(o_def, out_leaves[n_p:])
+    new_opt = _ckpt._scatter_zero(new_portable, opt_state)
+
+    extra: Dict[str, Any] = dict(local["extra"]) if i_am_src else {}
+    if size > 1:
+        blob = pickle.dumps(extra, protocol=pickle.HIGHEST_PROTOCOL) \
+            if i_am_src else b""
+        ln = _c._eager_broadcast(np.array([len(blob)], np.int64), src,
+                                 "hvd.resilience.warm.extra.len")
+        n = int(np.asarray(ln)[0])
+        if n:
+            buf = (np.frombuffer(blob, np.uint8).copy() if i_am_src
+                   else np.zeros(n, np.uint8))
+            buf = _c._eager_broadcast(buf, src,
+                                      "hvd.resilience.warm.extra")
+            extra = pickle.loads(np.asarray(buf, np.uint8).tobytes())
+        else:
+            extra = {}
+    return new_params, new_opt, extra
+
+
+def warm_restore(params, opt_state, *, ckpt_dir: Optional[str] = None,
+                 directory: Optional[str] = None):
+    """The warm-restart recovery ladder, called on every rank of the new
+    world right after (re)initializing the training state:
+
+    1. **peer spill** — each rank loads its host's freshest valid spill;
+       the highest committed step wins an eager ``Max`` allreduce
+       election and the lowest rank holding it re-broadcasts that state;
+    2. **disk checkpoint** — when no survivor holds a valid spill,
+       restore the newest intact checkpoint under ``ckpt_dir`` (the
+       repo-standard ``{"params", "opt_state", "step"}`` layout);
+    3. **fresh init** — nothing to recover: train from the passed-in
+       state.
+
+    Returns ``(params, opt_state, step, source, extra)`` with ``source``
+    in ``("spill", "disk", "fresh")``, ``step`` the recovered committed
+    step (-1 for fresh), and ``extra`` the dict spilled via
+    ``StepGuard.spill_extra`` (RNG key, data cursor; empty otherwise).
+    ZeRO-1 optimizer states come back re-sharded for THIS world size —
+    re-place them (``step.state_shardings`` / ``jax.device_put``) before
+    training, exactly as after ``checkpoint.restore``."""
+    directory = spill_dir() if directory is None else directory
+    size = basics.size()
+    local = best_local_spill(directory) if directory else None
+    local_step = local["step"] if local is not None else -1
+
+    if size > 1:
+        best = int(np.asarray(_c._eager_allreduce(
+            np.array([float(local_step)], np.float64), _c.Max,
+            "hvd.resilience.warm.step", 1.0, 1.0))[0])
+    else:
+        best = local_step
+
+    if best >= 0:
+        recovered = _peer_recover(params, opt_state, local, local_step,
+                                  best)
+        if recovered is not None:
+            new_params, new_opt, extra = recovered
+            if telemetry.enabled():
+                telemetry.counter(
+                    "hvd_warm_restart_peer_recoveries_total",
+                    "warm restarts recovered from a peer spill").inc()
+            log.info("warm restart: recovered committed step %d from a "
+                     "peer spill (no disk checkpoint read)", best)
+            return new_params, new_opt, best, "spill", extra
+
+    if ckpt_dir:
+        from horovod_tpu import checkpoint
+        found = np.zeros(1, np.int32)
+        if basics.rank() == 0 and checkpoint.latest_step(ckpt_dir) \
+                is not None:
+            found[0] = 1
+        if size > 1:
+            found = _c._eager_broadcast(found, 0,
+                                        "hvd.resilience.warm.disk")
+        if int(np.asarray(found)[0]):
+            template = {"params": params, "opt_state": opt_state,
+                        "step": np.zeros((), np.int64)}
+            state = checkpoint.restore(ckpt_dir, template)
+            if telemetry.enabled():
+                telemetry.counter(
+                    "hvd_warm_restart_disk_fallbacks_total",
+                    "warm restarts that fell back to the disk "
+                    "checkpoint").inc()
+            step = int(np.asarray(state["step"]))
+            log.info("warm restart: no usable peer spill — restored "
+                     "disk checkpoint step %d", step)
+            return (state["params"], state["opt_state"], step, "disk",
+                    {})
+
+    if telemetry.enabled():
+        telemetry.counter(
+            "hvd_warm_restart_fresh_inits_total",
+            "warm restarts with nothing to recover (fresh init)").inc()
+    log.info("warm restart: nothing to recover — fresh init")
+    return params, opt_state, -1, "fresh", {}
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat sender (the worker half of the health plane)
+# ---------------------------------------------------------------------------
+
+_progress_lock = threading.Lock()
+_progress_step = -1
+_progress_ts = 0.0
+
+
+def report_progress(step: int) -> None:
+    """Record that training reached ``step`` (monotonic; older steps are
+    ignored).  ``StepGuard.after_step`` calls this automatically; loops
+    without a guard call it directly.  The heartbeat sender attaches the
+    latest ``(step, ts)`` to every heartbeat so the launcher can tell a
+    stalled step from a dead process."""
+    global _progress_step, _progress_ts
+    with _progress_lock:
+        if step > _progress_step:
+            _progress_step = int(step)
+            _progress_ts = telemetry.clock()
+
+
+def progress() -> Tuple[int, float]:
+    with _progress_lock:
+        return _progress_step, _progress_ts
+
+
+class HeartbeatSender:
+    """Daemon thread sending ``{"kind": "heartbeat", rank, step,
+    progress_ts}`` to the launcher's health plane every ``interval``
+    seconds over the authenticated RPC plane.  Single-shot dials with no
+    retries and a short timeout — a slow or dead launcher must never
+    stall training — and every failure is swallowed (counted, logged at
+    debug)."""
+
+    def __init__(self, addr: str, port: int, key: bytes, rank: int,
+                 interval: float):
+        self.addr = addr
+        self.port = int(port)
+        self.key = key
+        self.rank = int(rank)
+        self.interval = max(0.05, float(interval))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="hvd-heartbeat", daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        from horovod_tpu.runner import rpc
+        while not self._stop.wait(self.interval):
+            if faults.drop_heartbeat(self.rank):
+                if telemetry.enabled():
+                    telemetry.counter(
+                        "hvd_heartbeat_dropped_total",
+                        "heartbeats suppressed by fault injection").inc()
+                continue
+            step, ts = progress()
+            try:
+                rpc.rpc_call(
+                    self.addr, self.port,
+                    {"kind": "heartbeat", "rank": self.rank,
+                     "step": step, "progress_ts": ts},
+                    self.key, timeout=max(1.0, self.interval),
+                    retries=0)
+                if telemetry.enabled():
+                    telemetry.counter(
+                        "hvd_heartbeat_sent_total",
+                        "heartbeats delivered to the launcher").inc()
+            except Exception as e:  # noqa: BLE001 — never stall training
+                if telemetry.enabled():
+                    telemetry.counter(
+                        "hvd_heartbeat_send_failures_total",
+                        "heartbeat sends that failed (launcher slow, "
+                        "restarting, or gone)").inc()
+                log.debug("heartbeat send failed: %s: %s",
+                          type(e).__name__, e)
+
+
+_heartbeat_sender: Optional[HeartbeatSender] = None
+_heartbeat_lock = threading.Lock()
+
+
+def start_heartbeat(rank: Optional[int] = None
+                    ) -> Optional[HeartbeatSender]:
+    """Start the heartbeat sender when the launcher configured the
+    health plane (``HOROVOD_HEALTH_RPC=addr:port`` in this rank's env).
+    Idempotent; called automatically from ``hvd.init()``.  Returns the
+    sender, or None when the health plane is not configured."""
+    global _heartbeat_sender
+    target = os.environ.get(_HEALTH_RPC_VAR, "").strip()
+    if not target:
+        return None
+    with _heartbeat_lock:
+        if _heartbeat_sender is not None:
+            return _heartbeat_sender
+        addr, _, port = target.rpartition(":")
+        if not addr or not port.isdigit():
+            log.warning("%s=%r is not addr:port — heartbeats disabled",
+                        _HEALTH_RPC_VAR, target)
+            return None
+        try:
+            interval = float(
+                os.environ.get(_HEARTBEAT_INTERVAL_VAR, "") or 2.0)
+        except ValueError:
+            log.warning("%s=%r is not a number — using 2.0s",
+                        _HEARTBEAT_INTERVAL_VAR,
+                        os.environ.get(_HEARTBEAT_INTERVAL_VAR))
+            interval = 2.0
+        if rank is None:
+            rank = int(os.environ.get("HOROVOD_RANK", "0") or 0)
+        from horovod_tpu.runner import rpc
+        key = rpc.job_key_bytes(os.environ.get("HOROVOD_SECRET_KEY"))
+        sender = HeartbeatSender(addr, int(port), key, rank, interval)
+        sender.start()
+        _heartbeat_sender = sender
+        log.debug("heartbeat sender started -> %s (interval %.2fs)",
+                  target, interval)
+        return sender
+
+
+def stop_heartbeat() -> None:
+    global _heartbeat_sender
+    with _heartbeat_lock:
+        if _heartbeat_sender is not None:
+            _heartbeat_sender.stop()
+            _heartbeat_sender = None
 
 
 # ---------------------------------------------------------------------------
@@ -600,8 +1112,13 @@ def maybe_save_and_exit(ckpt_dir: str, state, step: int) -> bool:
 
 
 def _reset_for_tests() -> None:
-    """Clear module state (preemption flag + handler marker)."""
-    global _handler_installed
+    """Clear module state (preemption flag + handler marker + heartbeat
+    sender + progress)."""
+    global _handler_installed, _progress_step, _progress_ts
     _preempt_event.clear()
     with _handler_lock:
         _handler_installed = False
+    stop_heartbeat()
+    with _progress_lock:
+        _progress_step = -1
+        _progress_ts = 0.0

@@ -7,11 +7,14 @@ assignment from ``host:slots`` pairs).
 
 from __future__ import annotations
 
+import logging
 import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 from horovod_tpu import telemetry
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -134,6 +137,12 @@ class HostBlacklist:
             # Cooldown elapsed: the host gets another chance.  If it is
             # still broken the next failure re-demotes it.
             del self._entries[hostname]
+            telemetry.counter(
+                "hvd_blacklist_expirations_total",
+                "Blacklist cooldowns that expired, re-admitting the "
+                "host").inc()
+            logger.info("blacklist cooldown expired for %s; host is "
+                        "eligible again", hostname)
             return False
         return True
 

@@ -17,7 +17,7 @@ import subprocess
 import sys
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from horovod_tpu import faults, telemetry
 from horovod_tpu.resilience import PREEMPTION_RC
@@ -159,7 +159,8 @@ def launch_job(rank_infos: List[RankInfo], command: List[str],
                output_dir: Optional[str] = None,
                prefix_output: bool = True,
                start_timeout: Optional[float] = None,
-               report: Optional[dict] = None) -> int:
+               report: Optional[dict] = None,
+               watchdog: Optional[Callable[[], list]] = None) -> int:
     """Run all ranks; on any non-zero exit terminate the rest (reference
     gloo_run.py:256-262).  Returns the job exit code.
 
@@ -167,7 +168,16 @@ def launch_job(rank_infos: List[RankInfo], command: List[str],
     ``report["failed"]`` = list of ``(rank, hostname, exit_code)`` for
     every rank that exited non-zero on its own (operator-stop SIGTERMs
     excluded — those are not host failures), ``report["signalled"]`` =
-    True when the launcher's own SIGINT/SIGTERM handler fired."""
+    True when the launcher's own SIGINT/SIGTERM handler fired.
+
+    ``watchdog``, when given, is polled in the supervision loop and
+    returns ``(rank, reason)`` pairs for ranks the health plane declared
+    dead (heartbeats gone) or hung (heartbeats alive, step stalled).
+    Those ranks are SIGKILLed — deliberately via :meth:`RankProcess.kill`
+    and not ``terminate()``, so the exit is attributed to the rank like
+    any crash and flows through the normal blame / soft-demotion /
+    elastic-restart machinery instead of being excused as launcher
+    teardown."""
     procs = [RankProcess(info, command, env, output_dir, prefix_output)
              for info, env in zip(rank_infos, env_per_rank)]
 
@@ -199,7 +209,21 @@ def launch_job(rank_infos: List[RankInfo], command: List[str],
             p.start()
         exit_code = 0
         running = set(range(len(procs)))
+        by_rank = {p.info.rank: p for p in procs}
         while running and not stop.is_set():
+            if watchdog is not None:
+                for bad_rank, reason in watchdog():
+                    victim = by_rank.get(bad_rank)
+                    if victim is None or victim.proc.poll() is not None:
+                        continue
+                    sys.stderr.write(
+                        f"hvdrun: health plane: rank {bad_rank} {reason}; "
+                        f"killing it to trigger a restart\n")
+                    telemetry.counter(
+                        "hvd_watchdog_kills_total",
+                        "Ranks SIGKILLed by the health-plane watchdog "
+                        "(dead or hung)").inc()
+                    victim.kill()
             for i in sorted(running):
                 rc = procs[i].proc.poll()
                 if rc is None:

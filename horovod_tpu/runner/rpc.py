@@ -198,17 +198,30 @@ class KeepaliveMonitor:
     silent past ``timeout`` is reported dead (the failure-detection half
     of the reference's task services).
 
+    Pings may carry a training step (:meth:`progress` — the heartbeat
+    health plane), which lets the monitor distinguish two very different
+    failures: a *dead* task (socket gone, pings stopped —
+    :meth:`dead_tasks`) and a *hung* one (pings keep arriving but the
+    step has not advanced past ``hang_deadline`` seconds —
+    :meth:`hung_tasks`).  The distinction matters because a hung worker
+    holds every peer hostage inside a collective: waiting for the
+    collective's own timeout wastes minutes the health plane can save.
+
     ``clock`` is a monotonic-seconds callable, injectable so tests step
     time instead of sleeping.  Call :meth:`forget` when a task finishes
     cleanly — a completed task stops pinging and must not be mistaken
     for a dead one."""
 
     def __init__(self, timeout: float = 60.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 hang_deadline: float = 0.0):
         self._clock = clock
         self._timeout = timeout
+        self._hang_deadline = hang_deadline
         self._last: dict = {}
+        self._steps: dict = {}          # task_id -> (step, last_advance_ts)
         self._reported_dead: set = set()
+        self._reported_hung: set = set()
         self._lock = threading.Lock()
 
     def ping(self, task_id) -> None:
@@ -217,12 +230,26 @@ class KeepaliveMonitor:
             # A task that pings again was a network blip, not a loss.
             self._reported_dead.discard(task_id)
 
+    def progress(self, task_id, step: int) -> None:
+        """A heartbeat carrying the task's training step.  Counts as a
+        ping; the hang clock restarts only when the step ADVANCES."""
+        with self._lock:
+            now = self._clock()
+            self._last[task_id] = now
+            self._reported_dead.discard(task_id)
+            prev = self._steps.get(task_id)
+            if prev is None or step > prev[0]:
+                self._steps[task_id] = (int(step), now)
+                self._reported_hung.discard(task_id)
+
     def forget(self, task_id) -> None:
         """Stop tracking a task (it reported its result or was removed
         from the job); silence from it is no longer a failure."""
         with self._lock:
             self._last.pop(task_id, None)
+            self._steps.pop(task_id, None)
             self._reported_dead.discard(task_id)
+            self._reported_hung.discard(task_id)
 
     def dead_tasks(self) -> list:
         now = self._clock()
@@ -238,6 +265,44 @@ class KeepaliveMonitor:
                 "Tasks whose keepalive pings went silent past the "
                 "timeout").inc(len(fresh))
         return dead
+
+    def hung_tasks(self) -> list:
+        """Tasks whose heartbeats still arrive but whose step has been
+        stalled longer than ``hang_deadline`` (0 disables).  Reported
+        once per stall episode — a step advance re-arms the detector.
+        Disjoint from :meth:`dead_tasks`: a silent task is dead, not
+        hung."""
+        if not self._hang_deadline:
+            return []
+        now = self._clock()
+        with self._lock:
+            hung = [
+                t for t, (step, advance_ts) in self._steps.items()
+                if now - advance_ts > self._hang_deadline
+                and now - self._last.get(t, 0.0) <= self._timeout
+            ]
+            fresh = [t for t in hung if t not in self._reported_hung]
+            self._reported_hung.update(fresh)
+        if fresh:
+            telemetry.counter(
+                "hvd_heartbeat_hangs_total",
+                "Tasks whose heartbeats stayed alive while the training "
+                "step stalled past the hang deadline").inc(len(fresh))
+        return fresh
+
+    def tracked(self) -> list:
+        """Every task id with any recorded state (ping or step)."""
+        with self._lock:
+            return sorted(set(self._last) | set(self._steps))
+
+    def step_lags(self) -> dict:
+        """Per-task straggler lag: ``max(step) - step`` over every task
+        that has reported a step.  Empty until the first progress ping."""
+        with self._lock:
+            if not self._steps:
+                return {}
+            top = max(step for step, _ in self._steps.values())
+            return {t: top - step for t, (step, _) in self._steps.items()}
 
 
 def find_free_port(bind: str = "") -> int:

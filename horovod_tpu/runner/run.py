@@ -16,8 +16,10 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shutil
 import socket
 import sys
+import tempfile
 import time
 from typing import List
 
@@ -72,6 +74,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Seconds until a blacklisted host becomes "
                         "eligible for re-allocation again (default: "
                         "demoted for the life of the job).")
+    p.add_argument("--heartbeat-interval", dest="heartbeat_interval",
+                   type=float, default=None,
+                   help="Enable the heartbeat health plane: every rank "
+                        "reports (step, progress_ts) to the launcher "
+                        "every N seconds over the authenticated RPC "
+                        "plane.  A rank silent past "
+                        "HOROVOD_HEARTBEAT_DEADLINE (default 5x the "
+                        "interval) is declared dead and killed for "
+                        "restart; with --hang-deadline, a rank whose "
+                        "heartbeats arrive but whose step stalls is "
+                        "killed proactively instead of waiting for the "
+                        "eager collective timeout.  Defaults to "
+                        "HOROVOD_HEARTBEAT_INTERVAL when set "
+                        "(docs/fault_tolerance.md).")
+    p.add_argument("--hang-deadline", dest="hang_deadline", type=float,
+                   default=None,
+                   help="Seconds a rank's training step may stall (while "
+                        "its heartbeats stay alive) before the launcher "
+                        "restarts it.  Requires --heartbeat-interval. "
+                        "Defaults to HOROVOD_HANG_DEADLINE; 0 disables "
+                        "hang detection.")
     p.add_argument("--network-interface", dest="network_interface",
                    default=None,
                    help="Comma-separated NIC name(s), in preference "
@@ -215,6 +238,36 @@ def run_command(args) -> int:
         os.environ.pop("HOROVOD_METRICS_FILE", None)
         telemetry.configure(enabled_flag=True)
         collector = _MetricsCollector(extra_env["HOROVOD_SECRET_KEY"])
+    # Heartbeat health plane (docs/fault_tolerance.md "Warm restart"):
+    # active only when an interval is configured, so launch paths (and
+    # tests) that stub _launch_once keep their historical signature.
+    hb_interval = getattr(args, "heartbeat_interval", None)
+    if hb_interval is None:
+        raw = os.environ.get("HOROVOD_HEARTBEAT_INTERVAL", "").strip()
+        hb_interval = float(raw) if raw else None
+    health = None
+    if hb_interval:
+        deadline = float(
+            os.environ.get("HOROVOD_HEARTBEAT_DEADLINE", "").strip()
+            or 5.0 * hb_interval)
+        hang = getattr(args, "hang_deadline", None)
+        if hang is None:
+            hang = float(
+                os.environ.get("HOROVOD_HANG_DEADLINE", "").strip() or 0.0)
+        health = _HealthPlane(extra_env["HOROVOD_SECRET_KEY"],
+                              hb_interval, deadline, hang)
+    # Warm-restart spill scratch dir: one per JOB, stable across elastic
+    # restart attempts so a new attempt's ranks find the old attempt's
+    # spills.  A user-provided HOROVOD_SPILL_DIR is respected (and never
+    # deleted); otherwise the launcher owns a temp dir for the job.
+    owned_spill_dir = None
+    spill_scratch = os.environ.get("HOROVOD_SPILL_DIR", "").strip()
+    if restarts > 0 and not spill_scratch:
+        owned_spill_dir = tempfile.mkdtemp(prefix="hvd-spill-")
+        spill_scratch = owned_spill_dir
+    if spill_scratch:
+        extra_env["HOROVOD_SPILL_DIR"] = spill_scratch
+    prev_np = None
     rc = 1
     try:
         for attempt in range(restarts + 1):
@@ -281,12 +334,22 @@ def run_command(args) -> int:
                       file=sys.stderr, flush=True)
             infos = hosts.allocate(usable, cur_np)
             extra_env["HOROVOD_RESTART_ATTEMPT"] = str(attempt)
+            if prev_np is not None and prev_np != cur_np:
+                # World size changed across the restart: workers use this
+                # to rescale the learning rate / accumulate so the global
+                # batch keeps its semantics (parallel.data.elastic_transition).
+                extra_env["HOROVOD_ELASTIC_PREV_SIZE"] = str(prev_np)
+            else:
+                extra_env.pop("HOROVOD_ELASTIC_PREV_SIZE", None)
+            prev_np = cur_np
             report: dict = {}
             # Metrics kwargs only when active: callers (and tests) that
             # stub _launch_once with the historical 5-arg signature stay
             # compatible on the metrics-off path.
             mkw = ({"metrics_file": metrics_file, "collector": collector}
                    if collector is not None else {})
+            if health is not None:
+                mkw["health"] = health
             rc = _launch_once(args, infos, addr, extra_env, report=report,
                               **mkw)
             if rc == 0:
@@ -308,6 +371,10 @@ def run_command(args) -> int:
                                      report.get("failed", ()), min_np)
         return rc
     finally:
+        if health is not None:
+            health.shutdown()
+        if owned_spill_dir is not None:
+            shutil.rmtree(owned_spill_dir, ignore_errors=True)
         if collector is not None:
             try:
                 _write_metrics_summary(metrics_file, collector, np_, rc)
@@ -315,6 +382,86 @@ def run_command(args) -> int:
                 print(f"hvdrun: could not write metrics summary to "
                       f"{metrics_file}: {e}", file=sys.stderr, flush=True)
             collector.shutdown()
+
+
+class _HealthPlane:
+    """Launcher-side heartbeat sink + watchdog (the driver half of the
+    elastic warm-restart health plane).
+
+    Rides the same authenticated RPC plane as :class:`_MetricsCollector`:
+    each rank's :class:`horovod_tpu.resilience.HeartbeatSender` pushes
+    ``{"kind": "heartbeat", rank, step, progress_ts}`` to
+    ``HOROVOD_HEALTH_RPC`` every ``interval`` seconds, and the
+    :class:`~horovod_tpu.runner.rpc.KeepaliveMonitor` underneath
+    distinguishes *dead* ranks (silent past ``deadline``) from *hung*
+    ones (heartbeats alive, step stalled past ``hang_deadline``).
+    A rank that never sent a single heartbeat is never declared dead
+    here — start-up and first-compile stalls belong to the rendezvous
+    timeouts, not the health plane."""
+
+    def __init__(self, secret: str, interval: float, deadline: float,
+                 hang_deadline: float):
+        from horovod_tpu.runner import rpc
+        self.interval = float(interval)
+        self.deadline = float(deadline)
+        self.hang_deadline = float(hang_deadline)
+        self.monitor = rpc.KeepaliveMonitor(timeout=self.deadline,
+                                            hang_deadline=self.hang_deadline)
+        self._killed: set = set()
+        self._last_gauge = 0.0
+        self._server = rpc.RpcServer(rpc.job_key_bytes(secret),
+                                     self._handle)
+
+    def _handle(self, req):
+        if isinstance(req, dict) and req.get("kind") == "heartbeat":
+            try:
+                self.monitor.progress(int(req.get("rank", -1)),
+                                      int(req.get("step", -1)))
+            except (TypeError, ValueError):
+                return {"ok": False}
+            return {"ok": True}
+        return {"ok": False}
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    def begin_attempt(self, ranks) -> None:
+        """Reset tracking for a fresh (re)launch — silence from the
+        previous attempt's ranks is no longer a failure (after a shrink
+        the old world's higher ranks must not haunt the monitor)."""
+        for r in set(self.monitor.tracked()) | set(ranks):
+            self.monitor.forget(r)
+        self._killed.clear()
+
+    def watchdog(self) -> list:
+        """``(rank, reason)`` pairs newly declared dead or hung since the
+        last call; each rank is reported once per attempt (it is about to
+        be killed).  Also refreshes the ``hvd_worker_step_lag`` straggler
+        gauges, throttled to one update per heartbeat interval."""
+        now = time.monotonic()
+        if now - self._last_gauge >= self.interval:
+            self._last_gauge = now
+            for r, lag in sorted(self.monitor.step_lags().items()):
+                telemetry.gauge(
+                    "hvd_worker_step_lag",
+                    "Steps this worker trails the fastest worker "
+                    "(heartbeat health plane)", rank=str(r)).set(float(lag))
+        out = []
+        for r in self.monitor.dead_tasks():
+            if r not in self._killed:
+                self._killed.add(r)
+                out.append((r, f"sent no heartbeat for > "
+                               f"{self.deadline:g}s"))
+        for r in self.monitor.hung_tasks():
+            if r not in self._killed:
+                self._killed.add(r)
+                out.append((r, f"is hung: heartbeats alive but the step "
+                               f"stalled > {self.hang_deadline:g}s"))
+        return out
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
 
 
 class _MetricsCollector:
@@ -429,7 +576,7 @@ def _demote_failed_hosts(blacklist, host_list, failed, min_np) -> None:
 
 
 def _launch_once(args, infos, addr, extra_env, report=None,
-                 metrics_file=None, collector=None) -> int:
+                 metrics_file=None, collector=None, health=None) -> int:
     port = args.rendezvous_port or launch.find_free_port()
     if getattr(args, "jax_distributed", False):
         # The jax.distributed coordinator runs INSIDE rank 0 (unlike the
@@ -454,6 +601,13 @@ def _launch_once(args, infos, addr, extra_env, report=None,
             env["HOROVOD_METRICS_FILE"] = _per_rank_metrics_path(
                 metrics_file, info.rank)
             env["HOROVOD_METRICS_RPC"] = f"{addr}:{collector.port}"
+    watchdog = None
+    if health is not None:
+        for env in env_per_rank:
+            env["HOROVOD_HEALTH_RPC"] = f"{addr}:{health.port}"
+            env["HOROVOD_HEARTBEAT_INTERVAL"] = str(health.interval)
+        health.begin_attempt([i.rank for i in infos])
+        watchdog = health.watchdog
     if args.verbose:
         for info in infos:
             print(f"hvdrun: rank {info.rank} -> {info.hostname} "
@@ -463,7 +617,8 @@ def _launch_once(args, infos, addr, extra_env, report=None,
         infos, args.command, env_per_rank,
         output_dir=args.output_filename,
         start_timeout=args.start_timeout,
-        report=report)
+        report=report,
+        watchdog=watchdog)
 
 
 def main(argv: List[str] = None) -> int:

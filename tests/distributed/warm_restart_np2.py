@@ -1,0 +1,94 @@
+"""Elastic warm-restart gate workload (run: hvdrun -np 2
+--elastic-restarts 1 --min-np 1, rank 1 on a demotable host — see
+ci/run_tests.sh and tests/test_chaos.py).
+
+Attempt 0 (np=2): guarded training commits + spills every step; the
+only DISK checkpoint is written at step ``DISK_STEP``; rank 1 SIGKILLs
+itself right after committing step ``CRASH_AT - 1``.  The launcher
+blames rank 1, demotes its host, and relaunches at np=1.
+
+Attempt 1 (np=1): :func:`horovod_tpu.resilience.warm_restore` must
+recover from the surviving PEER SPILL at the last *committed* step —
+strictly newer than the disk checkpoint, proving no orbax read — carry
+the ``spill_extra`` cursor across, apply the elastic continuity policy
+for the 2 -> 1 shrink, and train to the exact final state an
+uninterrupted run produces.
+"""
+import os
+import signal
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import checkpoint, resilience, telemetry
+
+hvd.init()
+rank, size = hvd.rank(), hvd.size()
+attempt = os.environ.get("HOROVOD_RESTART_ATTEMPT", "0")
+CKPT = os.environ["WARM_GATE_CKPT"]
+TOTAL = 8
+DISK_STEP = 2    # the one (stale) disk checkpoint
+CRASH_AT = 5     # rank 1 dies after committing step 4
+
+params = {"w": np.zeros(4, np.float32)}
+opt_state = {"m": np.zeros(4, np.float32)}
+guard = resilience.StepGuard(policy="rollback", nan_burst=1,
+                             snapshot_interval=1, sentinel_interval=0)
+
+params, opt_state, committed, source, extra = resilience.warm_restore(
+    params, opt_state, ckpt_dir=CKPT)
+start = committed + 1
+
+if attempt == "0":
+    assert (source, start) == ("fresh", 0), (source, start)
+else:
+    # The acceptance assertions: peer spill beat the disk checkpoint.
+    assert size == 1, f"expected surviving world of 1, got {size}"
+    assert source == "spill", \
+        f"expected peer-spill recovery, got {source!r}"
+    assert committed == CRASH_AT - 1, \
+        f"expected committed step {CRASH_AT - 1}, got {committed}"
+    assert committed > DISK_STEP, \
+        "peer spill must be newer than the disk checkpoint"
+    assert extra.get("cursor") == CRASH_AT - 1, extra
+    # World-size-change continuity: launcher injected PREV_SIZE=2.
+    prev, lr_scale, accum = hvd.elastic_transition(policy="lr_scale")
+    assert (prev, lr_scale, accum) == (2, 0.5, 1), (prev, lr_scale, accum)
+    # Deterministic shard reassignment from (committed step, new size):
+    # one rank now owns the whole permutation.
+    shard = hvd.elastic_shard(16, committed, size, rank)
+    assert sorted(shard.tolist()) == list(range(16)), shard
+
+for step in range(start, TOTAL):
+    # Every rank contributes the same value, so the allreduce mean — and
+    # therefore the final w — is identical at np=2 and np=1.
+    g = np.full(4, float(step), np.float32)
+    params = {"w": params["w"] + np.asarray(
+        hvd.allreduce(g, name=f"warm.{step}"))}
+    guard.spill_extra["cursor"] = step
+    params, opt_state, ev = guard.after_step(params, opt_state, step, 0.1)
+    assert ev.action == "ok", f"rank {rank} step {step}: {ev}"
+    if step + 1 == DISK_STEP:
+        checkpoint.save(CKPT, {"params": params, "opt_state": opt_state,
+                               "step": np.full((), step, np.int64)},
+                        step=step)
+    if attempt == "0" and rank == 1 and step + 1 == CRASH_AT:
+        # Hard failure AFTER the commit+spill of step 4: the surviving
+        # peer's spill now holds a step no disk checkpoint has.
+        os.kill(os.getpid(), signal.SIGKILL)
+
+want = float(sum(range(TOTAL)))
+np.testing.assert_allclose(params["w"], np.full(4, want), rtol=1e-6)
+
+if telemetry.enabled():
+    snap = hvd.metrics_snapshot()
+    from horovod_tpu.telemetry import aggregate
+    assert aggregate.counter_total(snap, "hvd_warm_restart_spills_total") \
+        >= 1, "no spill recorded"
+    if attempt == "1":
+        assert aggregate.counter_total(
+            snap, "hvd_warm_restart_peer_recoveries_total") >= 1, \
+            "no peer recovery recorded"
+
+print(f"WARM_OK attempt={attempt} rank={rank} size={size} "
+      f"source={source} committed={committed}", flush=True)

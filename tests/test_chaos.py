@@ -304,6 +304,136 @@ def test_chaos_rank0_save_failure_degrades_not_deadlocks(tmp_path):
     assert "FAILED" in out, out           # the loud log, not an exception
 
 
+def _fake_ssh(tmp_path):
+    """127.0.1.1 routes to loopback but is not classified local, so the
+    second rank rides the (fake) ssh path and its "host" is genuinely
+    blacklistable — the elastic restart then shrinks to np=1."""
+    fake_ssh = tmp_path / "fake_ssh"
+    fake_ssh.write_text(textwrap.dedent("""\
+        #!/bin/bash
+        exec bash -c "${@: -1}"
+    """))
+    fake_ssh.chmod(0o755)
+    return fake_ssh
+
+
+def test_chaos_warm_restart_recovers_from_peer_spill(tmp_path):
+    """The ISSUE 5 acceptance scenario: rank 1 SIGKILLs itself after
+    committing step 4 while the only disk checkpoint holds step 1; the
+    relaunch at np=1 must warm-restore from the surviving peer spill at
+    the last COMMITTED step (no orbax read), carry the spill_extra
+    cursor, apply the 2 -> 1 elastic continuity policy, and finish with
+    the exact state of an uninterrupted run.  All the assertions live in
+    the workload; this test checks the launcher-side story."""
+    ckpt = tmp_path / "ckpt"
+    workload = os.path.join(REPO, "tests", "distributed",
+                            "warm_restart_np2.py")
+    res = _hvdrun(
+        ["-np", "2", "-H", "localhost:1,127.0.1.1:1",
+         "--elastic-restarts", "2", "--min-np", "1",
+         sys.executable, workload],
+        env={
+            "HOROVOD_SSH_CMD": str(_fake_ssh(tmp_path)),
+            "WARM_GATE_CKPT": str(ckpt),
+        })
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out
+    # Attempt 0 dies mid-step-5 (rank 0's allreduce loses its killed
+    # peer); the relaunch is where the warm restore must land.
+    assert ("WARM_OK attempt=1 rank=0 size=1 source=spill committed=4"
+            in res.stdout), out
+    assert "blacklisting host 127.0.1.1" in res.stderr, out
+    assert "smaller world: 1/2" in res.stderr, out
+    assert "WARM_OK attempt=0" not in res.stdout, out
+
+
+def test_chaos_heartbeat_drop_triggers_proactive_restart(tmp_path):
+    """The health plane's dead-worker path: rank 1's heartbeats are
+    chaos-dropped after the first few, so nothing but the launcher-side
+    heartbeat deadline can end attempt 0 — both ranks are otherwise
+    asleep for 600s.  The watchdog must SIGKILL rank 1 within the
+    deadline, blame it like a crash, and relaunch on the surviving
+    host.  Bounded wall-clock IS the deadline assertion: without the
+    health plane this test cannot finish."""
+    script = tmp_path / "quiet.py"
+    script.write_text(textwrap.dedent("""\
+        import os
+        import time
+        import horovod_tpu as hvd
+
+        hvd.init()
+        if os.environ.get("HOROVOD_RESTART_ATTEMPT", "0") == "0":
+            time.sleep(600)   # only the health plane can end this
+        print(f"HB_OK attempt=1 rank={hvd.rank()} size={hvd.size()}",
+              flush=True)
+    """))
+    res = _hvdrun(
+        ["-np", "2", "-H", "localhost:1,127.0.1.1:1",
+         "--elastic-restarts", "1", "--min-np", "1",
+         "--heartbeat-interval", "0.2",
+         sys.executable, str(script)],
+        env={
+            "HOROVOD_SSH_CMD": str(_fake_ssh(tmp_path)),
+            "HOROVOD_FAULT_SPEC":
+                "rank=1,site=heartbeat,after=3,kind=heartbeat_drop,"
+                "attempt=0",
+        }, timeout=180)
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out
+    assert "firing kind=heartbeat_drop" in out, out
+    assert ("health plane: rank 1 sent no heartbeat for > 1s" in
+            res.stderr), out
+    assert "killing it to trigger a restart" in res.stderr, out
+    assert "HB_OK attempt=1 rank=0 size=1" in res.stdout, out
+
+
+def test_chaos_hung_worker_killed_before_eager_deadline(tmp_path):
+    """The hung-worker path: rank 1's heartbeats stay alive but its step
+    freezes, while rank 0 keeps advancing.  With the eager collective
+    timeout cranked far beyond the test budget, only the launcher's
+    hang deadline can detect this — it must kill rank 1 proactively and
+    relaunch, long before any collective deadline would fire."""
+    script = tmp_path / "wedge.py"
+    script.write_text(textwrap.dedent("""\
+        import os
+        import time
+        import horovod_tpu as hvd
+        from horovod_tpu import resilience
+
+        hvd.init()
+        rank = hvd.rank()
+        if os.environ.get("HOROVOD_RESTART_ATTEMPT", "0") == "0":
+            for step in range(3):
+                resilience.report_progress(step)
+                time.sleep(0.1)
+            if rank == 1:
+                time.sleep(600)   # wedged: heartbeats alive, step frozen
+            step = 3
+            while True:           # rank 0 stays healthy
+                resilience.report_progress(step)
+                step += 1
+                time.sleep(0.1)
+        print(f"HANG_OK attempt=1 rank={rank} size={hvd.size()}",
+              flush=True)
+    """))
+    res = _hvdrun(
+        ["-np", "2", "-H", "localhost:1,127.0.1.1:1",
+         "--elastic-restarts", "1", "--min-np", "1",
+         "--heartbeat-interval", "0.2", "--hang-deadline", "1.5",
+         sys.executable, str(script)],
+        env={
+            "HOROVOD_SSH_CMD": str(_fake_ssh(tmp_path)),
+            "HOROVOD_EAGER_OP_TIMEOUT": "600",
+        }, timeout=180)
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out
+    assert ("health plane: rank 1 is hung: heartbeats alive but the "
+            "step stalled > 1.5s" in res.stderr), out
+    assert "killing it to trigger a restart" in res.stderr, out
+    assert "HANG_OK attempt=1 rank=0 size=1" in res.stdout, out
+    assert "EagerStallError" not in out, out
+
+
 def test_chaos_spec_typo_fails_loudly(tmp_path):
     """A typo'd HOROVOD_FAULT_SPEC must fail the rank at the first
     injection point with FaultSpecError — a chaos run that silently

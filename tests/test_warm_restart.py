@@ -1,0 +1,399 @@
+"""Unit tests for the elastic warm-restart plane (horovod_tpu/resilience.py
+spill + recovery ladder, runner/rpc.py hang detection, faults.py plane
+chaos kinds, parallel/data.py elastic continuity).  Multi-process
+behaviour (peer election, launcher watchdog kills, restart-at-smaller-np)
+is covered in test_chaos.py and tests/distributed/warm_restart_np2.py."""
+
+import os
+import struct
+import time
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from horovod_tpu import faults, resilience
+from horovod_tpu.parallel import data as pdata
+from horovod_tpu.runner import rpc
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for var in ("HOROVOD_STEP_GUARD", "HOROVOD_SPILL_DIR",
+                "HOROVOD_SPILL_INTERVAL", "HOROVOD_HEALTH_RPC",
+                "HOROVOD_HEARTBEAT_INTERVAL", "HOROVOD_LKG_INTERVAL",
+                "HOROVOD_ELASTIC_BATCH_POLICY",
+                "HOROVOD_ELASTIC_PREV_SIZE", faults.ENV_VAR):
+        monkeypatch.delenv(var, raising=False)
+    faults.reset()
+    resilience._reset_for_tests()
+    yield
+    faults.reset()
+    resilience._reset_for_tests()
+
+
+def _state(seed=0):
+    rs = np.random.RandomState(seed)
+    params = {"w": jnp.asarray(rs.randn(4, 3), jnp.float32),
+              "b": jnp.zeros((3,), jnp.float32)}
+    opt = optax.adam(1e-3).init(params)
+    return params, opt
+
+
+# -- spill file format -------------------------------------------------------
+
+def test_spill_roundtrip(tmp_path):
+    params, opt = _state()
+    extra = {"rng": b"\x01\x02", "cursor": 17}
+    path = resilience.write_spill(str(tmp_path), params, opt, 42,
+                                  extra=extra, rank=0, world_size=2)
+    assert os.path.basename(path) == "rank0.spill"
+    rec = resilience.read_spill(path)
+    assert rec is not None
+    assert rec["step"] == 42
+    assert rec["world_size"] == 2
+    assert rec["rank"] == 0
+    assert rec["extra"] == extra
+    for got, want in zip(rec["params"],
+                         jax.tree_util.tree_leaves(params)):
+        np.testing.assert_array_equal(got, np.asarray(want))
+    for got, want in zip(rec["opt"], jax.tree_util.tree_leaves(opt)):
+        np.testing.assert_array_equal(got, np.asarray(want))
+
+
+def test_spill_rejects_torn_write(tmp_path):
+    params, opt = _state()
+    path = resilience.write_spill(str(tmp_path), params, opt, 7,
+                                  rank=0, world_size=1)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size // 2)
+    assert resilience.read_spill(path) is None
+    # short even of the header
+    with open(path, "r+b") as f:
+        f.truncate(4)
+    assert resilience.read_spill(path) is None
+
+
+def test_spill_rejects_crc_mismatch(tmp_path):
+    params, opt = _state()
+    path = resilience.write_spill(str(tmp_path), params, opt, 7,
+                                  rank=0, world_size=1)
+    with open(path, "r+b") as f:
+        f.seek(resilience._SPILL_HEADER.size + 10)
+        byte = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    assert resilience.read_spill(path) is None
+
+
+def test_spill_rejects_bad_magic_and_version(tmp_path):
+    params, opt = _state()
+    path = resilience.write_spill(str(tmp_path), params, opt, 7,
+                                  rank=0, world_size=1)
+    raw = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(b"NOTSPILL" + raw[8:])
+    assert resilience.read_spill(path) is None
+    blob = raw[resilience._SPILL_HEADER.size:]
+    hdr = resilience._SPILL_HEADER.pack(
+        resilience.SPILL_MAGIC, resilience.SPILL_VERSION + 1, 7, 1, 0,
+        len(blob), zlib.crc32(blob))
+    with open(path, "wb") as f:
+        f.write(hdr + blob)
+    assert resilience.read_spill(path) is None
+
+
+def test_best_local_spill_prefers_freshest_and_skips_corrupt(tmp_path):
+    params, opt = _state()
+    resilience.write_spill(str(tmp_path), params, opt, 5, rank=0,
+                           world_size=2)
+    newest = resilience.write_spill(str(tmp_path), params, opt, 9,
+                                    rank=1, world_size=2)
+    best = resilience.best_local_spill(str(tmp_path))
+    assert best is not None and best["step"] == 9
+    # corrupt the freshest: the older one must win
+    with open(newest, "r+b") as f:
+        f.truncate(os.path.getsize(newest) - 3)
+    best = resilience.best_local_spill(str(tmp_path))
+    assert best is not None and best["step"] == 5
+    assert resilience.best_local_spill(str(tmp_path / "missing")) is None
+
+
+# -- single-rank recovery ladder ---------------------------------------------
+
+def test_warm_restore_prefers_spill(hvd, tmp_path, monkeypatch):
+    params, opt = _state()
+    trained = jax.tree_util.tree_map(lambda x: x + 1.0, params)
+    resilience.write_spill(str(tmp_path), trained, opt, 12,
+                           extra={"cursor": 3}, rank=0, world_size=1)
+    monkeypatch.setenv("HOROVOD_SPILL_DIR", str(tmp_path))
+    p, o, step, source, extra = resilience.warm_restore(params, opt)
+    assert (step, source) == (12, "spill")
+    assert extra == {"cursor": 3}
+    for a, b in zip(jax.tree_util.tree_leaves(p),
+                    jax.tree_util.tree_leaves(trained)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_warm_restore_layout_mismatch_falls_through(hvd, tmp_path,
+                                                    monkeypatch):
+    params, opt = _state()
+    resilience.write_spill(str(tmp_path), params, opt, 12, rank=0,
+                           world_size=1)
+    monkeypatch.setenv("HOROVOD_SPILL_DIR", str(tmp_path))
+    other = {"w": jnp.zeros((2, 2), jnp.float32)}   # incongruent template
+    other_opt = optax.adam(1e-3).init(other)
+    p, o, step, source, extra = resilience.warm_restore(other, other_opt)
+    assert (step, source) == (-1, "fresh")
+    assert p is other
+
+
+def test_warm_restore_disk_fallback(hvd, tmp_path, monkeypatch):
+    from horovod_tpu import checkpoint
+    params, opt = _state()
+    trained = jax.tree_util.tree_map(lambda x: x * 2.0 + 1.0, params)
+    ckpt = tmp_path / "ckpt"
+    checkpoint.save(str(ckpt), {"params": trained, "opt_state": opt,
+                                "step": np.full((), 8, np.int64)}, step=8)
+    spills = tmp_path / "spills"   # exists but empty
+    spills.mkdir()
+    monkeypatch.setenv("HOROVOD_SPILL_DIR", str(spills))
+    p, o, step, source, extra = resilience.warm_restore(
+        params, opt, ckpt_dir=str(ckpt))
+    assert (step, source) == (8, "disk")
+    assert extra == {}
+    for a, b in zip(jax.tree_util.tree_leaves(p),
+                    jax.tree_util.tree_leaves(trained)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_warm_restore_fresh_when_nothing_to_recover(hvd, tmp_path):
+    params, opt = _state()
+    p, o, step, source, extra = resilience.warm_restore(
+        params, opt, ckpt_dir=str(tmp_path / "nope"),
+        directory=str(tmp_path / "empty"))
+    assert (step, source) == (-1, "fresh")
+    assert p is params and o is opt
+
+
+def test_step_guard_spills_on_commit(hvd, tmp_path, monkeypatch):
+    monkeypatch.setenv("HOROVOD_STEP_GUARD", "rollback")
+    monkeypatch.setenv("HOROVOD_LKG_INTERVAL", "1")
+    monkeypatch.setenv("HOROVOD_SPILL_DIR", str(tmp_path))
+    monkeypatch.setenv("HOROVOD_SPILL_INTERVAL", "2")
+    params, opt = _state()
+    guard = resilience.StepGuard()
+    guard.spill_extra["cursor"] = 123
+    for step in range(4):
+        params, opt, _ = guard.after_step(params, opt, step,
+                                          jnp.float32(0.5))
+    # commits at steps 0..3, spill every 2nd commit -> last spill step 3
+    rec = resilience.best_local_spill(str(tmp_path))
+    assert rec is not None
+    assert rec["step"] == 3
+    assert rec["extra"] == {"cursor": 123}
+    # and the guard reported progress for the heartbeat plane
+    assert resilience.progress()[0] == 3
+
+
+# -- heartbeat plane ---------------------------------------------------------
+
+def test_report_progress_is_monotonic():
+    resilience.report_progress(5)
+    resilience.report_progress(3)
+    step, ts = resilience.progress()
+    assert step == 5 and ts > 0.0
+
+
+def test_keepalive_monitor_distinguishes_dead_from_hung():
+    now = [0.0]
+    mon = rpc.KeepaliveMonitor(timeout=10.0, clock=lambda: now[0],
+                               hang_deadline=30.0)
+    mon.progress("rank0", 1)
+    mon.progress("rank1", 1)
+    # rank1 keeps heartbeating but its step never advances; rank0
+    # advances then goes silent.
+    for t in (10.0, 20.0, 31.0):
+        now[0] = t
+        mon.progress("rank1", 1)
+    now[0] = 20.0
+    mon.progress("rank0", 2)
+    now[0] = 31.0
+    assert mon.dead_tasks() == ["rank0"]      # silent since t=20
+    assert mon.hung_tasks() == ["rank1"]      # fresh pings, stalled step
+    # hung is reported once per episode
+    assert mon.hung_tasks() == []
+    # progress to a NEW step clears the episode
+    now[0] = 32.0
+    mon.progress("rank1", 2)
+    now[0] = 63.0
+    mon.progress("rank1", 2)
+    assert mon.hung_tasks() == ["rank1"]
+
+
+def test_keepalive_monitor_step_lags_and_forget():
+    now = [0.0]
+    mon = rpc.KeepaliveMonitor(timeout=10.0, clock=lambda: now[0],
+                               hang_deadline=0.0)
+    assert mon.step_lags() == {}
+    mon.progress("rank0", 10)
+    mon.progress("rank1", 4)
+    assert mon.step_lags() == {"rank0": 0, "rank1": 6}
+    assert mon.hung_tasks() == []   # hang detection disabled
+    mon.forget("rank1")
+    assert mon.step_lags() == {"rank0": 0}
+
+
+def test_heartbeat_sender_pushes_to_health_plane(monkeypatch):
+    """End-to-end over a real RpcServer: heartbeats arrive authenticated
+    and carry the latest reported step."""
+    got = []
+
+    def handler(req):
+        got.append(req)
+        return {"ok": True}
+
+    key = rpc.job_key_bytes("s3cret")
+    server = rpc.RpcServer(key, handler)
+    try:
+        monkeypatch.setenv("HOROVOD_HEALTH_RPC",
+                           f"127.0.0.1:{server.port}")
+        monkeypatch.setenv("HOROVOD_HEARTBEAT_INTERVAL", "0.05")
+        monkeypatch.setenv("HOROVOD_SECRET_KEY", "s3cret")
+        resilience.report_progress(41)
+        sender = resilience.start_heartbeat(rank=3)
+        assert sender is not None
+        assert resilience.start_heartbeat(rank=3) is sender  # idempotent
+        deadline = time.monotonic() + 5.0
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.01)
+        resilience.stop_heartbeat()
+        assert got, "no heartbeat arrived within 5s"
+        beat = got[0]
+        assert beat["kind"] == "heartbeat"
+        assert beat["rank"] == 3
+        assert beat["step"] == 41
+    finally:
+        server.shutdown()
+
+
+def test_start_heartbeat_without_env_is_noop():
+    assert resilience.start_heartbeat(rank=0) is None
+
+
+# -- chaos plane kinds -------------------------------------------------------
+
+def test_faults_parse_heartbeat_drop_and_spill_corrupt(monkeypatch):
+    monkeypatch.setenv(
+        faults.ENV_VAR,
+        "rank=1,kind=heartbeat_drop:3;"
+        "kind=spill_corrupt:64,count=1,after=5")
+    rules = faults.load()
+    hb = next(r for r in rules if r.kind == "heartbeat_drop")
+    # heartbeat_drop:N is shorthand for count=N
+    assert hb.arg == 3 and hb.count == 3 and hb.rank == 1
+    sc = next(r for r in rules if r.kind == "spill_corrupt")
+    assert sc.arg == 64 and sc.count == 1 and sc.after == 5
+
+
+def test_faults_reject_bad_plane_args(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "kind=heartbeat_drop:0")
+    with pytest.raises(faults.FaultSpecError):
+        faults.load()
+    faults.reset()
+    monkeypatch.setenv(faults.ENV_VAR, "kind=spill_corrupt:-1")
+    with pytest.raises(faults.FaultSpecError):
+        faults.load()
+
+
+def test_drop_heartbeat_fires_limited_times(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "kind=heartbeat_drop:2")
+    fired = [faults.drop_heartbeat(rank=0) for _ in range(4)]
+    assert fired == [True, True, False, False]
+
+
+def test_drop_heartbeat_respects_rank(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "rank=1,kind=heartbeat_drop")
+    assert not faults.drop_heartbeat(rank=0)
+    assert faults.drop_heartbeat(rank=1)
+
+
+def test_mangle_spill_truncates_file(tmp_path, monkeypatch):
+    path = tmp_path / "rank0.spill"
+    path.write_bytes(b"x" * 100)
+    monkeypatch.setenv(faults.ENV_VAR, "kind=spill_corrupt:10,count=1")
+    assert faults.mangle_spill(str(path), rank=0)
+    assert os.path.getsize(path) == 10
+    # count=1: the second spill lands intact
+    path.write_bytes(b"y" * 100)
+    assert not faults.mangle_spill(str(path), rank=0)
+    assert os.path.getsize(path) == 100
+
+
+def test_spill_corrupt_chains_into_rejection(hvd, tmp_path, monkeypatch):
+    """The fault hook wired inside write_spill: the file lands truncated
+    (default: half its size) and the validator rejects it — the ladder
+    sees no local spill."""
+    params, opt = _state()
+    monkeypatch.setenv(faults.ENV_VAR, "kind=spill_corrupt")
+    resilience.write_spill(str(tmp_path), params, opt, 4, rank=0,
+                           world_size=1)
+    assert resilience.best_local_spill(str(tmp_path)) is None
+
+
+# -- elastic continuity ------------------------------------------------------
+
+def test_elastic_shard_partitions_and_is_deterministic():
+    shards = [pdata.elastic_shard(100, 7, 4, r) for r in range(4)]
+    all_items = np.concatenate(shards)
+    assert sorted(all_items.tolist()) == list(range(100))
+    again = pdata.elastic_shard(100, 7, 4, 2)
+    np.testing.assert_array_equal(shards[2], again)
+    # different step or world size -> different permutation
+    assert not np.array_equal(pdata.elastic_shard(100, 8, 4, 2), again)
+    assert not np.array_equal(
+        pdata.elastic_shard(100, 7, 2, 1),
+        pdata.elastic_shard(100, 7, 4, 1)[:50])
+
+
+def test_elastic_shard_validates():
+    with pytest.raises(ValueError):
+        pdata.elastic_shard(10, 0, 0, 0)
+    with pytest.raises(ValueError):
+        pdata.elastic_shard(10, 0, 2, 2)
+
+
+def test_elastic_continuity_policies(monkeypatch):
+    # lr_scale: shrink 4 -> 2 halves the LR, no accumulation
+    scale, accum = pdata.elastic_continuity(4, 2, policy="lr_scale")
+    assert (scale, accum) == (0.5, 1)
+    # accumulate: shrink 4 -> 2 runs 2 micro-steps, LR unchanged
+    scale, accum = pdata.elastic_continuity(4, 2, policy="accumulate")
+    assert (scale, accum) == (1.0, 2)
+    # growth always rescales (accumulation cannot shrink a batch)
+    scale, accum = pdata.elastic_continuity(2, 4, policy="accumulate")
+    assert (scale, accum) == (2.0, 1)
+    # non-divisible shrink: ceil accumulation overshoots proportionally
+    scale, accum = pdata.elastic_continuity(4, 3, policy="accumulate")
+    assert accum == 2 and scale == pytest.approx(6.0 / 4.0)
+    # env default
+    monkeypatch.setenv("HOROVOD_ELASTIC_BATCH_POLICY", "accumulate")
+    assert pdata.elastic_continuity(4, 2) == (1.0, 2)
+    with pytest.raises(ValueError):
+        pdata.elastic_continuity(4, 2, policy="bogus")
+
+
+def test_elastic_transition_reads_env(monkeypatch):
+    # unset -> identity
+    assert pdata.elastic_transition(new_size=4) == (4, 1.0, 1)
+    monkeypatch.setenv("HOROVOD_ELASTIC_PREV_SIZE", "4")
+    prev, scale, accum = pdata.elastic_transition(new_size=2,
+                                                  policy="lr_scale")
+    assert (prev, scale, accum) == (4, 0.5, 1)
+    monkeypatch.setenv("HOROVOD_ELASTIC_PREV_SIZE", "nope")
+    with pytest.raises(ValueError):
+        pdata.elastic_transition(new_size=2)
