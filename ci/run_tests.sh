@@ -130,6 +130,47 @@ grep -q "HB_OK attempt=1 rank=0 size=1" "$HB_DIR/out.log"
 grep -q "health plane: rank 1 sent no heartbeat" "$HB_DIR/err.log"
 rm -rf "$HB_DIR"
 
+echo "--- fleet gate (2 jobs, 3 slots over fake ssh): priority-1 trainB
+--- takes the whole pool, priority-2 quickA starves past the deadline,
+--- the controller preempts trainB (rc 75, coordinated save, NO
+--- blacklist), admits quickA, re-admits trainB shrunken to np=2 and it
+--- resumes from the preemption checkpoint (docs/fleet.md).
+--- FLEET_GATE_* rides inline via env(1): the ssh rank path only
+--- forwards HOROVOD_*/PYTHONPATH/PATH/XLA_*/JAX_* variables."
+FLEET_DIR="$(mktemp -d)"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  HOROVOD_SSH_CMD="ci/fake_ssh.sh" \
+  HOROVOD_TERMINATE_GRACE_SECONDS=15 \
+  timeout 150 \
+  python -m horovod_tpu.runner fleet \
+  -H localhost:1,127.0.1.1:1,127.0.1.2:1 \
+  --starvation-deadline 2 --tick-interval 0.25 \
+  --metrics-file "$FLEET_DIR/fleet.json" \
+  --job "trainB 1 2:3 -- env FLEET_GATE_CKPT=$FLEET_DIR/ckpt \
+FLEET_GATE_STEPS=40 FLEET_GATE_STEP_SECONDS=0.25 \
+python tests/distributed/fleet_np2.py" \
+  --job "quickA 2 1 after=6 -- echo QUICK_OK" \
+  2> "$FLEET_DIR/err.log" | tee "$FLEET_DIR/out.log"
+grep -q "admit job trainB np=3" "$FLEET_DIR/err.log"
+grep -q "preempting job trainB" "$FLEET_DIR/err.log"
+grep -q "job trainB preempted (rc 75)" "$FLEET_DIR/err.log"
+grep -q "admit job quickA np=1" "$FLEET_DIR/err.log"
+grep -q "admit job trainB np=2" "$FLEET_DIR/err.log"
+grep -q "QUICK_OK" "$FLEET_DIR/out.log"
+grep -q "FLEET_RESUME job=trainB" "$FLEET_DIR/out.log"
+grep -q "FLEET_OK job=trainB" "$FLEET_DIR/out.log"
+! grep -q "blacklisting host" "$FLEET_DIR/err.log"
+python - "$FLEET_DIR/fleet.json" <<'PYEOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert doc["schema"] == "horovod_tpu.fleet.summary.v1", doc["schema"]
+assert doc["jobs"]["trainB"]["state"] == "done", doc["jobs"]
+assert doc["jobs"]["trainB"]["preemptions"] == 1, doc["jobs"]
+assert doc["jobs"]["quickA"]["state"] == "done", doc["jobs"]
+print("fleet summary OK")
+PYEOF
+rm -rf "$FLEET_DIR"
+
 echo "--- step-guard overhead (BENCH json; target < 2% on real chips —
 --- on the CPU smoke this only proves the lane runs end to end)"
 JAX_PLATFORMS=cpu python -m horovod_tpu.benchmark --step-guard

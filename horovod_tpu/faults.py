@@ -48,7 +48,15 @@ Keys:
              health plane went quiet while the process lives),
              ``spill_corrupt[:N]`` (truncate the just-written warm-
              restart spill file to N bytes — default half its size —
-             the torn-write simulation the CRC check must reject).
+             the torn-write simulation the CRC check must reject),
+             ``preempt_storm[:N]`` (fleet controller: preempt the
+             lowest-priority running job on N scheduler ticks — default
+             1 — the capacity-churn simulation: each victim must save,
+             requeue and resume),
+             ``host_flap[:N]`` (fleet controller: bounce a pool host in
+             and out of the shared blacklist on N consecutive matching
+             ticks — default 2, i.e. one out+in cycle — the flaky-NIC
+             simulation driving elastic shrink and re-grow).
 ``count``    maximum number of firings (default: unlimited for
              ``delay``/``error``/``nan``/``corrupt``/
              ``heartbeat_drop``/``spill_corrupt`` — chaos tests that
@@ -62,7 +70,10 @@ happen after the real collective ran.  Likewise the plane kinds
 (``heartbeat_drop``/``spill_corrupt``) fire only at their dedicated
 hooks — :func:`drop_heartbeat` in the heartbeat sender (site
 ``heartbeat``) and :func:`mangle_spill` in the spill writer (site
-``spill``) — never at :func:`inject`.
+``spill``) — never at :func:`inject`; and the fleet kinds
+(``preempt_storm``/``host_flap``) fire only at :func:`fleet_chaos`,
+which the fleet controller polls once per scheduler tick (site
+``fleet``).
 ``attempt``  only fire when ``HOROVOD_RESTART_ATTEMPT`` equals this
              value — lets an elastic-restart test kill attempt 0 and
              let attempt 1 run clean.
@@ -86,7 +97,7 @@ import numpy as np
 ENV_VAR = "HOROVOD_FAULT_SPEC"
 
 _KINDS = ("crash", "exit", "hang", "delay", "error", "nan", "corrupt",
-          "heartbeat_drop", "spill_corrupt")
+          "heartbeat_drop", "spill_corrupt", "preempt_storm", "host_flap")
 
 # Kinds that mutate an op's *output value* instead of disrupting control
 # flow; they fire at corrupt_output(), never at inject().
@@ -97,10 +108,14 @@ VALUE_KINDS = ("nan", "corrupt")
 # corrupt_output().
 PLANE_KINDS = ("heartbeat_drop", "spill_corrupt")
 
+# Kinds owned by the fleet controller's scheduler loop; they fire at
+# fleet_chaos(), never at inject()/corrupt_output().
+FLEET_KINDS = ("preempt_storm", "host_flap")
+
 SITES = (
     "allreduce", "allgather", "broadcast", "alltoall", "reducescatter",
     "barrier", "native_submit", "native_wait", "rpc", "spawn",
-    "heartbeat", "spill",
+    "heartbeat", "spill", "fleet",
 )
 
 
@@ -299,6 +314,12 @@ def parse_spec(spec: str) -> List[FaultRule]:
                             raise FaultSpecError(
                                 f"kind spill_corrupt:{arg} must keep "
                                 f">= 0 bytes")
+                    elif kind in FLEET_KINDS:
+                        arg = int(kind_arg) if kind_arg else None
+                        if arg is not None and arg < 1:
+                            raise FaultSpecError(
+                                f"kind {kind}:{arg} must fire on "
+                                f">= 1 tick")
                     elif kind_arg:
                         raise FaultSpecError(
                             f"kind {kind!r} takes no argument "
@@ -317,9 +338,16 @@ def parse_spec(spec: str) -> List[FaultRule]:
             raise FaultSpecError(
                 f"fault rule {chunk!r} has no kind= (one of "
                 f"{', '.join(_KINDS)})")
-        # heartbeat_drop:N is shorthand for count=N (N intervals).
+        # heartbeat_drop:N is shorthand for count=N (N intervals); same
+        # shorthand for the fleet kinds (N scheduler ticks).
         if kind == "heartbeat_drop" and count is None and arg is not None:
             count = arg
+        if kind in FLEET_KINDS and count is None:
+            # Unlike the wire kinds these act on a whole job/host per
+            # firing, so "unlimited" would never let the episode settle:
+            # default to one preemption / one out+in blacklist cycle.
+            count = arg if arg is not None else \
+                (1 if kind == "preempt_storm" else 2)
         if site is not None and site not in SITES:
             raise FaultSpecError(
                 f"unknown fault site {site!r}; shipped sites: "
@@ -387,7 +415,8 @@ def inject(site: str, detail: Optional[str] = None,
         return
     ctx_rank = _context_rank(rank)
     for rule in plan:
-        if rule.kind in VALUE_KINDS or rule.kind in PLANE_KINDS:
+        if (rule.kind in VALUE_KINDS or rule.kind in PLANE_KINDS
+                or rule.kind in FLEET_KINDS):
             continue
         if rule.arm(site, ctx_rank):
             rule.execute(site, detail, ctx_rank)
@@ -434,6 +463,29 @@ def drop_heartbeat(rank: Optional[int] = None) -> bool:
                            note=" (heartbeat suppressed)")
             dropped = True
     return dropped
+
+
+def fleet_chaos() -> List[str]:
+    """Fleet-controller hook, polled once per scheduler tick: returns
+    the fleet chaos kinds (``preempt_storm`` / ``host_flap``) whose
+    rules armed on this tick, one entry per firing.  The controller
+    owns the semantics — preempting the lowest-priority running job or
+    bouncing a pool host through the shared blacklist — because only it
+    knows the jobs and the pool.  Same zero-overhead contract as
+    :func:`inject` when no spec is set."""
+    plan = _plan
+    if plan is _UNSET:
+        plan = load()
+    if plan is None:
+        return []
+    fired: List[str] = []
+    for rule in plan:
+        if rule.kind not in FLEET_KINDS:
+            continue
+        if rule.arm("fleet", _context_rank(None)):
+            rule._announce("fleet", None, None)
+            fired.append(rule.kind)
+    return fired
 
 
 def mangle_spill(path: str, rank: Optional[int] = None) -> bool:

@@ -1087,6 +1087,10 @@ def exit_preempted() -> "None":
     (telemetry dumps, async-checkpoint drain) still run."""
     log.warning("exiting with preemption rc %d (reschedule, do not "
                 "blacklist)", PREEMPTION_RC)
+    # Kill the heartbeat first: a sender racing the interpreter teardown
+    # can otherwise push one last beat AFTER the launcher's monitor was
+    # reset for the next attempt, haunting the new world's bookkeeping.
+    stop_heartbeat()
     sys.exit(PREEMPTION_RC)
 
 
@@ -1101,6 +1105,10 @@ def maybe_save_and_exit(ckpt_dir: str, state, step: int) -> bool:
     from horovod_tpu import checkpoint
     log.warning("preemption requested — coordinated save at step %d "
                 "to %s", step, ckpt_dir)
+    # The save below can take a while on big states; keep the health
+    # plane fed so the watchdog never mistakes a rank mid-coordinated-
+    # save for a hung one and SIGKILLs it out of its own rescue.
+    report_progress(step)
     checkpoint.wait_for_async_save()
     checkpoint.save(ckpt_dir, state, step=step)
     if telemetry.enabled():

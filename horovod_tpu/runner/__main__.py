@@ -1,6 +1,14 @@
-"""``python -m horovod_tpu.runner`` = hvdrun."""
+"""``python -m horovod_tpu.runner`` = hvdrun; the ``fleet`` subcommand
+(``python -m horovod_tpu.runner fleet ...``) = hvdfleet."""
 import sys
 
-from horovod_tpu.runner.run import main
+
+def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "fleet":
+        from horovod_tpu.runner.fleet import main as fleet_main
+        return fleet_main(sys.argv[2:])
+    from horovod_tpu.runner.run import main as run_main
+    return run_main()
+
 
 sys.exit(main())

@@ -98,6 +98,21 @@ def allocate(hosts: List[HostSlots], np_: int) -> List[RankInfo]:
     return infos
 
 
+def free_slots(hosts: List[HostSlots],
+               used: Dict[str, int]) -> List[HostSlots]:
+    """Remaining per-host capacity after subtracting ``used`` (hostname →
+    slots held by running jobs).  Hosts with nothing left are dropped so
+    the result feeds straight into :func:`allocate`; order is preserved
+    because rank assignment is host-major and the fleet wants jobs packed
+    onto the same prefix of the pool."""
+    out: List[HostSlots] = []
+    for h in hosts:
+        left = h.slots - used.get(h.hostname, 0)
+        if left > 0:
+            out.append(HostSlots(hostname=h.hostname, slots=left))
+    return out
+
+
 class HostBlacklist:
     """Launcher-side record of hosts demoted after rank failures.
 

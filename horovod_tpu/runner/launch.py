@@ -55,12 +55,13 @@ def is_local(hostname: str) -> bool:
 class RankProcess:
     def __init__(self, info: RankInfo, command: List[str],
                  env: Dict[str, str], output_dir: Optional[str],
-                 prefix_output: bool):
+                 prefix_output: bool, label: Optional[str] = None):
         self.info = info
         self.command = command
         self.env = env
         self.output_dir = output_dir
         self.prefix_output = prefix_output
+        self.label = label
         self.proc: Optional[subprocess.Popen] = None
         self._pump: Optional[threading.Thread] = None
         self.terminated_by_launcher = False
@@ -129,7 +130,9 @@ class RankProcess:
                 self.proc.stdin.close()
 
     def _pump_output(self) -> None:
-        prefix = f"[{self.info.rank}]<stdout>:" if self.prefix_output else ""
+        tag = (f"{self.label}:{self.info.rank}" if self.label
+               else f"{self.info.rank}")
+        prefix = f"[{tag}]<stdout>:" if self.prefix_output else ""
         for line in iter(self.proc.stdout.readline, b""):
             sys.stdout.write(prefix + line.decode(errors="replace"))
             sys.stdout.flush()
@@ -154,13 +157,75 @@ class RankProcess:
             pass
 
 
+class JobControl:
+    """Steering handle for a job supervised OFF the main thread.
+
+    The fleet controller (``runner/fleet.py``) runs each job's
+    :func:`launch_job` in a worker thread, where ``signal.signal`` would
+    raise — so instead of POSIX signals the controller talks to the
+    supervisor through this object.  Two verbs:
+
+    * :meth:`preempt` — deliver SIGTERM to every rank's process group
+      WITHOUT marking the processes launcher-terminated.  Ranks that
+      installed :func:`horovod_tpu.resilience.install_preemption_handler`
+      save and exit rc 75; ranks that did not die of the signal.  Either
+      way the exits are attributed to *preemption* (no host blame, no
+      blacklist) because this flag is set.
+    * :meth:`stop` — operator-stop semantics, identical to the launcher's
+      own SIGINT/SIGTERM handler: tear everything down, report rc 130,
+      blame nothing.
+
+    Both verbs are safe to call before the ranks have spawned (the
+    request is latched and applied at attach time) and are idempotent.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._procs: Optional[List[RankProcess]] = None
+        self.preempt_requested = threading.Event()
+        self.stop_requested = threading.Event()
+
+    def _attach(self, procs: List[RankProcess]) -> None:
+        with self._lock:
+            self._procs = procs
+        # A verb that arrived before the ranks existed applies now.
+        if self.stop_requested.is_set():
+            self.stop()
+        elif self.preempt_requested.is_set():
+            self.preempt()
+
+    def preempt(self) -> None:
+        self.preempt_requested.set()
+        with self._lock:
+            procs = list(self._procs or ())
+        for p in procs:
+            # NOT p.terminate(): that would mark the exit as launcher
+            # teardown and hide the rc-75 / -SIGTERM preemption outcome.
+            if p.proc is None or p.proc.poll() is not None:
+                continue
+            try:
+                os.killpg(p.proc.pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def stop(self) -> None:
+        self.stop_requested.set()
+        with self._lock:
+            procs = list(self._procs or ())
+        for p in procs:
+            p.terminate()
+
+
 def launch_job(rank_infos: List[RankInfo], command: List[str],
                env_per_rank: List[Dict[str, str]],
                output_dir: Optional[str] = None,
                prefix_output: bool = True,
                start_timeout: Optional[float] = None,
                report: Optional[dict] = None,
-               watchdog: Optional[Callable[[], list]] = None) -> int:
+               watchdog: Optional[Callable[[], list]] = None,
+               install_signal_handlers: bool = True,
+               control: Optional[JobControl] = None,
+               label: Optional[str] = None) -> int:
     """Run all ranks; on any non-zero exit terminate the rest (reference
     gloo_run.py:256-262).  Returns the job exit code.
 
@@ -177,8 +242,15 @@ def launch_job(rank_infos: List[RankInfo], command: List[str],
     and not ``terminate()``, so the exit is attributed to the rank like
     any crash and flows through the normal blame / soft-demotion /
     elastic-restart machinery instead of being excused as launcher
-    teardown."""
-    procs = [RankProcess(info, command, env, output_dir, prefix_output)
+    teardown.
+
+    ``install_signal_handlers=False`` + ``control`` is the fleet path:
+    the supervisor runs off the main thread (``signal.signal`` would
+    raise there), so operator stop and preemption arrive through the
+    :class:`JobControl` instead of SIGINT/SIGTERM.  ``label`` prefixes
+    rank output as ``[label:rank]`` so interleaved jobs stay readable."""
+    procs = [RankProcess(info, command, env, output_dir, prefix_output,
+                         label=label)
              for info, env in zip(rank_infos, env_per_rank)]
 
     stop = threading.Event()
@@ -191,8 +263,12 @@ def launch_job(rank_infos: List[RankInfo], command: List[str],
         for p in procs:
             p.terminate()
 
-    old_int = signal.signal(signal.SIGINT, handle_signal)
-    old_term = signal.signal(signal.SIGTERM, handle_signal)
+    old_int = old_term = None
+    if install_signal_handlers:
+        old_int = signal.signal(signal.SIGINT, handle_signal)
+        old_term = signal.signal(signal.SIGTERM, handle_signal)
+    if control is not None:
+        control._attach(procs)
     try:
         # start_timeout bounds LAUNCHING only (spawning every rank — ssh may
         # block on remote hosts), never a healthy running job; rendezvous
@@ -211,6 +287,12 @@ def launch_job(rank_infos: List[RankInfo], command: List[str],
         running = set(range(len(procs)))
         by_rank = {p.info.rank: p for p in procs}
         while running and not stop.is_set():
+            if control is not None and control.stop_requested.is_set():
+                signalled.set()
+                stop.set()
+                for p in procs:
+                    p.terminate()
+                break
             if watchdog is not None:
                 for bad_rank, reason in watchdog():
                     victim = by_rank.get(bad_rank)
@@ -268,16 +350,23 @@ def launch_job(rank_infos: List[RankInfo], command: List[str],
             time.sleep(0.05)
         failed = []
         preempted = []
+        preempt_req = (control is not None and
+                       control.preempt_requested.is_set())
         for p in procs:
             p.proc.wait()
             rc = p.proc.returncode
             if rc not in (0, None) and exit_code == 0:
                 exit_code = rc
             if rc not in (0, None) and not p.terminated_by_launcher:
-                if rc == PREEMPTION_RC:
+                if rc == PREEMPTION_RC or (preempt_req and
+                                           rc == -signal.SIGTERM):
                     # A preempted rank is not a failure and not its
                     # host's fault: no blame, no blacklist — the elastic
                     # caller reschedules immediately (runner/run.py).
+                    # Under a controller-requested preemption a rank
+                    # that never installed the preemption handler dies
+                    # of the raw SIGTERM (-15); that is still the
+                    # controller's doing, not the host's.
                     preempted.append((p.info.rank, p.info.hostname, rc))
                     continue
                 # Genuine rank failure: it failed BEFORE the launcher
@@ -287,6 +376,13 @@ def launch_job(rank_infos: List[RankInfo], command: List[str],
                 # "peer closed connection" instead of the signal, and
                 # blaming ITS host would demote a healthy machine.
                 failed.append((p.info.rank, p.info.hostname, rc))
+        if preempt_req and not failed and preempted and \
+                exit_code in (0, -signal.SIGTERM, PREEMPTION_RC):
+            # The whole gang went down under a requested preemption:
+            # surface the canonical preemption code even if the first
+            # observed exit was a handler-less rank's -SIGTERM, so the
+            # caller's rc-75 requeue path fires uniformly.
+            exit_code = PREEMPTION_RC
         if signalled.is_set():
             # Operator stop: ALWAYS 130, even though the SIGTERMed ranks
             # report -15 — callers (elastic restarts) distinguish "the
@@ -311,5 +407,6 @@ def launch_job(rank_infos: List[RankInfo], command: List[str],
             report["signalled"] = signalled.is_set()
         return exit_code
     finally:
-        signal.signal(signal.SIGINT, old_int)
-        signal.signal(signal.SIGTERM, old_term)
+        if install_signal_handlers:
+            signal.signal(signal.SIGINT, old_int)
+            signal.signal(signal.SIGTERM, old_term)

@@ -251,6 +251,22 @@ class KeepaliveMonitor:
             self._reported_dead.discard(task_id)
             self._reported_hung.discard(task_id)
 
+    def forget_all(self) -> None:
+        """Atomically stop tracking every task.
+
+        Tearing a per-job monitor down mid-episode (fleet preemption, a
+        new elastic attempt) must not race a concurrent watchdog sweep
+        into reporting half-forgotten ranks: a sweep observes either the
+        full pre-teardown set or nothing.  Looping :meth:`forget` over
+        :meth:`tracked` cannot give that guarantee — an RPC handler can
+        insert between the snapshot and the per-id pops, and a sweep can
+        run mid-loop against a partially cleared map."""
+        with self._lock:
+            self._last.clear()
+            self._steps.clear()
+            self._reported_dead.clear()
+            self._reported_hung.clear()
+
     def dead_tasks(self) -> list:
         now = self._clock()
         with self._lock:

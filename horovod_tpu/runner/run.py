@@ -263,7 +263,13 @@ def run_command(args) -> int:
     owned_spill_dir = None
     spill_scratch = os.environ.get("HOROVOD_SPILL_DIR", "").strip()
     if restarts > 0 and not spill_scratch:
-        owned_spill_dir = tempfile.mkdtemp(prefix="hvd-spill-")
+        # Name the job in the prefix when running under the fleet
+        # controller so two jobs' scratch dirs are tellable apart on a
+        # shared host (the fleet normally provisions HOROVOD_SPILL_DIR
+        # itself; this is the fallback path).
+        job = os.environ.get("HOROVOD_FLEET_JOB", "").strip()
+        prefix = f"hvd-spill-{job}-" if job else "hvd-spill-"
+        owned_spill_dir = tempfile.mkdtemp(prefix=prefix)
         spill_scratch = owned_spill_dir
     if spill_scratch:
         extra_env["HOROVOD_SPILL_DIR"] = spill_scratch
@@ -430,8 +436,8 @@ class _HealthPlane:
         """Reset tracking for a fresh (re)launch — silence from the
         previous attempt's ranks is no longer a failure (after a shrink
         the old world's higher ranks must not haunt the monitor)."""
-        for r in set(self.monitor.tracked()) | set(ranks):
-            self.monitor.forget(r)
+        del ranks  # the atomic clear covers old and new worlds alike
+        self.monitor.forget_all()
         self._killed.clear()
 
     def watchdog(self) -> list:

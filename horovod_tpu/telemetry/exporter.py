@@ -117,7 +117,20 @@ def start_http_server(port: int, render_prometheus: Callable[[], str],
         "render_prometheus": staticmethod(render_prometheus),
         "snapshot_fn": staticmethod(snapshot_fn),
     })
-    server = ThreadingHTTPServer((bind, port), handler)
+    try:
+        server = ThreadingHTTPServer((bind, port), handler)
+    except OSError as e:
+        job = os.environ.get("HOROVOD_FLEET_JOB", "")
+        local_rank = os.environ.get("HOROVOD_LOCAL_RANK", "0")
+        raise OSError(
+            f"metrics exporter cannot bind {bind}:{port} "
+            f"(local rank {local_rank}"
+            + (f", fleet job {job!r}" if job else "")
+            + f"): {e}. Two jobs sharing a host must use distinct "
+            f"HOROVOD_METRICS_PORT bases — under hvdfleet set "
+            f"--metrics-port-base/--port-stride so per-job ranges "
+            f"(base + job_index*stride + local_rank) cannot overlap."
+        ) from e
     server.daemon_threads = True
     thread = threading.Thread(target=server.serve_forever,
                               name="hvd-metrics-http", daemon=True)

@@ -1,0 +1,54 @@
+"""Fleet gate workload: a preemptible trainer the ``hvdfleet``
+controller admits, preempts and resumes (ci/run_tests.sh fleet lane and
+tests/test_chaos.py fleet gates).
+
+Contract: install the preemption handler, checkpoint at every rc-75
+preemption (coordinated save via ``maybe_save_and_exit``), and resume
+from the saved step at WHATEVER world size the fleet re-admits us with.
+Every rank contributes the same per-step value, so the allreduce mean —
+and therefore the final ``w`` — is world-size invariant: one final
+value proves the whole admit → preempt → save → shrink/grow → resume
+episode lost no step and double-applied none.
+
+Env: ``FLEET_GATE_CKPT`` (required, checkpoint dir),
+``FLEET_GATE_STEPS`` (default 20), ``FLEET_GATE_STEP_SECONDS``
+(default 0.2 — paces the run so a mid-training preemption lands).
+"""
+import os
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import checkpoint, resilience
+
+hvd.init()
+rank, size = hvd.rank(), hvd.size()
+resilience.install_preemption_handler()
+
+CKPT = os.environ["FLEET_GATE_CKPT"]
+TOTAL = int(os.environ.get("FLEET_GATE_STEPS", "20"))
+DELAY = float(os.environ.get("FLEET_GATE_STEP_SECONDS", "0.2"))
+JOB = os.environ.get("HOROVOD_FLEET_JOB", "?")
+
+state = {"w": np.zeros(4, np.float32), "step": np.zeros((), np.int64)}
+state = checkpoint.restore(CKPT, state)
+start = int(state["step"])
+if start > 0:
+    prev = os.environ.get("HOROVOD_ELASTIC_PREV_SIZE", "")
+    print(f"FLEET_RESUME job={JOB} rank={rank} size={size} "
+          f"start={start} prev={prev}", flush=True)
+
+for step in range(start, TOTAL):
+    g = np.full(4, float(step), np.float32)
+    state["w"] = state["w"] + np.asarray(
+        hvd.allreduce(g, name=f"fleet.{step}"))
+    state["step"] = np.asarray(step + 1, np.int64)
+    resilience.report_progress(step)
+    time.sleep(DELAY)
+    resilience.maybe_save_and_exit(CKPT, state, step + 1)
+
+want = float(sum(range(TOTAL)))
+np.testing.assert_allclose(state["w"], np.full(4, want), rtol=1e-6)
+print(f"FLEET_OK job={JOB} rank={rank} size={size} steps={TOTAL}",
+      flush=True)

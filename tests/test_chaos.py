@@ -453,3 +453,109 @@ def test_chaos_spec_typo_fails_loudly(tmp_path):
     assert res.returncode != 0, err
     assert "FaultSpecError" in err, err
     assert "RAN_CLEAN" not in res.stdout, err
+
+
+def _hvdfleet(args, env=None, timeout=240):
+    full_env = dict(os.environ)
+    full_env["JAX_PLATFORMS"] = "cpu"
+    full_env["PYTHONPATH"] = REPO
+    full_env.pop("XLA_FLAGS", None)
+    # A preempted job may be mid-coordinated-save when SIGTERM lands on
+    # its peers; give the gang headroom before SIGKILL escalation.
+    full_env["HOROVOD_TERMINATE_GRACE_SECONDS"] = "15"
+    if env:
+        full_env.update(env)
+    cmd = [sys.executable, "-m", "horovod_tpu.runner", "fleet"] + args
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout, env=full_env, cwd=REPO)
+
+
+def test_chaos_fleet_priority_preemption_resumes(tmp_path):
+    """The ISSUE 6 acceptance scenario, end to end: a 3-slot pool runs
+    priority-1 trainB at np=3 (its max); priority-2 quickA arrives at
+    t=6s, cannot get its 1-slot gang, and starves past the 2s deadline.
+    The controller preempts trainB through the rc-75 path (SIGTERM ->
+    deferred handler -> coordinated save -> exit 75), admits quickA,
+    re-queues trainB WITHOUT blacklisting, and re-admits it at np=2 —
+    shrunken because quickA still holds a slot — where it warm-resumes
+    from the preemption checkpoint and converges to the exact value an
+    uninterrupted run produces.  The summary metrics must tell the same
+    story."""
+    import json
+
+    ckpt = tmp_path / "ckpt"
+    metrics = tmp_path / "fleet.json"
+    workload = os.path.join(REPO, "tests", "distributed", "fleet_np2.py")
+    train_cmd = f"{sys.executable} {workload}"
+    res = _hvdfleet(
+        ["-H", "localhost:3",
+         "--starvation-deadline", "2", "--tick-interval", "0.25",
+         "--metrics-file", str(metrics), "--verbose",
+         "--job",
+         f"trainB 1 2:3 env:FLEET_GATE_CKPT={ckpt} "
+         f"env:FLEET_GATE_STEPS=40 env:FLEET_GATE_STEP_SECONDS=0.25 "
+         f"-- {train_cmd}",
+         "--job",
+         "quickA 2 1 after=6 -- "
+         f"{sys.executable} -c \"print('QUICK_OK', flush=True)\""])
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out
+    # Admission story: trainB grabs the whole pool, quickA's starvation
+    # preempts it, and the resume is a 3 -> 2 elastic shrink.
+    assert "admit job trainB np=3" in res.stderr, out
+    assert "preempting job trainB" in res.stderr, out
+    assert "starved" in res.stderr, out
+    assert "job trainB preempted (rc 75)" in res.stderr, out
+    assert "admit job quickA np=1" in res.stderr, out
+    assert "admit job trainB np=2" in res.stderr, out
+    assert "prev_np=3 (resume)" in res.stderr, out
+    # Preemption is not the host's fault: nothing may be blacklisted.
+    assert "blacklisting host" not in res.stderr, out
+    # Workload story: quickA ran; trainB resumed from a saved step > 0
+    # at the smaller world and still converged.
+    assert "QUICK_OK" in res.stdout, out
+    assert "FLEET_RESUME job=trainB" in res.stdout, out
+    assert "prev=3" in res.stdout, out
+    assert "FLEET_OK job=trainB" in res.stdout, out
+    # Telemetry story: the summary counts the preemption and the waits.
+    doc = json.loads(metrics.read_text())
+    assert doc["schema"] == "horovod_tpu.fleet.summary.v1", doc
+    assert doc["jobs"]["trainB"]["state"] == "done", doc["jobs"]
+    assert doc["jobs"]["trainB"]["preemptions"] == 1, doc["jobs"]
+    assert doc["jobs"]["quickA"]["state"] == "done", doc["jobs"]
+    from horovod_tpu.telemetry import aggregate
+    snap = doc["controller"]["metrics"]
+    assert aggregate.counter_total(
+        snap, "hvd_fleet_preemptions_total") == 1, snap
+    assert aggregate.counter_total(
+        snap, "hvd_fleet_admissions_total") == 3, snap
+    assert "hvd_fleet_queue_wait_seconds" in json.dumps(snap), snap
+
+
+def test_chaos_fleet_preempt_storm_resumes(tmp_path):
+    """The fleet chaos kind end to end: HOROVOD_FAULT_SPEC arms a
+    single preempt_storm against the controller's scheduler loop
+    (site=fleet), which must hit the only running job ~5s into its
+    episode and drive the same save/requeue/resume cycle — the rank-side
+    injection points must NOT fire the fleet-only kind even though every
+    rank inherits the spec from the controller's environment."""
+    ckpt = tmp_path / "ckpt"
+    workload = os.path.join(REPO, "tests", "distributed", "fleet_np2.py")
+    res = _hvdfleet(
+        ["-H", "localhost:2",
+         "--tick-interval", "0.25", "--verbose",
+         "--job",
+         f"solo 1 2 env:FLEET_GATE_CKPT={ckpt} "
+         f"env:FLEET_GATE_STEPS=24 env:FLEET_GATE_STEP_SECONDS=0.25 "
+         f"-- {sys.executable} {workload}"],
+        env={"HOROVOD_FAULT_SPEC":
+                 "site=fleet,after=20,kind=preempt_storm:1"})
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out
+    assert "firing kind=preempt_storm" in res.stderr, out
+    assert "preempting job solo" in res.stderr, out
+    assert "chaos preempt_storm" in res.stderr, out
+    assert "job solo preempted (rc 75)" in res.stderr, out
+    assert "FLEET_RESUME job=solo" in res.stdout, out
+    assert "FLEET_OK job=solo" in res.stdout, out
+    assert "blacklisting host" not in res.stderr, out

@@ -147,3 +147,38 @@ def test_exit_kind_terminates_process():
 def test_crash_kind_sigkills_process():
     res = _run_inject("site=rpc,kind=crash")
     assert res.returncode == -9, res.stderr
+
+
+# -- fleet kinds -------------------------------------------------------------
+
+def test_parse_fleet_kinds_defaults_and_shorthand():
+    (storm,) = faults.parse_spec("site=fleet,kind=preempt_storm")
+    assert storm.kind == "preempt_storm" and storm.count == 1
+    (storm3,) = faults.parse_spec("site=fleet,kind=preempt_storm:3")
+    assert storm3.count == 3           # :N is shorthand for count=N
+    (flap,) = faults.parse_spec("site=fleet,kind=host_flap")
+    assert flap.count == 2             # one out+in blacklist cycle
+    with pytest.raises(faults.FaultSpecError, match=">= 1 tick"):
+        faults.parse_spec("site=fleet,kind=host_flap:0")
+
+
+def test_fleet_chaos_hook_fires_per_tick(monkeypatch):
+    monkeypatch.setenv(
+        faults.ENV_VAR,
+        "site=fleet,after=1,kind=preempt_storm:2;site=fleet,kind=host_flap")
+    faults.reset()
+    # tick 1: storm not armed yet (after=1), flap fires its 1st of 2
+    assert faults.fleet_chaos() == ["host_flap"]
+    # tick 2: both fire
+    assert sorted(faults.fleet_chaos()) == ["host_flap", "preempt_storm"]
+    # tick 3: storm's 2nd firing; flap exhausted
+    assert faults.fleet_chaos() == ["preempt_storm"]
+    assert faults.fleet_chaos() == []
+
+
+def test_fleet_kinds_never_fire_at_inject(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "kind=preempt_storm;kind=host_flap")
+    faults.reset()
+    faults.inject("allreduce")       # must not raise / fire
+    faults.inject("fleet")
+    assert faults.fleet_chaos() != []   # the dedicated hook still works

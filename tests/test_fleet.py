@@ -1,0 +1,435 @@
+"""Unit tests for the fleet controller (horovod_tpu/runner/fleet.py):
+job-spec grammar, gang admission and priority order, starvation-driven
+preemption through the rc-75 path, requeue-without-blacklist, failure
+blame through the shared blacklist, elastic grow, chaos hooks, and
+per-job isolation (secrets / spill dirs / metrics-port bases).
+
+No processes are spawned: a stub job runner stands in for launch_job,
+driven tick-by-tick with an injectable clock.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from horovod_tpu import faults, telemetry
+from horovod_tpu.resilience import PREEMPTION_RC
+from horovod_tpu.runner import fleet, hosts
+from horovod_tpu.runner.fleet import (
+    DONE, FAILED, QUEUED, RUNNING, FleetController, JobSpec,
+    parse_job_spec,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class StubRunner:
+    """Replaces launch_job: jobs 'run' until the test finishes them or
+    the controller preempts/stops them (honouring JobControl, like real
+    ranks with the preemption handler installed)."""
+
+    def __init__(self):
+        self.launches = []          # (name, np) per admission, in order
+        self.envs = {}              # name -> list of env_per_rank lists
+        self.active = {}            # name -> record of the live episode
+        self._lock = threading.Lock()
+
+    def __call__(self, job, infos, env_per_rank, control, report,
+                 watchdog):
+        rec = {"finish": threading.Event(), "rc": 0, "report": {}}
+        with self._lock:
+            self.launches.append((job.name, len(infos)))
+            self.envs.setdefault(job.name, []).append(env_per_rank)
+            self.active[job.name] = rec
+        while True:
+            if control.preempt_requested.is_set():
+                report.update({"failed": [], "signalled": False,
+                               "preempted": [(i.rank, i.hostname,
+                                              PREEMPTION_RC)
+                                             for i in infos]})
+                return PREEMPTION_RC
+            if control.stop_requested.is_set():
+                report.update({"failed": [], "preempted": [],
+                               "signalled": True})
+                return 130
+            if rec["finish"].is_set():
+                report.update(rec["report"])
+                return rec["rc"]
+            time.sleep(0.002)
+
+    def finish(self, name, rc=0, **report):
+        rec = self.active[name]
+        rec["rc"] = rc
+        rec["report"] = dict(
+            {"failed": [], "preempted": [], "signalled": False}, **report)
+        rec["finish"].set()
+
+
+def wait_for(cond, timeout=5.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        if time.monotonic() > deadline:
+            raise AssertionError(f"timed out waiting for {msg}")
+        time.sleep(0.005)
+
+
+def make_fleet(tmp_path, pool, specs, **kw):
+    clock = kw.pop("clock", FakeClock())
+    runner = kw.pop("runner", StubRunner())
+    ctl = FleetController(
+        pool, specs, fleet_dir=str(tmp_path / "fleet"), clock=clock,
+        sleep=lambda s: None, job_runner=runner, **kw)
+    return ctl, clock, runner
+
+
+def job(ctl, name):
+    return next(j for j in ctl.jobs if j.name == name)
+
+
+def settle(ctl, runner, name):
+    """Wait for the named job's episode thread to deliver its result,
+    then reap it with a tick."""
+    wait_for(lambda: job(ctl, name).result is not None
+             or job(ctl, name).thread is None, msg=f"{name} result")
+    ctl.tick()
+
+
+# -- spec grammar ------------------------------------------------------------
+
+def test_parse_job_spec_full():
+    s = parse_job_spec(
+        "trainB 1 2:3 after=1.5 restarts=0 env:FOO=bar -- "
+        "python train.py --lr 0.1")
+    assert (s.name, s.priority, s.min_np, s.max_np) == ("trainB", 1, 2, 3)
+    assert s.after == 1.5 and s.restarts == 0
+    assert s.env == {"FOO": "bar"}
+    assert s.command == ["python", "train.py", "--lr", "0.1"]
+
+
+def test_parse_job_spec_min_only_and_quoting():
+    s = parse_job_spec("a 0 1 -- python -c 'print(\"hi there\")'")
+    assert s.min_np == s.max_np == 1
+    assert s.command == ["python", "-c", 'print("hi there")']
+
+
+@pytest.mark.parametrize("line,match", [
+    ("a 1 2 python x.py", "no ' -- '"),
+    ("a 1 -- python x.py", "needs at least"),
+    ("a one 2 -- x", "not an int"),
+    ("a 1 3:2 -- x", "min_np <= max_np"),
+    ("a 1 0 -- x", "min_np <= max_np"),
+    ("a 1 2 color=red -- x", "unknown metadata key"),
+    ("a 1 2 -- ", "empty command"),
+])
+def test_parse_job_spec_errors(line, match):
+    with pytest.raises(ValueError, match=match):
+        parse_job_spec(line)
+
+
+def test_duplicate_job_names_rejected(tmp_path):
+    specs = [JobSpec("a", 1, 1, 1, ["x"]), JobSpec("a", 2, 1, 1, ["y"])]
+    with pytest.raises(ValueError, match="duplicate job names"):
+        make_fleet(tmp_path, hosts.parse_hosts("localhost:2"), specs)
+
+
+# -- admission ---------------------------------------------------------------
+
+def test_gang_admission_waits_for_min_np(tmp_path):
+    pool = hosts.parse_hosts("localhost:3")
+    specs = [JobSpec("a", 2, 2, 3, ["x"]), JobSpec("b", 1, 2, 2, ["y"])]
+    ctl, clock, runner = make_fleet(tmp_path, pool, specs)
+    ctl.tick()
+    # a (higher priority) takes max_np=3; b's gang of 2 is not free.
+    assert runner.launches == [("a", 3)]
+    assert job(ctl, "b").state == QUEUED
+    ctl.tick()
+    assert runner.launches == [("a", 3)]   # still queued, not crashed
+    runner.finish("a", rc=0)
+    settle(ctl, runner, "a")
+    assert job(ctl, "a").state == DONE
+    assert ("b", 2) in runner.launches     # full gang freed -> admitted
+
+
+def test_no_backfill_past_starved_head(tmp_path):
+    pool = hosts.parse_hosts("localhost:2")
+    specs = [JobSpec("big", 2, 2, 2, ["x"]),
+             JobSpec("small", 1, 1, 1, ["y"]),
+             JobSpec("first", 0, 1, 1, ["z"])]
+    ctl, clock, runner = make_fleet(tmp_path, pool, specs)
+    ctl.tick()
+    # big admitted np=2; nothing else fits.
+    assert runner.launches == [("big", 2)]
+    runner.finish("big", rc=0)
+    settle(ctl, runner, "big")
+    # After big: small (pri 1) outranks first (pri 0); both fit.
+    assert runner.launches[1:] == [("small", 1), ("first", 1)]
+
+
+def test_unsatisfiable_min_np_fails_not_crashes(tmp_path):
+    pool = hosts.parse_hosts("localhost:2")
+    specs = [JobSpec("huge", 1, 5, 5, ["x"]), JobSpec("ok", 0, 2, 2, ["y"])]
+    ctl, clock, runner = make_fleet(tmp_path, pool, specs)
+    assert job(ctl, "huge").state == FAILED   # can never fit: fail fast
+    ctl.tick()
+    assert runner.launches == [("ok", 2)]
+    runner.finish("ok")
+    settle(ctl, runner, "ok")
+    assert not ctl.alive()
+    assert job(ctl, "ok").state == DONE
+
+
+# -- preemption --------------------------------------------------------------
+
+def test_starvation_preempts_lowest_priority(tmp_path):
+    telemetry.configure(enabled_flag=True)
+    try:
+        pool = hosts.parse_hosts("localhost:3")
+        specs = [JobSpec("low", 1, 2, 3, ["x"]),
+                 JobSpec("mid", 2, 1, 1, ["m"], after=1.0),
+                 JobSpec("high", 3, 2, 2, ["h"], after=1.0)]
+        ctl, clock, runner = make_fleet(
+            tmp_path, pool, specs, starvation_deadline=5.0)
+        ctl.tick()
+        assert runner.launches == [("low", 3)]
+        clock.advance(2.0)      # mid+high now eligible, but 0 slots free
+        ctl.tick()
+        assert job(ctl, "high").state == QUEUED
+        assert not job(ctl, "low").control.preempt_requested.is_set()
+        clock.advance(5.0)      # head (high) starved past the deadline
+        ctl.tick()
+        # low is the only victim with priority < high's.
+        assert job(ctl, "low").control.preempt_requested.is_set()
+        settle(ctl, runner, "low")
+        lo = job(ctl, "low")
+        assert lo.state == QUEUED and lo.preempted and lo.prev_np == 3
+        assert lo.rc == PREEMPTION_RC
+        # NOTHING was blacklisted: preemption is not the host's fault.
+        assert ctl.blacklist.filter(pool) == pool
+        ctl.tick()
+        # high (pri 3) admitted first with its gang of 2, then mid (1).
+        assert ("high", 2) in runner.launches
+        assert ("mid", 1) in runner.launches
+        # low waits queued: 0 free until a winner finishes.
+        assert job(ctl, "low").state == QUEUED
+        runner.finish("high")
+        settle(ctl, runner, "high")
+        ctl.tick()
+        # low resumes elastically the moment its min_np gang frees —
+        # mid still holds a slot, so the world shrank from 3 to 2.
+        assert runner.launches[-1] == ("low", 2)
+        runner.finish("mid")
+        settle(ctl, runner, "mid")
+        snap = telemetry.metrics_snapshot()
+        from horovod_tpu.telemetry import aggregate
+        assert aggregate.counter_total(
+            snap, "hvd_fleet_preemptions_total") >= 1
+    finally:
+        telemetry.configure(enabled_flag=False)
+
+
+def test_resume_env_carries_prev_size_and_attempt(tmp_path):
+    pool = hosts.parse_hosts("localhost:3")
+    specs = [JobSpec("low", 1, 1, 3, ["x"]),
+             JobSpec("hi", 2, 2, 2, ["h"], after=1.0)]
+    ctl, clock, runner = make_fleet(
+        tmp_path, pool, specs, starvation_deadline=1.0)
+    ctl.tick()
+    assert runner.launches == [("low", 3)]
+    env0 = runner.envs["low"][0][0]
+    assert env0["HOROVOD_RESTART_ATTEMPT"] == "0"
+    assert "HOROVOD_ELASTIC_PREV_SIZE" not in env0
+    clock.advance(3.0)
+    ctl.tick()                  # hi starved -> preempt low
+    settle(ctl, runner, "low")
+    ctl.tick()                  # hi admitted np=2; low re-admitted np=1
+    wait_for(lambda: len(runner.envs.get("low", [])) == 2,
+             msg="low resumed")
+    env1 = runner.envs["low"][1][0]
+    assert env1["HOROVOD_RESTART_ATTEMPT"] == "1"
+    assert env1["HOROVOD_ELASTIC_PREV_SIZE"] == "3"
+    assert env1["HOROVOD_SIZE"] == "1"
+    # Spill dir is stable across the preemption (warm restart contract).
+    assert env1["HOROVOD_SPILL_DIR"] == env0["HOROVOD_SPILL_DIR"]
+    # Secret and rendezvous port stay job-private but fresh per episode.
+    assert env1["HOROVOD_SECRET_KEY"] == env0["HOROVOD_SECRET_KEY"]
+    assert env1["HOROVOD_RENDEZVOUS_PORT"] != \
+        env0["HOROVOD_RENDEZVOUS_PORT"]
+
+
+def test_equal_priority_never_preempts(tmp_path):
+    pool = hosts.parse_hosts("localhost:2")
+    specs = [JobSpec("a", 1, 2, 2, ["x"]),
+             JobSpec("b", 1, 2, 2, ["y"], after=0.5)]
+    ctl, clock, runner = make_fleet(
+        tmp_path, pool, specs, starvation_deadline=1.0)
+    ctl.tick()
+    clock.advance(10.0)
+    ctl.tick()
+    # b starves but a has EQUAL priority: no victim, a keeps running.
+    assert job(ctl, "a").state == RUNNING
+    assert not job(ctl, "a").control.preempt_requested.is_set()
+    assert job(ctl, "b").state == QUEUED
+
+
+# -- failure handling --------------------------------------------------------
+
+def test_failure_blames_host_via_shared_blacklist(tmp_path):
+    pool = hosts.parse_hosts("hostA:2,hostB:2")
+    specs = [JobSpec("a", 1, 2, 4, ["x"], restarts=1)]
+    ctl, clock, runner = make_fleet(tmp_path, pool, specs)
+    ctl.tick()
+    assert runner.launches == [("a", 4)]
+    runner.finish("a", rc=1, failed=[(2, "hostB", 1)])
+    settle(ctl, runner, "a")
+    assert ctl.blacklist.is_blacklisted("hostB")
+    a = job(ctl, "a")
+    assert not a.preempted
+    # Relaunched (same reap tick) shrunk onto the surviving host only.
+    wait_for(lambda: len(runner.envs["a"]) == 2, msg="relaunch")
+    assert runner.launches[-1] == ("a", 2)
+    assert {i.hostname for i in a.infos} == {"hostA"}
+    runner.finish("a", rc=1, failed=[])
+    settle(ctl, runner, "a")
+    assert a.state == FAILED    # budget (restarts=1) exhausted
+    assert a.rc == 1
+
+
+def test_blame_keeps_floor_for_smallest_live_job(tmp_path):
+    pool = hosts.parse_hosts("hostA:2")
+    specs = [JobSpec("a", 1, 2, 2, ["x"], restarts=1)]
+    ctl, clock, runner = make_fleet(tmp_path, pool, specs)
+    ctl.tick()
+    runner.finish("a", rc=1, failed=[(0, "hostA", 1)])
+    settle(ctl, runner, "a")
+    # Demoting the only host would leave 0 < min_np=2: soft demotion
+    # declines, the job relaunches in place.
+    assert not ctl.blacklist.is_blacklisted("hostA")
+    ctl.tick()
+    assert runner.launches[-1] == ("a", 2)
+
+
+# -- elastic grow ------------------------------------------------------------
+
+def test_spare_capacity_grows_running_job(tmp_path):
+    pool = hosts.parse_hosts("localhost:3")
+    specs = [JobSpec("big", 2, 2, 2, ["x"]),
+             JobSpec("grower", 1, 1, 3, ["y"])]
+    ctl, clock, runner = make_fleet(tmp_path, pool, specs, grow_after=5.0)
+    ctl.tick()
+    assert runner.launches == [("big", 2), ("grower", 1)]
+    runner.finish("big")
+    settle(ctl, runner, "big")
+    ctl.tick()
+    g = job(ctl, "grower")
+    assert g.state == RUNNING   # stabilization window: no thrash yet
+    clock.advance(6.0)
+    ctl.tick()                  # grow: controlled preempt + requeue
+    assert g.control.preempt_requested.is_set()
+    settle(ctl, runner, "grower")
+    assert g.preemptions == 0   # a resize is not a preemption
+    ctl.tick()
+    wait_for(lambda: len(runner.envs["grower"]) == 2, msg="regrow")
+    assert runner.launches[-1] == ("grower", 3)
+    assert runner.envs["grower"][1][0]["HOROVOD_ELASTIC_PREV_SIZE"] == "1"
+    runner.finish("grower")
+    settle(ctl, runner, "grower")
+    assert not ctl.alive()
+
+
+# -- chaos hooks -------------------------------------------------------------
+
+def test_chaos_preempt_storm_hits_lowest_priority(tmp_path, monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "site=fleet,kind=preempt_storm:1")
+    faults.reset()
+    pool = hosts.parse_hosts("localhost:3")
+    specs = [JobSpec("hi", 2, 1, 1, ["x"]), JobSpec("lo", 1, 1, 1, ["y"])]
+    ctl, clock, runner = make_fleet(tmp_path, pool, specs)
+    ctl.tick()      # admits both; chaos fired on this tick already or
+    ctl.tick()      # on this one (rule arms on first fleet_chaos call)
+    assert job(ctl, "lo").control.preempt_requested.is_set()
+    assert not job(ctl, "hi").control.preempt_requested.is_set()
+    settle(ctl, runner, "lo")
+    lo = job(ctl, "lo")
+    assert lo.preemptions == 1 and lo.rc == PREEMPTION_RC
+    # The free slot means the reap tick already resumed it (attempt 1).
+    assert lo.attempt == 2 and lo.state == RUNNING
+
+
+def test_chaos_host_flap_bounces_last_host(tmp_path, monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "site=fleet,kind=host_flap")
+    faults.reset()
+    pool = hosts.parse_hosts("hostA:1,hostB:1")
+    specs = [JobSpec("a", 1, 2, 2, ["x"], restarts=0)]
+    ctl, clock, runner = make_fleet(tmp_path, pool, specs)
+    ctl.tick()
+    assert runner.launches == [("a", 2)]
+    ctl.tick()      # flap #1: hostB demoted, job (on hostB) preempted
+    assert ctl.blacklist.is_blacklisted("hostB")
+    assert job(ctl, "a").control.preempt_requested.is_set()
+    settle(ctl, runner, "a")    # reap tick also fires flap #2 (forgive)
+    assert not ctl.blacklist.is_blacklisted("hostB")
+    a = job(ctl, "a")
+    assert a.state != FAILED    # the flap never burned a restart/blame
+    wait_for(lambda: len(runner.envs["a"]) >= 2, msg="re-admit")
+    assert runner.launches[-1] == ("a", 2)  # full gang, hostB included
+    assert {i.hostname for i in a.infos} == {"hostA", "hostB"}
+
+
+# -- per-job isolation -------------------------------------------------------
+
+def test_per_job_isolation(tmp_path):
+    pool = hosts.parse_hosts("localhost:4")
+    specs = [JobSpec("one", 1, 2, 2, ["x"]), JobSpec("two", 1, 2, 2, ["y"])]
+    ctl, clock, runner = make_fleet(
+        tmp_path, pool, specs, metrics_port_base=18000, port_stride=64,
+        metrics_file=str(tmp_path / "fleet.json"))
+    ctl.tick()
+    e1, e2 = runner.envs["one"][0], runner.envs["two"][0]
+    # Distinct secrets, spill dirs, rendezvous ports, metrics bases.
+    assert e1[0]["HOROVOD_SECRET_KEY"] != e2[0]["HOROVOD_SECRET_KEY"]
+    assert e1[0]["HOROVOD_SPILL_DIR"] != e2[0]["HOROVOD_SPILL_DIR"]
+    assert e1[0]["HOROVOD_RENDEZVOUS_PORT"] != \
+        e2[0]["HOROVOD_RENDEZVOUS_PORT"]
+    assert e1[0]["HOROVOD_METRICS_PORT"] == "18000"
+    assert e2[0]["HOROVOD_METRICS_PORT"] == "18064"
+    # Per-rank metrics files are per job AND per rank.
+    paths = {env["HOROVOD_METRICS_FILE"]
+             for env in e1 + e2}
+    assert len(paths) == 4
+    assert all(os.path.isdir(env["HOROVOD_SPILL_DIR"])
+               for env in e1 + e2)
+    assert e1[0]["HOROVOD_FLEET_JOB"] == "one"
+    for name in ("one", "two"):
+        runner.finish(name)
+        settle(ctl, runner, name)
+
+
+def test_stop_tears_down_all_jobs(tmp_path):
+    pool = hosts.parse_hosts("localhost:2")
+    specs = [JobSpec("a", 1, 1, 1, ["x"]), JobSpec("b", 1, 1, 1, ["y"])]
+    ctl, clock, runner = make_fleet(tmp_path, pool, specs)
+    ctl.tick()
+    ctl.stop()
+    wait_for(lambda: job(ctl, "a").result is not None and
+             job(ctl, "b").result is not None, msg="teardown")
+    assert ctl.run() == 130     # drains reaps, then reports operator stop
+    assert {j.state for j in ctl.jobs} == {"stopped"}

@@ -429,3 +429,90 @@ def test_run_command_min_np_validation():
     from horovod_tpu.runner import run as run_mod
     with pytest.raises(ValueError, match="min-np"):
         run_mod.run_command(_ns(np=2, min_np=4))
+
+
+def test_allocate_uneven_slots():
+    # Host-major packing across wildly uneven hosts.
+    pool = hosts.parse_hosts("big:5,tiny:1,mid:2")
+    infos = hosts.allocate(pool, 7)
+    assert [i.hostname for i in infos] == (
+        ["big"] * 5 + ["tiny"] + ["mid"])
+    assert [i.local_rank for i in infos] == [0, 1, 2, 3, 4, 0, 0]
+    assert infos[0].cross_size == 3
+    # np below the first host's capacity: a single-host gang.
+    infos = hosts.allocate(pool, 3)
+    assert {i.hostname for i in infos} == {"big"}
+    assert infos[0].cross_size == 1
+
+
+def test_allocate_after_partial_demotion():
+    # Demoting one host mid-fleet shrinks the gang but keeps packing
+    # host-major over the survivors (the fleet relaunch path).
+    pool = hosts.parse_hosts("h1:2,h2:2,h3:2")
+    bl = hosts.HostBlacklist()
+    bl.demote("h2", "rank 2 exited with code 1")
+    usable = bl.filter(pool)
+    assert [h.hostname for h in usable] == ["h1", "h3"]
+    infos = hosts.allocate(usable, 4)
+    assert [i.hostname for i in infos] == ["h1", "h1", "h3", "h3"]
+    # min_np beyond the shrunken capacity raises — the caller (fleet
+    # controller) queues the job rather than crashing.
+    with pytest.raises(ValueError, match="slots"):
+        hosts.allocate(usable, 5)
+
+
+def test_free_slots_subtracts_per_host_usage():
+    pool = hosts.parse_hosts("h1:2,h2:2,h3:1")
+    free = hosts.free_slots(pool, {"h1": 2, "h3": 1})
+    assert [(h.hostname, h.slots) for h in free] == [("h2", 2)]
+    # Partial usage keeps the host, with the remainder, in pool order.
+    free = hosts.free_slots(pool, {"h1": 1})
+    assert [(h.hostname, h.slots) for h in free] == [
+        ("h1", 1), ("h2", 2), ("h3", 1)]
+    # No usage: the pool comes back unchanged (fresh objects are fine).
+    free = hosts.free_slots(pool, {})
+    assert [(h.hostname, h.slots) for h in free] == [
+        ("h1", 2), ("h2", 2), ("h3", 1)]
+
+
+def test_keepalive_monitor_forget_all_is_atomic():
+    # forget_all must clear beats, steps and dead/hung dedup state in
+    # one critical section: the fleet controller calls it between a
+    # job's episodes while that job's old ranks may still be beating.
+    import threading
+
+    from horovod_tpu.runner.rpc import KeepaliveMonitor
+
+    t = [0.0]
+    mon = KeepaliveMonitor(timeout=0.5, clock=lambda: t[0],
+                           hang_deadline=10.0)
+    stop = threading.Event()
+    errors = []
+
+    def beat_loop():
+        i = 0
+        while not stop.is_set():
+            try:
+                mon.progress(i % 4, step=i)
+                i += 1
+            except Exception as e:  # pragma: no cover - fail loudly
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=beat_loop) for _ in range(3)]
+    for th in threads:
+        th.start()
+    try:
+        for _ in range(200):
+            mon.forget_all()
+    finally:
+        stop.set()
+        for th in threads:
+            th.join(timeout=5)
+    assert not errors
+    # After the final forget, stale ranks are gone: even far in the
+    # future nothing is reported dead or hung.
+    mon.forget_all()
+    t[0] = 1000.0
+    assert mon.dead_tasks() == []
+    assert mon.hung_tasks() == []
