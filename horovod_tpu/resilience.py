@@ -970,7 +970,7 @@ class HeartbeatSender:
                 continue
             step, ts = progress()
             try:
-                rpc.rpc_call(
+                resp = rpc.rpc_call(
                     self.addr, self.port,
                     {"kind": "heartbeat", "rank": self.rank,
                      "step": step, "progress_ts": ts},
@@ -980,6 +980,19 @@ class HeartbeatSender:
                     telemetry.counter(
                         "hvd_heartbeat_sent_total",
                         "heartbeats delivered to the launcher").inc()
+                if isinstance(resp, dict) and resp.get("preempt") and \
+                        not _preempt_event.is_set():
+                    # The launcher can't SIGTERM a remote rank (only its
+                    # ssh client) — the preemption arrives here instead,
+                    # and the next guarded step runs the same deferred
+                    # coordinated-save path as the signal handler.
+                    log.warning("launcher requested preemption via the "
+                                "health plane")
+                    if telemetry.enabled():
+                        telemetry.counter(
+                            "hvd_preempt_requests_total",
+                            "preemption signals received").inc()
+                    request_preemption()
             except Exception as e:  # noqa: BLE001 — never stall training
                 if telemetry.enabled():
                     telemetry.counter(

@@ -435,11 +435,18 @@ class FleetController:
                 self._preempt(victim, "chaos preempt_storm")
             elif kind == "host_flap":
                 host = self.pool[-1].hostname
-                if self.blacklist.is_blacklisted(host):
+                if host in self._flapped:
                     self.blacklist.forgive(host)
                     self._flapped.discard(host)
                     self._log(f"chaos host_flap: host {host} back in "
                               f"the pool")
+                elif self.blacklist.is_blacklisted(host):
+                    # Demoted for genuine rank failures, not by a prior
+                    # flap — forgiving it here would resurrect a
+                    # legitimately bad host mid-episode.
+                    self._log(f"chaos host_flap: host {host} is "
+                              f"blacklisted for real failures; leaving "
+                              f"it demoted")
                 else:
                     self.blacklist.demote(host, "chaos host_flap")
                     self._flapped.add(host)
@@ -471,6 +478,11 @@ class FleetController:
         self._log(f"preempting job {job.name} (priority {job.priority}, "
                   f"np={job.np}): {reason}")
         job.control.preempt()
+        if job.health is not None:
+            # SIGTERM only reaches local process groups (for a remote
+            # rank, its ssh client) — the health plane carries the
+            # preemption to every heartbeating rank end-to-end.
+            job.health.request_preempt()
 
     def _check_starvation(self) -> None:
         queue = self._queued()
@@ -484,6 +496,15 @@ class FleetController:
         waited = now - max(head.queued_at, head.eligible_at)
         if waited <= self.starvation_deadline:
             return
+        # Slots held by jobs already saving for preemption free at reap
+        # time; counting them as pending frees keeps the deficit from
+        # being recomputed from scratch every tick while a victim spends
+        # several ticks in its coordinated save — which would preempt
+        # extra victims beyond what the head job needs.
+        pending = sum(j.np for j in self.jobs if j.state == PREEMPTING)
+        deficit = head.spec.min_np - free - pending
+        if deficit <= 0:
+            return
         victims = [j for j in self._running()
                    if j.priority < head.priority]
         if not victims:
@@ -495,7 +516,6 @@ class FleetController:
         # Lowest priority first; among equals the most recently started
         # (least sunk work) goes first.
         victims.sort(key=lambda j: (j.priority, -j.started_at))
-        deficit = head.spec.min_np - free
         freed = 0
         for victim in victims:
             if freed >= deficit:
@@ -545,7 +565,16 @@ class FleetController:
         job.np = np_
         job.infos = infos
         job.started_at = now
-        job.control = launch.JobControl()
+        remote_preempt = None
+        if self.heartbeat_interval:
+            # Resolved at call time: job.health is created in
+            # _build_env, after the control.  Lets JobControl.preempt
+            # spare remote ranks' ssh clients and deliver the preemption
+            # over heartbeat responses instead.
+            def remote_preempt(j=job):
+                if j.health is not None:
+                    j.health.request_preempt()
+        job.control = launch.JobControl(remote_preempt=remote_preempt)
         host_summary = ",".join(
             f"{h}:{n}" for h, n in _host_counts(infos).items())
         self._log(f"admit job {job.name} np={np_} priority="
@@ -653,6 +682,14 @@ class FleetController:
     def _maybe_grow(self) -> None:
         if self._queued():
             return  # queued work has first claim on free slots
+        if any(j.state == PREEMPTING for j in self.jobs):
+            # A job mid-resize (or mid-preemption) is neither queued nor
+            # running, so the queue looks empty and the slot it was
+            # grown toward still looks free — growing another candidate
+            # now would double-book that slot and force a needless
+            # preemption once both re-admit.  One resize in flight at a
+            # time, across ticks as well as within one.
+            return
         free = sum(h.slots for h in self._free_hosts())
         if free <= 0:
             return
@@ -727,6 +764,15 @@ class FleetController:
             if job.state in (RUNNING, PREEMPTING) and \
                     job.control is not None:
                 job.control.stop()
+            elif job.state == QUEUED:
+                # A queued job has no process to tear down, but it still
+                # counts as live — with scheduling disabled under
+                # _stopping nothing would ever move it to a terminal
+                # state and run() would drain forever (e.g. an
+                # oversubscribed fleet, or a preempted job waiting to
+                # resume).
+                job.state = STOPPED
+                job.rc = 130
         self._log("stop requested; tearing down running jobs")
 
     def run(self) -> int:

@@ -175,13 +175,29 @@ class JobControl:
       own SIGINT/SIGTERM handler: tear everything down, report rc 130,
       blame nothing.
 
+    Signal delivery is inherently LOCAL: for a remote rank the spawned
+    process is its ssh client, so ``killpg`` would tear the transport
+    down under the remote process mid-save instead of preempting it —
+    the rank may linger on its host holding TPU devices and ports while
+    the controller reuses its slots.  When ``remote_preempt`` is given
+    (the fleet wires it to the per-job heartbeat health plane's
+    ``request_preempt``), :meth:`preempt` leaves remote ranks' ssh
+    clients alive and invokes the hook instead: the preemption rides the
+    authenticated RPC plane end-to-end, the remote rank saves and exits
+    rc 75, and ssh propagates that exit status back to the supervisor.
+    Without the hook (no ``--heartbeat-interval``), remote ranks only
+    get their transport torn down — coordinated-save preemption is then
+    guaranteed for local ranks only.
+
     Both verbs are safe to call before the ranks have spawned (the
     request is latched and applied at attach time) and are idempotent.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, remote_preempt: Optional[Callable[[], None]]
+                 = None) -> None:
         self._lock = threading.Lock()
         self._procs: Optional[List[RankProcess]] = None
+        self.remote_preempt = remote_preempt
         self.preempt_requested = threading.Event()
         self.stop_requested = threading.Event()
 
@@ -198,15 +214,25 @@ class JobControl:
         self.preempt_requested.set()
         with self._lock:
             procs = list(self._procs or ())
+        any_remote = False
         for p in procs:
             # NOT p.terminate(): that would mark the exit as launcher
             # teardown and hide the rc-75 / -SIGTERM preemption outcome.
             if p.proc is None or p.proc.poll() is not None:
                 continue
+            if self.remote_preempt is not None and \
+                    not is_local(p.info.hostname):
+                # SIGTERM here would only hit the local ssh client —
+                # the health plane delivers the preemption to the rank
+                # itself; ssh relays its rc-75 exit back.
+                any_remote = True
+                continue
             try:
                 os.killpg(p.proc.pid, signal.SIGTERM)
             except (ProcessLookupError, PermissionError):
                 pass
+        if any_remote:
+            self.remote_preempt()
 
     def stop(self) -> None:
         self.stop_requested.set()
@@ -323,8 +349,19 @@ def launch_job(rank_infos: List[RankInfo], command: List[str],
                             f"hvdrun: rank {procs[i].info.rank} exited "
                             f"with code {rc}; terminating remaining "
                             f"ranks.\n")
-                    for j in sorted(running):
-                        procs[j].terminate()
+                    if control is not None and \
+                            control.preempt_requested.is_set():
+                        # Controller-requested preemption: every rank
+                        # already has the request (SIGTERM locally, the
+                        # health plane remotely), so re-signalling here
+                        # would mark peers launcher-terminated (hiding
+                        # their rc-75 outcome) and kill remote ranks'
+                        # ssh clients mid-coordinated-save.  The grace /
+                        # hard-kill phase below still bounds laggards.
+                        pass
+                    else:
+                        for j in sorted(running):
+                            procs[j].terminate()
                     stop.set()
                 break
             time.sleep(0.05)

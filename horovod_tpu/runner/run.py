@@ -414,6 +414,7 @@ class _HealthPlane:
         self.monitor = rpc.KeepaliveMonitor(timeout=self.deadline,
                                             hang_deadline=self.hang_deadline)
         self._killed: set = set()
+        self._preempt = False
         self._last_gauge = 0.0
         self._server = rpc.RpcServer(rpc.job_key_bytes(secret),
                                      self._handle)
@@ -425,8 +426,17 @@ class _HealthPlane:
                                       int(req.get("step", -1)))
             except (TypeError, ValueError):
                 return {"ok": False}
-            return {"ok": True}
+            return {"ok": True, "preempt": self._preempt}
         return {"ok": False}
+
+    def request_preempt(self) -> None:
+        """Ask every heartbeating rank to preempt (coordinated save +
+        rc 75): subsequent heartbeat responses carry ``preempt: True``
+        and the rank-side :class:`~horovod_tpu.resilience.HeartbeatSender`
+        raises the deferred preemption flag.  This is the delivery path
+        that reaches REMOTE ranks — the launcher's SIGTERM can only hit
+        local process groups (for a remote rank, its ssh client)."""
+        self._preempt = True
 
     @property
     def port(self) -> int:
@@ -439,6 +449,7 @@ class _HealthPlane:
         del ranks  # the atomic clear covers old and new worlds alike
         self.monitor.forget_all()
         self._killed.clear()
+        self._preempt = False   # the new attempt starts unpreempted
 
     def watchdog(self) -> list:
         """``(rank, reason)`` pairs newly declared dead or hung since the

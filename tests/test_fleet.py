@@ -18,8 +18,8 @@ from horovod_tpu import faults, telemetry
 from horovod_tpu.resilience import PREEMPTION_RC
 from horovod_tpu.runner import fleet, hosts
 from horovod_tpu.runner.fleet import (
-    DONE, FAILED, QUEUED, RUNNING, FleetController, JobSpec,
-    parse_job_spec,
+    DONE, FAILED, PREEMPTING, QUEUED, RUNNING, STOPPED, FleetController,
+    JobSpec, parse_job_spec,
 )
 
 
@@ -82,6 +82,41 @@ class StubRunner:
         rec["report"] = dict(
             {"failed": [], "preempted": [], "signalled": False}, **report)
         rec["finish"].set()
+
+
+class HoldPreemptRunner(StubRunner):
+    """StubRunner whose jobs keep 'saving' after a preemption request
+    until the test calls :meth:`allow_preempt` — modelling the real
+    multi-tick coordinated-save window during which the victim stays in
+    PREEMPTING and its slots are still accounted as used."""
+
+    def __call__(self, job, infos, env_per_rank, control, report,
+                 watchdog):
+        rec = {"finish": threading.Event(), "rc": 0, "report": {},
+               "allow": threading.Event()}
+        with self._lock:
+            self.launches.append((job.name, len(infos)))
+            self.envs.setdefault(job.name, []).append(env_per_rank)
+            self.active[job.name] = rec
+        while True:
+            if control.preempt_requested.is_set() and \
+                    rec["allow"].is_set():
+                report.update({"failed": [], "signalled": False,
+                               "preempted": [(i.rank, i.hostname,
+                                              PREEMPTION_RC)
+                                             for i in infos]})
+                return PREEMPTION_RC
+            if control.stop_requested.is_set():
+                report.update({"failed": [], "preempted": [],
+                               "signalled": True})
+                return 130
+            if rec["finish"].is_set():
+                report.update(rec["report"])
+                return rec["rc"]
+            time.sleep(0.002)
+
+    def allow_preempt(self, name):
+        self.active[name]["allow"].set()
 
 
 def wait_for(cond, timeout=5.0, msg="condition"):
@@ -290,6 +325,39 @@ def test_equal_priority_never_preempts(tmp_path):
     assert job(ctl, "b").state == QUEUED
 
 
+def test_starvation_counts_inflight_saves_toward_deficit(tmp_path):
+    pool = hosts.parse_hosts("localhost:4")
+    specs = [JobSpec("lo1", 1, 2, 2, ["x"]),
+             JobSpec("lo2", 1, 2, 2, ["y"]),
+             JobSpec("hi", 3, 2, 2, ["h"], after=1.0)]
+    ctl, clock, runner = make_fleet(
+        tmp_path, pool, specs, starvation_deadline=2.0,
+        runner=HoldPreemptRunner())
+    ctl.tick()
+    assert runner.launches == [("lo1", 2), ("lo2", 2)]
+    clock.advance(4.0)
+    ctl.tick()      # hi starved: ONE victim's 2 slots cover min_np=2
+    preempted = [j.name for j in ctl.jobs if j.control is not None and
+                 j.control.preempt_requested.is_set()]
+    assert len(preempted) == 1
+    victim = preempted[0]
+    other = "lo2" if victim == "lo1" else "lo1"
+    # The victim's coordinated save spans several ticks; its slots are
+    # still in use but count as pending frees — the deficit must not be
+    # recomputed from scratch and claim a second victim.
+    ctl.tick()
+    ctl.tick()
+    ctl.tick()
+    assert job(ctl, victim).state == PREEMPTING
+    assert not job(ctl, other).control.preempt_requested.is_set()
+    runner.allow_preempt(victim)
+    settle(ctl, runner, victim)
+    assert ("hi", 2) in runner.launches
+    assert job(ctl, other).state == RUNNING
+    ctl.stop()
+    wait_for(lambda: not ctl.tick(), msg="fleet drain")
+
+
 # -- failure handling --------------------------------------------------------
 
 def test_failure_blames_host_via_shared_blacklist(tmp_path):
@@ -355,6 +423,40 @@ def test_spare_capacity_grows_running_job(tmp_path):
     assert not ctl.alive()
 
 
+def test_grow_waits_for_inflight_resize(tmp_path):
+    pool = hosts.parse_hosts("localhost:4")
+    specs = [JobSpec("c1", 9, 1, 1, ["x"]),
+             JobSpec("c2", 8, 1, 1, ["y"]),
+             JobSpec("a", 2, 1, 9, ["a"]),
+             JobSpec("b", 1, 1, 9, ["b"])]
+    ctl, clock, runner = make_fleet(
+        tmp_path, pool, specs, grow_after=1.0,
+        runner=HoldPreemptRunner())
+    ctl.tick()
+    assert runner.launches == [("c1", 1), ("c2", 1), ("a", 2)]
+    runner.finish("c1")
+    settle(ctl, runner, "c1")       # reap tick admits b onto c1's slot
+    assert ("b", 1) in runner.launches
+    clock.advance(1.5)              # a and b both pass the grow window
+    runner.finish("c2")
+    settle(ctl, runner, "c2")       # 1 slot frees: grow a (higher pri)
+    a, b = job(ctl, "a"), job(ctl, "b")
+    assert a.state == PREEMPTING and a.resizing
+    # While a's resize is in flight the free slot is spoken for: b is
+    # neither queued nor blocked, but grow-preempting it for the SAME
+    # slot would be a needless preemption.
+    ctl.tick()
+    ctl.tick()
+    assert not b.control.preempt_requested.is_set()
+    assert b.state == RUNNING
+    runner.allow_preempt("a")
+    settle(ctl, runner, "a")        # reap + re-admit a with the slot
+    wait_for(lambda: len(runner.envs["a"]) == 2, msg="a regrown")
+    assert runner.launches[-1] == ("a", 3)
+    ctl.stop()
+    wait_for(lambda: not ctl.tick(), msg="fleet drain")
+
+
 # -- chaos hooks -------------------------------------------------------------
 
 def test_chaos_preempt_storm_hits_lowest_priority(tmp_path, monkeypatch):
@@ -392,6 +494,28 @@ def test_chaos_host_flap_bounces_last_host(tmp_path, monkeypatch):
     wait_for(lambda: len(runner.envs["a"]) >= 2, msg="re-admit")
     assert runner.launches[-1] == ("a", 2)  # full gang, hostB included
     assert {i.hostname for i in a.infos} == {"hostA", "hostB"}
+
+
+def test_host_flap_spares_genuinely_blamed_host(tmp_path, monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "site=fleet,kind=host_flap:1")
+    faults.reset()
+    pool = hosts.parse_hosts("hostA:2,hostB:2")
+    specs = [JobSpec("a", 1, 2, 2, ["x"], restarts=3)]
+    ctl, clock, runner = make_fleet(tmp_path, pool, specs)
+    ctl.tick()
+    assert runner.launches == [("a", 2)]
+    # A genuine rank failure demotes hostB (NOT the flap's doing).
+    runner.finish("a", rc=1, failed=[(1, "hostB", 1)])
+    settle(ctl, runner, "a")
+    assert ctl.blacklist.is_blacklisted("hostB")
+    wait_for(lambda: job(ctl, "a").state == RUNNING, msg="relaunch")
+    ctl.tick()      # flap fires: pool[-1] (hostB) is blacklisted, but
+    ctl.tick()      # by blame — the flap must NOT resurrect it.
+    assert ctl.blacklist.is_blacklisted("hostB")
+    assert not ctl._flapped
+    assert job(ctl, "a").state == RUNNING   # and nothing was preempted
+    runner.finish("a")
+    settle(ctl, runner, "a")
 
 
 # -- per-job isolation -------------------------------------------------------
@@ -433,3 +557,22 @@ def test_stop_tears_down_all_jobs(tmp_path):
              job(ctl, "b").result is not None, msg="teardown")
     assert ctl.run() == 130     # drains reaps, then reports operator stop
     assert {j.state for j in ctl.jobs} == {"stopped"}
+
+
+def test_stop_with_queued_jobs_terminates(tmp_path):
+    # Oversubscribed fleet: "wait" can never start while "run" holds the
+    # only slot.  Operator stop must still drain — a QUEUED job counts
+    # as live, so leaving it queued would hang run() forever.
+    pool = hosts.parse_hosts("localhost:1")
+    specs = [JobSpec("run", 2, 1, 1, ["x"]),
+             JobSpec("wait", 1, 1, 1, ["y"])]
+    ctl, clock, runner = make_fleet(tmp_path, pool, specs)
+    ctl.tick()
+    assert job(ctl, "run").state == RUNNING
+    assert job(ctl, "wait").state == QUEUED
+    ctl.stop()
+    assert job(ctl, "wait").state == STOPPED
+    assert job(ctl, "wait").rc == 130
+    wait_for(lambda: not ctl.tick(), msg="fleet drain")
+    assert ctl.run() == 130
+    assert {j.state for j in ctl.jobs} == {STOPPED}

@@ -251,6 +251,47 @@ def test_launch_job_report_and_terminate_grace(tmp_path, monkeypatch, capfd):
         in err
 
 
+def test_jobcontrol_remote_preempt_uses_health_plane():
+    """JobControl.preempt SIGTERMs local ranks, but a remote rank's
+    local process is only its ssh client — with a remote_preempt hook
+    (the fleet wires the heartbeat health plane) the client is spared
+    and the hook delivers the preemption; without one it falls back to
+    signalling the client (the documented local-only limitation)."""
+    import signal as _signal
+    import subprocess
+    import sys as _sys
+    from horovod_tpu.runner import launch
+
+    def sleeper():
+        return subprocess.Popen(
+            [_sys.executable, "-c", "import time; time.sleep(60)"],
+            start_new_session=True)
+
+    local = launch.RankProcess(_rank_infos(1)[0], [], {}, None, False)
+    remote = launch.RankProcess(
+        _rank_infos(1, hostname="far.example")[0], [], {}, None, False)
+    local.proc = sleeper()
+    remote.proc = sleeper()     # stands in for the ssh client
+    delivered = []
+    ctl = launch.JobControl(remote_preempt=lambda: delivered.append(True))
+    ctl._attach([local, remote])
+    try:
+        ctl.preempt()
+        assert delivered == [True]
+        local.proc.wait(timeout=10)
+        assert local.proc.returncode == -_signal.SIGTERM
+        assert remote.proc.poll() is None   # ssh client left alive
+        ctl2 = launch.JobControl()          # no hook: legacy fallback
+        ctl2._attach([remote])
+        ctl2.preempt()
+        remote.proc.wait(timeout=10)
+        assert remote.proc.returncode == -_signal.SIGTERM
+    finally:
+        for p in (local.proc, remote.proc):
+            if p.poll() is None:
+                p.kill()
+
+
 def test_terminate_grace_env_parsing(monkeypatch, capsys):
     from horovod_tpu.runner import launch
     monkeypatch.setenv("HOROVOD_TERMINATE_GRACE_SECONDS", "2.5")

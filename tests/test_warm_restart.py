@@ -285,6 +285,54 @@ def test_start_heartbeat_without_env_is_noop():
     assert resilience.start_heartbeat(rank=0) is None
 
 
+def test_heartbeat_response_preempt_flag_raises_preemption(monkeypatch):
+    """The launcher's SIGTERM only reaches local process groups; for
+    remote ranks the preemption rides back on heartbeat responses and
+    must raise the same deferred flag as the signal handler."""
+    def handler(req):
+        del req
+        return {"ok": True, "preempt": True}
+
+    key = rpc.job_key_bytes("s3cret")
+    server = rpc.RpcServer(key, handler)
+    try:
+        monkeypatch.setenv("HOROVOD_HEALTH_RPC",
+                           f"127.0.0.1:{server.port}")
+        monkeypatch.setenv("HOROVOD_HEARTBEAT_INTERVAL", "0.05")
+        monkeypatch.setenv("HOROVOD_SECRET_KEY", "s3cret")
+        assert not resilience.preemption_requested()
+        resilience.start_heartbeat(rank=1)
+        deadline = time.monotonic() + 5.0
+        while not resilience.preemption_requested() and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        resilience.stop_heartbeat()
+        assert resilience.preemption_requested()
+    finally:
+        server.shutdown()
+
+
+def test_health_plane_request_preempt_roundtrip():
+    """_HealthPlane flips heartbeat responses to preempt=True after
+    request_preempt() and clears the flag on the next attempt."""
+    from horovod_tpu.runner.run import _HealthPlane
+    hp = _HealthPlane("s3cret", 0.1, 1.0, 0.0)
+    key = rpc.job_key_bytes("s3cret")
+    beat = {"kind": "heartbeat", "rank": 0, "step": 1,
+            "progress_ts": 1.0}
+    try:
+        resp = rpc.rpc_call("127.0.0.1", hp.port, dict(beat), key)
+        assert resp == {"ok": True, "preempt": False}
+        hp.request_preempt()
+        resp = rpc.rpc_call("127.0.0.1", hp.port, dict(beat), key)
+        assert resp == {"ok": True, "preempt": True}
+        hp.begin_attempt([0])   # fresh attempt starts unpreempted
+        resp = rpc.rpc_call("127.0.0.1", hp.port, dict(beat), key)
+        assert resp == {"ok": True, "preempt": False}
+    finally:
+        hp.shutdown()
+
+
 # -- chaos plane kinds -------------------------------------------------------
 
 def test_faults_parse_heartbeat_drop_and_spill_corrupt(monkeypatch):
