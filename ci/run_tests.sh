@@ -80,6 +80,19 @@ JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
 python tools/check_metrics.py "$ZERO_METRICS_DIR/metrics.json" 2
 rm -rf "$ZERO_METRICS_DIR"
 
+echo "--- gradient-compression gate (2 ranks x 8-device virtual mesh):
+--- int8 error-feedback LM microstep over the ZeRO wire — loss parity
+--- vs the uncompressed codec within 1% at equal steps, merged
+--- telemetry shows hvd_compression_bytes_out < bytes_in and the int8
+--- hvd_collective_bytes_total plane below none (docs/performance.md)"
+COMPRESSION_METRICS_DIR="$(mktemp -d)"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  HOROVOD_METRICS_FILE="$COMPRESSION_METRICS_DIR/metrics.json" \
+  python -m horovod_tpu.runner -np 2 \
+  python tests/distributed/compression_workload_np2.py
+python tools/check_metrics.py "$COMPRESSION_METRICS_DIR/metrics.json" 2
+rm -rf "$COMPRESSION_METRICS_DIR"
+
 echo "--- self-healing gate (2 ranks x 8-device virtual mesh): guarded
 --- step + coordinated NaN rollback + divergence-sentinel heal + async
 --- checkpoint, merged telemetry shows hvd_guard_* / hvd_rollback_* /
@@ -174,6 +187,11 @@ rm -rf "$FLEET_DIR"
 echo "--- step-guard overhead (BENCH json; target < 2% on real chips —
 --- on the CPU smoke this only proves the lane runs end to end)"
 JAX_PLATFORMS=cpu python -m horovod_tpu.benchmark --step-guard
+
+echo "--- compression wire ratio (BENCH json; int8 target >= 3x logical
+--- bytes with < 1% loss delta — trace-time counters, so the CPU smoke
+--- proves the real ratio, not just that the lane runs)"
+JAX_PLATFORMS=cpu python -m horovod_tpu.benchmark --compression int8
 
 echo "--- TSAN build + smoke (races inside libhorovod_tpu.so fail CI)"
 make -C horovod_tpu/native/cc tsan

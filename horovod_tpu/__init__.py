@@ -84,7 +84,7 @@ from horovod_tpu.ops.collective import (
     add_process_set,
     global_process_set,
 )
-from horovod_tpu.ops.compression import Compression
+from horovod_tpu.ops.compression import Compression, resolve_codec
 from horovod_tpu import checkpoint  # noqa: F401  (hvd.checkpoint.save/restore)
 from horovod_tpu import telemetry  # noqa: F401  (hvd.telemetry.counter/...)
 from horovod_tpu.telemetry import metrics_snapshot
@@ -127,7 +127,7 @@ __all__ = [
     # observability
     "telemetry", "metrics_snapshot",
     # training
-    "Compression", "checkpoint",
+    "Compression", "resolve_codec", "checkpoint",
     "DistributedOptimizer", "DistributedGradientTape", "make_training_step",
     "sharded_optimizer", "reshard_state",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_variables",

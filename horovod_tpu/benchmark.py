@@ -449,6 +449,7 @@ def run_lm_benchmark(d_model: int = 2048, n_layers: int = 8,
                      num_batches_per_iter: int = 8, num_iters: int = 5,
                      learning_rate: float = 1e-4, mesh=None,
                      shard_optimizer: bool = False,
+                     compression: Optional[str] = None,
                      verbose: bool = True) -> dict:
     """Transformer-LM synthetic training benchmark (single chip by
     default) — the compute-bound counterpart to the ResNet harness:
@@ -465,7 +466,10 @@ def run_lm_benchmark(d_model: int = 2048, n_layers: int = 8,
     (:mod:`horovod_tpu.parallel.zero`; defaults the mesh to ALL devices —
     sharding the update on one chip buys nothing) and reports per-device
     live-memory bytes next to MFU, since memory headroom is half the
-    point of sharding the optimizer state."""
+    point of sharding the optimizer state.  ``compression`` selects a
+    gradient wire codec (``"none"``, ``"bf16"``, ``"fp16"``, ``"int8"``,
+    ``"powersgd[:rank]"``) riding that wire — see
+    :mod:`horovod_tpu.ops.compression`."""
     from horovod_tpu.models import transformer as tfm
 
     if mesh is None:
@@ -493,7 +497,7 @@ def run_lm_benchmark(d_model: int = 2048, n_layers: int = 8,
     step, specs, opt_specs = tfm.make_train_step(
         cfg, optimizer, mesh, data_axis="data", attention=attention,
         remat=remat, steps_per_call=steps_per_call,
-        shard_optimizer=shard_optimizer)
+        shard_optimizer=shard_optimizer, compression=compression)
 
     params = tfm.init_params(jax.random.PRNGKey(0), cfg)
     params = jax.device_put(params, jax.tree_util.tree_map(
@@ -524,11 +528,12 @@ def run_lm_benchmark(d_model: int = 2048, n_layers: int = 8,
         pass
 
     if verbose:
+        comp_s = f" compression={compression}" if compression else ""
         print(f"LM: d_model={d_model} n_layers={n_layers} d_ff="
               f"{cfg.d_ff} vocab={vocab_size} T={seq_len} "
               f"batch={global_bs} attention={attention} remat={remat} "
-              f"shard_optimizer={shard_optimizer} chips={n_chips}",
-              flush=True)
+              f"shard_optimizer={shard_optimizer}{comp_s} "
+              f"chips={n_chips}", flush=True)
         print(f"Analytic {flops_per_step / 1e12:.2f} TFLOP/step "
               f"({flops_per_step / (global_bs * seq_len) / 1e6:.1f} "
               f"MFLOP/token)", flush=True)
@@ -573,7 +578,8 @@ def run_lm_benchmark(d_model: int = 2048, n_layers: int = 8,
         "n_heads": n_heads, "vocab_size": vocab_size,
         "seq_len": seq_len, "batch_size": global_bs,
         "attention": attention, "remat": remat,
-        "shard_optimizer": shard_optimizer, "n_chips": n_chips,
+        "shard_optimizer": shard_optimizer,
+        "compression": compression, "n_chips": n_chips,
         "tok_sec_per_chip": tok_sec_mean / n_chips,
         "tok_sec_conf": float(1.96 * np.std(tok_secs)) / n_chips,
         "flops_per_step_analytic": flops_per_step,
@@ -784,6 +790,94 @@ def run_step_guard_benchmark(model_name: str = "resnet50",
     return result
 
 
+def run_compression_benchmark(codec: str = "int8", verbose: bool = True,
+                              **lm_kwargs) -> dict:
+    """Gradient-compression A/B on the LM ZeRO lane (docs/performance.md):
+    run :func:`run_lm_benchmark` twice from identical seeds — once with
+    the uncompressed wire (``compression="none"``) and once with
+    ``codec`` — and report the loss delta at equal steps next to the
+    logical wire-byte ratio from ``hvd_collective_bytes_total``
+    (reduce-scatter + all-gather planes, diffed per run so repeated
+    invocations don't pollute each other).
+
+    The bytes counters are recorded at trace time, so the ratio is the
+    codec's logical transport saving, independent of host speed; the
+    loss delta is the error-feedback quality gate (target < 1%).
+
+    Prints one BENCH JSON line
+    (``{"metric": "compression_wire_ratio", ...}``) and returns the same
+    dict."""
+    import json
+
+    from horovod_tpu import telemetry
+    from horovod_tpu.ops import compression as compression_mod
+    from horovod_tpu.telemetry import aggregate
+
+    name = compression_mod.resolve_codec(codec).name
+    if name == "none":
+        raise ValueError(
+            "--compression needs a real codec (bf16, fp16, int8, "
+            "powersgd[:rank]); the lane already compares against 'none'")
+    # The codec rides the ZeRO reduce-scatter wire; force the sharded
+    # lane regardless of what the caller passed.
+    lm_kwargs["shard_optimizer"] = True
+    was_enabled = telemetry.enabled()
+    telemetry.configure(enabled_flag=True)
+
+    def _wire_bytes(before, after, codec_name):
+        return sum(
+            aggregate.counter_total(after, "hvd_collective_bytes_total",
+                                    {"kind": kind, "codec": codec_name})
+            - aggregate.counter_total(before, "hvd_collective_bytes_total",
+                                      {"kind": kind, "codec": codec_name})
+            for kind in ("reduce_scatter", "all_gather"))
+
+    try:
+        snap0 = telemetry.metrics_snapshot()
+        base = run_lm_benchmark(compression="none", verbose=verbose,
+                                **lm_kwargs)
+        snap1 = telemetry.metrics_snapshot()
+        comp = run_lm_benchmark(compression=codec, verbose=verbose,
+                                **lm_kwargs)
+        snap2 = telemetry.metrics_snapshot()
+    finally:
+        telemetry.configure(enabled_flag=was_enabled)
+
+    bytes_none = _wire_bytes(snap0, snap1, "none")
+    bytes_codec = _wire_bytes(snap1, snap2, name)
+    ratio = (bytes_none / bytes_codec) if bytes_codec else float("inf")
+    loss_delta_pct = (abs(comp["loss"] - base["loss"])
+                      / max(abs(base["loss"]), 1e-12) * 100.0)
+    # Acceptance floors (docs/performance.md): int8 packs 4 fp32 bytes
+    # into ~1 wire byte (minus per-bucket qparams), casts halve them.
+    target = {"int8": 3.0, "bf16": 1.9, "fp16": 1.9}.get(name)
+    result = {
+        "metric": "compression_wire_ratio",
+        "codec": name,
+        "value": round(ratio, 3),
+        "target_ratio": target,
+        "wire_bytes_none": int(bytes_none),
+        "wire_bytes_codec": int(bytes_codec),
+        "loss_none": round(base["loss"], 6),
+        "loss_codec": round(comp["loss"], 6),
+        "loss_delta_pct": round(loss_delta_pct, 4),
+        "loss_target_pct": 1.0,
+        "n_chips": base["n_chips"],
+        "d_model": base["d_model"],
+        "n_layers": base["n_layers"],
+        "tok_sec_per_chip_none": round(base["tok_sec_per_chip"], 1),
+        "tok_sec_per_chip_codec": round(comp["tok_sec_per_chip"], 1),
+    }
+    if verbose:
+        tgt = f" (target >= {target}x)" if target else ""
+        print(f"Compression {name}: wire bytes {int(bytes_none):,} -> "
+              f"{int(bytes_codec):,} ({ratio:.2f}x{tgt}); loss "
+              f"{base['loss']:.5f} -> {comp['loss']:.5f} "
+              f"({loss_delta_pct:.3f}% delta, target < 1%)", flush=True)
+    print("BENCH " + json.dumps(result), flush=True)
+    return result
+
+
 def _main():
     import argparse
     parser = argparse.ArgumentParser(
@@ -814,6 +908,12 @@ def _main():
                         help="LM lane with the ZeRO-1 sharded update over "
                              "all devices (reports MFU + per-device "
                              "live-memory bytes)")
+    parser.add_argument("--compression", default=None, metavar="CODEC",
+                        help="A/B the LM ZeRO lane with gradient codec "
+                             "CODEC (bf16, fp16, int8, powersgd[:rank]) "
+                             "against the uncompressed wire; prints a "
+                             "BENCH JSON row with the wire-byte ratio "
+                             "and loss delta")
     parser.add_argument("--d-model", type=int, default=None)
     parser.add_argument("--n-layers", type=int, default=None)
     parser.add_argument("--seq-len", type=int, default=None)
@@ -824,7 +924,7 @@ def _main():
                   num_warmup_batches=args.num_warmup_batches,
                   num_batches_per_iter=args.num_batches_per_iter,
                   num_iters=args.num_iters)
-    if args.lm or args.shard_optimizer:
+    if args.lm or args.shard_optimizer or args.compression:
         lm_kwargs = dict(num_warmup_batches=args.num_warmup_batches,
                          num_batches_per_iter=args.num_batches_per_iter,
                          num_iters=args.num_iters,
@@ -849,7 +949,11 @@ def _main():
         # its own default of 8/chip unless the flag was set explicitly.
         bs = lm_kwargs.pop("batch_size",
                            args.batch_size if args.batch_size != 64 else 8)
-        run_lm_benchmark(batch_size=bs, **lm_kwargs)
+        if args.compression:
+            run_compression_benchmark(args.compression, batch_size=bs,
+                                      **lm_kwargs)
+        else:
+            run_lm_benchmark(batch_size=bs, **lm_kwargs)
     elif args.step_guard:
         sg_kwargs = dict(kwargs, stem=args.stem)
         model, bs = args.model, args.batch_size

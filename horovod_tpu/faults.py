@@ -56,7 +56,12 @@ Keys:
              ``host_flap[:N]`` (fleet controller: bounce a pool host in
              and out of the shared blacklist on N consecutive matching
              ticks — default 2, i.e. one out+in cycle — the flaky-NIC
-             simulation driving elastic shrink and re-grow).
+             simulation driving elastic shrink and re-grow),
+             ``residual_drop[:N]`` (zero a rank's gradient-compression
+             error-feedback residual state before N steps — default 1 —
+             the lost-residual simulation: convergence must degrade
+             gracefully, never corrupt; fires at :func:`drop_residual`,
+             site ``compression``).
 ``count``    maximum number of firings (default: unlimited for
              ``delay``/``error``/``nan``/``corrupt``/
              ``heartbeat_drop``/``spill_corrupt`` — chaos tests that
@@ -69,8 +74,9 @@ eager collectives call on each op's result, because poisoning must
 happen after the real collective ran.  Likewise the plane kinds
 (``heartbeat_drop``/``spill_corrupt``) fire only at their dedicated
 hooks — :func:`drop_heartbeat` in the heartbeat sender (site
-``heartbeat``) and :func:`mangle_spill` in the spill writer (site
-``spill``) — never at :func:`inject`; and the fleet kinds
+``heartbeat``), :func:`mangle_spill` in the spill writer (site
+``spill``) and :func:`drop_residual` in the compressed training step
+(site ``compression``) — never at :func:`inject`; and the fleet kinds
 (``preempt_storm``/``host_flap``) fire only at :func:`fleet_chaos`,
 which the fleet controller polls once per scheduler tick (site
 ``fleet``).
@@ -97,16 +103,17 @@ import numpy as np
 ENV_VAR = "HOROVOD_FAULT_SPEC"
 
 _KINDS = ("crash", "exit", "hang", "delay", "error", "nan", "corrupt",
-          "heartbeat_drop", "spill_corrupt", "preempt_storm", "host_flap")
+          "heartbeat_drop", "spill_corrupt", "preempt_storm", "host_flap",
+          "residual_drop")
 
 # Kinds that mutate an op's *output value* instead of disrupting control
 # flow; they fire at corrupt_output(), never at inject().
 VALUE_KINDS = ("nan", "corrupt")
 
 # Kinds owned by the health/recovery planes; they fire at their dedicated
-# hooks (drop_heartbeat / mangle_spill), never at inject() or
-# corrupt_output().
-PLANE_KINDS = ("heartbeat_drop", "spill_corrupt")
+# hooks (drop_heartbeat / mangle_spill / drop_residual), never at
+# inject() or corrupt_output().
+PLANE_KINDS = ("heartbeat_drop", "spill_corrupt", "residual_drop")
 
 # Kinds owned by the fleet controller's scheduler loop; they fire at
 # fleet_chaos(), never at inject()/corrupt_output().
@@ -115,7 +122,7 @@ FLEET_KINDS = ("preempt_storm", "host_flap")
 SITES = (
     "allreduce", "allgather", "broadcast", "alltoall", "reducescatter",
     "barrier", "native_submit", "native_wait", "rpc", "spawn",
-    "heartbeat", "spill", "fleet",
+    "heartbeat", "spill", "fleet", "compression",
 )
 
 
@@ -314,6 +321,12 @@ def parse_spec(spec: str) -> List[FaultRule]:
                             raise FaultSpecError(
                                 f"kind spill_corrupt:{arg} must keep "
                                 f">= 0 bytes")
+                    elif kind == "residual_drop":
+                        arg = int(kind_arg) if kind_arg else None
+                        if arg is not None and arg < 1:
+                            raise FaultSpecError(
+                                f"kind residual_drop:{arg} must drop "
+                                f">= 1 residual")
                     elif kind in FLEET_KINDS:
                         arg = int(kind_arg) if kind_arg else None
                         if arg is not None and arg < 1:
@@ -339,9 +352,13 @@ def parse_spec(spec: str) -> List[FaultRule]:
                 f"fault rule {chunk!r} has no kind= (one of "
                 f"{', '.join(_KINDS)})")
         # heartbeat_drop:N is shorthand for count=N (N intervals); same
-        # shorthand for the fleet kinds (N scheduler ticks).
+        # shorthand for the fleet kinds (N scheduler ticks) and
+        # residual_drop (N steps — default one lost residual, so the
+        # episode settles and recovery is observable).
         if kind == "heartbeat_drop" and count is None and arg is not None:
             count = arg
+        if kind == "residual_drop" and count is None:
+            count = arg if arg is not None else 1
         if kind in FLEET_KINDS and count is None:
             # Unlike the wire kinds these act on a whole job/host per
             # firing, so "unlimited" would never let the episode settle:
@@ -461,6 +478,32 @@ def drop_heartbeat(rank: Optional[int] = None) -> bool:
         if rule.arm("heartbeat", ctx_rank):
             rule._announce("heartbeat", None, ctx_rank,
                            note=" (heartbeat suppressed)")
+            dropped = True
+    return dropped
+
+
+def drop_residual(rank: Optional[int] = None) -> bool:
+    """Compressed-training-step hook: True when an armed
+    ``residual_drop`` rule says this rank's error-feedback residual
+    state must be zeroed before the step (the lost-residual simulation —
+    e.g. a restore that predates the residuals, or a rank rebuilt from a
+    peer).  The caller owns the zeroing
+    (:func:`horovod_tpu.ops.compression.zero_residuals`); this hook only
+    arms and logs.  Same zero-overhead contract as :func:`inject` when
+    no spec is set."""
+    plan = _plan
+    if plan is _UNSET:
+        plan = load()
+    if plan is None:
+        return False
+    ctx_rank = _context_rank(rank)
+    dropped = False
+    for rule in plan:
+        if rule.kind != "residual_drop":
+            continue
+        if rule.arm("compression", ctx_rank):
+            rule._announce("compression", None, ctx_rank,
+                           note=" (residual state zeroed)")
             dropped = True
     return dropped
 

@@ -295,7 +295,8 @@ def make_train_step(cfg: TransformerConfig, optimizer, mesh,
                     packed: bool = False,
                     remat: str = "none",
                     steps_per_call: int = 1,
-                    shard_optimizer: bool = False):
+                    shard_optimizer: bool = False,
+                    compression=None):
     """Jitted SPMD training step over dp x tp x sp.
 
     Returns ``step(params, opt_state, tokens, labels) ->
@@ -319,11 +320,20 @@ def make_train_step(cfg: TransformerConfig, optimizer, mesh,
     so ``model_axis``/``seq_axis`` must be ``None``).  The returned step
     additionally carries ``step.init`` (build the sharded-layout state
     from params) and ``step.optimizer`` (the ``ShardedOptimizer``).
+
+    ``compression`` selects the gradient wire codec (name string, codec
+    instance, or ``None`` → ``HOROVOD_COMPRESSION``; see
+    :func:`horovod_tpu.ops.compression.resolve_codec`).  It rides the
+    ZeRO reduce-scatter/all-gather wire, so a non-``none`` codec
+    requires ``shard_optimizer=True``.
     """
     from horovod_tpu.ops.fusion import fused_pytree_mean
 
     specs = param_specs(cfg, model_axis)
     grad_axes = tuple(a for a in (data_axis, seq_axis) if a)
+
+    from horovod_tpu.ops import compression as compression_mod
+    codec = compression_mod.resolve_codec(compression)
 
     zopt = None
     if shard_optimizer:
@@ -334,7 +344,13 @@ def make_train_step(cfg: TransformerConfig, optimizer, mesh,
                 f"model_axis={model_axis!r}, seq_axis={seq_axis!r}")
         from horovod_tpu.parallel import zero
         zopt = zero.sharded_optimizer(
-            optimizer, data_axis, axis_size=int(mesh.shape[data_axis]))
+            optimizer, data_axis, axis_size=int(mesh.shape[data_axis]),
+            compression=codec)
+    elif not isinstance(codec, compression_mod.NoneCodec):
+        raise NotImplementedError(
+            f"compression={codec.name!r} rides the ZeRO reduce-scatter "
+            f"wire; pass shard_optimizer=True (the plain path's fused "
+            f"pmean has no per-bucket wire to compress)")
 
     def _one_step(params, opt_state, tokens, labels, segment_ids=None):
         from horovod_tpu import resilience

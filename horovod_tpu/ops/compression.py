@@ -1,16 +1,89 @@
 """Gradient compression (reference ``horovod/tensorflow/compression.py:20-75``
-and the torch/mxnet twins): an algorithm that casts tensors before the wire
-and restores them after.
+and the torch/mxnet twins): wire codecs that shrink what a collective moves.
 
-TPU-native note: on TPU the natural wire dtype is **bfloat16** (MXU-native,
-same exponent range as fp32 — no loss-scale gymnastics), so ``Compression.bf16``
-is provided alongside the reference's ``fp16``.
+Two layers live here:
+
+1. The **legacy per-tensor API** (:class:`Compressor` / :class:`Compression`)
+   — API parity with the reference: ``compress(tensor) -> (tensor, ctx)``
+   before the wire, ``decompress(tensor, ctx)`` after.  Cast-only, stateless;
+   used by the eager plane and the replicated allreduce path.
+
+2. The **bucket codec layer** (:class:`BucketCodec` and friends) — the
+   TPU-native subsystem: codecs that operate on the flat fusion buckets of a
+   :class:`horovod_tpu.ops.fusion.ReduceScatterPlan`, compressing BOTH phases
+   of the sharded-update wire format (reduce-scatter of gradients,
+   all-gather of updates).  Quantizing codecs carry **error-feedback
+   residuals** (Seide et al. 2014 1-bit SGD; Karimireddy et al. 2019 EF-SGD)
+   as rank-local state — the quantization error of step *t* is added back
+   into the transmission of step *t+1*, so the *cumulative* applied update
+   converges to the uncompressed trajectory even though each individual
+   step is lossy.  The low-rank codec follows PowerSGD (Vogels et al. 2019):
+   rank-R factor power iteration with a warm-started right factor.
+
+Available codecs (``HOROVOD_COMPRESSION=none|bf16|fp16|int8|powersgd[:rank]``
+or the ``compression=`` kwargs):
+
+========== =========== ======= ====================================
+codec      wire bytes  state   mechanism
+========== =========== ======= ====================================
+none       1x          --      pass-through (bit-exact)
+bf16       1/2x        --      bfloat16 cast (TPU-idiomatic)
+fp16       1/2x        --      float16 cast, clamped to +-65504
+int8       ~1/4x       EF      per-bucket affine uint8 quantization
+powersgd   ~R(m+n)/mn  EF + Q  rank-R power iteration (2-D leaves)
+========== =========== ======= ====================================
+
+TPU-native note: on TPU the natural cast dtype is **bfloat16** (MXU-native,
+same exponent range as fp32 — no loss-scale gymnastics), so
+``Compression.bf16`` is provided alongside the reference's ``fp16``.
+
+Design invariants:
+
+* **User dtypes stay untouched** — codecs cast/quantize on the wire and
+  decode back to the bucket dtype; parameters, gradients and optimizer
+  state keep their dtypes.
+* **Checkpoints stay untouched** — residual state is rank-local and
+  layout-dependent bookkeeping, deliberately EXCLUDED from the portable
+  checkpoint layout (:func:`horovod_tpu.parallel.zero.gather_full_state`);
+  a restore starts with zero residuals, which only delays error feedback
+  by one step.  Elastic world-size changes instead go through
+  :meth:`BucketCodec.reshard_state`, which preserves the pending error.
+* **Replicated consistency** — every rank decodes the *identical*
+  transmitted bytes (the all-to-all exchange / gathered shards), so
+  decoded means and gathered updates are bit-identical across ranks and
+  parameters never drift.
 """
 
 from __future__ import annotations
 
-import jax.numpy as jnp
+import dataclasses
+import os
+import re
+import time
+from typing import ClassVar, List, Optional, Tuple
 
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu import telemetry
+from horovod_tpu.ops import fusion
+from horovod_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+# Largest finite float16 value: fp32 -> fp16 casts of anything bigger give
+# inf, which a single rank then spreads through the whole allreduce.
+FP16_MAX = 65504.0
+
+HOROVOD_COMPRESSION_VAR = "HOROVOD_COMPRESSION"
+
+_warned_bad_env = False
+
+
+# ---------------------------------------------------------------------------
+# Legacy per-tensor API (reference compression.py:20-75).
+# ---------------------------------------------------------------------------
 
 class Compressor:
     """Interface (reference compression.py:20-33)."""
@@ -40,10 +113,14 @@ class _CastCompressor(Compressor):
     wire_dtype = None
 
     @classmethod
+    def _clip(cls, tensor):
+        return tensor
+
+    @classmethod
     def compress(cls, tensor):
         dtype = tensor.dtype
         if jnp.issubdtype(dtype, jnp.floating) and dtype != cls.wire_dtype:
-            return tensor.astype(cls.wire_dtype), dtype
+            return cls._clip(tensor).astype(cls.wire_dtype), dtype
         return tensor, None
 
     @classmethod
@@ -52,12 +129,23 @@ class _CastCompressor(Compressor):
 
 
 class FP16Compressor(_CastCompressor):
-    """Cast fp32/fp64 → fp16 on the wire (reference compression.py:46-63)."""
+    """Cast fp32/fp64 → fp16 on the wire (reference compression.py:46-63).
+
+    Values outside fp16's finite range are CLAMPED to ±65504 before the
+    cast: an unclamped cast maps them to inf, and one rank's inf poisons
+    every rank's reduced tensor.  The clamp loses magnitude information a
+    float16 wire could never carry anyway."""
     wire_dtype = jnp.float16
+
+    @classmethod
+    def _clip(cls, tensor):
+        lim = jnp.asarray(FP16_MAX, tensor.dtype)
+        return jnp.clip(tensor, -lim, lim)
 
 
 class BF16Compressor(_CastCompressor):
-    """TPU-idiomatic: bfloat16 on the wire."""
+    """TPU-idiomatic: bfloat16 on the wire (same exponent range as fp32,
+    so no clamp is needed)."""
     wire_dtype = jnp.bfloat16
 
 
@@ -66,3 +154,631 @@ class Compression:
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+
+
+# ---------------------------------------------------------------------------
+# Error-feedback state: one pytree per codec instance x plan.
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_pytree_node_class
+class CodecState:
+    """Rank-local wire-codec state for one :class:`ReduceScatterPlan`.
+
+    Per bucket ``b`` (``None`` where the codec keeps nothing):
+
+    * ``rs[b]`` — reduce-scatter error-feedback residual.  GLOBAL shape
+      ``(axis_size * padded_size(b),)``, sharded ``P(axis)`` so the local
+      view is this rank's own ``(padded_size(b),)`` residual over the full
+      bucket (every rank's gradient contribution is distinct).  fp32.
+    * ``ag[b]`` — all-gather residual.  GLOBAL shape ``(padded_size(b),)``,
+      sharded ``P(axis)``: each rank owns the residual of the shard it
+      transmits.  fp32.
+    * ``factors[b]`` — the PowerSGD right factor ``Q`` of shape
+      ``(n, rank)``, REPLICATED (every rank iterates the same subspace).
+
+    Like :class:`horovod_tpu.parallel.zero.ZeroShardedState` this layout is
+    global-array friendly: ``shard_map`` in/out specs from
+    :meth:`BucketCodec.state_specs` place the residuals 1/N per rank.
+    """
+
+    def __init__(self, rs, ag, factors):
+        self.rs = tuple(rs)
+        self.ag = tuple(ag)
+        self.factors = tuple(factors)
+
+    def tree_flatten(self):
+        return (self.rs, self.ag, self.factors), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        del aux
+        return cls(*children)
+
+    def __repr__(self):
+        live = sum(x is not None for x in self.rs + self.ag + self.factors)
+        return f"CodecState(buckets={len(self.rs)}, live_leaves={live})"
+
+
+def zero_residuals(state: Optional[CodecState]) -> Optional[CodecState]:
+    """Zero every error-feedback residual (sharding-preserving) while
+    keeping the PowerSGD factors — the ``residual_drop`` chaos hook's
+    payload, and the state a checkpoint restore starts from."""
+    if state is None:
+        return None
+
+    def z(group):
+        return tuple(None if a is None else a * jnp.zeros((), a.dtype)
+                     for a in group)
+
+    return CodecState(z(state.rs), z(state.ag), state.factors)
+
+
+# ---------------------------------------------------------------------------
+# Affine uint8 quantization helpers (per-bucket scale/offset).
+# ---------------------------------------------------------------------------
+
+def _affine_qparams(m):
+    """Per-bucket scale/offset over [0, 255].  A constant bucket (span 0)
+    quantizes exactly: scale falls back to 1 and every code is 0 == lo."""
+    lo = m.min().astype(jnp.float32)
+    span = m.max().astype(jnp.float32) - lo
+    scale = jnp.where(span > 0, span / 255.0, jnp.float32(1.0))
+    return scale, lo
+
+
+def _affine_encode(m, scale, lo):
+    q = jnp.round((m.astype(jnp.float32) - lo) / scale)
+    return jnp.clip(q, 0.0, 255.0).astype(jnp.uint8)
+
+
+def _affine_decode(q, scale, lo):
+    return q.astype(jnp.float32) * scale + lo
+
+
+# ---------------------------------------------------------------------------
+# Bucket codecs.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class BucketCodec:
+    """Base class: a hashable (static-arg friendly) per-bucket wire codec.
+
+    Subclasses implement ``reduce_scatter_bucket`` / ``all_gather_bucket``
+    for one flat padded bucket INSIDE ``shard_map`` (axis bound), plus the
+    plan/state hooks.  The driver functions below loop the plan's buckets
+    and keep the telemetry honest.
+    """
+
+    name: ClassVar[str] = "none"
+    stateful: ClassVar[bool] = False
+
+    # -- plan hooks ---------------------------------------------------------
+    def solo_leaf(self, shape: Tuple[int, ...], dtype) -> bool:
+        """True to claim a whole leaf as a dedicated (never-chunked) bucket
+        — the PowerSGD codec needs 2-D leaves intact."""
+        del shape, dtype
+        return False
+
+    # -- state tracking predicates (drive init/specs/reshard generically) --
+    def _tracks_rs(self, b: int, plan) -> bool:
+        del b, plan
+        return False
+
+    def _tracks_ag(self, b: int, plan) -> bool:
+        del b, plan
+        return False
+
+    def _init_factor(self, b: int, plan):
+        del b, plan
+        return None
+
+    # -- state lifecycle ----------------------------------------------------
+    def init_state(self, plan) -> Optional[CodecState]:
+        """Fresh (zero-residual) state in the GLOBAL layout; shard it with
+        :meth:`state_specs` (or let the training step's ``shard_map``
+        in_specs shard it on entry)."""
+        if not self.stateful:
+            return None
+        nb = len(plan.buckets)
+        n = plan.axis_size
+        rs = tuple(
+            jnp.zeros((n * plan.padded_size(b),), jnp.float32)
+            if self._tracks_rs(b, plan) else None for b in range(nb))
+        ag = tuple(
+            jnp.zeros((plan.padded_size(b),), jnp.float32)
+            if self._tracks_ag(b, plan) else None for b in range(nb))
+        factors = tuple(self._init_factor(b, plan) for b in range(nb))
+        return CodecState(rs, ag, factors)
+
+    def state_specs(self, plan, axis_name: str) -> Optional[CodecState]:
+        """PartitionSpec tree congruent to :meth:`init_state`'s output:
+        residuals sharded over ``axis_name``, factors replicated."""
+        if not self.stateful:
+            return None
+        from jax.sharding import PartitionSpec as P
+        nb = len(plan.buckets)
+        rs = tuple(P(axis_name) if self._tracks_rs(b, plan) else None
+                   for b in range(nb))
+        ag = tuple(P(axis_name) if self._tracks_ag(b, plan) else None
+                   for b in range(nb))
+        factors = tuple(P() if self._init_factor(b, plan) is not None
+                        else None for b in range(nb))
+        return CodecState(rs, ag, factors)
+
+    def reshard_state(self, state: Optional[CodecState], old_plan,
+                      new_plan) -> Optional[CodecState]:
+        """Re-bucket residual state for a DIFFERENT axis size (elastic warm
+        restart), preserving the PENDING error feedback.
+
+        In mean units the pending reduce-scatter error is
+        ``sum_r rs[r] / N``: the per-rank residuals are summed to one
+        per-leaf pending vector, scaled by ``N_new / N_old`` so the new
+        world's ``sum_r rs'[r] / N_new`` is unchanged, and assigned to rank
+        0 of the new layout.  The all-gather residual is already one global
+        vector in update units — it only needs re-bucketing.  PowerSGD
+        factors carry over by leaf (eligibility is shape-based, so a leaf's
+        low-rank status survives the reshard)."""
+        if not self.stateful:
+            return None
+        if state is None:
+            return self.init_state(new_plan)
+        n_old, n_new = old_plan.axis_size, new_plan.axis_size
+        nb_old, nb_new = len(old_plan.buckets), len(new_plan.buckets)
+
+        # pending reduce-scatter error, per leaf, in SUM units
+        pend = [state.rs[b].reshape(n_old, -1).sum(0).astype(jnp.float32)
+                if state.rs[b] is not None
+                else jnp.zeros((old_plan.padded_size(b),), jnp.float32)
+                for b in range(nb_old)]
+        pend_leaves = [l.astype(jnp.float32) * (n_new / n_old)
+                       for l in old_plan.split(pend)]
+        new_rs_rows = new_plan.concat(pend_leaves)
+
+        ag = [state.ag[b].astype(jnp.float32) if state.ag[b] is not None
+              else jnp.zeros((old_plan.padded_size(b),), jnp.float32)
+              for b in range(nb_old)]
+        new_ag_flats = new_plan.concat(old_plan.split(ag))
+
+        old_factor_by_leaf = {
+            old_plan.buckets[b][0][0]: state.factors[b]
+            for b in range(nb_old) if state.factors[b] is not None}
+
+        rs, ag_out, factors = [], [], []
+        for b in range(nb_new):
+            if self._tracks_rs(b, new_plan):
+                row0 = new_rs_rows[b].astype(jnp.float32)
+                rest = jnp.zeros(((n_new - 1) * new_plan.padded_size(b),),
+                                 jnp.float32)
+                rs.append(jnp.concatenate([row0, rest]) if n_new > 1
+                          else row0)
+            else:
+                rs.append(None)
+            ag_out.append(new_ag_flats[b].astype(jnp.float32)
+                          if self._tracks_ag(b, new_plan) else None)
+            fresh = self._init_factor(b, new_plan)
+            if fresh is not None:
+                carried = old_factor_by_leaf.get(new_plan.buckets[b][0][0])
+                factors.append(carried if carried is not None
+                               and tuple(carried.shape) == tuple(fresh.shape)
+                               else fresh)
+            else:
+                factors.append(None)
+        return CodecState(rs, ag_out, factors)
+
+    # -- wire ops (inside shard_map) ----------------------------------------
+    def reduce_scatter_bucket(self, b: int, flat, plan, axis_name,
+                              mean: bool, residual, factor):
+        """One bucket's compressed reduce-scatter.  Returns
+        ``(shard, new_residual, new_factor, wire_bytes)``."""
+        raise NotImplementedError
+
+    def all_gather_bucket(self, b: int, shard, plan, axis_name, residual):
+        """One bucket's compressed all-gather.  Returns
+        ``(full_flat, new_residual, wire_bytes)``."""
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class NoneCodec(BucketCodec):
+    """Bit-exact pass-through: the drivers delegate straight to
+    :func:`fusion.fused_reduce_scatter` / :func:`fusion.fused_all_gather`
+    (today's path, byte for byte)."""
+
+    name: ClassVar[str] = "none"
+    stateful: ClassVar[bool] = False
+
+
+@dataclasses.dataclass(frozen=True)
+class CastCodec(BucketCodec):
+    """Stateless dtype cast on the wire (bf16 or clamped fp16): 2x fewer
+    bytes for fp32 buckets, reduction runs at wire precision."""
+
+    wire: str = "bfloat16"
+    stateful: ClassVar[bool] = False
+
+    @property
+    def name(self) -> str:  # type: ignore[override]
+        return "bf16" if self.wire == "bfloat16" else "fp16"
+
+    @property
+    def wire_dtype(self):
+        return jnp.dtype(self.wire)
+
+    def _to_wire(self, x):
+        if x.dtype == self.wire_dtype or not jnp.issubdtype(
+                x.dtype, jnp.floating):
+            return x
+        if self.wire_dtype == jnp.float16:
+            lim = jnp.asarray(FP16_MAX, x.dtype)
+            x = jnp.clip(x, -lim, lim)
+        return x.astype(self.wire_dtype)
+
+    def reduce_scatter_bucket(self, b, flat, plan, axis_name, mean,
+                              residual, factor):
+        dtype = flat.dtype
+        w = self._to_wire(flat)
+        shard = lax.psum_scatter(w, axis_name, scatter_dimension=0,
+                                 tiled=True).astype(dtype)
+        if mean:
+            shard = shard * jnp.asarray(1.0 / plan.axis_size, dtype)
+        return (shard, None, None,
+                plan.padded_size(b) * w.dtype.itemsize)
+
+    def all_gather_bucket(self, b, shard, plan, axis_name, residual):
+        dtype = shard.dtype
+        w = self._to_wire(shard)
+        full = lax.all_gather(w, axis_name, axis=0,
+                              tiled=True).astype(dtype)
+        return full, None, plan.padded_size(b) * w.dtype.itemsize
+
+
+@dataclasses.dataclass(frozen=True)
+class Int8Codec(BucketCodec):
+    """Per-bucket affine uint8 quantization with error feedback, BOTH
+    phases compressed (~4x for fp32 buckets).
+
+    Reduce-scatter: each rank quantizes its full (residual-corrected)
+    bucket to uint8 and the ranks exchange shards with ``all_to_all`` —
+    the same per-rank wire volume a ring reduce-scatter moves, at 1/4 the
+    width — plus one tiny ``(scale, offset)`` pair per rank.  Each rank
+    then dequantizes the N received source shards at their own qparams and
+    sums: the reduction runs in fp32, so quantization error does NOT
+    compound across ranks and the residual (what the uint8 round dropped)
+    is fed back next step.
+
+    All-gather: each rank quantizes its update shard, shards are gathered
+    as uint8 and every rank decodes the identical bytes — parameters stay
+    replicated-consistent — with the shard-owner keeping the round-off as
+    the all-gather residual.
+
+    Integer buckets (no meaningful quantization) pass through uncompressed.
+    """
+
+    name: ClassVar[str] = "int8"
+    stateful: ClassVar[bool] = True
+
+    def _tracks_rs(self, b, plan):
+        return jnp.issubdtype(plan.bucket_dtype(b), jnp.floating)
+
+    def _tracks_ag(self, b, plan):
+        return jnp.issubdtype(plan.bucket_dtype(b), jnp.floating)
+
+    def reduce_scatter_bucket(self, b, flat, plan, axis_name, mean,
+                              residual, factor):
+        dtype = flat.dtype
+        n = plan.axis_size
+        if residual is None:  # non-float bucket: uncompressed
+            shard = lax.psum_scatter(flat, axis_name, scatter_dimension=0,
+                                     tiled=True)
+            if mean:
+                shard = shard * jnp.asarray(1.0 / n, dtype)
+            return shard, None, None, plan.padded_size(b) * dtype.itemsize
+        m = flat.astype(jnp.float32) + residual
+        scale, lo = _affine_qparams(m)
+        q = _affine_encode(m, scale, lo)
+        new_res = m - _affine_decode(q, scale, lo)
+        s = plan.shard_size(b)
+        # exchange: row i of ``ex`` is source rank i's uint8 shard for us
+        ex = lax.all_to_all(q.reshape(n, s), axis_name, 0, 0)
+        prm = lax.all_gather(jnp.stack([scale, lo]), axis_name, axis=0)
+        tot = (ex.astype(jnp.float32) * prm[:, 0:1] + prm[:, 1:2]).sum(0)
+        if mean:
+            tot = tot / n
+        return tot.astype(dtype), new_res, None, plan.padded_size(b) + 8
+
+    def all_gather_bucket(self, b, shard, plan, axis_name, residual):
+        dtype = shard.dtype
+        n = plan.axis_size
+        if residual is None:
+            full = lax.all_gather(shard, axis_name, axis=0, tiled=True)
+            return full, None, plan.padded_size(b) * dtype.itemsize
+        m = shard.astype(jnp.float32) + residual
+        scale, lo = _affine_qparams(m)
+        q = _affine_encode(m, scale, lo)
+        new_res = m - _affine_decode(q, scale, lo)
+        qs = lax.all_gather(q, axis_name, axis=0, tiled=True)
+        prm = lax.all_gather(jnp.stack([scale, lo]), axis_name, axis=0)
+        full = (qs.astype(jnp.float32).reshape(n, -1) * prm[:, 0:1]
+                + prm[:, 1:2]).reshape(-1)
+        return full.astype(dtype), new_res, plan.padded_size(b) + 8 * n
+
+
+@dataclasses.dataclass(frozen=True)
+class PowerSGDCodec(BucketCodec):
+    """PowerSGD-style low-rank transport (Vogels et al. 2019) for 2-D LM
+    weight gradients; bf16 cast everywhere else.
+
+    Eligible leaves (2-D, both dims >= 2*rank) get dedicated whole-leaf
+    buckets (``plan.lowrank``).  Per step, with ``M_r`` the rank's
+    residual-corrected (m, n) gradient and ``Q`` the warm-started (n, R)
+    right factor: ``P = mean_r(M_r Q)`` (one small psum), orthonormalize
+    ``P`` by QR, ``Q' = mean_r(M_r^T P_hat)`` (second small psum), decode
+    ``P_hat Q'^T ~= mean_r M_r`` identically on every rank, keep
+    ``M_r - decoded`` as the residual and ``Q'`` as next step's factor —
+    wire cost R(m+n) floats instead of m*n.  The all-gather phase (update
+    shards have no low-rank structure) rides the bf16 cast.
+    """
+
+    rank: int = 4
+    name: ClassVar[str] = "powersgd"
+    stateful: ClassVar[bool] = True
+
+    def __post_init__(self):
+        if self.rank < 1:
+            raise ValueError(f"powersgd rank must be >= 1, got {self.rank}")
+
+    @property
+    def _cast(self) -> CastCodec:
+        return CastCodec("bfloat16")
+
+    def solo_leaf(self, shape, dtype):
+        return (len(shape) == 2 and jnp.issubdtype(dtype, jnp.floating)
+                and min(shape) >= 2 * self.rank)
+
+    def _tracks_rs(self, b, plan):
+        return b in plan.lowrank
+
+    def _init_factor(self, b, plan):
+        if b not in plan.lowrank:
+            return None
+        _, n_cols = plan.bucket_leaf_shape(b)
+        key = jax.random.PRNGKey(0x9D + 31 * b)
+        return jax.random.normal(key, (n_cols, self.rank), jnp.float32)
+
+    def reduce_scatter_bucket(self, b, flat, plan, axis_name, mean,
+                              residual, factor):
+        if b not in plan.lowrank:
+            return self._cast.reduce_scatter_bucket(
+                b, flat, plan, axis_name, mean, None, None)
+        dtype = flat.dtype
+        n_ranks = plan.axis_size
+        m_rows, n_cols = plan.bucket_leaf_shape(b)
+        size = m_rows * n_cols
+        mat = (flat[:size].astype(jnp.float32)
+               + residual[:size]).reshape(m_rows, n_cols)
+        p = lax.psum(mat @ factor, axis_name) / n_ranks
+        p_hat, _ = jnp.linalg.qr(p)
+        q_new = lax.psum(mat.T @ p_hat, axis_name) / n_ranks
+        decoded = (p_hat @ q_new.T).reshape(-1)          # mean_r M_r, f32
+        new_res = jnp.concatenate([
+            mat.reshape(-1) - decoded,
+            jnp.zeros((plan.pad_elems(b),), jnp.float32)]) \
+            if plan.pad_elems(b) else mat.reshape(-1) - decoded
+        full = decoded if mean else decoded * n_ranks
+        if plan.pad_elems(b):
+            full = jnp.concatenate(
+                [full, jnp.zeros((plan.pad_elems(b),), jnp.float32)])
+        shard = plan.shard_slice(b, full.astype(dtype),
+                                 lax.axis_index(axis_name))
+        wire = (m_rows + n_cols) * self.rank * 4
+        return shard, new_res, q_new, wire
+
+    def all_gather_bucket(self, b, shard, plan, axis_name, residual):
+        return self._cast.all_gather_bucket(b, shard, plan, axis_name, None)
+
+
+# ---------------------------------------------------------------------------
+# Codec resolution: kwargs, legacy Compression classes, HOROVOD_COMPRESSION.
+# ---------------------------------------------------------------------------
+
+_CODEC_SPEC = re.compile(r"powersgd:(\d+)")
+
+
+def parse_codec(spec: str) -> BucketCodec:
+    """``"none"|"bf16"|"fp16"|"int8"|"powersgd"|"powersgd:R"`` -> codec."""
+    s = str(spec).strip().lower()
+    if s in ("", "none"):
+        return NoneCodec()
+    if s == "bf16":
+        return CastCodec("bfloat16")
+    if s == "fp16":
+        return CastCodec("float16")
+    if s == "int8":
+        return Int8Codec()
+    if s == "powersgd":
+        return PowerSGDCodec()
+    m = _CODEC_SPEC.fullmatch(s)
+    if m:
+        return PowerSGDCodec(rank=int(m.group(1)))
+    raise ValueError(
+        f"unknown compression codec {spec!r}: expected none, bf16, fp16, "
+        f"int8, powersgd or powersgd:<rank>")
+
+
+_LEGACY_TO_CODEC = {}  # populated below; class identity -> factory
+
+
+def resolve_codec(compression=None) -> BucketCodec:
+    """Normalize every accepted ``compression=`` form to a
+    :class:`BucketCodec`: codec instances pass through, strings are
+    parsed, the legacy :class:`Compression` classes map to their codec
+    twins, and the DEFAULT forms — ``None`` and ``Compression.none`` —
+    consult ``HOROVOD_COMPRESSION``.  An explicit codec (instance or
+    string, even ``"none"``) always wins over the env.  An unparseable
+    env value warns once and falls back to none — a typo must not surface
+    as a ValueError deep inside a jit trace."""
+    global _warned_bad_env
+    c = compression
+    consult_env = (compression is None
+                   or (isinstance(compression, type)
+                       and issubclass(compression, NoneCompressor)))
+    if isinstance(c, BucketCodec):
+        pass
+    elif isinstance(c, str):
+        c = parse_codec(c)
+    elif c is None:
+        c = NoneCodec()
+    elif isinstance(c, type) and issubclass(c, Compressor):
+        if issubclass(c, FP16Compressor):
+            c = CastCodec("float16")
+        elif issubclass(c, BF16Compressor):
+            c = CastCodec("bfloat16")
+        elif issubclass(c, NoneCompressor):
+            c = NoneCodec()
+        else:
+            raise TypeError(
+                f"custom Compressor subclass {c.__name__} has no bucket-"
+                f"codec equivalent; pass a BucketCodec instance instead")
+    else:
+        raise TypeError(
+            f"compression must be a BucketCodec, a codec name string, or "
+            f"one of the Compression.* classes; got {c!r}")
+    if consult_env and isinstance(c, NoneCodec):
+        env = os.environ.get(HOROVOD_COMPRESSION_VAR, "").strip()
+        if env:
+            try:
+                c = parse_codec(env)
+            except ValueError as e:
+                if not _warned_bad_env:
+                    _warned_bad_env = True
+                    log.warning("%s=%r ignored: %s",
+                                HOROVOD_COMPRESSION_VAR, env, e)
+    return c
+
+
+def as_legacy(codec: BucketCodec):
+    """The legacy per-tensor :class:`Compressor` equivalent of a stateless
+    codec (for the eager / replicated-allreduce paths), or ``None`` when
+    the codec has no per-tensor form (int8/powersgd need bucket state)."""
+    if isinstance(codec, NoneCodec):
+        return NoneCompressor
+    if isinstance(codec, CastCodec):
+        return (FP16Compressor if codec.wire_dtype == jnp.float16
+                else BF16Compressor)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Driver functions: the plan-wide compressed wire (inside shard_map).
+# ---------------------------------------------------------------------------
+
+def _record_compression(codec_name: str, bytes_in: int, bytes_out: int,
+                        seconds: float) -> None:
+    """Trace-time codec accounting (hvd_compression_*): byte counters are
+    trace-time decisions like the fusion series; encode_seconds is the
+    HOST time spent building the compressed collective at trace time."""
+    if not telemetry.enabled() or not bytes_in:
+        return
+    telemetry.counter(
+        "hvd_compression_bytes_in_total",
+        "Uncompressed payload bytes entering wire codecs (trace-time)",
+        codec=codec_name).inc(bytes_in)
+    telemetry.counter(
+        "hvd_compression_bytes_out_total",
+        "Compressed payload bytes leaving wire codecs (trace-time)",
+        codec=codec_name).inc(bytes_out)
+    telemetry.gauge(
+        "hvd_compression_ratio",
+        "bytes_in / bytes_out of the most recent codec application",
+        codec=codec_name).set(bytes_in / max(bytes_out, 1))
+    telemetry.counter(
+        "hvd_compression_encode_seconds_total",
+        "Host seconds spent building compressed collectives (trace-time)",
+        codec=codec_name).inc(max(seconds, 0.0))
+
+
+def compressed_reduce_scatter(leaves, axis_name, codec: BucketCodec, *,
+                              plan, state: Optional[CodecState] = None,
+                              mean: bool = True):
+    """Codec-aware twin of :func:`fusion.fused_reduce_scatter` over a
+    prebuilt plan: compress each bucket on the wire, return ``(shards,
+    new_state)``.  Must run inside ``shard_map`` with ``axis_name`` bound.
+    The none codec delegates to the fused path bit-exactly."""
+    codec = codec if codec is not None else NoneCodec()
+    if isinstance(codec, NoneCodec):
+        shards, _ = fusion.fused_reduce_scatter(leaves, axis_name,
+                                                mean=mean, plan=plan)
+        return shards, state
+    t0 = time.perf_counter()
+    flats = plan.concat(list(leaves))
+    nb = len(plan.buckets)
+    rs = list(state.rs) if state is not None else [None] * nb
+    factors = list(state.factors) if state is not None else [None] * nb
+    ag = tuple(state.ag) if state is not None else (None,) * nb
+    shards: List = []
+    bytes_in = bytes_out = 0
+    for b, flat in enumerate(flats):
+        shard, new_r, new_f, wire = codec.reduce_scatter_bucket(
+            b, flat, plan, axis_name, mean, rs[b], factors[b])
+        shards.append(shard)
+        if new_r is not None:
+            rs[b] = new_r
+        if new_f is not None:
+            factors[b] = new_f
+        bytes_in += plan.padded_size(b) * plan.bucket_dtype(b).itemsize
+        bytes_out += wire
+    fusion._record_plan("reduce_scatter", plan)
+    fusion.record_collective_bytes("reduce_scatter", codec.name, bytes_out)
+    _record_compression(codec.name, bytes_in, bytes_out,
+                        time.perf_counter() - t0)
+    new_state = (CodecState(rs, ag, factors) if codec.stateful else None)
+    return shards, new_state
+
+
+def compressed_all_gather(shards, plan, axis_name, codec: BucketCodec,
+                          state: Optional[CodecState] = None):
+    """Codec-aware twin of :func:`fusion.fused_all_gather`: compress each
+    update shard on the wire, gather, decode identically on every rank.
+    Returns ``(leaves, new_state)``."""
+    codec = codec if codec is not None else NoneCodec()
+    if isinstance(codec, NoneCodec):
+        return fusion.fused_all_gather(shards, plan, axis_name), state
+    shards = list(shards)
+    if len(shards) != len(plan.buckets):
+        raise ValueError(f"plan has {len(plan.buckets)} buckets, got "
+                         f"{len(shards)} shards")
+    t0 = time.perf_counter()
+    nb = len(plan.buckets)
+    ag = list(state.ag) if state is not None else [None] * nb
+    fulls: List = []
+    bytes_in = bytes_out = 0
+    for b, shard in enumerate(shards):
+        full, new_r, wire = codec.all_gather_bucket(
+            b, shard, plan, axis_name, ag[b])
+        fulls.append(full)
+        if new_r is not None:
+            ag[b] = new_r
+        bytes_in += plan.padded_size(b) * plan.bucket_dtype(b).itemsize
+        bytes_out += wire
+    fusion.record_collective_bytes("all_gather", codec.name, bytes_out)
+    _record_compression(codec.name, bytes_in, bytes_out,
+                        time.perf_counter() - t0)
+    leaves = plan.split(fulls)
+    new_state = (CodecState(state.rs if state is not None else (None,) * nb,
+                            ag,
+                            state.factors if state is not None
+                            else (None,) * nb)
+                 if codec.stateful else None)
+    return leaves, new_state
+
+
+def compressed_allreduce(leaves, axis_name, codec: BucketCodec, *,
+                         plan, state: Optional[CodecState] = None,
+                         mean: bool = True):
+    """Full compressed allreduce — the reduce-scatter / all-gather pair
+    back to back (the replicated-update path of
+    :func:`horovod_tpu.parallel.data.make_training_step` with a stateful
+    codec).  Returns ``(leaves, new_state)``."""
+    shards, state = compressed_reduce_scatter(
+        leaves, axis_name, codec, plan=plan, state=state, mean=mean)
+    return compressed_all_gather(shards, plan, axis_name, codec, state)

@@ -45,6 +45,12 @@ log = get_logger(__name__)
 # Reference default: 64 MB (operations.cc:379); same env knob name.
 DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024
 
+# Reduce-scatter buckets are additionally CHUNKED at this cap: BENCH_eager
+# measured a bandwidth cliff at 64 MB payloads (0.8 -> 0.2 GB/s), so plans
+# split any bucket above the cap into several pipeline-friendly chunks.
+# 0 disables chunking.
+DEFAULT_MAX_BUCKET_BYTES = 32 * 1024 * 1024
+
 _SIZE_SUFFIXES = {
     "": 1, "b": 1,
     "k": 1024, "kb": 1024, "kib": 1024,
@@ -53,6 +59,7 @@ _SIZE_SUFFIXES = {
 }
 
 _warned_bad_threshold = False
+_warned_bad_cap = False
 
 
 def parse_size_bytes(value: str) -> Optional[int]:
@@ -87,6 +94,42 @@ def fusion_threshold_bytes() -> int:
                 v, DEFAULT_FUSION_THRESHOLD)
         return DEFAULT_FUSION_THRESHOLD
     return parsed
+
+
+def max_bucket_bytes() -> int:
+    """The reduce-scatter bucket chunking cap from
+    ``HOROVOD_MAX_BUCKET_BYTES`` (same size grammar as the fusion
+    threshold; ``0`` disables chunking).  Unparseable values fall back to
+    the 32 MB default with a one-time warning."""
+    global _warned_bad_cap
+    v = os.environ.get("HOROVOD_MAX_BUCKET_BYTES")
+    if not v:
+        return DEFAULT_MAX_BUCKET_BYTES
+    parsed = parse_size_bytes(v)
+    if parsed is None:
+        if not _warned_bad_cap:
+            _warned_bad_cap = True
+            log.warning(
+                "HOROVOD_MAX_BUCKET_BYTES=%r is not a byte size (expected "
+                "e.g. 33554432, 32mb or 16MiB); using the default %d bytes",
+                v, DEFAULT_MAX_BUCKET_BYTES)
+        return DEFAULT_MAX_BUCKET_BYTES
+    return parsed
+
+
+def record_collective_bytes(kind: str, codec: str, nbytes: int) -> None:
+    """Trace-time wire accounting for SPMD collectives: the LOGICAL payload
+    bytes a collective moves per invocation (per rank), labeled by the wire
+    codec that produced them.  Like all fusion telemetry this counts
+    trace-time decisions — per-step traffic is trace counts x payload — so
+    two runs of the same program are directly comparable: the none-codec /
+    int8 ratio of ``hvd_collective_bytes_total`` IS the wire compression
+    ratio."""
+    if nbytes and telemetry.enabled():
+        telemetry.counter(
+            "hvd_collective_bytes_total",
+            "Logical wire payload bytes of SPMD collectives (trace-time)",
+            plane="spmd", kind=kind, codec=codec).inc(int(nbytes))
 
 
 def _vma_key(leaf):
@@ -161,6 +204,36 @@ def _record_buckets(kind: str, tensors, buckets, pad_bytes: int = 0):
             "(padding waste)", kind=kind).inc(pad_bytes)
 
 
+def _record_plan(kind: str, plan: "ReduceScatterPlan") -> None:
+    """Plan-based twin of :func:`_record_buckets` for the span wire format."""
+    if not telemetry.enabled():
+        return
+    telemetry.counter(
+        "hvd_fusion_requests_total",
+        "Fusion walks (trace-time bucketing decisions)", kind=kind).inc()
+    telemetry.counter(
+        "hvd_fusion_buckets_total",
+        "Fusion buckets produced across all fusion walks", kind=kind).inc(
+        len(plan.buckets))
+    telemetry.counter(
+        "hvd_fusion_tensors_total",
+        "Tensors routed through the fusion walks", kind=kind).inc(
+        plan.n_leaves)
+    hist = telemetry.histogram(
+        "hvd_fusion_bucket_bytes",
+        "Per-bucket payload size produced by the fusion walk",
+        bounds=telemetry.DEFAULT_BYTE_BUCKETS)
+    for b in range(len(plan.buckets)):
+        hist.observe(float(plan.bucket_size(b) *
+                           plan.bucket_dtype(b).itemsize))
+    pad = plan.total_pad_bytes()
+    if pad:
+        telemetry.counter(
+            "hvd_fusion_pad_bytes_total",
+            "Bytes of axis-size padding added to reduce-scatter buckets "
+            "(padding waste)", kind=kind).inc(pad)
+
+
 def fused_psum(tensors: Sequence[jax.Array], axis_name,
                mean: bool = True, threshold: int | None = None,
                prescale_factor: float = 1.0, postscale_factor: float = 1.0):
@@ -177,6 +250,8 @@ def fused_psum(tensors: Sequence[jax.Array], axis_name,
     threshold = fusion_threshold_bytes() if threshold is None else threshold
     buckets = _bucket_leaves(tensors, threshold)
     _record_buckets("psum", tensors, buckets)
+    record_collective_bytes("psum", "none", sum(
+        int(np.prod(t.shape)) * t.dtype.itemsize for t in tensors))
     reduce = lax.pmean if mean else lax.psum
     out: List = [None] * len(tensors)
     for bucket in buckets:
@@ -230,11 +305,19 @@ class ReduceScatterPlan:
     makes ``fused_reduce_scatter`` -> ``fused_all_gather`` a lossless round
     trip, and what :mod:`horovod_tpu.parallel.zero` uses to keep gradient
     shards, parameter shards and optimizer-state shards aligned.
+
+    Bucket membership is expressed as **spans** ``(leaf, start, stop)`` —
+    element ranges of the flattened leaf — so one oversized leaf (or one
+    oversized multi-leaf bucket) can be CHUNKED across several buckets
+    (``HOROVOD_MAX_BUCKET_BYTES``).  ``lowrank`` marks bucket indices the
+    requesting wire codec claimed as whole-leaf low-rank buckets
+    (:mod:`horovod_tpu.ops.compression`); those are never chunked.
     """
-    buckets: Tuple[Tuple[int, ...], ...]       # leaf indices per bucket
+    buckets: Tuple[Tuple[Tuple[int, int, int], ...], ...]  # spans per bucket
     shapes: Tuple[Tuple[int, ...], ...]        # per-leaf shapes
     dtypes: Tuple[str, ...]                    # per-leaf dtype names
     axis_size: int
+    lowrank: Tuple[int, ...] = ()              # codec-claimed bucket indices
 
     # -- static geometry ---------------------------------------------------
     def leaf_size(self, i: int) -> int:
@@ -242,7 +325,7 @@ class ReduceScatterPlan:
 
     def bucket_size(self, b: int) -> int:
         """Unpadded element count of bucket ``b``."""
-        return sum(self.leaf_size(i) for i in self.buckets[b])
+        return sum(stop - start for _, start, stop in self.buckets[b])
 
     def padded_size(self, b: int) -> int:
         """Bucket size rounded up to a multiple of ``axis_size``."""
@@ -256,7 +339,18 @@ class ReduceScatterPlan:
         return self.padded_size(b) - self.bucket_size(b)
 
     def bucket_dtype(self, b: int):
-        return jnp.dtype(self.dtypes[self.buckets[b][0]])
+        return jnp.dtype(self.dtypes[self.buckets[b][0][0]])
+
+    def bucket_leaf_shape(self, b: int) -> Optional[Tuple[int, ...]]:
+        """The original leaf shape when bucket ``b`` is exactly one WHOLE
+        leaf (the low-rank codec needs the 2-D geometry back), else None."""
+        spans = self.buckets[b]
+        if len(spans) != 1:
+            return None
+        i, start, stop = spans[0]
+        if start != 0 or stop != self.leaf_size(i):
+            return None
+        return self.shapes[i]
 
     @property
     def n_leaves(self) -> int:
@@ -266,6 +360,12 @@ class ReduceScatterPlan:
         return sum(self.pad_elems(b) * self.bucket_dtype(b).itemsize
                    for b in range(len(self.buckets)))
 
+    def total_padded_bytes(self) -> int:
+        """Per-rank logical payload of one reduce-scatter (or all-gather)
+        pass over every bucket at wire dtype == bucket dtype."""
+        return sum(self.padded_size(b) * self.bucket_dtype(b).itemsize
+                   for b in range(len(self.buckets)))
+
     # -- flat-buffer plumbing ---------------------------------------------
     def concat(self, leaves) -> List[jax.Array]:
         """Leaves -> one padded 1-D buffer per bucket (trace-safe)."""
@@ -273,8 +373,12 @@ class ReduceScatterPlan:
             raise ValueError(f"plan describes {self.n_leaves} leaves, got "
                              f"{len(leaves)}")
         flats = []
-        for b, bucket in enumerate(self.buckets):
-            parts = [leaves[i].reshape(-1) for i in bucket]
+        for b, spans in enumerate(self.buckets):
+            parts = []
+            for i, start, stop in spans:
+                flat_leaf = leaves[i].reshape(-1)
+                parts.append(flat_leaf if stop - start == self.leaf_size(i)
+                             else flat_leaf[start:stop])
             pad = self.pad_elems(b)
             if pad or not parts:
                 parts.append(jnp.zeros((pad if parts else self.padded_size(b),),
@@ -288,13 +392,19 @@ class ReduceScatterPlan:
         if len(flats) != len(self.buckets):
             raise ValueError(f"plan has {len(self.buckets)} buckets, got "
                              f"{len(flats)} buffers")
-        out: List = [None] * self.n_leaves
-        for b, bucket in enumerate(self.buckets):
+        pieces: List[List[Tuple[int, jax.Array]]] = [
+            [] for _ in range(self.n_leaves)]
+        for b, spans in enumerate(self.buckets):
             flat = flats[b][:self.bucket_size(b)]
-            sizes = [self.leaf_size(i) for i in bucket]
+            sizes = [stop - start for _, start, stop in spans]
             offsets = np.cumsum(sizes[:-1]).tolist()
-            for i, part in zip(bucket, jnp.split(flat, offsets)):
-                out[i] = part.reshape(self.shapes[i])
+            for (i, start, _), part in zip(spans, jnp.split(flat, offsets)):
+                pieces[i].append((start, part))
+        out: List = []
+        for i, segs in enumerate(pieces):
+            segs = [part for _, part in sorted(segs, key=lambda t: t[0])]
+            flat = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
+            out.append(flat.reshape(self.shapes[i]))
         return out
 
     def shard_slice(self, b: int, flat, index):
@@ -311,20 +421,83 @@ def _resolve_axis_size(axis_name, axis_size: Optional[int]) -> int:
     return int(np.prod([lax.axis_size(a) for a in names]))
 
 
+def _chunk_spans(spans, itemsize: int, cap: int):
+    """Split one bucket's span list into chunks of at most ``cap`` bytes
+    (element-granular: a span larger than the cap is cut mid-leaf)."""
+    cap_elems = max(1, cap // itemsize)
+    chunks, cur, cur_elems = [], [], 0
+    for leaf, start, stop in spans:
+        pos = start
+        while pos < stop:
+            take = min(stop - pos, cap_elems - cur_elems)
+            cur.append((leaf, pos, pos + take))
+            pos += take
+            cur_elems += take
+            if cur_elems == cap_elems:
+                chunks.append(cur)
+                cur, cur_elems = [], 0
+    if cur:
+        chunks.append(cur)
+    return chunks or [list(spans)]
+
+
 def make_reduce_scatter_plan(leaves, axis_size: int,
-                             threshold: int | None = None
-                             ) -> ReduceScatterPlan:
+                             threshold: int | None = None,
+                             codec=None,
+                             cap: int | None = None) -> ReduceScatterPlan:
     """Run the fusion bucketing walk over ``leaves`` (arrays or
     ShapeDtypeStructs) and freeze it, with per-bucket padding geometry for
-    an ``axis_size``-way reduce-scatter."""
+    an ``axis_size``-way reduce-scatter.
+
+    Buckets larger than ``cap`` bytes (``HOROVOD_MAX_BUCKET_BYTES``,
+    default 32 MB, 0 disables) are chunked into multiple buckets — the
+    64 MB payload cliff in BENCH_eager.json means several medium
+    collectives pipeline better than one giant one.  ``codec`` (a
+    :class:`horovod_tpu.ops.compression.BucketCodec`-shaped object) may
+    claim whole leaves as dedicated low-rank buckets via its
+    ``solo_leaf(shape, dtype)`` hook; claimed buckets are exempt from
+    chunking and listed in ``plan.lowrank``.
+    """
     leaves = list(leaves)
     threshold = fusion_threshold_bytes() if threshold is None else threshold
-    buckets = _bucket_leaves(leaves, threshold)
+    cap = max_bucket_bytes() if cap is None else cap
+    solo = [i for i, l in enumerate(leaves)
+            if codec is not None
+            and codec.solo_leaf(tuple(int(d) for d in l.shape),
+                                jnp.dtype(l.dtype))]
+    rest = [l for i, l in enumerate(leaves) if i not in solo]
+    rest_idx = [i for i in range(len(leaves)) if i not in solo]
+    walk = _bucket_leaves(rest, threshold)
+    span_buckets = [[(rest_idx[j], 0, int(np.prod(leaves[rest_idx[j]].shape)))
+                     for j in bucket] for bucket in walk]
+    chunked = 0
+    if cap:
+        out_buckets = []
+        for spans in span_buckets:
+            itemsize = jnp.dtype(leaves[spans[0][0]].dtype).itemsize
+            nbytes = sum((stop - start) * itemsize for _, start, stop in spans)
+            if nbytes > cap:
+                chunks = _chunk_spans(spans, itemsize, cap)
+                if len(chunks) > 1:
+                    chunked += 1
+                out_buckets.extend(chunks)
+            else:
+                out_buckets.append(spans)
+        span_buckets = out_buckets
+    if chunked and telemetry.enabled():
+        telemetry.counter(
+            "hvd_fusion_chunked_buckets_total",
+            "Fusion buckets split because they exceeded "
+            "HOROVOD_MAX_BUCKET_BYTES").inc(chunked)
+    lowrank = tuple(range(len(span_buckets), len(span_buckets) + len(solo)))
+    for i in solo:
+        span_buckets.append([(i, 0, int(np.prod(leaves[i].shape)))])
     return ReduceScatterPlan(
-        buckets=tuple(tuple(b) for b in buckets),
+        buckets=tuple(tuple(b) for b in span_buckets),
         shapes=tuple(tuple(int(d) for d in l.shape) for l in leaves),
         dtypes=tuple(str(jnp.dtype(l.dtype)) for l in leaves),
-        axis_size=int(axis_size))
+        axis_size=int(axis_size),
+        lowrank=lowrank)
 
 
 def fused_reduce_scatter(tensors: Sequence[jax.Array], axis_name,
@@ -350,8 +523,9 @@ def fused_reduce_scatter(tensors: Sequence[jax.Array], axis_name,
         plan = make_reduce_scatter_plan(tensors, n, threshold)
     if not tensors:
         return [], plan
-    _record_buckets("reduce_scatter", tensors, plan.buckets,
-                    pad_bytes=plan.total_pad_bytes())
+    _record_plan("reduce_scatter", plan)
+    record_collective_bytes("reduce_scatter", "none",
+                            plan.total_padded_bytes())
     shards = []
     inv = 1.0 / plan.axis_size
     for b, flat in enumerate(plan.concat(tensors)):
@@ -372,6 +546,7 @@ def fused_all_gather(shards: Sequence[jax.Array],
     if len(shards) != len(plan.buckets):
         raise ValueError(f"plan has {len(plan.buckets)} buckets, got "
                          f"{len(shards)} shards")
+    record_collective_bytes("all_gather", "none", plan.total_padded_bytes())
     flats = [lax.all_gather(s, axis_name, axis=0, tiled=True)
              for s in shards]
     return plan.split(flats)

@@ -36,12 +36,49 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from horovod_tpu import basics
 from horovod_tpu.topology import data_axis
 from horovod_tpu.ops import collective
+from horovod_tpu.ops import compression as compression_mod
 from horovod_tpu.ops.compression import Compression
+
+_warned_stateful_per_leaf = False
+
+
+def _legacy_compression(compression):
+    """Normalize a ``compression=`` kwarg for the PER-LEAF paths (eager
+    allreduce, replicated fused pmean): legacy ``Compressor`` classes —
+    including user subclasses — pass through; codec instances / name
+    strings map to their legacy cast twins.  Stateful codecs (int8,
+    powersgd) have no per-leaf form: they need the bucketed
+    reduce-scatter wire, so they warn once and fall back to uncompressed
+    — use ``make_training_step``/``DistributedOptimizer`` with the
+    sharded update (or a stateful-codec training step) to engage them."""
+    global _warned_stateful_per_leaf
+    if (isinstance(compression, type)
+            and issubclass(compression, compression_mod.Compressor)
+            and compression is not compression_mod.NoneCompressor):
+        return compression
+    codec = compression_mod.resolve_codec(
+        None if (isinstance(compression, type)
+                 and issubclass(compression, compression_mod.NoneCompressor))
+        else compression)
+    legacy = compression_mod.as_legacy(codec)
+    if legacy is None:
+        if not _warned_stateful_per_leaf:
+            _warned_stateful_per_leaf = True
+            from horovod_tpu.utils.logging import get_logger
+            get_logger(__name__).warning(
+                "compression codec %r needs the bucketed reduce-scatter "
+                "wire and does not apply to per-leaf allreduce; falling "
+                "back to uncompressed here (use shard_optimizer=True / "
+                "sharded_update=True, or make_training_step's stateful-"
+                "codec path)", codec.name)
+        return compression_mod.NoneCompressor
+    return legacy
 
 
 def _allreduce_tree(grads, axis_name: str, compression=Compression.none,
                     op=collective.Average):
     """Average a gradient pytree across workers — either plane."""
+    compression = _legacy_compression(compression)
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     compressed = [compression.compress(l) for l in leaves]
     cleaves = [c[0] for c in compressed]
@@ -116,11 +153,6 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     del named_parameters
     if sharded_update:
         from horovod_tpu.parallel import zero
-        if compression is not Compression.none:
-            raise NotImplementedError(
-                "sharded_update=True does not compose with gradient "
-                "compression: the wire format is flat reduce-scatter "
-                "buckets (see docs/performance.md)")
         if backward_passes_per_step > 1:
             raise NotImplementedError(
                 "sharded_update=True does not compose with "
@@ -131,7 +163,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                 f"sharded_update=True supports op=Average or op=Sum, "
                 f"got {op!r}")
         return zero.sharded_optimizer(optimizer, axis_name, mesh=mesh,
-                                      mean=op is collective.Average)
+                                      mean=op is collective.Average,
+                                      compression=compression)
     chain = optax.chain(
         distributed_gradients(compression=compression, axis_name=axis_name,
                               op=op),
@@ -248,6 +281,19 @@ def make_training_step(loss_fn: Callable,
     if shard_optimizer:
         return _make_sharded_training_step(loss_fn, optimizer, mesh, ax,
                                            donate, compression)
+    try:
+        codec = compression_mod.resolve_codec(
+            None if (isinstance(compression, type)
+                     and issubclass(compression, compression_mod.NoneCompressor))
+            else compression)
+    except TypeError:
+        codec = None   # custom legacy Compressor: per-leaf path below
+    if codec is not None and codec.stateful:
+        # int8 / powersgd need the bucketed reduce-scatter wire plus
+        # rank-local residual state — a different step shape from the
+        # stateless pmean chain below.
+        return _make_compressed_training_step(loss_fn, optimizer, mesh, ax,
+                                              donate, codec)
     dist_opt = optax.chain(
         distributed_gradients(compression=compression, axis_name=ax),
         optimizer)
@@ -300,12 +346,8 @@ def _make_sharded_training_step(loss_fn, optimizer, mesh, ax, donate,
     ``shard_map`` is built lazily on the first call and cached (one build
     per state treedef — the treedef is fixed for a given optimizer)."""
     from horovod_tpu.parallel import zero
-    if compression is not Compression.none:
-        raise NotImplementedError(
-            "shard_optimizer=True does not compose with gradient "
-            "compression: the wire format is flat reduce-scatter buckets "
-            "(see docs/performance.md)")
-    zopt = zero.sharded_optimizer(optimizer, ax, mesh=mesh)
+    zopt = zero.sharded_optimizer(optimizer, ax, mesh=mesh,
+                                  compression=compression)
 
     def _step(params, opt_state, batch):
         from horovod_tpu import resilience
@@ -334,12 +376,109 @@ def _make_sharded_training_step(loss_fn, optimizer, mesh, ax, donate,
     def step(params, opt_state, batch):
         if step.jitted is None:
             step.jitted = cache["fn"] = _build(opt_state)
+        from horovod_tpu import faults
+        if faults.drop_residual():
+            opt_state = _drop_residuals(opt_state)
         return step.jitted(params, opt_state, batch)
 
     step.init = zopt.init
     step.optimizer = zopt            # the ShardedOptimizer (specs, gather)
     step.jitted = None               # built on first call (state-dependent)
     step.state_shardings = functools.partial(zopt.state_shardings, mesh)
+    return step
+
+
+def _drop_residuals(opt_state):
+    """Zero every error-feedback residual inside an optimizer state —
+    the payload of the ``residual_drop`` chaos fault.  Handles both the
+    ZeRO wrapper state (``ZeroShardedState.wire``) and the bare
+    ``(CodecState, inner)`` pair of the compressed replicated step."""
+    from horovod_tpu.parallel import zero
+
+    def is_leaf(x):
+        return (isinstance(x, compression_mod.CodecState)
+                or zero.is_zero_state(x))
+
+    def fix(x):
+        if isinstance(x, compression_mod.CodecState):
+            return compression_mod.zero_residuals(x)
+        if zero.is_zero_state(x) and x.wire is not None:
+            return zero.ZeroShardedState(
+                x.inner, x.plan, x.treedef, x.optimizer,
+                wire=compression_mod.zero_residuals(x.wire), codec=x.codec)
+        return x
+
+    return jax.tree_util.tree_map(fix, opt_state, is_leaf=is_leaf)
+
+
+def _make_compressed_training_step(loss_fn, optimizer, mesh, ax, donate,
+                                   codec):
+    """The stateful-codec (int8 / powersgd) variant of
+    :func:`make_training_step` on the replicated-update path: gradients
+    ride the bucketed compressed reduce-scatter/all-gather wire
+    (:func:`horovod_tpu.ops.compression.compressed_allreduce`) and the
+    rank-local error-feedback residuals live in the opt state as
+    ``(CodecState, inner_optax_state)``.
+
+    The bucket plan depends on the parameter treedef, so ``step.init``
+    must run before the first ``step(...)`` call (it also builds the
+    residual state); the ``shard_map`` specs depend on the plan and are
+    built lazily like the ZeRO variant."""
+    from horovod_tpu.ops import fusion
+    holder = {}
+
+    def init(params):
+        leaves, _ = jax.tree_util.tree_flatten(params)
+        plan = fusion.make_reduce_scatter_plan(
+            leaves, int(mesh.shape[ax]), codec=codec)
+        holder["plan"] = plan
+        return codec.init_state(plan), optimizer.init(params)
+
+    def _step(params, opt_state, batch):
+        from horovod_tpu import resilience
+        wire, inner = opt_state
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+
+        def do_update():
+            gleaves, gdef = jax.tree_util.tree_flatten(grads)
+            reduced, new_wire = compression_mod.compressed_allreduce(
+                gleaves, ax, codec, plan=holder["plan"], state=wire,
+                mean=True)
+            avg = jax.tree_util.tree_unflatten(gdef, list(reduced))
+            updates, new_inner = optimizer.update(avg, inner, params)
+            return (optax.apply_updates(params, updates),
+                    (new_wire, new_inner))
+
+        (new_params, new_opt_state), mean_loss = resilience.apply_step_guard(
+            do_update, loss=loss, grads=grads,
+            old_state=(params, opt_state), axes=(ax,))
+        return new_params, new_opt_state, mean_loss
+
+    def _build():
+        opt_specs = (codec.state_specs(holder["plan"], ax), P())
+        smapped = jax.shard_map(
+            _step, mesh=mesh,
+            in_specs=(P(), opt_specs, P(ax)),
+            out_specs=(P(), opt_specs, P()),
+            check_vma=False)
+        return jax.jit(smapped, donate_argnums=(0, 1) if donate else ())
+
+    def step(params, opt_state, batch):
+        if step.jitted is None:
+            if "plan" not in holder:
+                raise RuntimeError(
+                    "call step.init(params) before the first step: the "
+                    "compressed wire's bucket plan is derived from the "
+                    "parameter tree at init")
+            step.jitted = _build()
+        from horovod_tpu import faults
+        if faults.drop_residual():
+            opt_state = _drop_residuals(opt_state)
+        return step.jitted(params, opt_state, batch)
+
+    step.init = init
+    step.codec = codec
+    step.jitted = None               # built on first call (plan-dependent)
     return step
 
 

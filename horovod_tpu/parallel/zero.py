@@ -50,6 +50,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horovod_tpu import telemetry
+from horovod_tpu.ops import compression as compression_mod
 from horovod_tpu.ops import fusion
 
 
@@ -59,28 +60,37 @@ class ZeroShardedState:
 
     ``inner`` is the wrapped optax optimizer's state with the *list of
     flat padded bucket vectors* playing the role of the params pytree.
-    The bucketing plan, the params treedef and the wrapped optimizer ride
-    along as static aux data so checkpointing can convert to/from the
-    replicated per-leaf layout without out-of-band bookkeeping.
+    ``wire`` is the wire codec's error-feedback residual state
+    (:class:`horovod_tpu.ops.compression.CodecState`, ``None`` for
+    stateless codecs).  The bucketing plan, the params treedef, the
+    wrapped optimizer and the codec ride along as static aux data so
+    checkpointing can convert to/from the replicated per-leaf layout
+    without out-of-band bookkeeping.
     """
 
     def __init__(self, inner: Any, plan: fusion.ReduceScatterPlan,
-                 treedef, optimizer: optax.GradientTransformation):
+                 treedef, optimizer: optax.GradientTransformation,
+                 wire: Any = None, codec: Any = None):
         self.inner = inner
         self.plan = plan
         self.treedef = treedef
         self.optimizer = optimizer
+        self.wire = wire
+        self.codec = codec
 
     def tree_flatten(self):
-        return (self.inner,), (self.plan, self.treedef, self.optimizer)
+        return ((self.inner, self.wire),
+                (self.plan, self.treedef, self.optimizer, self.codec))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(children[0], aux[0], aux[1], aux[2])
+        return cls(children[0], aux[0], aux[1], aux[2],
+                   wire=children[1], codec=aux[3])
 
     def __repr__(self):
+        codec = getattr(self.codec, "name", None) or "none"
         return (f"ZeroShardedState(buckets={len(self.plan.buckets)}, "
-                f"axis_size={self.plan.axis_size})")
+                f"axis_size={self.plan.axis_size}, codec={codec})")
 
 
 def is_zero_state(x) -> bool:
@@ -111,7 +121,8 @@ class ShardedOptimizer:
                  axis_name: str = "data", *,
                  axis_size: Optional[int] = None,
                  threshold: Optional[int] = None,
-                 mean: bool = True):
+                 mean: bool = True,
+                 compression=None):
         if not isinstance(axis_name, str):
             raise NotImplementedError(
                 f"sharded_optimizer shards over ONE mesh axis; got "
@@ -122,6 +133,7 @@ class ShardedOptimizer:
         self._axis_size = axis_size
         self.threshold = threshold
         self.mean = mean
+        self.codec = compression_mod.resolve_codec(compression)
 
     # -- layout ------------------------------------------------------------
     def _resolve_axis_size(self) -> int:
@@ -149,10 +161,13 @@ class ShardedOptimizer:
         """
         leaves, treedef = jax.tree_util.tree_flatten(params)
         plan = fusion.make_reduce_scatter_plan(
-            leaves, self._resolve_axis_size(), self.threshold)
+            leaves, self._resolve_axis_size(), self.threshold,
+            codec=self.codec)
         flats = plan.concat(leaves)
         return ZeroShardedState(self.inner.init(flats), plan, treedef,
-                                self.inner)
+                                self.inner,
+                                wire=self.codec.init_state(plan),
+                                codec=self.codec)
 
     def update(self, grads, state: ZeroShardedState, params=None):
         """The sharded update: reduce-scatter grads, step the optimizer on
@@ -178,19 +193,21 @@ class ShardedOptimizer:
                 f"re-init (or re-shard the checkpoint) for this mesh")
         self._record(plan)
 
-        grad_shards, _ = fusion.fused_reduce_scatter(
-            gleaves, self.axis_name, mean=self.mean, plan=plan)
+        grad_shards, wire = compression_mod.compressed_reduce_scatter(
+            gleaves, self.axis_name, self.codec, plan=plan,
+            state=state.wire, mean=self.mean)
         idx = lax.axis_index(self.axis_name)
         param_shards = [plan.shard_slice(b, flat, idx)
                         for b, flat in enumerate(
                             plan.concat(jax.tree_util.tree_leaves(params)))]
         upd_shards, new_inner = self.inner.update(
             grad_shards, state.inner, param_shards)
-        upd_leaves = fusion.fused_all_gather(upd_shards, plan,
-                                             self.axis_name)
+        upd_leaves, wire = compression_mod.compressed_all_gather(
+            upd_shards, plan, self.axis_name, self.codec, state=wire)
         updates = jax.tree_util.tree_unflatten(state.treedef, upd_leaves)
         return updates, ZeroShardedState(new_inner, plan, state.treedef,
-                                         self.inner)
+                                         self.inner, wire=wire,
+                                         codec=self.codec)
 
     def _record(self, plan: fusion.ReduceScatterPlan) -> None:
         if not telemetry.enabled():
@@ -223,7 +240,9 @@ class ShardedOptimizer:
             state.inner,
             transform_non_params=lambda _leaf: P())
         return ZeroShardedState(specs, state.plan, state.treedef,
-                                self.inner)
+                                self.inner,
+                                wire=self.codec.state_specs(state.plan, ax),
+                                codec=self.codec)
 
     def state_shardings(self, mesh, state: ZeroShardedState):
         """``NamedSharding`` tree for ``jax.device_put``-placing a freshly
@@ -239,15 +258,20 @@ def sharded_optimizer(optimizer: optax.GradientTransformation,
                       axis_size: Optional[int] = None,
                       mesh=None,
                       threshold: Optional[int] = None,
-                      mean: bool = True) -> ShardedOptimizer:
+                      mean: bool = True,
+                      compression=None) -> ShardedOptimizer:
     """Wrap an element-wise optax ``optimizer`` for ZeRO-1 sharded updates
     over ``axis_name`` (see the module docstring for the algorithm and
     restrictions).  ``axis_size`` (or ``mesh``) pins the shard count at
-    init time; omitted, it is read from ``hvd.mesh()``."""
+    init time; omitted, it is read from ``hvd.mesh()``.  ``compression``
+    selects the wire codec applied per bucket inside the reduce-scatter /
+    all-gather pair (:mod:`horovod_tpu.ops.compression`; default none,
+    overridable via ``HOROVOD_COMPRESSION``)."""
     if mesh is not None and axis_size is None:
         axis_size = int(mesh.shape[axis_name])
     return ShardedOptimizer(optimizer, axis_name, axis_size=axis_size,
-                            threshold=threshold, mean=mean)
+                            threshold=threshold, mean=mean,
+                            compression=compression)
 
 
 # ---------------------------------------------------------------------------
@@ -263,6 +287,12 @@ def gather_full_state(state: ZeroShardedState):
     Reads the state leaves as GLOBAL arrays (a ``P(axis)``-sharded leaf's
     global shape is the full flat bucket), so on a fully-addressable mesh
     no explicit collective is needed.
+
+    Wire-codec residual state (``state.wire``) is deliberately EXCLUDED:
+    checkpoints stay byte-identical with and without compression, and a
+    restore simply starts with zero residuals (error feedback loses at
+    most one pending step of correction).  Elastic axis-size changes go
+    through :func:`reshard_state`, which DOES carry the pending error.
     """
     plan, treedef = state.plan, state.treedef
 
@@ -309,7 +339,8 @@ def scatter_full_state(full_state, like: ZeroShardedState
         return plan.concat(jax.tree_util.tree_leaves(per_leaf_subtree))
 
     new_inner = _map_param_subtrees(like.optimizer, collapse, full_state)
-    return ZeroShardedState(new_inner, plan, like.treedef, like.optimizer)
+    return ZeroShardedState(new_inner, plan, like.treedef, like.optimizer,
+                            wire=like.wire, codec=like.codec)
 
 
 def reshard_state(state: ZeroShardedState, like: ZeroShardedState
@@ -322,9 +353,22 @@ def reshard_state(state: ZeroShardedState, like: ZeroShardedState
     (the element-wise moments are only re-arranged, never recomputed).
     ``like`` is the freshly ``init``-ed state on the new mesh; place the
     result with :meth:`ShardedOptimizer.state_shardings` before
-    training."""
+    training.
+
+    Wire-codec residual state rides along codec-aware: the pending
+    error-feedback correction is re-bucketed for the new axis size
+    (:meth:`horovod_tpu.ops.compression.BucketCodec.reshard_state`) so a
+    shrink/grow does not silently drop the error a quantizing codec still
+    owes the model."""
     if telemetry.enabled():
         telemetry.counter(
             "hvd_zero_reshards_total",
             "ZeRO-1 states re-bucketed for a different axis size").inc()
-    return scatter_full_state(gather_full_state(state), like=like)
+    out = scatter_full_state(gather_full_state(state), like=like)
+    codec = like.codec if like.codec is not None else state.codec
+    if codec is not None and codec.stateful and state.wire is not None:
+        out = ZeroShardedState(
+            out.inner, out.plan, out.treedef, out.optimizer,
+            wire=codec.reshard_state(state.wire, state.plan, like.plan),
+            codec=codec)
+    return out

@@ -559,3 +559,55 @@ def test_chaos_fleet_preempt_storm_resumes(tmp_path):
     assert "FLEET_RESUME job=solo" in res.stdout, out
     assert "FLEET_OK job=solo" in res.stdout, out
     assert "blacklisting host" not in res.stderr, out
+
+
+def test_chaos_residual_drop_training_tolerates(monkeypatch):
+    """residual_drop at site=compression zeroes a rank's error-feedback
+    residual state mid-training; the step guard/sentinel contract is that
+    training degrades gracefully — every subsequent loss stays finite and
+    the trajectory still improves (EF loses at most the pending step of
+    correction, like a fresh restore)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu import faults
+
+    monkeypatch.setenv(
+        "HOROVOD_FAULT_SPEC",
+        "rank=*,site=compression,kind=residual_drop,after=3")
+    monkeypatch.setenv("HOROVOD_STEP_GUARD", "skip")
+    faults.reset()
+    try:
+        hvd.init()
+        mesh = hvd.mesh()
+
+        def loss_fn(p, batch):
+            x, y = batch
+            return jnp.mean((jnp.tanh(x @ p["w"]) - y) ** 2)
+
+        def batch(i, n=16):
+            x = jax.random.normal(jax.random.PRNGKey(100 + i), (n, 12))
+            y = jax.random.normal(jax.random.PRNGKey(200 + i), (n, 3))
+            return x, y
+
+        params = {"w": jax.random.normal(jax.random.PRNGKey(0),
+                                         (12, 3)) * 0.3}
+        step = hvd.make_training_step(loss_fn, optax.adam(5e-2), mesh,
+                                      compression="int8")
+        state = step.init(params)
+        losses = []
+        for i in range(8):
+            params, state, loss = step(params, state, batch(0))
+            losses.append(float(loss))
+        assert all(np.isfinite(l) for l in losses), losses
+        assert losses[-1] < losses[0], losses
+        # the rule really fired (exactly once: residual_drop defaults
+        # to count=1)
+        (rule,) = faults.load()
+        assert rule._fired == 1
+    finally:
+        faults.reset()
+        hvd.shutdown()

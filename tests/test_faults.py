@@ -182,3 +182,35 @@ def test_fleet_kinds_never_fire_at_inject(monkeypatch):
     faults.inject("allreduce")       # must not raise / fire
     faults.inject("fleet")
     assert faults.fleet_chaos() != []   # the dedicated hook still works
+
+
+# -- residual_drop (site=compression; fires at drop_residual) ---------------
+
+def test_parse_residual_drop_defaults_and_shorthand():
+    (r,) = faults.parse_spec("site=compression,kind=residual_drop")
+    assert r.kind == "residual_drop" and r.count == 1
+    (r,) = faults.parse_spec("site=compression,kind=residual_drop:3")
+    assert r.count == 3
+    with pytest.raises(faults.FaultSpecError, match="residual_drop"):
+        faults.parse_spec("kind=residual_drop:0")
+
+
+def test_drop_residual_hook(monkeypatch):
+    monkeypatch.setenv(
+        faults.ENV_VAR, "site=compression,kind=residual_drop,after=2")
+    faults.reset()
+    assert faults.drop_residual() is False
+    assert faults.drop_residual() is False
+    assert faults.drop_residual() is True     # fires on the third step
+    assert faults.drop_residual() is False    # default count=1: once only
+
+
+def test_drop_residual_skipped_by_inject(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "site=compression,kind=residual_drop")
+    faults.reset()
+    faults.inject("compression")              # plane kinds never fire here
+    assert faults.drop_residual() is True
+
+
+def test_drop_residual_noop_without_spec():
+    assert faults.drop_residual() is False
