@@ -69,6 +69,34 @@ JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
 python tools/check_metrics.py "$METRICS_DIR/metrics.json" 2
 rm -rf "$METRICS_DIR"
 
+echo "--- online-autotune gate (2 ranks): Bayesian explorer pins, the
+--- drift detector re-opens after a 128x payload shift, the cache hit
+--- ratio climbs, and the merged summary carries the hvd_autotune_*
+--- tuned-config gauges (docs/performance.md, 'Adaptive control plane')"
+AUTOTUNE_DIR="$(mktemp -d)"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  HOROVOD_METRICS_FILE="$AUTOTUNE_DIR/metrics.json" \
+  HOROVOD_AUTOTUNE_WARMUP_SAMPLES=1 HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE=3 \
+  HOROVOD_AUTOTUNE_SAMPLES=3 HOROVOD_AUTOTUNE_BAYES_TRIALS=10 \
+  python -m horovod_tpu.runner -np 2 \
+  --autotune --autotune-log-file "$AUTOTUNE_DIR/autotune.csv" \
+  python tests/distributed/autotune_workload_np2.py
+python tools/check_metrics.py "$AUTOTUNE_DIR/metrics.json" 2
+grep -q ",reopen$" "$AUTOTUNE_DIR/autotune.csv"
+python - "$AUTOTUNE_DIR/metrics.json" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+for rank in ("0", "1"):
+    metrics = doc["ranks"][rank]["metrics"]
+    for gauge in ("hvd_autotune_cycle_time_ms",
+                  "hvd_autotune_fusion_threshold_bytes",
+                  "hvd_autotune_chunk_bytes",
+                  "hvd_autotune_cache_hit_ratio"):
+        assert metrics.get(gauge, {}).get("values"), (rank, gauge)
+print("AUTOTUNE_METRICS_OK")
+EOF
+rm -rf "$AUTOTUNE_DIR"
+
 echo "--- ZeRO-1 gate (2 ranks x 8-device virtual mesh): sharded-update
 --- trajectory == replicated, 1/8 per-rank state, merged telemetry shows
 --- hvd_fusion_* + hvd_zero_* (docs/performance.md)"

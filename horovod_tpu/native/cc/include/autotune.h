@@ -79,12 +79,17 @@ class BayesianOptimizer {
 };
 
 // Values broadcast from the coordinator inside every ResponseList while
-// autotuning (and once more to pin the final best).
+// autotuning (and on every cycle thereafter: the post-pin monitor keeps
+// attaching the pinned block so a drift-triggered re-tune can start
+// proposing again without any protocol change).
 struct TunedParams {
   bool present = false;        // wire: block attached
   bool tuning = false;         // autotune still exploring
   double cycle_time_ms = 1.0;
   int64_t fusion_threshold = 64 * 1024 * 1024;
+  // Eager-transport sub-chunk size (data_plane.cc pipelined ring); 0 =
+  // chunking disabled, exchanges stay monolithic.
+  int64_t chunk_bytes = 0;
   bool cache_enabled = true;
   // Hierarchical routing as categorical dimensions (reference
   // parameter_manager.h:133-246 tunes the same booleans); explored only
@@ -96,7 +101,10 @@ struct TunedParams {
 };
 
 // Coordinator-side tuner: warmup -> samples of bytes/usec -> median score
-// per trial -> Bayesian proposal -> converge and pin best.
+// per trial -> Bayesian proposal -> converge and pin best -> MONITOR: keep
+// sampling the pinned configuration and re-open exploration when the
+// observed bandwidth drifts out of band (workload shift, topology change,
+// noisy-neighbor onset).  Tuning is online, not one-shot.
 class ParameterManager {
  public:
   // Seeds the search at the configured defaults; active iff
@@ -106,15 +114,23 @@ class ParameterManager {
   //   HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE  busy cycles per sample (10)
   //   HOROVOD_AUTOTUNE_SAMPLES           samples per trial, median (5)
   //   HOROVOD_AUTOTUNE_BAYES_TRIALS      max trials before pinning (20)
+  //   HOROVOD_AUTOTUNE_DRIFT_RATIO       drift band, see Update() (0.5)
+  //   HOROVOD_AUTOTUNE_DRIFT_WINDOWS     consecutive out-of-band
+  //                                      windows to re-open tuning (2)
   // hier_*_state: the bootstrap-agreed initial routing; hier_available:
   // every rank verified the same homogeneous block mapping, making the
   // two hierarchical booleans explorable (otherwise they are pinned at
-  // their bootstrap state, like cache with capacity 0).
+  // their bootstrap state, like cache with capacity 0).  chunk_bytes:
+  // the configured eager sub-chunk size; 0 = chunking disabled AND not
+  // explored (the dimension only exists when the feature is on).
   void Initialize(int rank, double cycle_ms, int64_t fusion_bytes,
                   bool cache_enabled, bool hier_allreduce = false,
-                  bool hier_allgather = false, bool hier_available = false);
+                  bool hier_allgather = false, bool hier_available = false,
+                  int64_t chunk_bytes = 0);
 
   bool active() const { return active_; }
+  bool monitoring() const { return monitoring_; }
+  int reopens() const { return reopens_; }
 
   // Coordinator, once per cycle: `bytes` = payload the cycle's responses
   // moved (0 = idle cycle, not scored).  Returns true when the current
@@ -125,9 +141,11 @@ class ParameterManager {
 
  private:
   bool Tune(double median_score);
+  bool Monitor(double median_score);
   void ApplyPoint(const std::vector<double>& x);
   std::vector<double> CurrentPoint() const;
-  void LogTrial(double score, bool pinned);
+  int Dims() const;
+  void LogTrial(double score, bool pinned, const char* phase);
 
   bool active_ = false;
   int rank_ = 0;
@@ -135,8 +153,10 @@ class ParameterManager {
   // Current (or pinned-best) values.
   double cycle_time_ms_ = 1.0;
   int64_t fusion_threshold_ = 64 * 1024 * 1024;
+  int64_t chunk_bytes_ = 0;
   bool cache_enabled_ = true;
   bool cache_available_ = true;  // false: cache capacity 0, don't explore
+  bool chunk_available_ = false; // false: chunking off, don't explore
   bool hier_ar_ = false;
   bool hier_ag_ = false;
   bool hier_available_ = false;  // false: topology can't go 2-level
@@ -153,6 +173,19 @@ class ParameterManager {
   int trials_ = 0;
   int no_improve_streak_ = 0;
   double best_seen_ = -1e300;
+
+  // Post-pin drift detector.  The baseline is NOT the pinned best_score
+  // (a noisy maximum) but the first steady-state median observed after
+  // the pin — self-calibrating against optimizer optimism.  A window is
+  // "drifted" when its median leaves [ratio * baseline, baseline / ratio];
+  // DRIFT_WINDOWS consecutive drifted windows re-open exploration with a
+  // fresh surrogate (old observations describe the old workload).
+  bool monitoring_ = false;
+  double baseline_score_ = 0.0;   // 0 = unset, first monitor window sets it
+  double drift_ratio_ = 0.5;
+  int drift_windows_needed_ = 2;
+  int drifted_windows_ = 0;
+  int reopens_ = 0;
 
   BayesianOptimizer optimizer_{5};
   std::ofstream log_;

@@ -13,6 +13,9 @@
 #ifndef HVD_DATA_PLANE_H
 #define HVD_DATA_PLANE_H
 
+#include <atomic>
+#include <cstdint>
+#include <functional>
 #include <memory>
 #include <vector>
 
@@ -69,6 +72,20 @@ class DataPlane {
     hier_ag_enabled_ = allgather;
   }
 
+  // Pipelined-transport sub-chunk size (HOROVOD_EAGER_CHUNK_BYTES /
+  // autotuned TunedParams.chunk_bytes).  Oversized ring exchanges are
+  // reduced in chunk-sized granules AS BYTES ARRIVE instead of after the
+  // whole monolithic transfer — the reduce runs on cache-warm data while
+  // the kernel socket buffers keep the wire busy.  0 disables (monolithic
+  // exchange + one trailing reduce pass).  Like SetHierarchicalEnabled,
+  // only flipped at agreed response-stream positions; chunking is a
+  // local streaming decision (the wire byte stream is identical either
+  // way), so even a transiently mixed value cannot desynchronize peers.
+  void SetChunkBytes(int64_t chunk_bytes) {
+    chunk_bytes_ = chunk_bytes > 0 ? chunk_bytes : 0;
+  }
+  int64_t chunk_bytes() const { return chunk_bytes_; }
+
   // In-place ring allreduce over buf (count elements).  Dispatches to the
   // hierarchical path (intra-host reduce-scatter -> cross-host allreduce
   // per chunk -> intra-host allgather) when SetTopology enabled it and
@@ -117,11 +134,30 @@ class DataPlane {
   // large payloads).  Public for the cc-local Adasum butterfly helper;
   // not a general-purpose API.  Pass self_rank() for the direction that
   // is not used (its buffer may be null with 0 bytes).
+  // `on_recv` (may be empty): invoked from the poll loop after each recv
+  // drain with the total bytes received so far — the hook the pipelined
+  // ring uses to reduce completed sub-chunks while the exchange is still
+  // in flight.  It runs on the calling thread between socket drains, so
+  // it must be brief relative to the kernel buffer drain time.
   Status SendRecv(int send_peer, const void* sbuf, size_t sbytes,
-                  int recv_peer, void* rbuf, size_t rbytes);
+                  int recv_peer, void* rbuf, size_t rbytes,
+                  const std::function<void(size_t)>& on_recv = nullptr);
   int self_rank() const { return rank_; }
 
  private:
+  // Persistent ring scratch, grown monotonically and reused across
+  // collectives (background thread only).  A fresh std::vector per call
+  // paid a zero-fill pass plus cold-page faults on every multi-MB
+  // exchange; reuse keeps the pages warm (~6x cheaper per 64 MB,
+  // measured) and the capacity is bounded by the largest ring chunk
+  // seen (payload / group size).
+  char* EnsureScratch(size_t n) {
+    if (n > scratch_cap_) {
+      scratch_.reset(new char[n]);
+      scratch_cap_ = n;
+    }
+    return scratch_.get();
+  }
 
   // The two halves of the ring (chunk layout = ChunkOffsets(count, n)):
   // after the reduce-scatter phase, member at position p holds the full
@@ -144,8 +180,13 @@ class DataPlane {
   bool hier_enabled_ = false;
   bool hier_ag_enabled_ = false;
   int64_t hier_threshold_ = 0;
+  // Atomic: the background thread flips it from TunedParams while a
+  // framework thread may read it through hvd_tuned_chunk_bytes().
+  std::atomic<int64_t> chunk_bytes_{0};
   TcpSocket listener_;
   std::vector<std::unique_ptr<TcpSocket>> peers_;  // [size], self = null
+  std::unique_ptr<char[]> scratch_;
+  size_t scratch_cap_ = 0;
 };
 
 // Typed reduction: acc[i] op= val[i].  Exposed for the fusion layer.

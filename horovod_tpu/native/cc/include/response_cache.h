@@ -54,6 +54,13 @@ class ResponseCache {
   // (FIFO eviction — deterministic across ranks).
   void Put(const Request& params, const Response& resp);
 
+  // Drop every entry (capacity stays).  Called at a deterministic
+  // response-stream position — process-set registration, elastic world
+  // reshape — so the replicas stay identical: a stale fast path must not
+  // survive a membership change (a hit bit indexed against slots the
+  // other side rebuilt differently would desynchronize every rank).
+  void Clear();
+
   static void SetBit(std::vector<uint64_t>* bits, int64_t slot);
 
   size_t size() const { return by_name_.size(); }

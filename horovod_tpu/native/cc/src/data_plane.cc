@@ -295,10 +295,12 @@ void DataPlane::Shutdown() {
 // side can deadlock on TCP buffers (the role cuda streams + NCCL play in
 // reference nccl_operations.cc — here it's just careful socket plumbing).
 Status DataPlane::SendRecv(int send_peer, const void* sbuf, size_t sbytes,
-                           int recv_peer, void* rbuf, size_t rbytes) {
+                           int recv_peer, void* rbuf, size_t rbytes,
+                           const std::function<void(size_t)>& on_recv) {
   if (send_peer == rank_ && recv_peer == rank_) {
     if (rbytes != sbytes) return Status::Unknown("self sendrecv size mismatch");
     std::memcpy(rbuf, sbuf, sbytes);
+    if (on_recv) on_recv(rbytes);
     return Status::OK();
   }
   TcpSocket* ssock = send_peer == rank_ ? nullptr : peers_[send_peer].get();
@@ -347,6 +349,7 @@ Status DataPlane::SendRecv(int send_peer, const void* sbuf, size_t sbytes,
       }
     }
     if (ri >= 0 && (fds[ri].revents & (POLLIN | POLLERR | POLLHUP))) {
+      size_t before = rleft;
       while (rleft > 0) {
         ssize_t r = ::recv(rsock->fd(), rp, rleft, MSG_DONTWAIT);
         if (r > 0) {
@@ -359,6 +362,10 @@ Status DataPlane::SendRecv(int send_peer, const void* sbuf, size_t sbytes,
         if (errno == EINTR) continue;
         return Status::Unknown(std::string("recv: ") + std::strerror(errno));
       }
+      // Progress hook AFTER the drain (not per recv syscall): the
+      // pipelined ring reduces completed sub-chunks here while the
+      // kernel buffers keep both directions moving.
+      if (on_recv && rleft < before) on_recv(rbytes - rleft);
     }
   }
   return Status::OK();
@@ -453,21 +460,55 @@ Status DataPlane::RingReduceScatterPhase(const std::vector<int32_t>& group,
   int64_t max_chunk = 0;
   for (int i = 0; i < c.v.size; ++i)
     max_chunk = std::max(max_chunk, c.off[i + 1] - c.off[i]);
-  std::vector<char> scratch(static_cast<size_t>(max_chunk) * c.esz);
+  char* scratch = EnsureScratch(static_cast<size_t>(max_chunk) * c.esz);
 
   // Ring reduce-scatter: after size-1 steps, chunk (pos+1)%size holds the
-  // full reduction on this member.  The reduce stays OUTSIDE the
-  // exchange: folding it into the recv drain was measured slower — the
-  // single-threaded drain stops feeding the send direction while it
-  // reduces, stalling the stream for longer than the saved memory pass.
+  // full reduction on this member.
+  //
+  // Small exchanges keep the reduce OUTSIDE the exchange: folding it into
+  // the recv drain per-syscall was measured slower — the single-threaded
+  // drain stops feeding the send direction while it reduces, stalling the
+  // stream for longer than the saved memory pass.  Oversized exchanges
+  // invert that trade: a monolithic recv-then-reduce touches the whole
+  // ring chunk COLD (tens of MB, far past LLC), and the wire sits idle
+  // for the entire trailing reduce pass — the measured 0.8 -> 0.2 GB/s
+  // cliff at 64 MB.  The pipelined path reduces CHUNK-sized granules from
+  // the progress hook as they complete: each granule is still cache-warm
+  // from the recv, and the kernel socket buffers keep both directions
+  // streaming during the (short) per-granule reduce.
+  const int64_t chunk = chunk_bytes_.load(std::memory_order_relaxed);
   for (int s = 0; s < c.v.size - 1; ++s) {
     int send_c = (c.v.me - s + c.v.size) % c.v.size;
     int recv_c = (c.v.me - s - 1 + c.v.size) % c.v.size;
-    Status st = SendRecv(c.right, c.ptr_of(send_c), c.bytes_of(send_c),
-                         c.left, scratch.data(), c.bytes_of(recv_c));
-    if (!st.ok()) return st;
-    ReduceInto(c.ptr_of(recv_c), scratch.data(),
-               c.off[recv_c + 1] - c.off[recv_c], dtype, op);
+    const int64_t elems = c.off[recv_c + 1] - c.off[recv_c];
+    Status st;
+    if (chunk > 0 && c.bytes_of(recv_c) > static_cast<size_t>(chunk) &&
+        c.esz > 0) {
+      const int64_t step_elems =
+          std::max<int64_t>(chunk / static_cast<int64_t>(c.esz), 1);
+      int64_t reduced = 0;  // elements already folded into ptr_of(recv_c)
+      auto on_recv = [&](size_t done_bytes) {
+        int64_t avail = static_cast<int64_t>(done_bytes / c.esz);
+        while (avail - reduced >= step_elems) {
+          ReduceInto(c.ptr_of(recv_c) + static_cast<size_t>(reduced) * c.esz,
+                     scratch + static_cast<size_t>(reduced) * c.esz,
+                     step_elems, dtype, op);
+          reduced += step_elems;
+        }
+      };
+      st = SendRecv(c.right, c.ptr_of(send_c), c.bytes_of(send_c),
+                    c.left, scratch, c.bytes_of(recv_c), on_recv);
+      if (!st.ok()) return st;
+      if (reduced < elems)  // tail granule (and the self-memcpy path)
+        ReduceInto(c.ptr_of(recv_c) + static_cast<size_t>(reduced) * c.esz,
+                   scratch + static_cast<size_t>(reduced) * c.esz,
+                   elems - reduced, dtype, op);
+    } else {
+      st = SendRecv(c.right, c.ptr_of(send_c), c.bytes_of(send_c),
+                    c.left, scratch, c.bytes_of(recv_c));
+      if (!st.ok()) return st;
+      ReduceInto(c.ptr_of(recv_c), scratch, elems, dtype, op);
+    }
   }
   return Status::OK();
 }
@@ -802,11 +843,25 @@ Status DataPlane::Broadcast(void* buf, int64_t count, DataType dtype,
   if (v.size == 1) return Status::OK();
   const size_t nbytes = static_cast<size_t>(count) * DataTypeSize(dtype);
   if (rank_ == root) {
-    for (int p = 0; p < v.size; ++p) {
-      int r = v.global_of(p);
-      if (r == rank_) continue;
-      Status st = peers_[r]->SendAll(buf, nbytes);
-      if (!st.ok()) return st;
+    // Oversized fan-out interleaves chunk-sized slices ACROSS peers:
+    // while the root writes peer p+1's slice, peer p's slice is already
+    // draining out of its kernel socket buffer, instead of every later
+    // peer idling until the full monolithic send to its predecessors
+    // completes.  The per-peer byte stream is unchanged (in-order
+    // slices), so receivers stay a single RecvAll.
+    const int64_t chunk = chunk_bytes_.load(std::memory_order_relaxed);
+    const size_t step = chunk > 0 && static_cast<size_t>(chunk) < nbytes
+                            ? static_cast<size_t>(chunk)
+                            : nbytes;
+    const char* base = static_cast<const char*>(buf);
+    for (size_t off = 0; off < nbytes; off += step) {
+      const size_t n = std::min(step, nbytes - off);
+      for (int p = 0; p < v.size; ++p) {
+        int r = v.global_of(p);
+        if (r == rank_) continue;
+        Status st = peers_[r]->SendAll(base + off, n);
+        if (!st.ok()) return st;
+      }
     }
     return Status::OK();
   }

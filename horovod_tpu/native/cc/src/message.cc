@@ -125,6 +125,7 @@ std::string ResponseList::Serialize() const {
     PutPod<uint8_t>(&buf, params.tuning ? 1 : 0);
     PutPod<double>(&buf, params.cycle_time_ms);
     PutPod<int64_t>(&buf, params.fusion_threshold);
+    PutPod<int64_t>(&buf, params.chunk_bytes);
     PutPod<uint8_t>(&buf, params.cache_enabled ? 1 : 0);
     PutPod<uint8_t>(&buf, params.hier_allreduce ? 1 : 0);
     PutPod<uint8_t>(&buf, params.hier_allgather ? 1 : 0);
@@ -165,7 +166,8 @@ Status ResponseList::Parse(const std::string& buf, ResponseList* out) {
   if (out->params.present) {
     uint8_t tuning, cache, har, hag;
     if (!rd.GetPod(&tuning) || !rd.GetPod(&out->params.cycle_time_ms) ||
-        !rd.GetPod(&out->params.fusion_threshold) || !rd.GetPod(&cache) ||
+        !rd.GetPod(&out->params.fusion_threshold) ||
+        !rd.GetPod(&out->params.chunk_bytes) || !rd.GetPod(&cache) ||
         !rd.GetPod(&har) || !rd.GetPod(&hag))
       return Malformed("params body");
     out->params.tuning = tuning != 0;

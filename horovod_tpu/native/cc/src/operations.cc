@@ -68,9 +68,22 @@ struct GlobalState {
   ResponseCache cache;
   ParameterManager param_manager;
   bool autotune = false;       // attach TunedParams to every ResponseList
-  bool cache_enabled = true;   // autotune-gated (flips in lock-step)
+  // Autotune-gated, flips in lock-step; atomic because hvd_cache_enabled
+  // reads it from framework threads.
+  std::atomic<bool> cache_enabled{true};
   std::vector<char> fusion_buffer;
   double cycle_time_ms = 1.0;
+
+  // Live-config mirrors + cache counters for the C introspection API
+  // (hvd_tuned_* / hvd_cache_*): written by the background thread at the
+  // same response-stream positions the values take effect, read by
+  // framework threads (telemetry gauges, stall reports).
+  std::atomic<double> tuned_cycle_ms{1.0};
+  std::atomic<int64_t> tuned_fusion_bytes{64 * 1024 * 1024};
+  std::atomic<int64_t> tuned_chunk_bytes{0};
+  std::atomic<bool> autotune_exploring{false};
+  std::atomic<uint64_t> cache_lookups{0};
+  std::atomic<uint64_t> cache_hit_count{0};
 
   // Wakes the background loop the moment work arrives, instead of letting
   // a fresh enqueue wait out the remainder of the cycle sleep — cuts
@@ -173,6 +186,18 @@ void ParticipateJoined(const Response& resp) {
 int64_t ExecuteResponse(const Response& resp) {
   auto entries = g->queue.TakeEntries(resp);
   for (auto& e : entries) g->timeline.NegotiateEnd(e->name);
+  // Seed large outputs from the warm-buffer pool before the per-op
+  // resize_uninit: recycled pages skip the kernel zero-page fault that
+  // dominates fresh multi-MB allocations (tensor_queue.h).  Input size
+  // is a good proxy for output size on every op but allgather/alltoall,
+  // where it is a lower bound — still warm for the common equal-shape
+  // case.
+  for (auto& e : entries) {
+    const size_t want =
+        static_cast<size_t>(e->count) * DataTypeSize(e->dtype);
+    if (want >= (1 << 20) && e->output.capacity() < want)
+      e->output = g->queue.AcquireBuffer(want);
+  }
   if (entries.empty()) {
     // Joined zero-participation applies only to the GLOBAL set; a
     // non-member of a subset collective simply skips it (it holds no
@@ -427,6 +452,13 @@ int64_t ExecuteResponse(const Response& resp) {
     case OpType::kProcessSet: {
       // Install the registry entry lock-step (same response stream
       // position on every rank) and hand the id back as an int32.
+      // Membership changed — invalidate the steady-state fast path at
+      // this same deterministic stream position on every rank: cached
+      // responses negotiated under the old membership must not be
+      // announced as hit bits afterwards.  (Elastic world-size changes
+      // invalidate for free: a restart builds a fresh GlobalState and an
+      // empty cache.)
+      g->cache.Clear();
       auto& e = entries[0];
       std::vector<int32_t> members;
       for (auto v : resp.first_dims)
@@ -581,14 +613,26 @@ void BackgroundThread() {
   g->timeline.Initialize(EnvStr("HOROVOD_TIMELINE"), g->rank);
   g->cycle_time_ms = EnvDouble("HOROVOD_CYCLE_TIME", 1.0);
   g->cache_enabled = g->cache.enabled();
+  // Pipelined eager transport: sub-chunk size for oversized ring
+  // exchanges (data_plane.cc).  On by default — the monolithic path is
+  // the measured 64 MB cliff; 0 restores it.  1 MiB won the loopback
+  // sweep (256 KB..4 MiB); the autotuner can move it per deployment.
+  const int64_t chunk_bytes =
+      EnvInt("HOROVOD_EAGER_CHUNK_BYTES", 1024 * 1024);
+  g->data_plane.SetChunkBytes(chunk_bytes);
+  g->tuned_cycle_ms.store(g->cycle_time_ms);
+  g->tuned_fusion_bytes.store(g->controller.fusion_threshold());
+  g->tuned_chunk_bytes.store(g->data_plane.chunk_bytes());
   g->autotune = EnvBool("HOROVOD_AUTOTUNE", false);
+  g->autotune_exploring.store(g->autotune);
   if (g->autotune)
     g->param_manager.Initialize(g->rank, g->cycle_time_ms,
                                 g->controller.fusion_threshold(),
                                 g->cache_enabled,
                                 g->hierarchical_enabled,
                                 g->hierarchical_allgather_enabled,
-                                g->hierarchical_available);
+                                g->hierarchical_available,
+                                g->data_plane.chunk_bytes());
 
   if (s.ok()) g->initialized.store(true);  // before the init_cv handshake:
   // the caller may enqueue the moment hvd_init returns.
@@ -618,10 +662,14 @@ void BackgroundThread() {
       // proves OUR dims are unchanged, and the coordinator recovers them
       // from the cached response's first_dims (see ResponseCache::Expand).
       int64_t slot = g->cache_enabled ? g->cache.Lookup(r) : -1;
-      if (slot >= 0)
+      if (g->cache_enabled)
+        g->cache_lookups.fetch_add(1, std::memory_order_relaxed);
+      if (slot >= 0) {
+        g->cache_hit_count.fetch_add(1, std::memory_order_relaxed);
         ResponseCache::SetBit(&mine.cache_hits, slot);
-      else
+      } else {
         mine.requests.push_back(std::move(r));
+      }
     }
     mine.shutdown = g->shutting_down.load();
 
@@ -643,6 +691,7 @@ void BackgroundThread() {
       g->cycle_time_ms = responses.params.cycle_time_ms;
       g->controller.set_fusion_threshold(responses.params.fusion_threshold);
       g->cache_enabled = responses.params.cache_enabled;
+      g->data_plane.SetChunkBytes(responses.params.chunk_bytes);
       // The tuner only proposes hierarchical=true on an agreed-available
       // topology; applying here (before this list executes) keeps the
       // routing flip at the same response-stream position on every rank.
@@ -653,6 +702,11 @@ void BackgroundThread() {
         g->hierarchical_enabled = responses.params.hier_allreduce;
         g->hierarchical_allgather_enabled = responses.params.hier_allgather;
       }
+      // Mirror for the C introspection API (stall reports, telemetry).
+      g->tuned_cycle_ms.store(responses.params.cycle_time_ms);
+      g->tuned_fusion_bytes.store(responses.params.fusion_threshold);
+      g->tuned_chunk_bytes.store(responses.params.chunk_bytes);
+      g->autotune_exploring.store(responses.params.tuning);
     }
     // The verdict list arrives unfused (per-name) so ExecuteResponse can
     // refresh the cache; fuse locally with the master's own walk.
@@ -660,13 +714,11 @@ void BackgroundThread() {
     int64_t cycle_bytes = 0;
     for (const auto& resp : responses.responses)
       cycle_bytes += ExecuteResponse(resp);
-    if (g->autotune && g->rank == 0) {
-      g->param_manager.Update(cycle_bytes);
-      if (tuned.present && !tuned.tuning)
-        // The pinned-best params just rode this cycle's list ("once more
-        // to pin"); stop attaching from here on.
-        g->autotune = false;
-    }
+    // Online autotuning: Update keeps scoring after the pin (the manager
+    // switches to drift monitoring and re-opens exploration on a workload
+    // shift), so the TunedParams block keeps riding every list — no
+    // one-shot cutoff.
+    if (g->autotune && g->rank == 0) g->param_manager.Update(cycle_bytes);
     shutdown_seen = responses.shutdown;
 
     if (!shutdown_seen) {
@@ -768,6 +820,30 @@ int hvd_hierarchical_allgather_enabled() {
   return g && g->hierarchical_allgather_enabled ? 1 : 0;
 }
 int hvd_is_initialized() { return g && g->initialized.load() ? 1 : 0; }
+
+double hvd_tuned_cycle_time_ms() {
+  return g ? g->tuned_cycle_ms.load() : 0.0;
+}
+int64_t hvd_tuned_fusion_threshold() {
+  return g ? g->tuned_fusion_bytes.load() : -1;
+}
+int64_t hvd_tuned_chunk_bytes() {
+  return g ? g->tuned_chunk_bytes.load() : -1;
+}
+int hvd_autotune_exploring() {
+  return g && g->autotune_exploring.load() ? 1 : 0;
+}
+int hvd_cache_enabled() { return g && g->cache_enabled ? 1 : 0; }
+int64_t hvd_cache_lookups() {
+  return g ? static_cast<int64_t>(
+                 g->cache_lookups.load(std::memory_order_relaxed))
+           : 0;
+}
+int64_t hvd_cache_hits() {
+  return g ? static_cast<int64_t>(
+                 g->cache_hit_count.load(std::memory_order_relaxed))
+           : 0;
+}
 
 int64_t hvd_enqueue(int op_type, const char* name, const void* data,
                     const int64_t* shape, int32_t ndim, int dtype, int arg,
@@ -875,6 +951,13 @@ int hvd_read_output(int64_t handle, void* dst, int64_t count) {
   std::memcpy(dst, e->output.data(), nbytes);
   g->queue.Release(handle);
   return 0;
+}
+
+const void* hvd_output_ptr(int64_t handle) {
+  if (g == nullptr) return nullptr;
+  auto e = g->queue.Get(handle);
+  if (!e || !e->done || !e->status.ok()) return nullptr;
+  return e->output.data();
 }
 
 void hvd_release(int64_t handle) {

@@ -18,17 +18,27 @@ namespace hvd {
 namespace {
 constexpr double kCycleMinMs = 0.1, kCycleMaxMs = 20.0;
 constexpr double kFusionMinMb = 1.0, kFusionMaxMb = 64.0;
+// Eager sub-chunk search range (log-scale, like cycle time): small enough
+// to keep the reduce working set cache-warm, large enough to amortize the
+// per-chunk poll round trip.
+constexpr double kChunkMinKb = 256.0, kChunkMaxKb = 32768.0;
 }  // namespace
+
+int ParameterManager::Dims() const {
+  return 3 + (chunk_available_ ? 1 : 0) + (hier_available_ ? 2 : 0);
+}
 
 void ParameterManager::Initialize(int rank, double cycle_ms,
                                   int64_t fusion_bytes, bool cache_enabled,
                                   bool hier_allreduce, bool hier_allgather,
-                                  bool hier_available) {
+                                  bool hier_available, int64_t chunk_bytes) {
   rank_ = rank;
   cycle_time_ms_ = cycle_ms;
   fusion_threshold_ = fusion_bytes;
   cache_enabled_ = cache_enabled;
   cache_available_ = cache_enabled;  // capacity 0: never explore cache=on
+  chunk_bytes_ = chunk_bytes;
+  chunk_available_ = chunk_bytes > 0;  // chunking off: never explore it
   hier_ar_ = hier_allreduce;
   hier_ag_ = hier_allgather;
   hier_available_ = hier_available;
@@ -38,8 +48,8 @@ void ParameterManager::Initialize(int rank, double cycle_ms,
   // topology that cannot go 2-level the hierarchical coordinates would
   // be dead dimensions — identical real configs observed as distinct
   // points whose score differences are pure noise, degrading the
-  // surrogate for the three live knobs.
-  optimizer_ = BayesianOptimizer(hier_available_ ? 5 : 3);
+  // surrogate for the live knobs.  Same for chunking when disabled.
+  optimizer_ = BayesianOptimizer(Dims());
 
   warmup_remaining_ =
       static_cast<int>(EnvInt("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 3));
@@ -47,6 +57,10 @@ void ParameterManager::Initialize(int rank, double cycle_ms,
       static_cast<int>(EnvInt("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", 10));
   samples_per_trial_ = static_cast<int>(EnvInt("HOROVOD_AUTOTUNE_SAMPLES", 5));
   max_trials_ = static_cast<int>(EnvInt("HOROVOD_AUTOTUNE_BAYES_TRIALS", 20));
+  drift_ratio_ = EnvDouble("HOROVOD_AUTOTUNE_DRIFT_RATIO", 0.5);
+  if (drift_ratio_ <= 0.0 || drift_ratio_ >= 1.0) drift_ratio_ = 0.5;
+  drift_windows_needed_ =
+      static_cast<int>(EnvInt("HOROVOD_AUTOTUNE_DRIFT_WINDOWS", 2));
   sample_start_ = std::chrono::steady_clock::now();
 
   if (rank_ == 0) {
@@ -55,19 +69,20 @@ void ParameterManager::Initialize(int rank, double cycle_ms,
       log_.open(path, std::ios::trunc);
       log_ << "trial,cycle_time_ms,fusion_threshold_mb,cache_enabled,"
               "hier_allreduce,hier_allgather,"
-              "score_bytes_per_usec,best_score,pinned\n";
+              "score_bytes_per_usec,best_score,pinned,chunk_kb,phase\n";
       log_.flush();
     }
     LOG(Info) << "Autotuner: enabled (warmup " << warmup_remaining_
               << " samples, " << samples_per_trial_ << " samples/trial, "
-              << max_trials_ << " trials max)";
+              << max_trials_ << " trials max, drift band ["
+              << drift_ratio_ << "x, " << (1.0 / drift_ratio_) << "x])";
   }
 }
 
 std::vector<double> ParameterManager::CurrentPoint() const {
-  // Unit-box encoding: x0 = log-cycle, x1 = fusion MB, x2 = cache, and —
-  // only when the topology can go 2-level — x3/x4 = hierarchical
-  // allreduce/allgather (categorical, rounded).
+  // Unit-box encoding: x0 = log-cycle, x1 = fusion MB, x2 = cache, then —
+  // only when the feature is live — the log-chunk coordinate, then the
+  // hierarchical allreduce/allgather booleans (categorical, rounded).
   double x0 = (std::log(cycle_time_ms_) - std::log(kCycleMinMs)) /
               (std::log(kCycleMaxMs) - std::log(kCycleMinMs));
   double x1 = (static_cast<double>(fusion_threshold_) / (1024 * 1024) -
@@ -76,6 +91,13 @@ std::vector<double> ParameterManager::CurrentPoint() const {
   std::vector<double> x = {std::min(std::max(x0, 0.0), 1.0),
                            std::min(std::max(x1, 0.0), 1.0),
                            cache_enabled_ ? 1.0 : 0.0};
+  if (chunk_available_) {
+    double kb = static_cast<double>(chunk_bytes_) / 1024.0;
+    kb = std::min(std::max(kb, kChunkMinKb), kChunkMaxKb);
+    double xc = (std::log(kb) - std::log(kChunkMinKb)) /
+                (std::log(kChunkMaxKb) - std::log(kChunkMinKb));
+    x.push_back(std::min(std::max(xc, 0.0), 1.0));
+  }
   if (hier_available_) {
     x.push_back(hier_ar_ ? 1.0 : 0.0);
     x.push_back(hier_ag_ ? 1.0 : 0.0);
@@ -90,17 +112,26 @@ void ParameterManager::ApplyPoint(const std::vector<double>& x) {
   double mb = kFusionMinMb + x[1] * (kFusionMaxMb - kFusionMinMb);
   fusion_threshold_ = static_cast<int64_t>(mb * 1024 * 1024);
   cache_enabled_ = cache_available_ && x[2] >= 0.5;
+  size_t i = 3;
+  if (chunk_available_ && x.size() > i) {
+    double kb = std::exp(std::log(kChunkMinKb) +
+                         x[i] * (std::log(kChunkMaxKb) -
+                                 std::log(kChunkMinKb)));
+    chunk_bytes_ = static_cast<int64_t>(kb * 1024.0);
+    ++i;
+  }
   // The hierarchical coordinates exist only on a 2-level-capable
   // topology (see Initialize); otherwise the booleans stay pinned at
   // their bootstrap state.
-  if (hier_available_ && x.size() >= 5) {
-    hier_ar_ = x[3] >= 0.5;
-    hier_ag_ = x[4] >= 0.5;
+  if (hier_available_ && x.size() > i + 1) {
+    hier_ar_ = x[i] >= 0.5;
+    hier_ag_ = x[i + 1] >= 0.5;
   }
 }
 
 bool ParameterManager::Update(int64_t bytes) {
-  if (!active_ || bytes <= 0) return false;  // idle cycles are not scored
+  if ((!active_ && !monitoring_) || bytes <= 0)
+    return false;  // idle cycles are not scored
   auto now = std::chrono::steady_clock::now();
   if (steps_in_sample_ == 0)
     // A sample's clock starts at its first busy cycle: idle gaps BETWEEN
@@ -131,7 +162,7 @@ bool ParameterManager::Update(int64_t bytes) {
   std::sort(scores_.begin(), scores_.end());
   double median = scores_[scores_.size() / 2];
   scores_.clear();
-  return Tune(median);
+  return monitoring_ ? Monitor(median) : Tune(median);
 }
 
 bool ParameterManager::Tune(double median_score) {
@@ -149,19 +180,26 @@ bool ParameterManager::Tune(double median_score) {
   // The trial row records the configuration that was just SCORED; the
   // pinned row must record the configuration the runtime will RUN, so it
   // is logged only after ApplyPoint(best_x) below.
-  LogTrial(median_score, false);
+  LogTrial(median_score, false, "explore");
 
   if (pin) {
     ApplyPoint(optimizer_.best_x());
-    LogTrial(optimizer_.best_score(), true);
+    LogTrial(optimizer_.best_score(), true, "pinned");
+    // Not a dead stop any more: keep scoring the pinned configuration and
+    // let Monitor() re-open exploration when the workload drifts.
     active_ = false;
+    monitoring_ = true;
+    baseline_score_ = 0.0;  // first steady-state window calibrates it
+    drifted_windows_ = 0;
     LOG(Info) << "Autotuner: converged after " << trials_
               << " trials; pinned cycle_time_ms=" << cycle_time_ms_
               << " fusion_threshold=" << fusion_threshold_
+              << " chunk_bytes=" << chunk_bytes_
               << " cache=" << (cache_enabled_ ? 1 : 0)
               << " hier_allreduce=" << (hier_ar_ ? 1 : 0)
               << " hier_allgather=" << (hier_ag_ ? 1 : 0)
-              << " (best " << optimizer_.best_score() << " bytes/usec)";
+              << " (best " << optimizer_.best_score()
+              << " bytes/usec); monitoring for drift";
     if (log_.is_open()) log_.flush();
     return true;
   }
@@ -170,13 +208,51 @@ bool ParameterManager::Tune(double median_score) {
   return true;
 }
 
-void ParameterManager::LogTrial(double score, bool pinned) {
+bool ParameterManager::Monitor(double median_score) {
+  if (baseline_score_ <= 0.0) {
+    baseline_score_ = median_score;
+    return false;
+  }
+  const bool drifted = median_score < baseline_score_ * drift_ratio_ ||
+                       median_score > baseline_score_ / drift_ratio_;
+  if (!drifted) {
+    drifted_windows_ = 0;
+    // Slow EMA tracks benign slow drift so the band re-centers instead of
+    // eventually tripping on accumulated harmless change.
+    baseline_score_ = 0.9 * baseline_score_ + 0.1 * median_score;
+    return false;
+  }
+  if (++drifted_windows_ < drift_windows_needed_) return false;
+
+  // Sustained drift: the pinned configuration was tuned for a workload
+  // that no longer exists.  Re-open exploration with a fresh surrogate —
+  // the old observations describe the old workload.
+  LogTrial(median_score, false, "reopen");
+  optimizer_ = BayesianOptimizer(Dims());
+  trials_ = 0;
+  no_improve_streak_ = 0;
+  best_seen_ = -1e300;
+  warmup_remaining_ = 1;  // one discarded sample to flush the transition
+  monitoring_ = false;
+  active_ = true;
+  drifted_windows_ = 0;
+  ++reopens_;
+  LOG(Info) << "Autotuner: drift detected (window " << median_score
+            << " bytes/usec vs baseline " << baseline_score_
+            << "); re-opening exploration (reopen #" << reopens_ << ")";
+  return false;
+}
+
+void ParameterManager::LogTrial(double score, bool pinned,
+                                const char* phase) {
   if (!log_.is_open()) return;
   log_ << trials_ << "," << cycle_time_ms_ << ","
        << (static_cast<double>(fusion_threshold_) / (1024 * 1024)) << ","
        << (cache_enabled_ ? 1 : 0) << "," << (hier_ar_ ? 1 : 0) << ","
        << (hier_ag_ ? 1 : 0) << "," << score << ","
-       << optimizer_.best_score() << "," << (pinned ? 1 : 0) << "\n";
+       << optimizer_.best_score() << "," << (pinned ? 1 : 0) << ","
+       << (static_cast<double>(chunk_bytes_) / 1024.0) << ","
+       << phase << "\n";
   log_.flush();
 }
 
@@ -186,6 +262,7 @@ TunedParams ParameterManager::Current() const {
   p.tuning = active_;
   p.cycle_time_ms = cycle_time_ms_;
   p.fusion_threshold = fusion_threshold_;
+  p.chunk_bytes = chunk_bytes_;
   p.cache_enabled = cache_enabled_;
   p.hier_allreduce = hier_ar_;
   p.hier_allgather = hier_ag_;

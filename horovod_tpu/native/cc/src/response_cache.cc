@@ -106,6 +106,12 @@ void ResponseCache::Put(const Request& params, const Response& resp) {
   fifo_.push_back(slot);
 }
 
+void ResponseCache::Clear() {
+  slots_.assign(slots_.size(), Slot{});
+  fifo_.clear();
+  by_name_.clear();
+}
+
 void ResponseCache::SetBit(std::vector<uint64_t>* bits, int64_t slot) {
   size_t word = static_cast<size_t>(slot) / 64;
   if (bits->size() <= word) bits->resize(word + 1, 0);

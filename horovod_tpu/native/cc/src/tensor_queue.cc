@@ -127,8 +127,39 @@ void TensorQueue::Release(int64_t handle) {
     auto nit = by_name_.find(it->second->name);
     if (nit != by_name_.end() && nit->second == it->second)
       by_name_.erase(nit);
+    // Park a large output buffer for reuse instead of freeing it: the
+    // next collective's resize_uninit + memcpy then writes warm pages.
+    // When the pool is full, displace the smallest parked buffer — a
+    // mixed-size workload must not let small buffers squat in the pool
+    // while the large ones (whose cold-page cost dominates) churn.
+    RawBuffer& buf = it->second->output;
+    if (buf.capacity() >= kPoolMinBytes) {
+      if (pool_.size() < kPoolMax) {
+        pool_.push_back(std::move(buf));
+      } else {
+        size_t mi = 0;
+        for (size_t i = 1; i < pool_.size(); ++i)
+          if (pool_[i].capacity() < pool_[mi].capacity()) mi = i;
+        if (pool_[mi].capacity() < buf.capacity())
+          pool_[mi] = std::move(buf);
+      }
+    }
     by_handle_.erase(it);
   }
+}
+
+RawBuffer TensorQueue::AcquireBuffer(size_t min_bytes) {
+  std::lock_guard<std::mutex> lk(mu_);
+  // LIFO, first fit: the most recently parked buffer has the warmest
+  // pages, and pool_ is at most kPoolMax entries.
+  for (size_t i = pool_.size(); i-- > 0;) {
+    if (pool_[i].capacity() >= min_bytes) {
+      RawBuffer out = std::move(pool_[i]);
+      pool_.erase(pool_.begin() + static_cast<ptrdiff_t>(i));
+      return out;
+    }
+  }
+  return RawBuffer{};
 }
 
 size_t TensorQueue::NumPending() {

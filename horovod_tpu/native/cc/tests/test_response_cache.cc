@@ -1,0 +1,94 @@
+// Response-cache invalidation oracle (ci/run_tests.sh via `make unittest`,
+// gated by tests/test_response_cache.py).
+//
+// The property under test is the determinism contract in response_cache.h:
+// the cached fast path must NOT survive a membership change.  Clear() is
+// called at the kProcessSet response-stream position (operations.cc), and
+// an elastic world reshape rebuilds GlobalState with a fresh cache; either
+// way a stale hit bit indexed against slots the coordinator rebuilt
+// differently would desynchronize every rank.  Here the slot-level
+// semantics are pinned: hits before Clear, misses after, slots reusable
+// after, and FIFO eviction intact across the boundary.
+
+#include <cassert>
+#include <cstdio>
+
+#include "response_cache.h"
+
+using hvd::OpType;
+using hvd::Request;
+using hvd::Response;
+using hvd::ResponseCache;
+
+namespace {
+
+Request MakeReq(const std::string& name, int64_t dim0) {
+  Request r;
+  r.rank = 0;
+  r.op_type = OpType::kAllreduce;
+  r.name = name;
+  r.shape = {dim0, 4};
+  return r;
+}
+
+Response MakeResp(const std::string& name) {
+  Response resp;
+  resp.op_type = OpType::kAllreduce;
+  resp.names = {name};
+  resp.cacheable = true;
+  return resp;
+}
+
+}  // namespace
+
+int main() {
+  ResponseCache cache;
+  cache.Initialize(4);
+  assert(cache.enabled());
+
+  // Steady state: put + same-params lookup hits.
+  for (int i = 0; i < 3; ++i) {
+    const std::string name = "g." + std::to_string(i);
+    cache.Put(MakeReq(name, 8), MakeResp(name));
+  }
+  assert(cache.size() == 3);
+  const int64_t slot_g1 = cache.Lookup(MakeReq("g.1", 8));
+  assert(slot_g1 >= 0);
+  // Changed params on the same name: no stale hit.
+  assert(cache.Lookup(MakeReq("g.1", 16)) == -1);
+
+  // Membership change: every entry must die at once.
+  cache.Clear();
+  assert(cache.size() == 0);
+  assert(cache.Lookup(MakeReq("g.1", 8)) == -1);
+
+  // The cleared cache must be fully reusable: slots refill and a
+  // re-announced name can land on a DIFFERENT slot than before the
+  // clear — which is exactly why a pre-clear hit bit may not be
+  // trusted after the boundary.
+  cache.Put(MakeReq("h.0", 8), MakeResp("h.0"));
+  cache.Put(MakeReq("h.1", 8), MakeResp("h.1"));
+  cache.Put(MakeReq("g.1", 8), MakeResp("g.1"));
+  const int64_t slot_g1_after = cache.Lookup(MakeReq("g.1", 8));
+  assert(slot_g1_after >= 0);
+  assert(slot_g1_after != slot_g1);   // name re-slotted post-clear
+  assert(cache.size() == 3);
+
+  // FIFO eviction still deterministic after the clear: fill to capacity,
+  // add one more, and the OLDEST post-clear entry ("h.0") is the victim.
+  cache.Put(MakeReq("h.3", 8), MakeResp("h.3"));
+  assert(cache.size() == 4);
+  cache.Put(MakeReq("h.4", 8), MakeResp("h.4"));
+  assert(cache.size() == 4);
+  assert(cache.Lookup(MakeReq("h.0", 8)) == -1);     // evicted
+  assert(cache.Lookup(MakeReq("g.1", 8)) >= 0);      // survivor
+  assert(cache.Lookup(MakeReq("h.4", 8)) >= 0);      // newcomer
+
+  // Clear is idempotent and safe on an already-empty cache.
+  cache.Clear();
+  cache.Clear();
+  assert(cache.size() == 0);
+
+  std::printf("RESPONSE CACHE GATE OK\n");
+  return 0;
+}

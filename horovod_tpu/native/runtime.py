@@ -14,6 +14,7 @@ import ctypes
 import os
 import threading
 import time
+import weakref
 from typing import Optional
 
 import numpy as np
@@ -121,6 +122,12 @@ class Runtime:
         self._op_warn = _env_float("HOROVOD_EAGER_OP_WARN_SECONDS", 60.0)
         self._watchdog_stop: Optional[threading.Event] = None
         self._watchdog_thread: Optional[threading.Thread] = None
+        # Zero-copy result reads (HOROVOD_EAGER_ZERO_COPY=0 restores the
+        # copying hvd_read_output path): the returned ndarray wraps the
+        # native output buffer directly and releases it when garbage
+        # collected.  Skips one full-payload copy into cold pages per op.
+        self._zero_copy = os.environ.get(
+            "HOROVOD_EAGER_ZERO_COPY", "1") not in ("0", "false", "")
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -165,6 +172,30 @@ class Runtime:
         self._hier_fn = getattr(lib, "hvd_hierarchical_enabled", None)
         self._hier_ag_fn = getattr(
             lib, "hvd_hierarchical_allgather_enabled", None)
+        # Optional symbols (getattr: tolerate a stale prebuilt library).
+        self._output_ptr_fn = getattr(lib, "hvd_output_ptr", None)
+        if self._output_ptr_fn is not None:
+            self._output_ptr_fn.argtypes = [ctypes.c_longlong]
+            self._output_ptr_fn.restype = ctypes.c_void_p
+        # Adaptive-control-plane introspection (stall reports + telemetry).
+        self._tuned_cycle_fn = getattr(lib, "hvd_tuned_cycle_time_ms", None)
+        if self._tuned_cycle_fn is not None:
+            self._tuned_cycle_fn.restype = ctypes.c_double
+        self._tuned_fusion_fn = getattr(
+            lib, "hvd_tuned_fusion_threshold", None)
+        if self._tuned_fusion_fn is not None:
+            self._tuned_fusion_fn.restype = ctypes.c_longlong
+        self._tuned_chunk_fn = getattr(lib, "hvd_tuned_chunk_bytes", None)
+        if self._tuned_chunk_fn is not None:
+            self._tuned_chunk_fn.restype = ctypes.c_longlong
+        self._exploring_fn = getattr(lib, "hvd_autotune_exploring", None)
+        self._cache_enabled_fn = getattr(lib, "hvd_cache_enabled", None)
+        self._cache_lookups_fn = getattr(lib, "hvd_cache_lookups", None)
+        if self._cache_lookups_fn is not None:
+            self._cache_lookups_fn.restype = ctypes.c_longlong
+        self._cache_hits_fn = getattr(lib, "hvd_cache_hits", None)
+        if self._cache_hits_fn is not None:
+            self._cache_hits_fn.restype = ctypes.c_longlong
         port = int(os.environ.get("HOROVOD_RENDEZVOUS_PORT", "0"))
         rc = lib.hvd_init(self.rank, self.size, self.local_rank,
                           self.local_size, addr.encode(), port)
@@ -173,6 +204,10 @@ class Runtime:
                 f"native runtime init failed (rank {self.rank}): "
                 f"{lib.hvd_last_error().decode()}")
         self._lib = lib
+        # Feed the ops-layer bucketing the LIVE tuned fusion threshold
+        # (import here, not at module top: runtime is below the ops layer).
+        from horovod_tpu.ops import fusion as _fusion
+        _fusion.set_live_threshold_provider(self._live_fusion_threshold)
         if self._op_warn:
             self._watchdog_stop = threading.Event()
             self._watchdog_thread = threading.Thread(
@@ -187,8 +222,19 @@ class Runtime:
             self._watchdog_stop = None
             self._watchdog_thread = None
         if self._lib is not None:
+            # Final gauge snapshot BEFORE shutdown zeroes the native state,
+            # so the metrics summary records the config the job ended on.
+            self._publish_autotune_gauges()
+            from horovod_tpu.ops import fusion as _fusion
+            _fusion.set_live_threshold_provider(None)
             self._lib.hvd_shutdown()
             self._lib = None
+
+    def _live_fusion_threshold(self) -> Optional[int]:
+        if self._lib is None or self._tuned_fusion_fn is None:
+            return None
+        v = int(self._tuned_fusion_fn())
+        return v if v > 0 else None
 
     def hierarchical_enabled(self) -> bool:
         """True when the bootstrap agreement enabled the 2-level
@@ -199,6 +245,60 @@ class Runtime:
         """True when the bootstrap agreement enabled the 2-level
         allgather (HOROVOD_HIERARCHICAL_ALLGATHER)."""
         return bool(self._hier_ag_fn and self._hier_ag_fn())
+
+    # -- adaptive-control-plane introspection ------------------------------
+
+    def tuned_config(self) -> dict:
+        """The live control-plane configuration: the latest TunedParams
+        applied from the response stream (env-configured defaults when
+        autotuning is off), plus the response-cache counters.  Empty dict
+        when the runtime is stopped or the library predates the
+        introspection exports."""
+        if self._lib is None or self._tuned_cycle_fn is None:
+            return {}
+        lookups = int(self._cache_lookups_fn())  \
+            if self._cache_lookups_fn is not None else 0
+        hits = int(self._cache_hits_fn())  \
+            if self._cache_hits_fn is not None else 0
+        return {
+            "cycle_time_ms": float(self._tuned_cycle_fn()),
+            "fusion_threshold_bytes": int(self._tuned_fusion_fn())
+            if self._tuned_fusion_fn is not None else -1,
+            "chunk_bytes": int(self._tuned_chunk_fn())
+            if self._tuned_chunk_fn is not None else -1,
+            "exploring": bool(self._exploring_fn())
+            if self._exploring_fn is not None else False,
+            "cache_enabled": bool(self._cache_enabled_fn())
+            if self._cache_enabled_fn is not None else False,
+            "cache_lookups": lookups,
+            "cache_hits": hits,
+            "cache_hit_ratio": (hits / lookups) if lookups else 0.0,
+        }
+
+    def _publish_autotune_gauges(self) -> None:
+        """Mirror the tuned config into telemetry gauges (merged into the
+        hvdrun --metrics-file summary; docs/metrics.md)."""
+        if not telemetry.enabled():
+            return
+        cfg = self.tuned_config()
+        if not cfg:
+            return
+        telemetry.gauge(
+            "hvd_autotune_cycle_time_ms",
+            "Active coordination cycle time (latest TunedParams)",
+        ).set(cfg["cycle_time_ms"])
+        telemetry.gauge(
+            "hvd_autotune_fusion_threshold_bytes",
+            "Active fusion threshold (latest TunedParams)",
+        ).set(float(cfg["fusion_threshold_bytes"]))
+        telemetry.gauge(
+            "hvd_autotune_chunk_bytes",
+            "Active pipelined-transport chunk size (0 = monolithic)",
+        ).set(float(cfg["chunk_bytes"]))
+        telemetry.gauge(
+            "hvd_autotune_cache_hit_ratio",
+            "Response-cache hit ratio for this rank's announcements",
+        ).set(cfg["cache_hit_ratio"])
 
     # -- collectives -------------------------------------------------------
 
@@ -246,6 +346,19 @@ class Runtime:
         op and its completion never arrived, so the suspects are exactly
         the peers whose readiness the coordinator is still missing."""
         suspects = [r for r in range(self.size) if r != self.rank]
+        # Name the control-plane config the op ran under: a stall that
+        # appears right after the autotuner moved the cycle time or chunk
+        # size points at the tuner, and the report should say so.
+        cfg = self.tuned_config()
+        cfg_note = ""
+        if cfg:
+            cfg_note = (
+                f" Active control-plane config: cycle_time="
+                f"{cfg['cycle_time_ms']:.2f}ms, fusion_threshold="
+                f"{cfg['fusion_threshold_bytes']} bytes, chunk_bytes="
+                f"{cfg['chunk_bytes']}"
+                + (", autotuner exploring" if cfg["exploring"] else "")
+                + ".")
         return (
             f"Stalled eager op '{name}': submitted by rank {self.rank} "
             f"but not completed after {elapsed:.1f}s. One or more ranks "
@@ -254,7 +367,7 @@ class Runtime:
             f"coordinator's stall watchdog, HOROVOD_STALL_CHECK_TIME_"
             f"SECONDS, reports the authoritative list on rank 0). "
             f"Possible causes: a crashed or hung peer, a deadlocked "
-            f"submission order, or a network partition.")
+            f"submission order, or a network partition." + cfg_note)
 
     def _watchdog(self) -> None:
         """Background stall reporter for the default (no hard timeout)
@@ -264,6 +377,12 @@ class Runtime:
         warn = self._op_warn
         interval = min(warn, 5.0)
         while not self._watchdog_stop.wait(interval):
+            # Keep the autotune gauges fresh while the job runs — the
+            # watchdog is the one periodic thread the runtime already has.
+            try:
+                self._publish_autotune_gauges()
+            except Exception:   # never let telemetry kill the watchdog
+                pass
             now = time.monotonic()
             reports = []
             with self._inflight_lock:
@@ -378,13 +497,26 @@ class Runtime:
             # n_src = the source count (process-set size for subset ops).
             received = np.array(recv[:n_src], dtype=np.int64)
         n = self._lib.hvd_output_size(h)
-        out = np.empty(int(n), dtype=dtype)
-        rc = self._lib.hvd_read_output(
-            h, out.ctypes.data_as(ctypes.c_void_p), n)
-        if rc != 0:
-            err = self._lib.hvd_last_error().decode()
-            self._lib.hvd_release(h)
-            raise RuntimeError(err)
+        out = None
+        nbytes = int(n) * np.dtype(dtype).itemsize
+        if self._zero_copy and self._output_ptr_fn is not None and nbytes:
+            ptr = self._output_ptr_fn(h)
+            if ptr:
+                # Wrap the native buffer directly; the finalizer returns
+                # it to the warm pool when the LAST view dies (reshapes
+                # below keep `out` alive as their base).  hvd_release is
+                # null-state-safe, so a GC after shutdown is fine.
+                cbuf = (ctypes.c_byte * nbytes).from_address(ptr)
+                out = np.frombuffer(cbuf, dtype=dtype)
+                weakref.finalize(out, self._lib.hvd_release, h)
+        if out is None:
+            out = np.empty(int(n), dtype=dtype)
+            rc = self._lib.hvd_read_output(
+                h, out.ctypes.data_as(ctypes.c_void_p), n)
+            if rc != 0:
+                err = self._lib.hvd_last_error().decode()
+                self._lib.hvd_release(h)
+                raise RuntimeError(err)
         if trailing_shape:
             inner = int(np.prod(trailing_shape)) or 1
             out = out.reshape((int(n) // inner,) + tuple(trailing_shape))

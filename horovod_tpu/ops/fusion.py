@@ -61,6 +61,22 @@ _SIZE_SUFFIXES = {
 _warned_bad_threshold = False
 _warned_bad_cap = False
 
+# Live fusion-threshold provider (adaptive control plane): the native
+# runtime registers a callable returning the latest autotuned threshold
+# so bucketing follows the tuner ONLINE instead of freezing the env value
+# at import.  None (no provider, or provider returns None) falls back to
+# the HOROVOD_FUSION_THRESHOLD env / default path below.
+_live_threshold_provider = None
+
+
+def set_live_threshold_provider(provider) -> None:
+    """Register (or clear, with ``None``) the live-threshold source.
+
+    Called by ``native.runtime.Runtime`` on start/stop; anything else
+    supplying a dynamic threshold (tests, notebooks) may use it too."""
+    global _live_threshold_provider
+    _live_threshold_provider = provider
+
 
 def parse_size_bytes(value: str) -> Optional[int]:
     """``"64mb"`` / ``"32MiB"`` / ``"67108864"`` -> bytes, or None when the
@@ -76,11 +92,20 @@ def parse_size_bytes(value: str) -> Optional[int]:
 
 
 def fusion_threshold_bytes() -> int:
-    """The fusion bucket limit from ``HOROVOD_FUSION_THRESHOLD`` (bytes, or
-    with a ``kb``/``mb``/``MiB``-style binary suffix).  An unparseable value
-    falls back to the 64 MB default with a one-time warning — a typo in an
-    env var must not surface as a ``ValueError`` deep inside a jit trace."""
+    """The live fusion bucket limit: the autotuned value when a native
+    runtime registered a provider (set_live_threshold_provider), else
+    ``HOROVOD_FUSION_THRESHOLD`` (bytes, or with a ``kb``/``mb``/``MiB``-style
+    binary suffix).  An unparseable env value falls back to the 64 MB
+    default with a one-time warning — a typo in an env var must not
+    surface as a ``ValueError`` deep inside a jit trace."""
     global _warned_bad_threshold
+    if _live_threshold_provider is not None:
+        try:
+            live = _live_threshold_provider()
+        except Exception:
+            live = None   # a dying runtime must not break bucketing
+        if live is not None and live > 0:
+            return int(live)
     v = os.environ.get("HOROVOD_FUSION_THRESHOLD")
     if not v:
         return DEFAULT_FUSION_THRESHOLD

@@ -124,8 +124,20 @@ def build_parser() -> argparse.ArgumentParser:
     tune.add_argument("--fusion-threshold-mb", type=float, default=None)
     tune.add_argument("--cycle-time-ms", type=float, default=None)
     tune.add_argument("--cache-capacity", type=int, default=None)
-    tune.add_argument("--autotune", action="store_true", default=False)
-    tune.add_argument("--autotune-log-file", default=None)
+    tune.add_argument("--autotune", action="store_true", default=False,
+                      help="Online Bayesian autotuning of the control "
+                           "plane (cycle time, fusion threshold, transport "
+                           "chunk size, response cache): explores, pins "
+                           "the best config, then keeps monitoring and "
+                           "re-opens tuning when throughput drifts.  "
+                           "Progress lands in hvd_autotune_* gauges "
+                           "(--metrics-file) and the --autotune-log-file "
+                           "CSV; see docs/performance.md, 'Adaptive "
+                           "control plane'.")
+    tune.add_argument("--autotune-log-file", default=None,
+                      help="Per-trial CSV from the rank-0 tuner (one row "
+                           "per trial; phase column marks pinned/reopen "
+                           "transitions).")
 
     timeline = p.add_argument_group("timeline")
     timeline.add_argument("--timeline-filename", default=None)

@@ -1,0 +1,151 @@
+"""Online-autotuning gate workload (run: hvdrun -np 2 --autotune
+--autotune-log-file ... with HOROVOD_METRICS_FILE, see ci/run_tests.sh).
+
+Proves the tuner is no longer one-shot:
+
+1. steady phase — small repeated-name allreduces until the Bayesian
+   explorer pins a configuration (``tuned_config()["exploring"]`` goes
+   False on BOTH ranks via the piggybacked TunedParams), while the
+   response-cache hit ratio climbs;
+2. workload shift — the payload jumps 128x, the drift detector's
+   monitoring windows leave the pinned baseline band, and exploration
+   REOPENS (exploring flips back True, distinct configs are sampled
+   again, rank 0's CSV gains a ``reopen`` phase row);
+3. telemetry — after shutdown the hvd_autotune_* gauges carry the final
+   tuned configuration into the per-rank snapshot the at-exit exporter
+   ships to the launcher's merged summary.
+
+Run with the fast trial schedule (HOROVOD_AUTOTUNE_WARMUP_SAMPLES=1,
+_STEPS_PER_SAMPLE=3, _SAMPLES=3, _BAYES_TRIALS=10) so a full
+pin -> drift -> reopen arc fits in seconds; one monitoring window is
+then 9 busy cycles and reopen needs 2 consecutive drifted windows.
+"""
+import csv
+import os
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import basics, telemetry
+
+hvd.init()
+rank, size = hvd.rank(), hvd.size()
+assert size == 2, f"this workload expects -np 2, got size={size}"
+assert os.environ.get("HOROVOD_AUTOTUNE") == "1", \
+    "launch with --autotune (runner injects HOROVOD_AUTOTUNE=1)"
+
+rt = basics.runtime()
+cfg = rt.tuned_config()
+assert cfg and cfg["exploring"], f"tuner not exploring at start: {cfg}"
+
+NAMES = [f"steady.{i}" for i in range(8)]
+small = np.full(16 * 1024, 1.0, np.float32)        # 64 KiB
+
+
+def step(i, payload, prefix=None):
+    name = f"{prefix}.{i % 8}" if prefix else NAMES[i % 8]
+    out = hvd.allreduce(payload, average=False, name=name)
+    assert float(np.asarray(out)[0]) == float(size)
+
+
+def all_agree(local_flag):
+    """Loop-exit control: ranks apply the piggybacked TunedParams at
+    their own cycle tick, so a bare local poll of tuned_config() can
+    diverge by one step — and a divergent break means mismatched
+    collective streams (deadlock).  Reduce the local verdict so every
+    rank breaks at the SAME iteration."""
+    got = hvd.allreduce(np.array([1.0 if local_flag else 0.0], np.float32),
+                        average=False, name="ctl.agree")
+    return float(np.asarray(got)[0]) == float(size)
+
+
+# One pass over the names: every announcement is a cold miss, so this is
+# the hit-ratio floor the steady state must climb away from.
+for i in range(8):
+    step(i, small)
+early = rt.tuned_config()
+
+# Steady phase: drive until the explorer pins.  Fast schedule caps the
+# search at 10 trials x 9 busy cycles (+ warmup), so 600 steps is ample.
+pinned = False
+for i in range(600):
+    step(i, small)
+    cfg = rt.tuned_config()
+    if all_agree(not cfg["exploring"]):
+        pinned = True
+        break
+assert pinned, "tuner failed to pin within 600 steady steps"
+pinned_cfg = (round(cfg["cycle_time_ms"], 3),
+              cfg["fusion_threshold_bytes"], cfg["chunk_bytes"])
+
+# Steady-state coordination fast path: with 8 recurring names the cached
+# one-bit announcements dominate and the hit ratio climbs well clear of
+# the cold-start floor.
+late = rt.tuned_config()
+assert late["cache_hits"] > early["cache_hits"], (early, late)
+assert late["cache_hit_ratio"] > early["cache_hit_ratio"] + 0.1, \
+    (early["cache_hit_ratio"], late["cache_hit_ratio"])
+
+# Let the monitor calibrate its drift baseline on the SMALL-payload
+# steady state (first post-pin window sets it; one window = 9 cycles).
+for i in range(24):
+    step(i, small)
+
+# Workload shift: 128x the payload moves bytes/usec far outside the
+# [ratio*baseline, baseline/ratio] band; after 2 drifted windows the
+# tuner must re-open exploration.
+big = np.full(2 * 1024 * 1024, 1.0, np.float32)    # 8 MiB
+reopened = False
+for i in range(150):
+    step(i, big, prefix="shift")
+    if all_agree(rt.tuned_config()["exploring"]):
+        reopened = True
+        break
+assert reopened, "drift detector never re-opened exploration after shift"
+
+# Re-exploration must actually MOVE the knobs: sample until two distinct
+# configurations (or one differing from the pinned one) are observed.
+seen = set()
+moved = False
+for i in range(200):
+    step(i, big, prefix="shift")
+    c = rt.tuned_config()
+    seen.add((round(c["cycle_time_ms"], 3), c["fusion_threshold_bytes"],
+              c["chunk_bytes"]))
+    if all_agree(len(seen) >= 2 or pinned_cfg not in seen):
+        moved = True
+        break
+assert moved, \
+    f"re-exploration never left the pinned config {pinned_cfg}: {seen}"
+
+final_cfg = rt.tuned_config()
+hvd.shutdown()   # publishes the hvd_autotune_* gauges before export
+
+# Rank 0's tuner owns the CSV: the arc must be explore -> pinned ->
+# reopen -> explore (LogTrial flushes per row, so it is readable now).
+log_path = os.environ.get("HOROVOD_AUTOTUNE_LOG")
+if rank == 0:
+    assert log_path, "gate must be launched with --autotune-log-file"
+    with open(log_path) as f:
+        phases = [row["phase"] for row in csv.DictReader(f)]
+    assert "pinned" in phases, phases
+    assert "reopen" in phases, phases
+    assert phases.index("reopen") > phases.index("pinned"), phases
+    assert "explore" in phases[phases.index("reopen"):], \
+        f"no exploration after reopen: {phases}"
+
+# The merged --metrics-file summary gets these via the at-exit exporter;
+# assert locally that shutdown published them with sane values.
+snap = hvd.metrics_snapshot()
+for gauge in ("hvd_autotune_cycle_time_ms",
+              "hvd_autotune_fusion_threshold_bytes",
+              "hvd_autotune_chunk_bytes",
+              "hvd_autotune_cache_hit_ratio"):
+    values = snap.get(gauge, {}).get("values", [])
+    assert values, f"gauge {gauge} missing from snapshot"
+gauge_val = snap["hvd_autotune_cycle_time_ms"]["values"][0]["value"]
+assert gauge_val > 0, snap["hvd_autotune_cycle_time_ms"]
+
+print(f"AUTOTUNE_WORKLOAD_OK rank={rank} "
+      f"pinned={pinned_cfg} final={final_cfg['cycle_time_ms']:.2f}ms "
+      f"hit_ratio={final_cfg['cache_hit_ratio']:.3f}", flush=True)

@@ -77,7 +77,7 @@ def _worker():
         # amortized by the response cache after the first round).
         sizes_mb = [float(s) for s in
                     os.environ.get("BENCH_EAGER_SIZES_MB",
-                                   "1,4,16,64").split(",")]
+                                   "1,4,16,64,128,256").split(",")]
         rows = []
         for mb in sizes_mb:
             n = int(mb * (1 << 20) / 4)
@@ -91,6 +91,9 @@ def _worker():
                          "algbw_gbs": round(algbw, 3),
                          "busbw_gbs": round(algbw * ring, 3)})
         out["rows"] = rows
+        from horovod_tpu import basics
+        out["chunk_bytes"] = basics.runtime().tuned_config() \
+            .get("chunk_bytes", 0)
 
     elif mode == "fused":
         # Fusion-buffer workload: many small named tensors in flight at
@@ -149,6 +152,12 @@ def _worker():
                                                   "1.0")),
             "autotune": autotune,
         })
+        if autotune:
+            # Online-adaptation snapshot: the tuner is expected to be
+            # PINNED-and-monitoring here (exploring False), with the
+            # steady-state cache fast path carrying the announcements.
+            from horovod_tpu import basics
+            out["tuned"] = basics.runtime().tuned_config()
     else:
         raise SystemExit(f"unknown BENCH_EAGER_MODE={mode!r}")
 
@@ -205,7 +214,7 @@ def main():
                     help="small sizes / fewer configs (CI smoke)")
     args = ap.parse_args()
 
-    sizes = "1,4" if args.quick else "1,4,16,64"
+    sizes = "1,4" if args.quick else "1,4,16,64,128,256"
     autotune_log = os.path.join(tempfile.gettempdir(),
                                 f"bench_eager_autotune_{os.getpid()}.csv")
     # Reduced tuner schedule so convergence fits the settle loop:
@@ -222,6 +231,13 @@ def main():
     configs = [
         ("large_defaults", args.np,
          {"BENCH_EAGER_MODE": "large", "BENCH_EAGER_SIZES_MB": sizes}),
+        # Pipelined transport off: the pre-chunking data plane reduces
+        # each ring exchange only after the whole payload lands — the
+        # before/after pair for the >=64 MB bandwidth cliff.
+        ("large_no_chunk", args.np,
+         {"BENCH_EAGER_MODE": "large",
+          "BENCH_EAGER_SIZES_MB": "1,4" if args.quick else "16,64,128",
+          "HOROVOD_EAGER_CHUNK_BYTES": "0"}),
         ("fused_defaults", args.np, {"BENCH_EAGER_MODE": "fused"}),
         ("fused_no_fusion", args.np,
          {"BENCH_EAGER_MODE": "fused", "HOROVOD_FUSION_THRESHOLD": "0"}),
@@ -256,15 +272,19 @@ def main():
     # Attach the tuner's trial log (trial rows + the pinned row) so the
     # artifact shows WHAT the tuner chose, not just that it helped.
     pinned = None
+    phases = {}
     try:
         import csv
         with open(autotune_log) as f:
             for row in csv.DictReader(f):
+                phase = row.get("phase", "")
+                phases[phase] = phases.get(phase, 0) + 1
                 if row.get("pinned") == "1":
                     pinned = {
                         "cycle_time_ms": float(row["cycle_time_ms"]),
                         "fusion_threshold_mb":
                             float(row["fusion_threshold_mb"]),
+                        "chunk_kb": float(row.get("chunk_kb", 0) or 0),
                         "cache_enabled": row["cache_enabled"] == "1",
                         "hier_allreduce": row.get("hier_allreduce") == "1",
                         "hier_allgather": row.get("hier_allgather") == "1",
@@ -282,7 +302,15 @@ def main():
                     "both ranks and the kernel share the core: absolute "
                     "GB/s is environment-capped, read the RELATIVE "
                     "comparisons (fusion/cycle/autotune)"),
+           # The pre-pipelining artifact's 64 MB row (chunking, buffer
+           # pool and zero-copy read all absent): the cliff this sweep's
+           # large_defaults vs large_no_chunk pair tracks.
+           "pre_pipelining_64mb_algbw_gbs": 0.201,
            "autotune_pinned": pinned,
+           # trial-log phase counts: "explore" rows are live trials,
+           # "pinned" the convergence, "reopen" drift-triggered restarts
+           # (the tuner monitors forever; a steady bench stays at 0).
+           "autotune_phases": phases,
            "results": results}
     line = json.dumps(doc)
     print(line)
