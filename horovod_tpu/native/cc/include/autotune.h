@@ -180,8 +180,14 @@ class ParameterManager {
   // "drifted" when its median leaves [ratio * baseline, baseline / ratio];
   // DRIFT_WINDOWS consecutive drifted windows re-open exploration with a
   // fresh surrogate (old observations describe the old workload).
+  // In-band windows re-center the baseline with a slow EMA, but only
+  // within the anchor's own band: the anchor is the post-pin calibration
+  // score and bounds how far benign re-centering may walk — otherwise a
+  // gradual regression that stays in-band per-window (-20% repeatedly)
+  // would drag the baseline down forever and never re-open exploration.
   bool monitoring_ = false;
   double baseline_score_ = 0.0;   // 0 = unset, first monitor window sets it
+  double anchor_score_ = 0.0;     // post-pin calibration; EMA clamp anchor
   double drift_ratio_ = 0.5;
   int drift_windows_needed_ = 2;
   int drifted_windows_ = 0;

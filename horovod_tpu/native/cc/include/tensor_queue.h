@@ -145,6 +145,14 @@ class TensorQueue {
   RawBuffer AcquireBuffer(size_t min_bytes);
 
   // Handle API.
+  // Seed the handle counter (called once per hvd_init with the init
+  // epoch in the high bits).  Handles must be unique across ELASTIC
+  // RE-INITS, not just within one: a zero-copy result array from a
+  // previous init fires weakref.finalize(hvd_release, old_handle)
+  // whenever Python garbage-collects it, and hvd_release resolves
+  // against the CURRENT global state — a recycled id would release a
+  // live entry mid-flight (output buffer parked/reused under a waiter).
+  void SeedHandles(int64_t start);
   bool Poll(int64_t handle);
   // Blocks until done; returns entry (still owned by table until Release).
   Status Wait(int64_t handle, EntryPtr* out);

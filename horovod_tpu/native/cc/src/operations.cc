@@ -188,13 +188,22 @@ int64_t ExecuteResponse(const Response& resp) {
   for (auto& e : entries) g->timeline.NegotiateEnd(e->name);
   // Seed large outputs from the warm-buffer pool before the per-op
   // resize_uninit: recycled pages skip the kernel zero-page fault that
-  // dominates fresh multi-MB allocations (tensor_queue.h).  Input size
-  // is a good proxy for output size on every op but allgather/alltoall,
-  // where it is a lower bound — still warm for the common equal-shape
-  // case.
+  // dominates fresh multi-MB allocations (tensor_queue.h).  The size
+  // must be the REAL output size: an undersized warm buffer is taken
+  // out of the pool only to be freed by the subsequent resize_uninit —
+  // the pool drains with zero reuse benefit.  Input size is exact for
+  // allreduce/broadcast (and an upper bound for reducescatter);
+  // allgather concatenates over the group, so size it from the
+  // response's recorded per-position counts; alltoall's output depends
+  // on received splits not resolved until the exchange, so skip it.
   for (auto& e : entries) {
-    const size_t want =
-        static_cast<size_t>(e->count) * DataTypeSize(e->dtype);
+    size_t want = static_cast<size_t>(e->count) * DataTypeSize(e->dtype);
+    if (resp.op_type == OpType::kAlltoall) break;
+    if (resp.op_type == OpType::kAllgather) {
+      int64_t total_elems = 0;
+      for (auto d : resp.first_dims) total_elems += d;
+      want = static_cast<size_t>(total_elems) * DataTypeSize(e->dtype);
+    }
     if (want >= (1 << 20) && e->output.capacity() < want)
       e->output = g->queue.AcquireBuffer(want);
   }
@@ -773,6 +782,15 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
     // HorovodGlobalState).
   }
   g = new GlobalState();
+  // Handle ids carry the init epoch in their high bits so they are
+  // unique across elastic re-inits: stale zero-copy finalizers from a
+  // previous init (weakref.finalize -> hvd_release) resolve against the
+  // CURRENT state, and a fresh TensorQueue restarting at 0 would hand a
+  // live entry the same id — its release would park the output buffer
+  // mid-flight (silent corruption / stranded waiter).  2^40 handles per
+  // epoch and 2^23 epochs keep the id positive for any real job.
+  static int64_t init_epoch = 0;  // guarded by g_mu (like g itself)
+  g->queue.SeedHandles(++init_epoch << 40);
   g->rank = rank;
   g->size = size;
   g->local_rank = local_rank;

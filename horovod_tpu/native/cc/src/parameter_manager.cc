@@ -211,6 +211,7 @@ bool ParameterManager::Tune(double median_score) {
 bool ParameterManager::Monitor(double median_score) {
   if (baseline_score_ <= 0.0) {
     baseline_score_ = median_score;
+    anchor_score_ = median_score;
     return false;
   }
   const bool drifted = median_score < baseline_score_ * drift_ratio_ ||
@@ -218,8 +219,16 @@ bool ParameterManager::Monitor(double median_score) {
   if (!drifted) {
     drifted_windows_ = 0;
     // Slow EMA tracks benign slow drift so the band re-centers instead of
-    // eventually tripping on accumulated harmless change.
+    // eventually tripping on accumulated harmless change — but clamped to
+    // the post-pin calibration anchor's band.  Unbounded, a gradual
+    // regression staying in-band per window (e.g. -20% repeatedly) would
+    // walk the baseline down with it and NEVER re-open exploration; the
+    // clamp caps total benign re-centering at one band width, so
+    // cumulative degradation beyond ratio^2 of the anchor still trips.
     baseline_score_ = 0.9 * baseline_score_ + 0.1 * median_score;
+    baseline_score_ = std::min(
+        std::max(baseline_score_, anchor_score_ * drift_ratio_),
+        anchor_score_ / drift_ratio_);
     return false;
   }
   if (++drifted_windows_ < drift_windows_needed_) return false;

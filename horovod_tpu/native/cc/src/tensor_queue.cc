@@ -95,6 +95,11 @@ void TensorQueue::Close() {
   closed_ = true;
 }
 
+void TensorQueue::SeedHandles(int64_t start) {
+  std::lock_guard<std::mutex> lk(mu_);
+  next_handle_ = start;
+}
+
 bool TensorQueue::Poll(int64_t handle) {
   std::lock_guard<std::mutex> lk(mu_);
   auto it = by_handle_.find(handle);

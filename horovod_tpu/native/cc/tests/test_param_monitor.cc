@@ -1,0 +1,84 @@
+// Drift-monitor oracle (ci/run_tests.sh via `make unittest`, gated by
+// tests/test_autotune.py).
+//
+// The property under test is the ANCHORED baseline in
+// ParameterManager::Monitor(): in-band windows re-center the drift
+// baseline with a slow EMA, but only within the post-pin calibration
+// anchor's band.  Unbounded, a gradual throughput regression that stays
+// in-band per window (e.g. -5% repeatedly) walks the baseline down with
+// itself — the median/baseline ratio converges to the band edge from
+// above and exploration NEVER re-opens, no matter how much total
+// bandwidth is lost.  With the clamp, benign re-centering is capped at
+// one band width, so cumulative degradation beyond ratio^2 of the
+// anchor must still trip a re-tune.
+//
+// Determinism: with STEPS_PER_SAMPLE=1 a sample opens and closes at the
+// same steady_clock stamp, so its duration clamps to 1 usec and the
+// score equals the bytes fed to Update() exactly — no wall-clock noise.
+
+#include <cassert>
+#include <cstdio>
+#include <cstdlib>
+
+#include "autotune.h"
+
+using hvd::ParameterManager;
+
+namespace {
+
+ParameterManager MakePinned(int64_t steady_bytes) {
+  // Fast deterministic schedule: every Update() is one sample and one
+  // trial; 3 trials then pin.
+  setenv("HOROVOD_AUTOTUNE", "1", 1);
+  setenv("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "0", 1);
+  setenv("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", "1", 1);
+  setenv("HOROVOD_AUTOTUNE_SAMPLES", "1", 1);
+  setenv("HOROVOD_AUTOTUNE_BAYES_TRIALS", "3", 1);
+  setenv("HOROVOD_AUTOTUNE_DRIFT_RATIO", "0.5", 1);
+  setenv("HOROVOD_AUTOTUNE_DRIFT_WINDOWS", "2", 1);
+
+  ParameterManager pm;
+  pm.Initialize(/*rank=*/0, /*cycle_ms=*/1.0,
+                /*fusion_bytes=*/64 * 1024 * 1024, /*cache_enabled=*/true);
+  assert(pm.active());
+  for (int i = 0; i < 3; ++i) pm.Update(steady_bytes);
+  assert(!pm.active() && pm.monitoring());
+  pm.Update(steady_bytes);  // first monitor window calibrates the anchor
+  assert(pm.monitoring());
+  return pm;
+}
+
+}  // namespace
+
+int main() {
+  const int64_t kSteady = 1000000;
+
+  // Benign fluctuation: +/-8% around the anchor re-centers, never trips.
+  {
+    ParameterManager pm = MakePinned(kSteady);
+    for (int i = 0; i < 40; ++i)
+      pm.Update(i % 2 ? kSteady * 92 / 100 : kSteady * 108 / 100);
+    assert(pm.monitoring() && pm.reopens() == 0);
+  }
+
+  // Gradual regression: -5% per window stays inside the [0.5x, 2x] band
+  // relative to the walking baseline forever (the unclamped EMA's
+  // median/baseline ratio converges to 0.5 from above), but crosses the
+  // anchor-clamped floor once cumulative loss passes ratio^2 = 4x.
+  {
+    ParameterManager pm = MakePinned(kSteady);
+    double score = static_cast<double>(kSteady);
+    bool reopened = false;
+    for (int i = 0; i < 80 && !reopened; ++i) {
+      score *= 0.95;
+      pm.Update(static_cast<int64_t>(score));
+      reopened = pm.reopens() > 0;
+    }
+    assert(reopened &&
+           "gradual in-band regression must eventually re-open tuning");
+    assert(pm.active() && !pm.monitoring());
+  }
+
+  std::printf("PARAM MONITOR GATE OK\n");
+  return 0;
+}

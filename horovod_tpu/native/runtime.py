@@ -128,6 +128,14 @@ class Runtime:
         # collected.  Skips one full-payload copy into cold pages per op.
         self._zero_copy = os.environ.get(
             "HOROVOD_EAGER_ZERO_COPY", "1") not in ("0", "false", "")
+        # Rank-agreed autotuned fusion threshold, latched ONLY inside the
+        # sync_tuned_config() collective.  The raw hvd_tuned_* atomics
+        # move at each rank's own cycle tick; feeding them straight into
+        # trace-time bucketing would let two ranks bucket the same step
+        # with different thresholds and trace divergent fused programs
+        # (a hang).  None = never synced -> bucketing stays on the
+        # env/default path, which is rank-agreed by construction.
+        self._agreed_fusion_threshold: Optional[int] = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -204,8 +212,11 @@ class Runtime:
                 f"native runtime init failed (rank {self.rank}): "
                 f"{lib.hvd_last_error().decode()}")
         self._lib = lib
-        # Feed the ops-layer bucketing the LIVE tuned fusion threshold
-        # (import here, not at module top: runtime is below the ops layer).
+        # Feed the ops-layer bucketing the tuned fusion threshold.  The
+        # provider serves the sync_tuned_config()-latched value, never
+        # the raw atomic — see the rank-agreement contract in
+        # ops/fusion.py.  (Import here, not at module top: runtime is
+        # below the ops layer.)
         from horovod_tpu.ops import fusion as _fusion
         _fusion.set_live_threshold_provider(self._live_fusion_threshold)
         if self._op_warn:
@@ -231,10 +242,17 @@ class Runtime:
             self._lib = None
 
     def _live_fusion_threshold(self) -> Optional[int]:
-        if self._lib is None or self._tuned_fusion_fn is None:
+        """The threshold served to trace-time bucketing: the last value
+        latched by the sync_tuned_config() collective — i.e. a value
+        every rank agreed on at the same program point — or None (fall
+        back to the env path) before the first sync.  Deliberately NOT
+        the hvd_tuned_fusion_threshold atomic: ranks apply TunedParams
+        at unsynchronized wall-clock moments, so the raw value can
+        differ across ranks mid-trial and bucketing with it would trace
+        divergent fused programs."""
+        if self._lib is None:
             return None
-        v = int(self._tuned_fusion_fn())
-        return v if v > 0 else None
+        return self._agreed_fusion_threshold
 
     def hierarchical_enabled(self) -> bool:
         """True when the bootstrap agreement enabled the 2-level
@@ -274,6 +292,42 @@ class Runtime:
             "cache_hits": hits,
             "cache_hit_ratio": (hits / lookups) if lookups else 0.0,
         }
+
+    def sync_tuned_config(self) -> dict:
+        """Collectively agree on the tuned config and latch it for
+        trace-time consumers (the ops/fusion.py bucketer).
+
+        The native plane applies TunedParams at the same response-stream
+        position on every rank, but framework threads read the mirrors at
+        arbitrary wall-clock moments — mid-trial, two ranks can observe
+        different values.  A fused SPMD program bucketed under different
+        thresholds differs per rank, which hangs the job, so the Python
+        bucketer only ever follows the tuner through this COLLECTIVE: a
+        Min-allreduce over each rank's locally observed values whose
+        result is identical everywhere.  Must be called by ALL ranks at
+        the same program point (it is a native allreduce) — a natural
+        spot is between steps, next to checkpointing or eval.
+
+        Returns the agreed ``{"fusion_threshold_bytes", "chunk_bytes"}``
+        (empty when the runtime is stopped or the library predates the
+        introspection exports).  Non-positive agreed values (old library,
+        tuner off) leave the latch untouched.
+        """
+        cfg = self.tuned_config()
+        if not cfg:
+            return {}
+        local = np.array([cfg["fusion_threshold_bytes"],
+                          cfg["chunk_bytes"]], dtype=np.int64)
+        self._sync_seq = getattr(self, "_sync_seq", 0) + 1
+        # 3 = ReduceOp Min (ops/collective.py; hvd_common.h kMin) — any
+        # deterministic reduction works, consistency is the point.
+        agreed = np.asarray(self.allreduce(
+            f"hvd.autotune.sync.{self._sync_seq}", local, 3)).ravel()
+        fusion_bytes, chunk_bytes = int(agreed[0]), int(agreed[1])
+        if fusion_bytes > 0:
+            self._agreed_fusion_threshold = fusion_bytes
+        return {"fusion_threshold_bytes": fusion_bytes,
+                "chunk_bytes": chunk_bytes}
 
     def _publish_autotune_gauges(self) -> None:
         """Mirror the tuned config into telemetry gauges (merged into the
@@ -505,7 +559,11 @@ class Runtime:
                 # Wrap the native buffer directly; the finalizer returns
                 # it to the warm pool when the LAST view dies (reshapes
                 # below keep `out` alive as their base).  hvd_release is
-                # null-state-safe, so a GC after shutdown is fine.
+                # null-state-safe, so a GC after shutdown is fine; and
+                # handle ids carry an init epoch (tensor_queue
+                # SeedHandles), so a finalizer surviving an elastic
+                # re-init can never release a recycled id in the new
+                # runtime's table.
                 cbuf = (ctypes.c_byte * nbytes).from_address(ptr)
                 out = np.frombuffer(cbuf, dtype=dtype)
                 weakref.finalize(out, self._lib.hvd_release, h)

@@ -63,9 +63,19 @@ _warned_bad_cap = False
 
 # Live fusion-threshold provider (adaptive control plane): the native
 # runtime registers a callable returning the latest autotuned threshold
-# so bucketing follows the tuner ONLINE instead of freezing the env value
-# at import.  None (no provider, or provider returns None) falls back to
+# so bucketing follows the tuner instead of freezing the env value at
+# import.  None (no provider, or provider returns None) falls back to
 # the HOROVOD_FUSION_THRESHOLD env / default path below.
+#
+# CONTRACT: the provider must return a RANK-AGREED value — the same
+# number on every rank at the same point of the (SPMD) Python program.
+# Bucketing runs on framework threads at trace time; if two ranks read
+# different thresholds they trace DIFFERENT fused programs, which
+# desynchronizes the collective streams and hangs the job rather than
+# erroring.  ``native.runtime.Runtime`` honors this by serving a value
+# latched only inside ``Runtime.sync_tuned_config()`` (a collective),
+# never the raw tuner atomic that each rank updates at its own cycle
+# tick.
 _live_threshold_provider = None
 
 
@@ -73,7 +83,9 @@ def set_live_threshold_provider(provider) -> None:
     """Register (or clear, with ``None``) the live-threshold source.
 
     Called by ``native.runtime.Runtime`` on start/stop; anything else
-    supplying a dynamic threshold (tests, notebooks) may use it too."""
+    supplying a dynamic threshold (tests, notebooks) may use it too —
+    but every registered provider must honor the rank-agreement
+    contract documented on ``_live_threshold_provider``."""
     global _live_threshold_provider
     _live_threshold_provider = provider
 
@@ -92,8 +104,9 @@ def parse_size_bytes(value: str) -> Optional[int]:
 
 
 def fusion_threshold_bytes() -> int:
-    """The live fusion bucket limit: the autotuned value when a native
-    runtime registered a provider (set_live_threshold_provider), else
+    """The live fusion bucket limit: the rank-agreed autotuned value when
+    a native runtime registered a provider (set_live_threshold_provider)
+    and has latched one via ``Runtime.sync_tuned_config()``, else
     ``HOROVOD_FUSION_THRESHOLD`` (bytes, or with a ``kb``/``mb``/``MiB``-style
     binary suffix).  An unparseable env value falls back to the 64 MB
     default with a one-time warning — a typo in an env var must not

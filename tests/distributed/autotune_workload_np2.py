@@ -11,7 +11,10 @@ Proves the tuner is no longer one-shot:
    monitoring windows leave the pinned baseline band, and exploration
    REOPENS (exploring flips back True, distinct configs are sampled
    again, rank 0's CSV gains a ``reopen`` phase row);
-3. telemetry — after shutdown the hvd_autotune_* gauges carry the final
+3. agreed trace-time propagation — the SPMD bucketer ignores the raw
+   per-rank tuner mirrors until ``sync_tuned_config()`` (a collective)
+   latches a rank-agreed threshold into ``ops/fusion.py``;
+4. telemetry — after shutdown the hvd_autotune_* gauges carry the final
    tuned configuration into the per-rank snapshot the at-exit exporter
    ships to the launcher's merged summary.
 
@@ -27,6 +30,7 @@ import numpy as np
 
 import horovod_tpu as hvd
 from horovod_tpu import basics, telemetry
+from horovod_tpu.ops import fusion
 
 hvd.init()
 rank, size = hvd.rank(), hvd.size()
@@ -77,6 +81,21 @@ for i in range(600):
 assert pinned, "tuner failed to pin within 600 steady steps"
 pinned_cfg = (round(cfg["cycle_time_ms"], 3),
               cfg["fusion_threshold_bytes"], cfg["chunk_bytes"])
+
+# Trace-time propagation is gated on agreement: the SPMD bucketer keeps
+# the env/default threshold until sync_tuned_config() — a collective
+# whose Min-allreduced result is identical on every rank — latches the
+# tuned value (raw per-rank reads could diverge mid-trial and trace
+# mismatched fused programs).
+env_threshold = (fusion.parse_size_bytes(
+    os.environ.get("HOROVOD_FUSION_THRESHOLD") or "")
+    or fusion.DEFAULT_FUSION_THRESHOLD)
+assert fusion.fusion_threshold_bytes() == env_threshold, \
+    "bucketer moved off the agreed env/default path before any sync"
+agreed = rt.sync_tuned_config()
+assert agreed["fusion_threshold_bytes"] > 0, agreed
+assert fusion.fusion_threshold_bytes() == agreed["fusion_threshold_bytes"], \
+    (fusion.fusion_threshold_bytes(), agreed)
 
 # Steady-state coordination fast path: with 8 recurring names the cached
 # one-bit announcements dominate and the hit ratio climbs well clear of

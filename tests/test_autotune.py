@@ -177,3 +177,15 @@ def test_bayes_vs_grid_oracle():
                          capture_output=True, text=True, timeout=300)
     assert res.returncode == 0, res.stdout + res.stderr
     assert "BAYES ORACLE GATE OK" in res.stdout
+
+
+def test_monitor_anchor_oracle():
+    """Drift-monitor anchoring gate: benign +/-8% fluctuation around the
+    post-pin anchor must never re-open tuning, while a gradual -5%/window
+    regression (in-band against a walking baseline forever) must trip the
+    anchor-clamped floor (native/cc/tests/test_param_monitor.cc)."""
+    cc_dir = os.path.join(REPO, "horovod_tpu", "native", "cc")
+    res = subprocess.run(["make", "-s", "unittest"], cwd=cc_dir,
+                         capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "PARAM MONITOR GATE OK" in res.stdout

@@ -94,6 +94,41 @@ def test_threshold_env_garbage_falls_back_with_one_warning(monkeypatch):
     assert len(warnings) == 1  # one-time, not per call
 
 
+def test_live_threshold_provider_wins_and_clears(monkeypatch):
+    """A registered provider overrides the env path; clearing it (and a
+    provider returning None / raising) restores the env value."""
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "1024")
+    try:
+        fusion.set_live_threshold_provider(lambda: 4096)
+        assert fusion.fusion_threshold_bytes() == 4096
+        fusion.set_live_threshold_provider(lambda: None)
+        assert fusion.fusion_threshold_bytes() == 1024
+        def boom():
+            raise RuntimeError("dying runtime")
+        fusion.set_live_threshold_provider(boom)
+        assert fusion.fusion_threshold_bytes() == 1024
+    finally:
+        fusion.set_live_threshold_provider(None)
+    assert fusion.fusion_threshold_bytes() == 1024
+
+
+def test_runtime_provider_serves_latch_not_raw_atomic():
+    """Rank-agreement contract: the runtime's provider must serve only
+    the sync_tuned_config()-latched value — the raw tuned atomic moves
+    at each rank's own cycle tick and two ranks reading it at trace time
+    could bucket the same step differently (divergent fused programs)."""
+    from horovod_tpu.native import runtime as native_runtime
+    rt = native_runtime.Runtime(rank=0, size=1)
+    rt._lib = object()                    # "started", no real library
+    rt._tuned_fusion_fn = lambda: 123456  # raw atomic mid-trial
+    # Never synced: the provider must NOT leak the raw value.
+    assert rt._live_fusion_threshold() is None
+    rt._agreed_fusion_threshold = 2048    # what a sync would latch
+    assert rt._live_fusion_threshold() == 2048
+    rt._lib = None                        # stopped runtime goes quiet
+    assert rt._live_fusion_threshold() is None
+
+
 # ---------------------------------------------------------------------------
 # Bucketing invariants
 # ---------------------------------------------------------------------------

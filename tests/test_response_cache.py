@@ -65,7 +65,18 @@ INVALIDATION_SCRIPT = textwrap.dedent("""\
 
     # Elastic world-size change: a re-init builds a fresh native state —
     # the counters restart at zero, i.e. no stale fast path crosses an
-    # elastic boundary.
+    # elastic boundary.  A zero-copy result array rides across it: its
+    # weakref finalizer fires hvd_release(old_handle) against the NEW
+    # runtime whenever Python collects it, so handle ids must be unique
+    # across inits (epoch in the high bits) or the release would free a
+    # live epoch-2 entry mid-flight.
+    import gc
+    tok = rt.allreduce_submit("epoch1.survivor",
+                              np.full(8, 5.0, np.float32), 1)  # 1 = Sum
+    h_epoch1 = tok[0]
+    survivor = rt.allreduce_finish(tok)
+    np.testing.assert_allclose(np.asarray(survivor).ravel(),
+                               np.full(8, 5.0 * size))
     hvd.shutdown()
     hvd.init()
     rt = basics.runtime()
@@ -74,6 +85,15 @@ INVALIDATION_SCRIPT = textwrap.dedent("""\
     out = np.asarray(hvd.allreduce(np.full(8, 3.0, np.float32),
                                    op=hvd.Sum, name="cache.0"))
     np.testing.assert_allclose(out, np.full(8, 3.0 * size))
+    # Epoch-2 ids live above every epoch-1 id (pre-fix the fresh queue
+    # restarted at 0 and re-walked the old range); the stale finalizer
+    # must no-op while an epoch-2 op is in flight.
+    tok2 = rt.allreduce_submit("epoch2.t", np.full(8, 7.0, np.float32), 1)
+    assert tok2[0] > h_epoch1, (tok2[0], h_epoch1)
+    del survivor
+    gc.collect()   # fires the epoch-1 finalizer against the new state
+    out2 = np.asarray(rt.allreduce_finish(tok2))
+    np.testing.assert_allclose(out2.ravel(), np.full(8, 7.0 * size))
     print(f"CACHE_INVALIDATION_OK rank={rank}")
 """)
 
