@@ -49,6 +49,69 @@ JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
   python -m horovod_tpu.runner -np 4 \
   python tests/distributed/hier_check_np4.py
 
+echo "--- topology-aware hierarchical gate (np=4, 2 slots/host over fake
+--- ssh): launcher must inject HOROVOD_TOPOLOGY, workers verify
+--- hvd.topology() leader election, the hier and flat eager allreduces
+--- must be BITWISE identical, and the merged telemetry must show
+--- cross-host bytes == flat bytes / local_size exactly via
+--- hvd_collective_bytes_total{plane=eager,level}
+--- (docs/performance.md, 'Hierarchical collectives')"
+HIER_DIR="$(mktemp -d)"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  HOROVOD_SSH_CMD="ci/fake_ssh.sh" \
+  HOROVOD_HIER_GATE_DIR="$HIER_DIR" \
+  HOROVOD_METRICS_FILE="$HIER_DIR/hier.json" \
+  HOROVOD_HIERARCHICAL_ALLREDUCE=1 \
+  HOROVOD_HIERARCHICAL_ALLREDUCE_THRESHOLD=0 \
+  python -m horovod_tpu.runner -np 4 -H localhost:2,127.0.1.1:2 \
+  python tests/distributed/hierarchical_np4.py
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  HOROVOD_SSH_CMD="ci/fake_ssh.sh" \
+  HOROVOD_HIER_GATE_DIR="$HIER_DIR" \
+  HOROVOD_METRICS_FILE="$HIER_DIR/flat.json" \
+  HOROVOD_HIERARCHICAL_ALLREDUCE=0 \
+  python -m horovod_tpu.runner -np 4 -H localhost:2,127.0.1.1:2 \
+  python tests/distributed/hierarchical_np4.py
+python tools/check_metrics.py "$HIER_DIR/hier.json" 4
+python tools/check_metrics.py "$HIER_DIR/flat.json" 4
+PYTHONPATH="$PWD" python - "$HIER_DIR" <<'EOF'
+import json, pathlib, sys
+import numpy as np
+from horovod_tpu.telemetry import aggregate
+
+d = pathlib.Path(sys.argv[1])
+# Bit parity: integer-valued float32 payloads make every partial sum
+# exact, so the two routings must agree byte for byte on every rank.
+for r in range(4):
+    for n in (65536, 1000003):
+        a = np.load(d / f"out_hier_r{r}_n{n}.npy")
+        b = np.load(d / f"out_flat_r{r}_n{n}.npy")
+        assert a.dtype == b.dtype and a.shape == b.shape, (r, n)
+        assert (a.view(np.uint8) == b.view(np.uint8)).all(), \
+            f"hier vs flat allreduce differ bitwise (rank {r}, n {n})"
+
+def eager_bytes(path, level):
+    doc = json.load(open(path))
+    return aggregate.counter_total(
+        doc["merged"], "hvd_collective_bytes_total",
+        {"plane": "eager", "kind": "allreduce", "level": level})
+
+cross = eager_bytes(d / "hier.json", "cross")
+flat = eager_bytes(d / "flat.json", "flat")
+# Ops that stay flat even under hier routing (the 64-byte bootstrap
+# topology agreement runs before SetTopology exists) book identically in
+# both runs; subtracting the hier run's flat residue isolates exactly
+# the traffic that SWITCHED planes, which must shrink by local_size=2
+# (logical per-level accounting, see data_plane.h).
+residue = eager_bytes(d / "hier.json", "flat")
+assert cross > 0 and flat > residue > 0, (cross, flat, residue)
+assert 2 * cross == flat - residue, \
+    f"cross {cross} != (flat {flat} - residue {residue}) / 2"
+print(f"HIER_NP4_OK cross_bytes={cross:.0f} flat_bytes={flat:.0f} "
+      f"residue={residue:.0f}")
+EOF
+rm -rf "$HIER_DIR"
+
 echo "--- TF1-session async collectives (2 ranks, pruned-sync reaping)"
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" HOROVOD_TF1_ASYNC=1 \
   python -m horovod_tpu.runner -np 2 \
@@ -220,6 +283,14 @@ echo "--- compression wire ratio (BENCH json; int8 target >= 3x logical
 --- bytes with < 1% loss delta — trace-time counters, so the CPU smoke
 --- proves the real ratio, not just that the lane runs)"
 JAX_PLATFORMS=cpu python -m horovod_tpu.benchmark --compression int8
+
+echo "--- hierarchical allreduce A/B (BENCH json; two hvdrun -np 4
+--- loopback runs, flat ring vs 2-level; every worker asserts the
+--- hier_allreduce knob live in runtime.tuned_config() — on this rig
+--- the row bounds software overhead, the transport win is the np=4
+--- telemetry gate's exact 1/local_size byte ratio)"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  python -m horovod_tpu.benchmark --hierarchical --out BENCH_hier.json
 
 echo "--- TSAN build + smoke (races inside libhorovod_tpu.so fail CI)"
 make -C horovod_tpu/native/cc tsan

@@ -43,6 +43,8 @@ from horovod_tpu.basics import (
     num_devices,
     local_devices,
     mesh,
+    topology,
+    Topology,
     mpi_threads_supported,
     mpi_built,
     mpi_enabled,
@@ -105,13 +107,21 @@ from horovod_tpu.parallel.zero import sharded_optimizer, reshard_state
 from horovod_tpu import resilience  # noqa: F401  (hvd.resilience.StepGuard/...)
 from horovod_tpu.resilience import StepGuard, warm_restore, report_progress
 
+# Importing the `horovod_tpu.topology` SUBMODULE (here or anywhere) sets the
+# package attribute "topology" to the module, shadowing the hvd.topology()
+# accessor imported above.  Import the submodule once, then rebind the
+# accessor LAST: later `from horovod_tpu.topology import ...` statements
+# resolve through sys.modules and do not re-set the attribute.
+from horovod_tpu import topology as _topology_mod  # noqa: F401
+from horovod_tpu.basics import topology  # noqa: F811
+
 __version__ = "0.5.0"
 
 __all__ = [
     # lifecycle / topology
     "init", "shutdown", "is_initialized",
     "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",
-    "num_devices", "local_devices", "mesh",
+    "num_devices", "local_devices", "mesh", "topology", "Topology",
     "mpi_threads_supported",
     "mpi_built", "mpi_enabled", "gloo_built", "gloo_enabled",
     "nccl_built", "ddl_built", "mlsl_built", "tpu_built", "tpu_enabled",

@@ -30,7 +30,7 @@ from __future__ import annotations
 import atexit
 import os
 import threading
-from typing import Optional, Sequence
+from typing import NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -260,6 +260,125 @@ def cross_rank() -> int:
 def cross_size() -> int:
     _check_initialized()
     return _state.cross_size
+
+
+class Topology(NamedTuple):
+    """The job's host→slots map plus this rank's place in it — the Python
+    face of the launcher's ``HOROVOD_TOPOLOGY`` export (the LOCAL/CROSS
+    communicator hierarchy of reference ``common.h:105-109`` as data).
+
+    ``hosts`` is in rank order (host-major allocation); ``leaders`` holds
+    the global rank of each host's slot 0 — the one-rank-per-host CROSS
+    set — and ``local_group`` the global ranks sharing this rank's host.
+    Both planes consume it: the eager data plane's 2-level rings and
+    ``topology.build_mesh``'s automatic ``("dcn", "ici")`` shape.
+    """
+    hosts: Tuple[Tuple[str, int], ...]   # ((hostname, slots), ...)
+    hostname: str                        # this rank's host ("" if unknown)
+    leaders: Tuple[int, ...]             # global rank of slot 0 per host
+    local_group: Tuple[int, ...]         # global ranks on this host
+    rank: int
+    size: int
+    local_rank: int
+    local_size: int
+    cross_rank: int
+    cross_size: int
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.hosts)
+
+    @property
+    def leader(self) -> int:
+        """This host's leader (global rank of local slot 0)."""
+        return self.local_group[0] if self.local_group else self.rank
+
+    @property
+    def is_leader(self) -> bool:
+        return self.local_rank == 0
+
+
+def _build_topology(rank: int, size: int, local_rank: int, local_size: int,
+                    cross_rank: int, cross_size: int) -> Topology:
+    """Resolve the host map: the launcher's ``HOROVOD_TOPOLOGY`` when it
+    matches the live world size, else a uniform synthesis from the
+    LOCAL/CROSS env contract.  The mismatch guard matters for elastic
+    jobs: the launcher re-exports the string on every attempt, but a
+    worker that mutated HOROVOD_SIZE itself (tests do) must not inherit a
+    stale host list."""
+    spec = os.environ.get("HOROVOD_TOPOLOGY", "").strip()
+    hosts: list = []
+    if spec:
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if ":" in part:
+                name, slots = part.rsplit(":", 1)
+                hosts.append((name, int(slots)))
+            else:
+                hosts.append((part, 1))
+        if sum(s for _, s in hosts) != size:
+            hosts = []
+    if not hosts:
+        # Uniform block synthesis (rank = host*local_size + local_rank):
+        # cross_size hosts of local_size slots, last host taking the
+        # remainder of a non-divisible world.
+        name = os.environ.get("HOROVOD_HOSTNAME", "")
+        n_hosts = max(cross_size, 1)
+        for h in range(n_hosts):
+            slots = min(local_size, size - h * local_size) \
+                if local_size > 0 else size
+            if slots <= 0:
+                break
+            hosts.append((name, slots))
+    leaders, starts = [], []
+    base = 0
+    for _, slots in hosts:
+        leaders.append(base)
+        starts.append(base)
+        base += slots
+    # Locate this rank's host block by rank offset.
+    host_idx, host_start, host_slots = 0, 0, size
+    for i, (_, slots) in enumerate(hosts):
+        if starts[i] <= rank < starts[i] + slots:
+            host_idx, host_start, host_slots = i, starts[i], slots
+            break
+    hostname = hosts[host_idx][0] if hosts else \
+        os.environ.get("HOROVOD_HOSTNAME", "")
+    local_group = tuple(range(host_start, host_start + host_slots))
+    return Topology(
+        hosts=tuple(hosts), hostname=hostname, leaders=tuple(leaders),
+        local_group=local_group, rank=rank, size=size,
+        local_rank=local_rank, local_size=local_size,
+        cross_rank=cross_rank, cross_size=cross_size)
+
+
+def topology() -> Topology:
+    """The discovered job topology (hosts, leaders, local group) — see
+    :class:`Topology`.  Rebuilt on every call from the current state +
+    environment, so an elastic restart's re-exported ``HOROVOD_TOPOLOGY``
+    is picked up by the re-initialized worker."""
+    _check_initialized()
+    return _build_topology(_state.rank, _state.size, _state.local_rank,
+                           _state.local_size, _state.cross_rank,
+                           _state.cross_size)
+
+
+def _topology_unchecked() -> Topology:
+    """Env-only topology probe for callers that may run before
+    ``hvd.init()`` (``topology.build_mesh``'s automatic hybrid shape).
+    Falls back to a single-host view when nothing is exported."""
+    if _state.initialized:
+        return topology()
+    rank = _env_int("HOROVOD_RANK", 0)
+    size = _env_int("HOROVOD_SIZE", 1)
+    local_size = _env_int("HOROVOD_LOCAL_SIZE", size)
+    return _build_topology(
+        rank, size, _env_int("HOROVOD_LOCAL_RANK", rank), local_size,
+        _env_int("HOROVOD_CROSS_RANK", rank // max(local_size, 1)),
+        _env_int("HOROVOD_CROSS_SIZE",
+                 -(-size // max(local_size, 1))))
 
 
 def num_devices() -> int:

@@ -45,6 +45,26 @@ int hvd_cache_enabled();
 int64_t hvd_cache_lookups();
 int64_t hvd_cache_hits();
 
+// 1 when the bootstrap agreement verified a hierarchical-capable topology
+// (homogeneous block mapping, >1 host) — the autotuner may then flip the
+// hier_* routing even if the env flags left it off.
+int hvd_hierarchical_available();
+// Per-level collective accounting (hvd_hier_* telemetry).  Allreduce
+// counters book LOGICAL payload per level (local = full tensor, cross =
+// this rank's 1/local_size chunk; summed over ranks the cross/flat ratio
+// is exactly 1/local_size); allgather counters book wire sends per level.
+// All monotonic since init; 0 when uninitialized.
+int64_t hvd_hier_local_bytes();
+int64_t hvd_hier_cross_bytes();
+int64_t hvd_hier_local_us();
+int64_t hvd_hier_cross_us();
+int64_t hvd_hier_allreduce_ops();
+int64_t hvd_flat_allreduce_bytes();
+int64_t hvd_flat_allreduce_ops();
+int64_t hvd_hier_ag_local_bytes();
+int64_t hvd_hier_ag_cross_bytes();
+int64_t hvd_hier_ag_ops();
+
 // Enqueue a collective.  `shape` has `ndim` dims (scalar: ndim=0).
 // `arg` = reduce-op code (allreduce/reducescatter) or root rank (broadcast).
 // `splits`/`nsplits`: alltoall only — dim-0 rows sent to each destination

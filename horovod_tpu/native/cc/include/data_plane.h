@@ -144,6 +144,26 @@ class DataPlane {
                   const std::function<void(size_t)>& on_recv = nullptr);
   int self_rank() const { return rank_; }
 
+  // Per-level payload accounting (hvd_hier_* telemetry; read through the
+  // hvd_hier_* C exports from the Python watchdog).  "local" = intra-host
+  // legs, "cross" = the one-leader-per-host DCN leg.  Counters hold this
+  // rank's LOGICAL payload contribution, not wire bytes: the hierarchical
+  // cross leg books my finished chunk (count/local_size of the tensor) and
+  // the flat ring books the full tensor, so summed over ranks the
+  // cross/flat ratio is exactly 1/local_size — the quantity the np=4 CI
+  // gate asserts.  Relaxed ordering: written by the background collective
+  // thread, read by the metrics publisher; counters tolerate staleness.
+  int64_t hier_local_bytes() const { return hier_local_bytes_.load(std::memory_order_relaxed); }
+  int64_t hier_cross_bytes() const { return hier_cross_bytes_.load(std::memory_order_relaxed); }
+  int64_t hier_local_us() const { return hier_local_us_.load(std::memory_order_relaxed); }
+  int64_t hier_cross_us() const { return hier_cross_us_.load(std::memory_order_relaxed); }
+  int64_t hier_allreduce_ops() const { return hier_allreduce_ops_.load(std::memory_order_relaxed); }
+  int64_t flat_allreduce_bytes() const { return flat_allreduce_bytes_.load(std::memory_order_relaxed); }
+  int64_t flat_allreduce_ops() const { return flat_allreduce_ops_.load(std::memory_order_relaxed); }
+  int64_t hier_ag_local_bytes() const { return hier_ag_local_bytes_.load(std::memory_order_relaxed); }
+  int64_t hier_ag_cross_bytes() const { return hier_ag_cross_bytes_.load(std::memory_order_relaxed); }
+  int64_t hier_ag_ops() const { return hier_ag_ops_.load(std::memory_order_relaxed); }
+
  private:
   // Persistent ring scratch, grown monotonically and reused across
   // collectives (background thread only).  A fresh std::vector per call
@@ -183,6 +203,16 @@ class DataPlane {
   // Atomic: the background thread flips it from TunedParams while a
   // framework thread may read it through hvd_tuned_chunk_bytes().
   std::atomic<int64_t> chunk_bytes_{0};
+  std::atomic<int64_t> hier_local_bytes_{0};
+  std::atomic<int64_t> hier_cross_bytes_{0};
+  std::atomic<int64_t> hier_local_us_{0};
+  std::atomic<int64_t> hier_cross_us_{0};
+  std::atomic<int64_t> hier_allreduce_ops_{0};
+  std::atomic<int64_t> flat_allreduce_bytes_{0};
+  std::atomic<int64_t> flat_allreduce_ops_{0};
+  std::atomic<int64_t> hier_ag_local_bytes_{0};
+  std::atomic<int64_t> hier_ag_cross_bytes_{0};
+  std::atomic<int64_t> hier_ag_ops_{0};
   TcpSocket listener_;
   std::vector<std::unique_ptr<TcpSocket>> peers_;  // [size], self = null
   std::unique_ptr<char[]> scratch_;

@@ -655,6 +655,16 @@ Status DataPlane::Allreduce(void* buf, int64_t count, DataType dtype,
   if (group.empty() && hier_enabled_ &&
       count * static_cast<int64_t>(DataTypeSize(dtype)) >= hier_threshold_)
     return HierarchicalAllreduce(buf, count, dtype, op);
+  if (group.empty()) {
+    // Flat-path payload accounting (the baseline the hier_cross counter
+    // is compared against): every byte of the tensor rides the one flat
+    // ring, which spans hosts — summed over ranks this is size * payload
+    // while the hierarchical cross counter sums to nhosts * payload.
+    flat_allreduce_bytes_.fetch_add(
+        count * static_cast<int64_t>(DataTypeSize(dtype)),
+        std::memory_order_relaxed);
+    flat_allreduce_ops_.fetch_add(1, std::memory_order_relaxed);
+  }
   Status st = RingReduceScatterPhase(group, buf, count, dtype, op);
   if (!st.ok()) return st;
   return RingAllgatherPhase(group, buf, count, dtype);
@@ -682,8 +692,11 @@ Status DataPlane::HierarchicalAllreduce(void* buf, int64_t count,
   for (int h = 0; h < nhosts; ++h)
     cross_group[h] = h * local_size_ + local_rank_;
 
+  using clk = std::chrono::steady_clock;
+  const auto t0 = clk::now();
   Status st = RingReduceScatterPhase(local_group, buf, count, dtype, op);
   if (!st.ok()) return st;
+  const auto t1 = clk::now();
 
   // My finished chunk under the local ring layout.
   auto off = ChunkOffsets(count, local_size_);
@@ -699,7 +712,27 @@ Status DataPlane::HierarchicalAllreduce(void* buf, int64_t count,
     st = RingAllgatherPhase(cross_group, cptr, ccount, dtype);
     if (!st.ok()) return st;
   }
-  return RingAllgatherPhase(local_group, buf, count, dtype);
+  const auto t2 = clk::now();
+  st = RingAllgatherPhase(local_group, buf, count, dtype);
+  const auto t3 = clk::now();
+
+  // Payload accounting (see the header comment on hier_local_bytes()):
+  // local books the full tensor, cross books my finished chunk — the
+  // per-rank 1/local_size slice that actually crosses hosts.  The chunks
+  // partition `count` within each host, so summed over all ranks the
+  // cross counter is exactly nhosts * tensor bytes.
+  const int64_t esize = static_cast<int64_t>(DataTypeSize(dtype));
+  auto us = [](clk::duration d) {
+    return std::chrono::duration_cast<std::chrono::microseconds>(d).count();
+  };
+  hier_local_bytes_.fetch_add(count * esize, std::memory_order_relaxed);
+  hier_cross_bytes_.fetch_add(ccount > 0 ? ccount * esize : 0,
+                              std::memory_order_relaxed);
+  hier_local_us_.fetch_add(us(t1 - t0) + us(t3 - t2),
+                           std::memory_order_relaxed);
+  hier_cross_us_.fetch_add(us(t2 - t1), std::memory_order_relaxed);
+  hier_allreduce_ops_.fetch_add(1, std::memory_order_relaxed);
+  return st;
 }
 
 Status DataPlane::Reducescatter(const void* in, void* out, int64_t count,
@@ -811,6 +844,8 @@ Status DataPlane::HierarchicalAllgather(
                          from, o + displ[from],
                          static_cast<size_t>(counts[from]));
     if (!st.ok()) return st;
+    hier_ag_cross_bytes_.fetch_add(counts[rank_],
+                                   std::memory_order_relaxed);
   }
 
   // B. local fan-out: with peer at local position me±k, exchange my
@@ -829,8 +864,14 @@ Status DataPlane::HierarchicalAllgather(
                            from, o + displ[theirs],
                            static_cast<size_t>(counts[theirs]));
       if (!st.ok()) return st;
+      hier_ag_local_bytes_.fetch_add(counts[mine],
+                                     std::memory_order_relaxed);
     }
   }
+  // Unlike the allreduce counters these book WIRE sends per level: the
+  // allgather has no fixed per-op payload ratio (it depends on counts),
+  // so the useful telemetry is the actual per-level traffic split.
+  hier_ag_ops_.fetch_add(1, std::memory_order_relaxed);
   return Status::OK();
 }
 

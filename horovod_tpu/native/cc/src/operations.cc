@@ -863,6 +863,40 @@ int64_t hvd_cache_hits() {
            : 0;
 }
 
+int hvd_hierarchical_available() {
+  return g && g->hierarchical_available ? 1 : 0;
+}
+int64_t hvd_hier_local_bytes() {
+  return g ? g->data_plane.hier_local_bytes() : 0;
+}
+int64_t hvd_hier_cross_bytes() {
+  return g ? g->data_plane.hier_cross_bytes() : 0;
+}
+int64_t hvd_hier_local_us() {
+  return g ? g->data_plane.hier_local_us() : 0;
+}
+int64_t hvd_hier_cross_us() {
+  return g ? g->data_plane.hier_cross_us() : 0;
+}
+int64_t hvd_hier_allreduce_ops() {
+  return g ? g->data_plane.hier_allreduce_ops() : 0;
+}
+int64_t hvd_flat_allreduce_bytes() {
+  return g ? g->data_plane.flat_allreduce_bytes() : 0;
+}
+int64_t hvd_flat_allreduce_ops() {
+  return g ? g->data_plane.flat_allreduce_ops() : 0;
+}
+int64_t hvd_hier_ag_local_bytes() {
+  return g ? g->data_plane.hier_ag_local_bytes() : 0;
+}
+int64_t hvd_hier_ag_cross_bytes() {
+  return g ? g->data_plane.hier_ag_cross_bytes() : 0;
+}
+int64_t hvd_hier_ag_ops() {
+  return g ? g->data_plane.hier_ag_ops() : 0;
+}
+
 int64_t hvd_enqueue(int op_type, const char* name, const void* data,
                     const int64_t* shape, int32_t ndim, int dtype, int arg,
                     const int64_t* splits, int32_t nsplits, int set_id) {

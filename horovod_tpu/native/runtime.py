@@ -204,6 +204,21 @@ class Runtime:
         self._cache_hits_fn = getattr(lib, "hvd_cache_hits", None)
         if self._cache_hits_fn is not None:
             self._cache_hits_fn.restype = ctypes.c_longlong
+        # Hierarchical-plane introspection (per-level byte/latency
+        # counters + topology availability), all optional symbols.
+        self._hier_avail_fn = getattr(
+            lib, "hvd_hierarchical_available", None)
+        self._hier_counter_fns = {}
+        for sym in ("hvd_hier_local_bytes", "hvd_hier_cross_bytes",
+                    "hvd_hier_local_us", "hvd_hier_cross_us",
+                    "hvd_hier_allreduce_ops", "hvd_flat_allreduce_bytes",
+                    "hvd_flat_allreduce_ops", "hvd_hier_ag_local_bytes",
+                    "hvd_hier_ag_cross_bytes", "hvd_hier_ag_ops"):
+            fn = getattr(lib, sym, None)
+            if fn is not None:
+                fn.restype = ctypes.c_longlong
+                self._hier_counter_fns[sym] = fn
+        self._hier_published = {}   # sym -> last value already inc'd
         port = int(os.environ.get("HOROVOD_RENDEZVOUS_PORT", "0"))
         rc = lib.hvd_init(self.rank, self.size, self.local_rank,
                           self.local_size, addr.encode(), port)
@@ -291,6 +306,14 @@ class Runtime:
             "cache_lookups": lookups,
             "cache_hits": hits,
             "cache_hit_ratio": (hits / lookups) if lookups else 0.0,
+            # Hierarchical routing as the data plane currently runs it —
+            # env defaults until the autotuner flips the knobs through
+            # the response stream (the "observed live" knob of
+            # BENCH_hier.json).
+            "hier_allreduce": self.hierarchical_enabled(),
+            "hier_allgather": self.hierarchical_allgather_enabled(),
+            "hier_available": bool(self._hier_avail_fn
+                                   and self._hier_avail_fn()),
         }
 
     def sync_tuned_config(self) -> dict:
@@ -317,17 +340,28 @@ class Runtime:
         if not cfg:
             return {}
         local = np.array([cfg["fusion_threshold_bytes"],
-                          cfg["chunk_bytes"]], dtype=np.int64)
+                          cfg["chunk_bytes"],
+                          1 if cfg.get("hier_allreduce") else 0,
+                          1 if cfg.get("hier_allgather") else 0],
+                         dtype=np.int64)
         self._sync_seq = getattr(self, "_sync_seq", 0) + 1
         # 3 = ReduceOp Min (ops/collective.py; hvd_common.h kMin) — any
-        # deterministic reduction works, consistency is the point.
+        # deterministic reduction works, consistency is the point.  For
+        # the boolean hier knobs Min is AND: a rank that has not yet
+        # applied the enabling TunedParams reports the conservative
+        # answer, so the agreed view only says "on" once EVERY rank
+        # routes hierarchically.
         agreed = np.asarray(self.allreduce(
             f"hvd.autotune.sync.{self._sync_seq}", local, 3)).ravel()
         fusion_bytes, chunk_bytes = int(agreed[0]), int(agreed[1])
         if fusion_bytes > 0:
             self._agreed_fusion_threshold = fusion_bytes
-        return {"fusion_threshold_bytes": fusion_bytes,
-                "chunk_bytes": chunk_bytes}
+        out = {"fusion_threshold_bytes": fusion_bytes,
+               "chunk_bytes": chunk_bytes}
+        if agreed.size >= 4:   # old peers may still send 2-wide payloads
+            out["hier_allreduce"] = bool(agreed[2])
+            out["hier_allgather"] = bool(agreed[3])
+        return out
 
     def _publish_autotune_gauges(self) -> None:
         """Mirror the tuned config into telemetry gauges (merged into the
@@ -353,6 +387,85 @@ class Runtime:
             "hvd_autotune_cache_hit_ratio",
             "Response-cache hit ratio for this rank's announcements",
         ).set(cfg["cache_hit_ratio"])
+        telemetry.gauge(
+            "hvd_autotune_hier_allreduce",
+            "1 while the 2-level eager allreduce routing is active",
+        ).set(1.0 if cfg.get("hier_allreduce") else 0.0)
+        telemetry.gauge(
+            "hvd_autotune_hier_allgather",
+            "1 while the 2-level eager allgather routing is active",
+        ).set(1.0 if cfg.get("hier_allgather") else 0.0)
+        self._publish_hier_metrics()
+
+    def _publish_hier_metrics(self) -> None:
+        """Mirror the native per-level counters into telemetry.
+
+        The native atomics are monotonic since init while telemetry
+        counters only support inc(), so each publish adds the DELTA since
+        the previous one (``self._hier_published``).  Two series come out:
+        ``hvd_hier_*`` (per-level payload/latency, the operator-facing
+        breakdown) and ``hvd_collective_bytes_total{plane="eager",level}``
+        — the same metric name the SPMD plane uses, so the np=4 CI gate
+        can assert cross-host bytes == flat/local_size from ONE merged
+        metrics file regardless of plane."""
+        if not telemetry.enabled() or not self._hier_counter_fns:
+            return
+
+        def delta(sym: str) -> int:
+            fn = self._hier_counter_fns.get(sym)
+            if fn is None:
+                return 0
+            now = int(fn())
+            d = now - self._hier_published.get(sym, 0)
+            self._hier_published[sym] = now
+            return max(d, 0)
+
+        def bump(name: str, help_: str, d: int, **labels) -> None:
+            if d:
+                telemetry.counter(name, help_, **labels).inc(d)
+
+        bytes_help = ("Per-level payload bytes of eager hierarchical "
+                      "collectives (allreduce: logical payload; "
+                      "allgather: wire sends)")
+        secs_help = "Per-level wall seconds inside eager hierarchical ops"
+        wire_help = ("Logical wire payload bytes of SPMD collectives "
+                     "(trace-time)")
+        bump("hvd_hier_bytes_total", bytes_help,
+             delta("hvd_hier_local_bytes"), level="local", op="allreduce")
+        cross_b = delta("hvd_hier_cross_bytes")
+        bump("hvd_hier_bytes_total", bytes_help, cross_b,
+             level="cross", op="allreduce")
+        bump("hvd_hier_bytes_total", bytes_help,
+             delta("hvd_hier_ag_local_bytes"), level="local",
+             op="allgather")
+        cross_ag = delta("hvd_hier_ag_cross_bytes")
+        bump("hvd_hier_bytes_total", bytes_help, cross_ag,
+             level="cross", op="allgather")
+        local_us = delta("hvd_hier_local_us")
+        cross_us = delta("hvd_hier_cross_us")
+        if local_us:
+            telemetry.counter("hvd_hier_seconds_total", secs_help,
+                              level="local").inc(local_us / 1e6)
+        if cross_us:
+            telemetry.counter("hvd_hier_seconds_total", secs_help,
+                              level="cross").inc(cross_us / 1e6)
+        bump("hvd_hier_allreduce_ops_total",
+             "Eager allreduces routed through the 2-level path",
+             delta("hvd_hier_allreduce_ops"))
+        bump("hvd_hier_allgather_ops_total",
+             "Eager allgathers routed through the 2-level path",
+             delta("hvd_hier_ag_ops"))
+        flat_b = delta("hvd_flat_allreduce_bytes")
+        bump("hvd_flat_allreduce_ops_total",
+             "Eager allreduces that took the flat O(world) ring",
+             delta("hvd_flat_allreduce_ops"))
+        # Cross-plane merged series (same name as ops/fusion.py's):
+        bump("hvd_collective_bytes_total", wire_help, flat_b,
+             plane="eager", kind="allreduce", codec="none", level="flat")
+        bump("hvd_collective_bytes_total", wire_help, cross_b,
+             plane="eager", kind="allreduce", codec="none", level="cross")
+        bump("hvd_collective_bytes_total", wire_help, cross_ag,
+             plane="eager", kind="allgather", codec="none", level="cross")
 
     # -- collectives -------------------------------------------------------
 

@@ -772,6 +772,46 @@ def compressed_all_gather(shards, plan, axis_name, codec: BucketCodec,
     return leaves, new_state
 
 
+def cross_level_psum(x, axis_name, codec=None):
+    """``lax.psum(x, axis_name)`` with an optional stateless wire codec —
+    the per-level codec hook of the hierarchical plane ("int8 on DCN, none
+    on ICI").  Accepts ``None``/``"none"``, ``"bf16"``, ``"fp16"`` or
+    ``"int8"`` (or the equivalent codec instances).
+
+    The int8 form quantizes against a *shared* scale (``pmax`` of the
+    per-rank absmax, one scalar on the wire) so every rank decodes
+    identically, reduces in int32 so up to 2^23 ranks of ±127 cannot
+    overflow, and rescales once.  Stateful codecs (powersgd) are rejected:
+    error feedback belongs to the intra-level plan state
+    (:func:`compressed_reduce_scatter`), not a single psum hop.
+    """
+    codec = resolve_codec(codec if codec is not None else "none")
+    esize = jnp.dtype(x.dtype).itemsize
+    if isinstance(codec, NoneCodec):
+        fusion.record_collective_bytes("cross_psum", "none",
+                                       x.size * esize, level="dcn")
+        return lax.psum(x, axis_name)
+    if isinstance(codec, CastCodec):
+        wire = jnp.dtype(codec.wire_dtype)
+        fusion.record_collective_bytes("cross_psum", codec.name,
+                                       x.size * wire.itemsize, level="dcn")
+        return lax.psum(x.astype(wire), axis_name).astype(x.dtype)
+    if isinstance(codec, Int8Codec):
+        absmax = jnp.max(jnp.abs(x)).astype(jnp.float32)
+        scale = lax.pmax(absmax, axis_name) / 127.0
+        safe = jnp.where(scale > 0, scale, 1.0)
+        q = jnp.clip(jnp.round(x.astype(jnp.float32) / safe),
+                     -127, 127).astype(jnp.int8)
+        total = lax.psum(q.astype(jnp.int32), axis_name)
+        fusion.record_collective_bytes("cross_psum", codec.name,
+                                       x.size, level="dcn")
+        return (total.astype(jnp.float32) * safe).astype(x.dtype)
+    raise ValueError(
+        f"cross_level_psum supports stateless codecs (none/bf16/fp16/int8); "
+        f"got {codec.name!r} — stateful codecs need plan-level error "
+        f"feedback, use compressed_reduce_scatter instead")
+
+
 def compressed_allreduce(leaves, axis_name, codec: BucketCodec, *,
                          plan, state: Optional[CodecState] = None,
                          mean: bool = True):

@@ -155,19 +155,24 @@ def max_bucket_bytes() -> int:
     return parsed
 
 
-def record_collective_bytes(kind: str, codec: str, nbytes: int) -> None:
+def record_collective_bytes(kind: str, codec: str, nbytes: int,
+                            level: Optional[str] = None) -> None:
     """Trace-time wire accounting for SPMD collectives: the LOGICAL payload
     bytes a collective moves per invocation (per rank), labeled by the wire
     codec that produced them.  Like all fusion telemetry this counts
     trace-time decisions — per-step traffic is trace counts x payload — so
     two runs of the same program are directly comparable: the none-codec /
     int8 ratio of ``hvd_collective_bytes_total`` IS the wire compression
-    ratio."""
+    ratio.  ``level`` ("ici"/"dcn") labels the leg of a two-level
+    hierarchical collective; flat collectives omit it."""
     if nbytes and telemetry.enabled():
+        labels = dict(plane="spmd", kind=kind, codec=codec)
+        if level is not None:
+            labels["level"] = level
         telemetry.counter(
             "hvd_collective_bytes_total",
             "Logical wire payload bytes of SPMD collectives (trace-time)",
-            plane="spmd", kind=kind, codec=codec).inc(int(nbytes))
+            **labels).inc(int(nbytes))
 
 
 def _vma_key(leaf):
@@ -569,6 +574,50 @@ def fused_reduce_scatter(tensors: Sequence[jax.Array], axis_name,
     for b, flat in enumerate(plan.concat(tensors)):
         shard = lax.psum_scatter(flat, axis_name, scatter_dimension=0,
                                  tiled=True)
+        if mean:
+            shard = shard * jnp.asarray(inv, shard.dtype)
+        shards.append(shard)
+    return shards, plan
+
+
+def fused_hierarchical_reduce_scatter(
+        tensors: Sequence[jax.Array], ici_axis: str, dcn_axis: str,
+        mean: bool = True, threshold: int | None = None,
+        plan: Optional[ReduceScatterPlan] = None,
+        axis_size: Optional[int] = None):
+    """Two-level reduce-scatter: intra-slice ``psum_scatter`` over
+    ``ici_axis`` then a ``psum`` of the 1/ici shard over ``dcn_axis``, so
+    the DCN leg carries 1/ici_size of every bucket's bytes (the mesh twin
+    of ``NCCLHierarchicalAllreduce``'s local-RS + cross-allreduce prefix).
+
+    The plan is built over the ICI axis size only — shards stay
+    ici-sharded, replicated over DCN — so the returned ``(shards, plan)``
+    pair feeds :func:`fused_all_gather` with ``axis_name=ici_axis`` (an
+    intra-slice gather; no DCN traffic on the way back).  That makes this
+    a drop-in for :func:`fused_reduce_scatter` in ZeRO-1: optimizer state
+    is partitioned 1/ici-way per slice, and only the reduce leg crosses
+    hosts.  ``mean=True`` folds the full two-level divide into one
+    ``1/(ici*dcn)`` multiply on the shard.
+    """
+    tensors = list(tensors)
+    ici = _resolve_axis_size(ici_axis, axis_size)
+    dcn = _resolve_axis_size(dcn_axis, None)
+    if plan is None:
+        plan = make_reduce_scatter_plan(tensors, ici, threshold)
+    if not tensors:
+        return [], plan
+    _record_plan("hier_reduce_scatter", plan)
+    record_collective_bytes("hier_reduce_scatter", "none",
+                            plan.total_padded_bytes(), level="ici")
+    record_collective_bytes("hier_reduce_scatter", "none",
+                            plan.total_padded_bytes() // max(ici, 1),
+                            level="dcn")
+    shards = []
+    inv = 1.0 / (plan.axis_size * dcn)
+    for b, flat in enumerate(plan.concat(tensors)):
+        shard = lax.psum_scatter(flat, ici_axis, scatter_dimension=0,
+                                 tiled=True)
+        shard = lax.psum(shard, dcn_axis)
         if mean:
             shard = shard * jnp.asarray(inv, shard.dtype)
         shards.append(shard)

@@ -9,11 +9,16 @@ reduce-scatter -> cross-node allreduce -> intra-node allgather,
 
 On TPU the hierarchy is two mesh axes: a fast intra-slice ICI axis and a
 slow cross-slice DCN axis (built with
-``mesh_utils.create_hybrid_device_mesh`` — see topology.build_mesh).  A
-plain ``psum`` over both axes already lets XLA pick the schedule; the
-explicit reduce-scatter/psum/all-gather decomposition below pins the
-bandwidth-optimal pattern: each DCN link carries only 1/ici_size of the
-payload.
+``mesh_utils.create_hybrid_device_mesh`` — see topology.build_mesh, which
+derives the ``("dcn", "ici")`` shape from ``hvd.topology()`` when none is
+given).  A plain ``psum`` over both axes already lets XLA pick the
+schedule; the explicit reduce-scatter/psum/all-gather decomposition below
+pins the bandwidth-optimal pattern: each DCN link carries only 1/ici_size
+of the payload.
+
+The gather legs use ``lax.all_gather`` (each-byte-once ring traffic, not
+the O(global)-bytes-per-link masked psum this module used to carry) and
+repair the vma annotation explicitly — see :func:`_gather_replicated`.
 """
 
 from __future__ import annotations
@@ -21,6 +26,46 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from horovod_tpu.parallel._vma import vma_of
+
+
+def _gather_replicated(v, axis: str):
+    """``lax.all_gather(v, axis)`` whose result is typed *replicated* over
+    ``axis``, so it can flow out of a ``check_vma=True`` shard_map through
+    a replicated ``P()`` out_spec.
+
+    The bandwidth story: all_gather's ring moves each byte once
+    ((n-1)/n of the output per link), while the masked-psum spelling —
+    reduce a zero-padded full-size buffer — moves O(output) bytes per
+    link per step unless XLA pattern-matches the one-hot away.  The typing
+    story is the hard part: on vma-tracking JAX an all_gather output is
+    "possibly varying over {axis}" even though every shard is bitwise
+    identical.  We repair that with ``lax.pcast(..., to="unvarying")``
+    where the primitive exists; if neither vma tracking nor pcast is
+    present (jax 0.4.x, where check_vma is shimmed off) the raw all_gather
+    is already fine; only when vma is tracked but unvarying-pcast is
+    refused do we fall back to the masked psum, the one collective whose
+    output vma inference marks unvarying.
+    """
+    n = lax.axis_size(axis)
+    out = lax.all_gather(v, axis, axis=0, tiled=True)
+    if axis not in vma_of(out):
+        return out  # not varying (or vma untracked): already replicated
+    try:
+        return lax.pcast(out, (axis,), to="unvarying")
+    except (TypeError, ValueError, NotImplementedError):
+        pass
+    # Fallback: masked psum — unvarying by construction, at ICI-bandwidth
+    # cost (~2x the all_gather ring if XLA keeps the reduction).
+    idx = lax.axis_index(axis)
+    buf = jnp.zeros((n,) + v.shape, v.dtype).at[idx].set(v)
+    return lax.psum(buf, axis).reshape((n * v.shape[0],) + v.shape[1:])
+
+
+def _record(kind: str, nbytes: int, level: str) -> None:
+    from horovod_tpu.ops.fusion import record_collective_bytes
+    record_collective_bytes(kind, "none", nbytes, level=level)
 
 
 def hierarchical_allreduce(x, ici_axis: str, dcn_axis: str,
@@ -30,35 +75,40 @@ def hierarchical_allreduce(x, ici_axis: str, dcn_axis: str,
     Equivalent to ``psum(x, (ici_axis, dcn_axis))`` but with the cross-slice
     leg carrying 1/ici_size of the bytes (the reference's exact trick:
     nccl_operations.cc:151-346).
+
+    ``average=True`` folds the two-level divide into one ``1/(ici*dcn)``
+    multiply applied to the DCN-reduced *shard* — before the ICI gather —
+    so the scaling touches 1/ici of the elements the reference's
+    divide-after-allreduce would.
     """
     ici = lax.axis_size(ici_axis)
+    dcn = lax.axis_size(dcn_axis)
     flat = x.reshape(-1)
     n = flat.shape[0]
     pad = (-n) % ici
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    esize = flat.dtype.itemsize
     # Intra-slice reduce-scatter: each chip ends with 1/ici of the sum.
     shard = lax.psum_scatter(flat, ici_axis, scatter_dimension=0, tiled=True)
+    _record("hier_allreduce", flat.shape[0] * esize, "ici")
     # Cross-slice allreduce on the small shard (rides DCN).
     shard = lax.psum(shard, dcn_axis)
-    # Intra-slice gather restores the full tensor.  Expressed as a masked
-    # psum rather than lax.all_gather: the result is bitwise-replicated
-    # over the ICI axis, and psum is the only collective whose output JAX's
-    # vma inference marks *unvarying* — an all_gather output would be
-    # "possibly varying over {ici}" and could not be returned through a
-    # replicated out_spec (P()).  Cost note: if XLA does not fold the
-    # one-hot into a gather, a ring lowering moves ~2(n-1)/n of the full
-    # payload on ICI vs (n-1)/n for all_gather — an ICI-only overhead; the
-    # DCN leg (the scarce link this decomposition optimizes) still carries
-    # exactly 1/ici of the bytes.
-    idx = lax.axis_index(ici_axis)
-    buf = jnp.zeros((ici,) + shard.shape, shard.dtype).at[idx].set(shard)
-    full = lax.psum(buf, ici_axis).reshape(-1)
+    _record("hier_allreduce", shard.size * esize, "dcn")
+    if average:
+        # Hoisted: one multiply on the 1/ici-size shard, covering both
+        # levels.  Integer payloads fall back to the post-gather divide
+        # (a 1/(ici*dcn) multiply would truncate to zero).
+        if jnp.issubdtype(shard.dtype, jnp.inexact):
+            shard = shard * (1.0 / (ici * dcn))
+            average = False
+    # Intra-slice gather restores the full tensor, replicated over ICI.
+    full = _gather_replicated(shard, ici_axis).reshape(-1)
     if pad:
         full = full[:n]
     out = full.reshape(x.shape)
     if average:
-        out = out / (ici * lax.axis_size(dcn_axis))
+        out = out / (ici * dcn)
     return out
 
 
@@ -87,23 +137,14 @@ def hierarchical_allgather(x, ici_axis: str, dcn_axis: str):
     Concatenation order is (dcn, ici, local dim 0), matching a flat
     allgather over a mesh whose ICI axis is minor.
 
-    Expressed as masked psums rather than ``lax.all_gather`` for the same
-    reason as :func:`hierarchical_allreduce`'s gather leg: psum output is
-    the one collective vma marks *unvarying*, so the result can flow out
-    of a ``check_vma=True`` shard_map through a replicated ``P()`` spec.
-    CAVEAT: the masked-psum form pays for that typing property with
-    bandwidth — each gather leg reduces a zero-padded GLOBAL-size buffer,
-    so every link carries O(global) bytes per level, NOT the
-    each-byte-once traffic of the reference's leader scheme.  Semantics
-    match; if XLA's psum-of-one-hot pattern matching does not rewrite it
-    to a gather on your target, prefer ``lax.all_gather`` per level and
-    handle the vma/replication annotation explicitly.
+    Both legs are ``lax.all_gather`` rings (each byte crosses each link
+    once) with the replication annotation handled by
+    :func:`_gather_replicated` — the O(global)-bytes-per-link masked-psum
+    caveat this function used to document is gone.
     """
-    def gather(v, axis):
-        n = lax.axis_size(axis)
-        idx = lax.axis_index(axis)
-        buf = jnp.zeros((n,) + v.shape, v.dtype).at[idx].set(v)
-        out = lax.psum(buf, axis)
-        return out.reshape((n * v.shape[0],) + v.shape[1:])
-
-    return gather(gather(x, ici_axis), dcn_axis)
+    esize = x.dtype.itemsize
+    local = _gather_replicated(x, ici_axis)
+    _record("hier_allgather", local.size * esize, "ici")
+    out = _gather_replicated(local, dcn_axis)
+    _record("hier_allgather", out.size * esize, "dcn")
+    return out

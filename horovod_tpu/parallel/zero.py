@@ -122,7 +122,9 @@ class ShardedOptimizer:
                  axis_size: Optional[int] = None,
                  threshold: Optional[int] = None,
                  mean: bool = True,
-                 compression=None):
+                 compression=None,
+                 cross_axis_name: Optional[str] = None,
+                 cross_compression=None):
         if not isinstance(axis_name, str):
             raise NotImplementedError(
                 f"sharded_optimizer shards over ONE mesh axis; got "
@@ -134,6 +136,17 @@ class ShardedOptimizer:
         self.threshold = threshold
         self.mean = mean
         self.codec = compression_mod.resolve_codec(compression)
+        # Hierarchical (two-level) mode: axis_name is the intra-slice ICI
+        # axis; cross_axis_name the DCN axis.  Shards stay 1/ici per slice
+        # (replicated over DCN) and only the reduce leg crosses hosts —
+        # cross-host bytes drop to 1/ici of the flat scheme's.  The cross
+        # codec is deliberately independent ("int8 on DCN, none on ICI")
+        # and NEVER read from HOROVOD_COMPRESSION: quantizing the slow
+        # link is an explicit choice.
+        self.cross_axis_name = cross_axis_name
+        self.cross_codec = (compression_mod.resolve_codec(
+            cross_compression if cross_compression is not None else "none")
+            if cross_axis_name is not None else None)
 
     # -- layout ------------------------------------------------------------
     def _resolve_axis_size(self) -> int:
@@ -193,9 +206,26 @@ class ShardedOptimizer:
                 f"re-init (or re-shard the checkpoint) for this mesh")
         self._record(plan)
 
-        grad_shards, wire = compression_mod.compressed_reduce_scatter(
-            gleaves, self.axis_name, self.codec, plan=plan,
-            state=state.wire, mean=self.mean)
+        if self.cross_axis_name is not None:
+            # Two-level: intra-slice RS (unscaled) -> per-shard DCN psum
+            # (with the cross codec) -> one hoisted 1/(ici*dcn) multiply
+            # on the shard.  The all-gather below stays intra-slice.
+            grad_shards, wire = compression_mod.compressed_reduce_scatter(
+                gleaves, self.axis_name, self.codec, plan=plan,
+                state=state.wire, mean=False)
+            dcn = lax.axis_size(self.cross_axis_name)
+            grad_shards = [
+                compression_mod.cross_level_psum(
+                    s, self.cross_axis_name, self.cross_codec)
+                for s in grad_shards]
+            if self.mean:
+                grad_shards = [
+                    s * jnp.asarray(1.0 / (plan.axis_size * dcn), s.dtype)
+                    for s in grad_shards]
+        else:
+            grad_shards, wire = compression_mod.compressed_reduce_scatter(
+                gleaves, self.axis_name, self.codec, plan=plan,
+                state=state.wire, mean=self.mean)
         idx = lax.axis_index(self.axis_name)
         param_shards = [plan.shard_slice(b, flat, idx)
                         for b, flat in enumerate(
@@ -215,6 +245,11 @@ class ShardedOptimizer:
         telemetry.counter(
             "hvd_zero_updates_total",
             "Sharded (ZeRO-1) optimizer updates traced").inc()
+        if self.cross_axis_name is not None:
+            telemetry.counter(
+                "hvd_zero_hier_updates_total",
+                "ZeRO-1 updates using the two-level (ICI+DCN) reduce "
+                "path").inc()
         telemetry.counter(
             "hvd_zero_buckets_total",
             "Flat buckets in sharded optimizer updates").inc(
@@ -259,19 +294,30 @@ def sharded_optimizer(optimizer: optax.GradientTransformation,
                       mesh=None,
                       threshold: Optional[int] = None,
                       mean: bool = True,
-                      compression=None) -> ShardedOptimizer:
+                      compression=None,
+                      cross_axis_name: Optional[str] = None,
+                      cross_compression=None) -> ShardedOptimizer:
     """Wrap an element-wise optax ``optimizer`` for ZeRO-1 sharded updates
     over ``axis_name`` (see the module docstring for the algorithm and
     restrictions).  ``axis_size`` (or ``mesh``) pins the shard count at
     init time; omitted, it is read from ``hvd.mesh()``.  ``compression``
     selects the wire codec applied per bucket inside the reduce-scatter /
     all-gather pair (:mod:`horovod_tpu.ops.compression`; default none,
-    overridable via ``HOROVOD_COMPRESSION``)."""
+    overridable via ``HOROVOD_COMPRESSION``).
+
+    ``cross_axis_name`` enables the hierarchical mode on a two-level
+    (``"dcn"``/``"ici"``) mesh: ``axis_name`` becomes the intra-slice ICI
+    axis, state shards 1/ici-way per slice, and gradients cross hosts
+    only as 1/ici-size shards through one DCN ``psum`` — optionally
+    quantized by ``cross_compression`` (stateless: none/bf16/fp16/int8,
+    see :func:`horovod_tpu.ops.compression.cross_level_psum`)."""
     if mesh is not None and axis_size is None:
         axis_size = int(mesh.shape[axis_name])
     return ShardedOptimizer(optimizer, axis_name, axis_size=axis_size,
                             threshold=threshold, mean=mean,
-                            compression=compression)
+                            compression=compression,
+                            cross_axis_name=cross_axis_name,
+                            cross_compression=cross_compression)
 
 
 # ---------------------------------------------------------------------------

@@ -98,6 +98,23 @@ def allocate(hosts: List[HostSlots], np_: int) -> List[RankInfo]:
     return infos
 
 
+def topology_string(infos: List[RankInfo]) -> str:
+    """Serialize an allocation back to the ``"h1:2,h2:2"`` dialect of
+    :func:`parse_hosts`, in rank order — the value the launcher exports as
+    ``HOROVOD_TOPOLOGY`` so every rank can reconstruct the host→slots map
+    (``hvd.topology()``: hosts, leaders, local group) without a collective.
+    Built from the ACTIVE allocation, not the user's ``-H`` argument, so an
+    elastic restart or fleet resize that shrinks the world re-serializes
+    the topology the surviving ranks actually have."""
+    hosts: List[HostSlots] = []
+    for info in infos:   # rank order == host-major order (allocate())
+        if hosts and hosts[-1].hostname == info.hostname:
+            hosts[-1].slots += 1
+        else:
+            hosts.append(HostSlots(info.hostname, 1))
+    return ",".join(f"{h.hostname}:{h.slots}" for h in hosts)
+
+
 def free_slots(hosts: List[HostSlots],
                used: Dict[str, int]) -> List[HostSlots]:
     """Remaining per-host capacity after subtracting ``used`` (hostname →

@@ -617,6 +617,9 @@ def _launch_once(args, infos, addr, extra_env, report=None,
         extra_env["HOROVOD_JAX_DISTRIBUTED"] = "1"
         extra_env["HOROVOD_COORDINATOR_ADDR"] = f"{addr}:{jport}"
     multi_host = len({i.hostname for i in infos}) > 1
+    # Serialized host→slots map for hvd.topology() (recomputed per attempt,
+    # so elastic/fleet resizes re-export the surviving allocation).
+    extra_env["HOROVOD_TOPOLOGY"] = hosts.topology_string(infos)
     env_per_rank = [
         config_parser.runtime_env(info, addr, port, extra_env,
                                   multi_host=multi_host)

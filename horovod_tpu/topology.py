@@ -35,9 +35,26 @@ def build_mesh(axes: Sequence[str] = ("data",),
     n = len(devices)
     axes = tuple(axes)
     if shape is None:
-        if len(axes) != 1:
+        if axes == ("dcn", "ici"):
+            # Derive the hybrid shape from the launcher-discovered
+            # topology: dcn = number of hosts, ici = devices per host.
+            # hvd.topology() falls back to a single host when the job was
+            # not launched through hvdrun, which degenerates to (1, n) —
+            # a flat mesh with a unit DCN axis, still valid for the
+            # hierarchical collectives (the dcn psum is a no-op).
+            from horovod_tpu import basics as _basics
+            topo = _basics._topology_unchecked()
+            dcn = max(topo.num_hosts, 1)
+            if n % dcn != 0:
+                raise ValueError(
+                    f"cannot derive ('dcn', 'ici') mesh shape: {n} devices "
+                    f"do not divide evenly over {dcn} hosts "
+                    f"({topo.hosts}); pass shape= explicitly")
+            shape = (dcn, n // dcn)
+        elif len(axes) != 1:
             raise ValueError(f"shape required for multi-axis mesh {axes}")
-        shape = (n,)
+        else:
+            shape = (n,)
     want = int(np.prod(shape))
     if want < n:
         # Underfilled meshes take a device prefix — the launcher's rank

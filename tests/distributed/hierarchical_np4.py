@@ -1,0 +1,83 @@
+"""Two-host-shaped np=4 gate workload for the topology-aware hierarchical
+eager plane (NOT pytest-collected: ci/run_tests.sh launches it TWICE over
+ci/fake_ssh.sh with -H localhost:2,127.0.1.1:2 — once with the 2-level
+routing on, once flat — then compares the runs):
+
+* topology env injection: the launcher must export HOROVOD_TOPOLOGY and
+  hvd.topology() must reconstruct the host map, the leader set (global
+  rank of each host's slot 0) and this rank's local group from it;
+* bit-parity: allreduce outputs are saved per rank/size and the driver
+  asserts the hierarchical run is BITWISE identical to the flat run
+  (payloads are integer-valued float32, so float summation order cannot
+  differ — any byte difference is a routing bug);
+* byte accounting: each run dumps merged telemetry; the driver asserts
+  the hierarchical run's cross-host (leader-ring) payload is exactly
+  flat / local_size via hvd_collective_bytes_total{plane="eager",level}.
+"""
+import os
+import pathlib
+import sys
+
+import numpy as np
+
+rank = int(os.environ["HOROVOD_RANK"])
+size = int(os.environ["HOROVOD_SIZE"])
+assert size == 4, f"gate expects -np 4, got {size}"
+
+# --- topology env injection (tentpole part 1) -----------------------------
+topo_env = os.environ.get("HOROVOD_TOPOLOGY")
+assert topo_env == "localhost:2,127.0.1.1:2", (
+    f"launcher did not export the host map: HOROVOD_TOPOLOGY={topo_env!r}")
+
+import horovod_tpu as hvd  # noqa: E402
+
+hvd.init()
+t = hvd.topology()
+assert t.hosts == (("localhost", 2), ("127.0.1.1", 2)), t
+assert t.leaders == (0, 2), t            # leader election: slot 0 per host
+assert t.num_hosts == 2 and t.local_size == 2, t
+host = rank // 2
+assert t.local_group == (2 * host, 2 * host + 1), t
+assert t.leader == 2 * host, t
+assert t.is_leader == (rank % 2 == 0), t
+assert t.hostname == ("localhost" if host == 0 else "127.0.1.1"), t
+
+from horovod_tpu import basics  # noqa: E402
+
+hier = os.environ.get("HOROVOD_HIERARCHICAL_ALLREDUCE", "0") == "1"
+mode = "hier" if hier else "flat"
+rt = basics.runtime()
+if hier:
+    assert rt.hierarchical_enabled(), (
+        "hierarchical routing did not engage (agreement rejected the "
+        "launcher topology?)")
+    cfg = rt.tuned_config()
+    assert cfg.get("hier_allreduce") is True, cfg
+    assert cfg.get("hier_available") is True, cfg
+else:
+    assert not rt.hierarchical_enabled()
+# The rank-agreed view of the knob (the autotune sync path widened for
+# the hier booleans).  Called in BOTH modes so the two runs issue the
+# SAME op sequence — the driver's byte-ratio check subtracts the flat
+# residue of the hier run (bootstrap agreement + any op below the
+# threshold), which only cancels when the op sets match.
+agreed = rt.sync_tuned_config()
+assert agreed.get("hier_allreduce") is hier, agreed
+
+# --- bit-parity payloads ---------------------------------------------------
+# Integer-valued float32: every partial sum is exact, so the hierarchical
+# and flat reductions must agree BIT FOR BIT whatever the summation order.
+out_dir = pathlib.Path(os.environ["HOROVOD_HIER_GATE_DIR"])
+sizes = (65536, 1_000_003)   # >= 2 sizes; the odd one forces uneven chunks
+for n in sizes:
+    rng = np.random.default_rng(1234 + rank)
+    x = rng.integers(-1000, 1000, size=n).astype(np.float32)
+    got = np.asarray(hvd.allreduce(x, average=False, name=f"gate.{n}"))
+    np.save(out_dir / f"out_{mode}_r{rank}_n{n}.npy", got)
+
+# Explicit shutdown: Runtime.stop() publishes the final hier/flat byte
+# counters into telemetry BEFORE the atexit metrics dump writes the file.
+hvd.shutdown()
+if rank == 0:
+    print(f"HIER_GATE_OK mode={mode} sizes={len(sizes)}")
+sys.exit(0)

@@ -1,0 +1,293 @@
+"""Numerical-parity suite for the two-level (hierarchical) collectives.
+
+Covers the mesh plane of ISSUE 9: `hierarchical_allreduce` /
+`hierarchical_pytree_mean` against the flat `psum` / `fused_pytree_mean`
+oracles on a 2x2 ("dcn", "ici") mesh, padding edge cases, a dtype sweep,
+the replicated-out_spec regression for the all_gather-based gather legs,
+the hoisted average scaling, the two-level fused reduce-scatter, per-level
+cross codecs, and topology-derived mesh shapes.  The eager-plane
+hier-vs-flat bit-parity twin at np=4 lives in
+tests/distributed/hierarchical_np4.py (ci/run_tests.sh).
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.ops import fusion
+from horovod_tpu.parallel.hierarchical import (hierarchical_allgather,
+                                               hierarchical_allreduce,
+                                               hierarchical_pytree_mean)
+from horovod_tpu.topology import build_mesh
+
+
+def _mesh22(hvd):
+    # 8 virtual devices, 4 used: the prefix warning is expected, not the
+    # subject under test here.
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return build_mesh(axes=("dcn", "ici"), shape=(2, 2))
+
+
+# ---------------------------------------------------------------------------
+# Allreduce / pytree-mean parity on the 2x2 mesh.
+# ---------------------------------------------------------------------------
+
+def test_allreduce_matches_flat_psum_2x2(hvd):
+    mesh = _mesh22(hvd)
+    x = jnp.arange(4 * 3, dtype=jnp.float32).reshape(4, 3) + 0.5
+    args = dict(mesh=mesh, in_specs=P(("dcn", "ici")),
+                out_specs=P(("dcn", "ici")), check_vma=True)
+    a = jax.jit(jax.shard_map(
+        lambda v: lax.psum(v, ("dcn", "ici")), **args))(x)
+    b = jax.jit(jax.shard_map(
+        lambda v: hierarchical_allreduce(v, "ici", "dcn"), **args))(x)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_allreduce_average_matches_flat_mean(hvd):
+    """average=True (the hoisted 1/(ici*dcn) shard multiply) equals the
+    flat psum divided by the full axis product."""
+    mesh = _mesh22(hvd)
+    x = jnp.linspace(-3.0, 5.0, 12, dtype=jnp.float32).reshape(4, 3)
+    args = dict(mesh=mesh, in_specs=P(("dcn", "ici")),
+                out_specs=P(("dcn", "ici")), check_vma=True)
+    want = jax.jit(jax.shard_map(
+        lambda v: lax.psum(v, ("dcn", "ici")) / 4.0, **args))(x)
+    got = jax.jit(jax.shard_map(
+        lambda v: hierarchical_allreduce(v, "ici", "dcn", average=True),
+        **args))(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6)
+
+
+def test_pytree_mean_matches_fused_pytree_mean(hvd):
+    mesh = _mesh22(hvd)
+    rng = np.random.default_rng(7)
+    tree = {"w": jnp.asarray(rng.standard_normal((5, 3)), jnp.float32),
+            "b": jnp.asarray(rng.standard_normal((7,)), jnp.float32)}
+    args = dict(mesh=mesh, in_specs=P(), out_specs=P(), check_vma=True)
+    want = jax.jit(jax.shard_map(
+        lambda t: fusion.fused_pytree_mean(t, ("dcn", "ici")), **args))(tree)
+    got = jax.jit(jax.shard_map(
+        lambda t: hierarchical_pytree_mean(t, "ici", "dcn"), **args))(tree)
+    for k in tree:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Padding + dtype edge cases.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 7, 9])
+def test_allreduce_padding_not_divisible_by_ici(hvd, n):
+    """Every n % ici residue (ici=4) exercises the pad/unpad path."""
+    mesh = build_mesh(axes=("dcn", "ici"), shape=(2, 4))
+    x = jnp.arange(n, dtype=jnp.float32) + 1.0
+    out = jax.jit(jax.shard_map(
+        lambda v: hierarchical_allreduce(v, "ici", "dcn"),
+        mesh=mesh, in_specs=P(), out_specs=P(), check_vma=True))(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x) * 8.0,
+                               rtol=1e-6)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float16", "bfloat16",
+                                   "int32"])
+def test_allreduce_dtype_sweep(hvd, dtype):
+    mesh = _mesh22(hvd)
+    x = jnp.asarray([1, 2, 3, 4, 5], dtype=dtype)
+    out = jax.jit(jax.shard_map(
+        lambda v: hierarchical_allreduce(v, "ici", "dcn"),
+        mesh=mesh, in_specs=P(), out_specs=P(), check_vma=True))(x)
+    np.testing.assert_array_equal(np.asarray(out, dtype="float64"),
+                                  np.asarray(x, dtype="float64") * 4.0)
+
+
+def test_allreduce_average_int_dtype_falls_back(hvd):
+    """Integer payloads cannot take the hoisted float multiply; average
+    still divides (matching the pre-hoist semantics)."""
+    mesh = _mesh22(hvd)
+    x = jnp.asarray([4, 8, 12], dtype=jnp.int32)
+    out = jax.jit(jax.shard_map(
+        lambda v: hierarchical_allreduce(v, "ici", "dcn", average=True),
+        mesh=mesh, in_specs=P(), out_specs=P(), check_vma=True))(x)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+# ---------------------------------------------------------------------------
+# S1 regression: gather legs return through a replicated P() out_spec
+# under check_vma=True.
+# ---------------------------------------------------------------------------
+
+def test_allgather_replicated_out_spec_check_vma(hvd):
+    """The all_gather-based legs must produce output typed replicated:
+    out_specs=P() + check_vma=True fails to trace otherwise."""
+    mesh = _mesh22(hvd)
+    x = jnp.arange(8, dtype=jnp.float32).reshape(4, 2)
+    out = jax.jit(jax.shard_map(
+        lambda v: hierarchical_allgather(v, "ici", "dcn"),
+        mesh=mesh, in_specs=P(("dcn", "ici")), out_specs=P(),
+        check_vma=True))(x)
+    # Gather order is (dcn, ici, local dim 0) — matches a flat allgather
+    # over a mesh whose ici axis is minor, i.e. the original row order.
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=0)
+
+
+def test_allreduce_replicated_out_spec_check_vma(hvd):
+    mesh = _mesh22(hvd)
+    x = jnp.arange(6, dtype=jnp.float32)
+    out = jax.jit(jax.shard_map(
+        lambda v: hierarchical_allreduce(v, "ici", "dcn"),
+        mesh=mesh, in_specs=P(), out_specs=P(), check_vma=True))(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x) * 4.0,
+                               rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Two-level fused reduce-scatter (the ZeRO-1 reduce leg).
+# ---------------------------------------------------------------------------
+
+def test_fused_hierarchical_reduce_scatter_parity(hvd):
+    """RS(ici)+psum(dcn) shards, gathered back over ici only, must equal
+    the flat mean over both axes."""
+    mesh = build_mesh(axes=("dcn", "ici"), shape=(2, 4))
+    rng = np.random.default_rng(11)
+    leaves = [jnp.asarray(rng.standard_normal((6, 3)), jnp.float32),
+              jnp.asarray(rng.standard_normal((5,)), jnp.float32)]
+
+    def hier(ts):
+        shards, plan = fusion.fused_hierarchical_reduce_scatter(
+            ts, "ici", "dcn", mean=True)
+        return fusion.fused_all_gather(shards, plan, "ici")
+
+    def flat(ts):
+        return [lax.psum(t, ("dcn", "ici")) / 8.0 for t in ts]
+
+    args = dict(mesh=mesh, in_specs=P(), out_specs=P(), check_vma=True)
+    got = jax.jit(jax.shard_map(hier, **args))(leaves)
+    want = jax.jit(jax.shard_map(flat, **args))(leaves)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_zero_cross_axis_matches_flat_zero(hvd):
+    """ShardedOptimizer(cross_axis_name=...) on a (2, 4) mesh tracks the
+    flat 8-way sharded optimizer (same grads, same params)."""
+    import optax
+    from horovod_tpu.parallel.zero import sharded_optimizer
+
+    mesh = build_mesh(axes=("dcn", "ici"), shape=(2, 4))
+    rng = np.random.default_rng(3)
+    params = {"w": jnp.asarray(rng.standard_normal((6, 2)), jnp.float32)}
+    grads = {"w": jnp.asarray(rng.standard_normal((6, 2)), jnp.float32)}
+
+    flat_opt = sharded_optimizer(optax.sgd(0.1), axis_name="ici",
+                                 axis_size=4)
+    hier_opt = sharded_optimizer(optax.sgd(0.1), axis_name="ici",
+                                 axis_size=4, cross_axis_name="dcn")
+
+    def step(opt):
+        def f(p, g):
+            st = opt.init(p)
+            upd, _ = opt.update(g, st, p)
+            return optax.apply_updates(p, upd)
+        return jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+            check_vma=True))(params, grads)
+
+    # Oracle: flat ici-only sharding averages over 4; the hierarchical
+    # run averages over all 8 ranks.  With replicated grads both equal
+    # plain SGD on the raw gradient.
+    want = {"w": params["w"] - 0.1 * grads["w"]}
+    for out in (step(flat_opt), step(hier_opt)):
+        np.testing.assert_allclose(np.asarray(out["w"]),
+                                   np.asarray(want["w"]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Per-level cross codecs.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("codec", ["none", "bf16", "fp16", "int8"])
+def test_cross_level_psum_codecs(hvd, codec):
+    from horovod_tpu.ops.compression import cross_level_psum
+
+    mesh = build_mesh(axes=("dcn", "ici"), shape=(2, 4))
+    x = jnp.asarray([1.0, -2.0, 3.5, 0.0], dtype=jnp.float32)
+    out = jax.jit(jax.shard_map(
+        lambda v: cross_level_psum(v, "dcn", codec),
+        mesh=mesh, in_specs=P(), out_specs=P(), check_vma=True))(x)
+    tol = 0.0 if codec == "none" else 0.1
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x) * 2.0,
+                               atol=tol, rtol=0.02 if tol else 0)
+
+
+def test_cross_level_psum_rejects_stateful_codec(hvd):
+    from horovod_tpu.ops.compression import cross_level_psum
+
+    mesh = build_mesh(axes=("dcn", "ici"), shape=(2, 4))
+    with pytest.raises(ValueError, match="stateless"):
+        jax.jit(jax.shard_map(
+            lambda v: cross_level_psum(v, "dcn", "powersgd"),
+            mesh=mesh, in_specs=P(), out_specs=P()))(
+                jnp.ones((4,), jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# S6: topology-derived mesh shapes.
+# ---------------------------------------------------------------------------
+
+def test_build_mesh_auto_dcn_ici_from_topology(monkeypatch):
+    """axes=("dcn","ici") with no shape derives (hosts, devices/hosts)
+    from HOROVOD_TOPOLOGY."""
+    monkeypatch.setenv("HOROVOD_TOPOLOGY", "a:1,b:1")
+    monkeypatch.setenv("HOROVOD_SIZE", "2")
+    mesh = build_mesh(axes=("dcn", "ici"))
+    assert mesh.axis_names == ("dcn", "ici")
+    assert mesh.devices.shape == (2, 4)   # 2 hosts x (8 devices / 2)
+
+
+def test_build_mesh_auto_single_host_degenerates(monkeypatch):
+    monkeypatch.delenv("HOROVOD_TOPOLOGY", raising=False)
+    mesh = build_mesh(axes=("dcn", "ici"))
+    assert mesh.devices.shape[0] == 1    # unit DCN axis
+
+
+def test_build_mesh_auto_indivisible_raises(monkeypatch):
+    monkeypatch.setenv("HOROVOD_TOPOLOGY", "a:1,b:1,c:1")
+    monkeypatch.setenv("HOROVOD_SIZE", "3")
+    with pytest.raises(ValueError, match="divide"):
+        build_mesh(axes=("dcn", "ici"))   # 8 devices over 3 hosts
+
+
+def test_build_mesh_underfilled_warning_still_fires():
+    """Mismatched EXPLICIT shapes keep warning about the device prefix
+    (the guard the auto-shape path must not silence)."""
+    with pytest.warns(UserWarning, match="covers 4 of 8"):
+        build_mesh(axes=("dcn", "ici"), shape=(2, 2))
+
+
+def test_build_mesh_multi_axis_other_names_still_require_shape():
+    with pytest.raises(ValueError, match="shape required"):
+        build_mesh(axes=("data", "model"))
+
+
+def test_hvd_topology_accessor(hvd, monkeypatch):
+    """hvd.topology() reflects HOROVOD_TOPOLOGY (leaders = slot 0 of each
+    host, local_group = this host's ranks)."""
+    import horovod_tpu as hvd_mod
+    monkeypatch.setenv("HOROVOD_TOPOLOGY", "x:1")
+    t = hvd_mod.topology()
+    assert t.size == hvd_mod.size() and t.rank == hvd_mod.rank()
+    assert t.leaders[0] == 0
+    assert t.rank in t.local_group
+    assert t.leader == t.local_group[0]
+    assert sum(s for _, s in t.hosts) == t.size
