@@ -1,0 +1,32 @@
+# Developer entry points.  CI runs ci/run_tests.sh; these are the local
+# shortcuts for its individual lanes.
+
+.PHONY: lint test build unittest sanitize sanitize-asan sanitize-ubsan
+
+# Distributed-correctness static analysis (docs/static_analysis.md):
+# rank-divergent collectives, env-var registry drift, telemetry drift.
+lint:
+	python -m tools.hvdlint
+
+# Uninstrumented native runtime build (flock-serialized, idempotent).
+build:
+	python -m horovod_tpu.native.build
+
+# Native C++ oracles (bayes/response-cache/param-monitor gates).
+unittest:
+	$(MAKE) -C horovod_tpu/native/cc unittest
+
+# Fast pytest lane on the virtual CPU mesh.
+test:
+	python -m pytest tests/ -x -q
+
+# Concurrency gate: sanitizer rebuild + np=2 distributed suite with the
+# sanitizer runtime preloaded; triaged logs land in ci/artifacts/.
+sanitize:
+	ci/run_sanitizer.sh tsan
+
+sanitize-asan:
+	ci/run_sanitizer.sh asan
+
+sanitize-ubsan:
+	ci/run_sanitizer.sh ubsan

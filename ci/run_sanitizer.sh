@@ -1,0 +1,119 @@
+#!/usr/bin/env bash
+# Native concurrency gate: rebuild libhorovod_tpu.so under a sanitizer,
+# preload the matching runtime into the Python ranks, and run the np=2
+# distributed native-op suite against it.  Any report whose SUMMARY frame
+# lands in libhorovod_tpu.so fails the lane; reports suppressed by
+# horovod_tpu/native/cc/tsan.supp (jaxlib/XLA's uninstrumented internals)
+# are counted and archived but do not fail.
+#
+# Usage: ci/run_sanitizer.sh [tsan|asan|ubsan]   (default tsan)
+# Artifacts (raw logs + triage summary) land in $SAN_ARTIFACT_DIR
+# (default ci/artifacts/sanitizer/<variant>).
+#
+# docs/static_analysis.md, "Sanitizer lanes" documents the local recipe.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+VARIANT="${1:-tsan}"
+CC_DIR=horovod_tpu/native/cc
+SUPP="$PWD/$CC_DIR/tsan.supp"
+ART="${SAN_ARTIFACT_DIR:-ci/artifacts/sanitizer/$VARIANT}"
+LOG_BASE="$ART/report"
+
+case "$VARIANT" in
+  tsan)
+    PRELOAD="$(g++ -print-file-name=libtsan.so)"
+    # exitcode=0: the suite's pass/fail is the functional signal; race
+    # verdicts come from the log triage below, after suppressions.
+    # report_mutex_bugs=0: libtsan is preloaded into an uninstrumented
+    # CPython/jaxlib process whose internal allocators free memory TSan
+    # cannot see, so its sync-object table rots on address reuse and the
+    # mutex-USAGE checks (double lock / unlock of unlocked / destroyed
+    # mutex) misfire on provably-scoped guards — including inside
+    # libstdc++'s own condition_variable::wait.  Data races,
+    # use-after-free and thread leaks (the signals this gate exists for)
+    # are unaffected.
+    SAN_ENV="TSAN_OPTIONS=log_path=$PWD/$LOG_BASE suppressions=$SUPP exitcode=0 report_mutex_bugs=0"
+    ;;
+  asan)
+    PRELOAD="$(g++ -print-file-name=libasan.so)"
+    # Python itself trips ASan's allocation interposition checks when the
+    # runtime is merely preloaded; keep the gate on OUR library's errors.
+    SAN_ENV="ASAN_OPTIONS=log_path=$PWD/$LOG_BASE exitcode=0:detect_leaks=0:verify_asan_link_order=0"
+    ;;
+  ubsan)
+    PRELOAD="$(g++ -print-file-name=libubsan.so)"
+    SAN_ENV="UBSAN_OPTIONS=log_path=$PWD/$LOG_BASE print_stacktrace=1"
+    ;;
+  *)
+    echo "run_sanitizer.sh: unknown variant '$VARIANT' (tsan|asan|ubsan)" >&2
+    exit 2
+    ;;
+esac
+
+if [ ! -f "$PRELOAD" ] || [ "$PRELOAD" = "${PRELOAD#/}" ]; then
+  echo "run_sanitizer.sh: lib${VARIANT}.so not found by g++; skipping" >&2
+  exit 0
+fi
+
+mkdir -p "$ART"
+rm -f "$LOG_BASE".*
+
+echo "--- $VARIANT: instrumented rebuild of libhorovod_tpu.so"
+make -C "$CC_DIR" "$VARIANT"
+
+restore() {
+  # Whatever happened, never leave an instrumented library behind for
+  # later lanes (or developers) to load by accident.
+  make -C "$CC_DIR" clean >/dev/null
+  python -m horovod_tpu.native.build >/dev/null
+}
+trap restore EXIT
+
+echo "--- $VARIANT: np=2 distributed native-op suite (preload $PRELOAD)"
+SAN_KEY="${SAN_ENV%%=*}"
+SAN_VAL="${SAN_ENV#*=}"
+set +e
+env LD_PRELOAD="$PRELOAD" "$SAN_KEY=$SAN_VAL" \
+  JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  python -m horovod_tpu.runner -np 2 \
+  python -m pytest tests/distributed/test_native_ops.py -x -q
+SUITE_RC=$?
+set -e
+if [ "$SUITE_RC" -ne 0 ]; then
+  echo "$VARIANT: functional suite failed (rc=$SUITE_RC)" >&2
+  exit "$SUITE_RC"
+fi
+
+# --- triage: suppressed noise vs frames that fail the lane -------------
+shopt -s nullglob
+LOGS=("$LOG_BASE".*)
+TOTAL=0 OURS=0 SUPPRESSED=0
+if [ "${#LOGS[@]}" -gt 0 ]; then
+  TOTAL=$(grep -h "^SUMMARY:" "${LOGS[@]}" | wc -l || true)
+  OURS=$(grep -h "SUMMARY:.*libhorovod_tpu" "${LOGS[@]}" | wc -l || true)
+  # Suppression hit counts are printed by TSan at process exit into the
+  # same logs ("ThreadSanitizer: Matched N suppressions").
+  SUPPRESSED=$( (grep -ho "Matched [0-9]* suppressions" "${LOGS[@]}" \
+    || true) | awk '{s+=$2} END {print s+0}')
+fi
+
+{
+  echo "sanitizer lane: $VARIANT"
+  echo "reports (post-suppression SUMMARY lines): $TOTAL"
+  echo "  attributed to libhorovod_tpu.so (FAIL): $OURS"
+  echo "  suppression matches (jaxlib/XLA noise): $SUPPRESSED"
+  if [ "$TOTAL" -gt 0 ]; then
+    echo "top frames of surviving reports (all in uninstrumented deps"
+    echo "unless the lane failed):"
+    grep -h "^SUMMARY:" "${LOGS[@]}" | sort | uniq -c | sort -rn | head -10
+  fi
+} | tee "$ART/triage.txt"
+
+if [ "$OURS" -gt 0 ]; then
+  echo "--- $VARIANT: report(s) attributed to libhorovod_tpu.so:" >&2
+  grep -nE -B2 -A20 "SUMMARY:.*libhorovod_tpu" "${LOGS[@]}" | head -120 >&2
+  echo "$VARIANT lane FAILED (logs archived in $ART)" >&2
+  exit 1
+fi
+echo "$VARIANT lane OK (artifacts in $ART)"

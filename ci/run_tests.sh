@@ -4,7 +4,14 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "--- build native runtime"
+echo "--- hvdlint (distributed-correctness static analysis;
+--- docs/static_analysis.md: rank-divergent collectives, env-var
+--- registry drift, telemetry catalogue drift)"
+python -m tools.hvdlint
+
+echo "--- build native runtime (warnings are errors in CI)"
+make -C horovod_tpu/native/cc clean >/dev/null
+make -C horovod_tpu/native/cc WERROR=1
 python -m horovod_tpu.native.build
 
 #  (The Bayesian-optimizer grid-search oracle gate runs inside the fast
@@ -292,27 +299,10 @@ echo "--- hierarchical allreduce A/B (BENCH json; two hvdrun -np 4
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
   python -m horovod_tpu.benchmark --hierarchical --out BENCH_hier.json
 
-echo "--- TSAN build + smoke (races inside libhorovod_tpu.so fail CI)"
-make -C horovod_tpu/native/cc tsan
-rm -f /tmp/tsan_ci.*
-LD_PRELOAD="$(g++ -print-file-name=libtsan.so)" \
-  TSAN_OPTIONS="log_path=/tmp/tsan_ci exitcode=0" \
-  JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
-  python -m horovod_tpu.runner -np 2 \
-  python -m pytest tests/distributed/test_native_ops.py -x -q
-# jaxlib's uninstrumented XLA internals produce known-noise reports
-# (whose stacks may even pass through interposed frames of our .so);
-# only races TSAN itself ATTRIBUTES to our library — the SUMMARY line —
-# are failures.
-if grep -lE "SUMMARY: ThreadSanitizer.*libhorovod_tpu" /tmp/tsan_ci.* \
-    2>/dev/null; then
-  echo "TSAN: data race attributed to libhorovod_tpu.so"
-  grep -nE -B2 -A20 "SUMMARY: ThreadSanitizer.*libhorovod_tpu" \
-    /tmp/tsan_ci.* | head -80
-  exit 1
-fi
-# restore the uninstrumented library for anything run after CI
-make -C horovod_tpu/native/cc clean >/dev/null
-python -m horovod_tpu.native.build >/dev/null
+echo "--- sanitizer lane (TSAN build + np=2 distributed suite; races
+--- attributed to libhorovod_tpu.so fail CI, jaxlib/XLA noise is
+--- suppressed by native/cc/tsan.supp; raw logs + triage summary are
+--- archived under ci/artifacts/sanitizer/)"
+ci/run_sanitizer.sh tsan
 
 echo "CI OK"

@@ -35,6 +35,7 @@ from typing import NamedTuple, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from horovod_tpu import config
 from horovod_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -82,8 +83,8 @@ def _reset_state_locked() -> None:
 
 
 def _env_int(name: str, default: int) -> int:
-    v = os.environ.get(name)
-    return default if v is None or v == "" else int(v)
+    # Registry-checked read (python -m tools.hvdlint, env-registry rule).
+    return config.env_int(name, default)
 
 
 def init(comm=None, ranks: Optional[Sequence[int]] = None) -> None:
@@ -105,8 +106,8 @@ def init(comm=None, ranks: Optional[Sequence[int]] = None) -> None:
         if _state.initialized:
             return
 
-        coord = os.environ.get("HOROVOD_COORDINATOR_ADDR")
-        if coord and os.environ.get("HOROVOD_JAX_DISTRIBUTED", "0") == "1":
+        coord = config.env_raw("HOROVOD_COORDINATOR_ADDR")
+        if coord and config.env_str("HOROVOD_JAX_DISTRIBUTED", "0") == "1":
             # Multi-host JAX bootstrap (replaces gloo full-mesh rendezvous,
             # reference gloo_context.cc:56-157).  Must run before ANY other
             # jax call that would initialize the XLA backend, so no
@@ -125,8 +126,8 @@ def init(comm=None, ranks: Optional[Sequence[int]] = None) -> None:
             # (MPI_Comm_split_type COMM_TYPE_SHARED, mpi_controller.cc:25-81);
             # env overrides win, then an mpi4py shared split, then the
             # single-node assumption.
-            local_rank = os.environ.get("HOROVOD_LOCAL_RANK")
-            local_size = os.environ.get("HOROVOD_LOCAL_SIZE")
+            local_rank = config.env_raw("HOROVOD_LOCAL_RANK")
+            local_size = config.env_raw("HOROVOD_LOCAL_SIZE")
             if local_rank is not None and local_size is not None:
                 _state.local_rank = int(local_rank)
                 _state.local_size = int(local_size)
@@ -190,7 +191,7 @@ def init(comm=None, ranks: Optional[Sequence[int]] = None) -> None:
                   "devices=%d", _state.rank, _state.size, _state.local_rank,
                   _state.local_size, len(jax.local_devices()))
 
-    if os.environ.get("HOROVOD_HEALTH_RPC"):
+    if config.env_raw("HOROVOD_HEALTH_RPC"):
         # The hvdrun health plane is listening: start pushing heartbeats
         # as soon as the worker has a rank (lazy import keeps resilience
         # out of the minimal init path).
@@ -201,7 +202,7 @@ def init(comm=None, ranks: Optional[Sequence[int]] = None) -> None:
 def shutdown() -> None:
     """Shut down horovod_tpu (reference ``basics.py:63-67`` →
     ``horovod_shutdown``, ``operations.cc:624-629``)."""
-    if os.environ.get("HOROVOD_HEALTH_RPC"):
+    if config.env_raw("HOROVOD_HEALTH_RPC"):
         from horovod_tpu import resilience
         resilience.stop_heartbeat()
     with _state.lock:
@@ -306,7 +307,7 @@ def _build_topology(rank: int, size: int, local_rank: int, local_size: int,
     jobs: the launcher re-exports the string on every attempt, but a
     worker that mutated HOROVOD_SIZE itself (tests do) must not inherit a
     stale host list."""
-    spec = os.environ.get("HOROVOD_TOPOLOGY", "").strip()
+    spec = config.env_str("HOROVOD_TOPOLOGY", "").strip()
     hosts: list = []
     if spec:
         for part in spec.split(","):
@@ -324,7 +325,7 @@ def _build_topology(rank: int, size: int, local_rank: int, local_size: int,
         # Uniform block synthesis (rank = host*local_size + local_rank):
         # cross_size hosts of local_size slots, last host taking the
         # remainder of a non-divisible world.
-        name = os.environ.get("HOROVOD_HOSTNAME", "")
+        name = config.env_str("HOROVOD_HOSTNAME", "")
         n_hosts = max(cross_size, 1)
         for h in range(n_hosts):
             slots = min(local_size, size - h * local_size) \
@@ -345,7 +346,7 @@ def _build_topology(rank: int, size: int, local_rank: int, local_size: int,
             host_idx, host_start, host_slots = i, starts[i], slots
             break
     hostname = hosts[host_idx][0] if hosts else \
-        os.environ.get("HOROVOD_HOSTNAME", "")
+        config.env_str("HOROVOD_HOSTNAME", "")
     local_group = tuple(range(host_start, host_start + host_slots))
     return Topology(
         hosts=tuple(hosts), hostname=hostname, leaders=tuple(leaders),

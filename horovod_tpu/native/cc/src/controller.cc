@@ -656,6 +656,10 @@ Response Controller::ConstructResponse(const std::string& key) {
       // Payload size for joined ranks' zero-participation buffers.
       resp.first_dims.assign(1, NumElements(first.shape));
       break;
+    case OpType::kProcessSet:
+      // Handled (and returned from) by the registration branch above;
+      // listed so -Wswitch keeps this switch exhaustive.
+      break;
   }
   return resp;
 }

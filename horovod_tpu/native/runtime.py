@@ -19,7 +19,7 @@ from typing import Optional
 
 import numpy as np
 
-from horovod_tpu import faults, telemetry
+from horovod_tpu import config, faults, telemetry
 from horovod_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -37,10 +37,8 @@ class EagerStallError(RuntimeError):
 
 
 def _env_float(name: str, default: Optional[float]) -> Optional[float]:
-    v = os.environ.get(name)
-    if v in (None, ""):
-        return default
-    return float(v)
+    # Registry-checked read (python -m tools.hvdlint, env-registry rule).
+    return config.env_float(name, default)
 
 _LIB_NAME = "libhorovod_tpu.so"
 
@@ -70,7 +68,7 @@ def _find_library() -> str:
         os.path.join(here, _LIB_NAME),
         os.path.join(here, "cc", "build", _LIB_NAME),
     ]
-    env = os.environ.get("HOROVOD_TPU_NATIVE_LIB")
+    env = config.env_raw("HOROVOD_TPU_NATIVE_LIB")
     if env:
         # An explicit override must be honored or fail loudly — never
         # silently substituted with the default build.
@@ -126,7 +124,7 @@ class Runtime:
         # copying hvd_read_output path): the returned ndarray wraps the
         # native output buffer directly and releases it when garbage
         # collected.  Skips one full-payload copy into cold pages per op.
-        self._zero_copy = os.environ.get(
+        self._zero_copy = config.env_str(
             "HOROVOD_EAGER_ZERO_COPY", "1") not in ("0", "false", "")
         # Rank-agreed autotuned fusion threshold, latched ONLY inside the
         # sync_tuned_config() collective.  The raw hvd_tuned_* atomics
@@ -176,7 +174,7 @@ class Runtime:
         lib.hvd_release.restype = None
         lib.hvd_last_error.argtypes = []
         lib.hvd_last_error.restype = ctypes.c_char_p
-        addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR", "127.0.0.1")
+        addr = config.env_str("HOROVOD_RENDEZVOUS_ADDR", "127.0.0.1")
         self._hier_fn = getattr(lib, "hvd_hierarchical_enabled", None)
         self._hier_ag_fn = getattr(
             lib, "hvd_hierarchical_allgather_enabled", None)
@@ -219,7 +217,7 @@ class Runtime:
                 fn.restype = ctypes.c_longlong
                 self._hier_counter_fns[sym] = fn
         self._hier_published = {}   # sym -> last value already inc'd
-        port = int(os.environ.get("HOROVOD_RENDEZVOUS_PORT", "0"))
+        port = config.env_int("HOROVOD_RENDEZVOUS_PORT", 0)
         rc = lib.hvd_init(self.rank, self.size, self.local_rank,
                           self.local_size, addr.encode(), port)
         if rc != 0:

@@ -19,6 +19,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from horovod_tpu import config
+
 from horovod_tpu import faults, telemetry
 from horovod_tpu.resilience import PREEMPTION_RC
 from horovod_tpu.runner.hosts import RankInfo
@@ -30,7 +32,7 @@ DEFAULT_TERMINATE_GRACE_SECONDS = 10.0
 
 
 def _terminate_grace_seconds() -> float:
-    v = os.environ.get("HOROVOD_TERMINATE_GRACE_SECONDS", "")
+    v = config.env_str("HOROVOD_TERMINATE_GRACE_SECONDS", "")
     try:
         return float(v) if v else DEFAULT_TERMINATE_GRACE_SECONDS
     except ValueError:
@@ -94,7 +96,7 @@ class RankProcess:
             # HOROVOD_SSH_CMD: override for tests and exotic transports
             # (reference horovodrun has no override; its ssh path is
             # untested for the same reason ours would otherwise be).
-            ssh = os.environ.get("HOROVOD_SSH_CMD", "ssh")
+            ssh = config.env_str("HOROVOD_SSH_CMD", "ssh")
             cmd = [ssh, "-o", "StrictHostKeyChecking=no",
                    self.info.hostname, remote]
             env = dict(os.environ)

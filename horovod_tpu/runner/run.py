@@ -24,7 +24,7 @@ import time
 from typing import List
 
 import horovod_tpu
-from horovod_tpu import telemetry
+from horovod_tpu import config, telemetry
 from horovod_tpu.resilience import PREEMPTION_RC
 from horovod_tpu.runner import config_parser, hosts, launch
 
@@ -215,7 +215,7 @@ def run_command(args) -> int:
     # an externally coordinated job).
     extra_env.setdefault(
         "HOROVOD_SECRET_KEY",
-        os.environ.get("HOROVOD_SECRET_KEY") or config_parser.job_secret())
+        config.env_raw("HOROVOD_SECRET_KEY") or config_parser.job_secret())
 
     # The coordinator lives on rank 0's host.  Only an all-local job may use
     # loopback: with remote ranks in the mix they must reach rank 0 by its
@@ -240,7 +240,7 @@ def run_command(args) -> int:
     blacklist = hosts.HostBlacklist(
         cooldown=getattr(args, "blacklist_cooldown", None))
     metrics_file = (getattr(args, "metrics_file", None) or
-                    os.environ.get("HOROVOD_METRICS_FILE", "").strip() or
+                    config.env_str("HOROVOD_METRICS_FILE", "").strip() or
                     None)
     collector = None
     if metrics_file:
@@ -255,17 +255,17 @@ def run_command(args) -> int:
     # tests) that stub _launch_once keep their historical signature.
     hb_interval = getattr(args, "heartbeat_interval", None)
     if hb_interval is None:
-        raw = os.environ.get("HOROVOD_HEARTBEAT_INTERVAL", "").strip()
+        raw = config.env_str("HOROVOD_HEARTBEAT_INTERVAL", "").strip()
         hb_interval = float(raw) if raw else None
     health = None
     if hb_interval:
         deadline = float(
-            os.environ.get("HOROVOD_HEARTBEAT_DEADLINE", "").strip()
+            config.env_str("HOROVOD_HEARTBEAT_DEADLINE", "").strip()
             or 5.0 * hb_interval)
         hang = getattr(args, "hang_deadline", None)
         if hang is None:
             hang = float(
-                os.environ.get("HOROVOD_HANG_DEADLINE", "").strip() or 0.0)
+                config.env_str("HOROVOD_HANG_DEADLINE", "").strip() or 0.0)
         health = _HealthPlane(extra_env["HOROVOD_SECRET_KEY"],
                               hb_interval, deadline, hang)
     # Warm-restart spill scratch dir: one per JOB, stable across elastic
@@ -273,13 +273,13 @@ def run_command(args) -> int:
     # spills.  A user-provided HOROVOD_SPILL_DIR is respected (and never
     # deleted); otherwise the launcher owns a temp dir for the job.
     owned_spill_dir = None
-    spill_scratch = os.environ.get("HOROVOD_SPILL_DIR", "").strip()
+    spill_scratch = config.env_str("HOROVOD_SPILL_DIR", "").strip()
     if restarts > 0 and not spill_scratch:
         # Name the job in the prefix when running under the fleet
         # controller so two jobs' scratch dirs are tellable apart on a
         # shared host (the fleet normally provisions HOROVOD_SPILL_DIR
         # itself; this is the fallback path).
-        job = os.environ.get("HOROVOD_FLEET_JOB", "").strip()
+        job = config.env_str("HOROVOD_FLEET_JOB", "").strip()
         prefix = f"hvd-spill-{job}-" if job else "hvd-spill-"
         owned_spill_dir = tempfile.mkdtemp(prefix=prefix)
         spill_scratch = owned_spill_dir

@@ -444,7 +444,7 @@ def test_process_set_allgather_broadcast_barrier(hvd, rank, size):
     if size < 2:
         pytest.skip("needs >= 2 ranks")
     ps = hvd.add_process_set(list(range(size - 1)))  # all but the last rank
-    if rank < size - 1:
+    if rank < size - 1:  # hvdlint: allow(rank-divergent) — subset collectives over ps
         out = np.asarray(hvd.allgather(
             np.full((rank + 1, 2), float(rank), np.float32),
             name="ps.ag", process_set=ps))
@@ -465,7 +465,7 @@ def test_process_set_registration_validation(hvd, rank, size):
         pytest.skip("needs >= 2 ranks")
     # Non-member submission is refused locally.
     ps = hvd.add_process_set([0])
-    if rank != 0:
+    if rank != 0:  # hvdlint: allow(rank-divergent) — non-member refusal is the test
         with pytest.raises(RuntimeError, match="not a member"):
             hvd.allreduce(np.ones(1, np.float32), name="ps.nonmember",
                           process_set=ps)
@@ -484,7 +484,7 @@ def test_process_set_alltoall_uneven(hvd, rank, size):
         pytest.skip("needs >= 3 ranks")
     members = [0, size - 1]
     ps = hvd.add_process_set(members)
-    if rank in members:
+    if rank in members:  # hvdlint: allow(rank-divergent) — subset alltoall over ps
         pos = members.index(rank)
         splits = np.array([1, 2], np.int64)     # to position 0 and 1
         x = np.full((3, 1), float(100 + pos), np.float32)
@@ -506,7 +506,7 @@ def test_process_set_then_cached_global_steady_state(hvd, rank, size):
         pytest.skip("needs >= 2 ranks")
     ps = hvd.add_process_set([0])
     for step in range(4):
-        if rank == 0:
+        if rank == 0:  # hvdlint: allow(rank-divergent) — member-only subset traffic
             hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
                           name="ps.cachemix.sub", process_set=ps)
         # Same names every step -> cached bit announcements after step 1.

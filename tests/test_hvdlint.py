@@ -1,0 +1,330 @@
+"""hvdlint's own gate: every rule fires on its trigger fixture, stays
+quiet on the matching clean fixture, honors the pragma grammar — and the
+shipped tree itself is lint-clean.
+
+Fixtures live in string literals, so the linter's AST scan of this file
+never sees them as real code.
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from tools import hvdlint
+from tools.hvdlint import env_registry, metrics_drift, rank_divergence
+from tools.hvdlint.common import Source, repo_root
+
+REPO = repo_root(os.path.dirname(__file__))
+
+
+def _src(code, path="horovod_tpu/fixture.py"):
+    return Source(path, textwrap.dedent(code))
+
+
+def _rank_findings(code):
+    return rank_divergence.check_source(_src(code))
+
+
+# --- rank-divergence ---------------------------------------------------
+
+def test_rank_guarded_collective_triggers():
+    out = _rank_findings("""
+        import horovod_tpu as hvd
+        def f():
+            if hvd.rank() == 0:
+                hvd.allreduce([1.0])
+    """)
+    assert len(out) == 1 and out[0].rule == "rank-divergent"
+    assert "allreduce" in out[0].message
+
+
+def test_else_arm_of_rank_guard_triggers():
+    out = _rank_findings("""
+        import horovod_tpu as hvd
+        def f():
+            if hvd.rank() == 0:
+                pass
+            else:
+                hvd.barrier()
+    """)
+    assert len(out) == 1 and "barrier" in out[0].message
+
+
+def test_is_leader_and_bare_name_guards_trigger():
+    out = _rank_findings("""
+        import horovod_tpu as hvd
+        def f(topo, local_rank):
+            if topo.is_leader:
+                hvd.broadcast([1.0], root_rank=0)
+            if local_rank == 0:
+                hvd.allgather([1.0])
+    """)
+    assert {f.line for f in out} == {5, 7}
+
+
+def test_short_circuit_boolop_triggers():
+    out = _rank_findings("""
+        import horovod_tpu as hvd
+        def f():
+            ok = hvd.rank() == 0 and hvd.barrier()
+    """)
+    assert len(out) == 1
+
+
+def test_unconditional_collective_is_clean():
+    assert _rank_findings("""
+        import horovod_tpu as hvd
+        def f(flag):
+            hvd.allreduce([1.0])
+            if flag:
+                hvd.barrier()   # data-independent guard: fine
+    """) == []
+
+
+def test_foreign_bases_and_os_path_join_are_clean():
+    assert _rank_findings("""
+        import os
+        import numpy as np
+        from jax import lax
+        def f(rank, t):
+            if rank == 0:
+                p = os.path.join("a", "b")
+                q = "-".join(["a", "b"])
+                np.broadcast(np.ones(1), (3,))
+                lax.broadcast(1.0, (2,))
+                t.join()
+            return p, q
+    """) == []
+
+
+def test_lax_cond_body_triggers_lambda_and_named_fn():
+    out = _rank_findings("""
+        import horovod_tpu as hvd
+        from jax import lax
+        def f(pred):
+            lax.cond(pred, lambda: hvd.barrier(), lambda: None)
+        def branch(x):
+            return hvd.allreduce(x)
+        def g(pred, x):
+            return lax.cond(pred, branch, lambda v: v, x)
+    """)
+    assert len(out) == 2
+    assert all("lax.cond" in f.message for f in out)
+
+
+def test_while_loop_body_triggers():
+    out = _rank_findings("""
+        import horovod_tpu as hvd
+        from jax import lax
+        def f(x):
+            return lax.while_loop(lambda s: s < 3,
+                                  lambda s: hvd.allreduce(s), x)
+    """)
+    assert len(out) == 1
+
+
+def test_pragma_on_line_above_and_on_guard():
+    assert _rank_findings("""
+        import horovod_tpu as hvd
+        def f():
+            if hvd.rank() == 0:
+                # hvdlint: allow(rank-divergent)
+                hvd.allreduce([1.0])
+    """) == []
+    assert _rank_findings("""
+        import horovod_tpu as hvd
+        def f():
+            if hvd.rank() == 0:  # hvdlint: allow(rank-divergent)
+                hvd.allreduce([1.0])
+                hvd.barrier()
+    """) == []
+
+
+def test_pragma_for_other_rule_does_not_suppress():
+    out = _rank_findings("""
+        import horovod_tpu as hvd
+        def f():
+            if hvd.rank() == 0:  # hvdlint: allow(env-registry)
+                hvd.allreduce([1.0])
+    """)
+    assert len(out) == 1
+
+
+# --- env-registry ------------------------------------------------------
+
+@pytest.fixture()
+def lint_tree(tmp_path):
+    """A throwaway repo root with its own config.py and metrics.md."""
+    (tmp_path / "horovod_tpu").mkdir()
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "horovod_tpu" / "config.py").write_text(textwrap.dedent("""
+        from typing import Dict, NamedTuple
+        class EnvVar(NamedTuple):
+            name: str
+            type: type
+            default: object
+            doc: str
+            native: bool = False
+        REGISTRY: Dict[str, EnvVar] = {
+            "HOROVOD_GOOD_KNOB": EnvVar(
+                "HOROVOD_GOOD_KNOB", int, 1, "registered and used"),
+        }
+    """))
+    (tmp_path / "docs" / "metrics.md").write_text(
+        "| Metric | Type | Meaning |\n|---|---|---|\n")
+
+    def _write(rel, code):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(code))
+        return rel
+
+    return tmp_path, _write
+
+
+def test_unregistered_env_read_triggers(lint_tree):
+    root, write = lint_tree
+    rel = write("horovod_tpu/mod.py", """
+        import os
+        a = os.environ.get("HOROVOD_GOOD_KNOB")
+        b = os.getenv("HOROVOD_MYSTERY")
+        c = os.environ["HOROVOD_MYSTERY2"]
+    """)
+    out = env_registry.check(str(root), [rel])
+    names = {f.message.split()[3] for f in out}
+    assert "HOROVOD_MYSTERY" in names and "HOROVOD_MYSTERY2" in names
+    assert all("GOOD_KNOB" not in f.message for f in out)
+
+
+def test_env_read_via_helper_and_const_indirection(lint_tree):
+    root, write = lint_tree
+    rel = write("horovod_tpu/mod.py", """
+        VAR = "HOROVOD_INDIRECT"
+        def _env_int(name, default):
+            import os
+            return int(os.environ.get(name) or default)
+        x = _env_int(VAR, 3)
+    """)
+    out = env_registry.check(str(root), [rel])
+    assert any("HOROVOD_INDIRECT" in f.message for f in out)
+
+
+def test_orphan_registry_entry_triggers(lint_tree):
+    root, write = lint_tree
+    rel = write("horovod_tpu/mod.py", "x = 1\n")
+    out = env_registry.check(str(root), [rel])
+    assert any("HOROVOD_GOOD_KNOB" in f.message and "orphan" in f.message
+               for f in out)
+
+
+def test_registered_read_is_clean(lint_tree):
+    root, write = lint_tree
+    rel = write("horovod_tpu/mod.py", """
+        import os
+        a = os.environ.get("HOROVOD_GOOD_KNOB")
+    """)
+    assert env_registry.check(str(root), [rel]) == []
+
+
+def test_native_read_requires_native_flag(lint_tree):
+    root, write = lint_tree
+    cc = root / "horovod_tpu" / "native" / "cc" / "src"
+    cc.mkdir(parents=True)
+    (cc / "mod.cc").write_text(
+        'int a = EnvInt("HOROVOD_GOOD_KNOB", 1);\n'
+        'int b = EnvInt("HOROVOD_CC_ONLY", 2);\n')
+    rel = write("horovod_tpu/mod.py",
+                'import os\nx = os.environ.get("HOROVOD_GOOD_KNOB")\n')
+    out = env_registry.check(str(root), [rel])
+    msgs = [f.message for f in out]
+    assert any("HOROVOD_CC_ONLY" in m and "no entry" in m for m in msgs)
+    assert any("HOROVOD_GOOD_KNOB" in m and "native=True" in m for m in msgs)
+
+
+def test_pragma_suppresses_env_read(lint_tree):
+    root, write = lint_tree
+    rel = write("horovod_tpu/mod.py", """
+        import os
+        # hvdlint: allow(env-registry)
+        a = os.environ.get("HOROVOD_DELIBERATELY_UNREGISTERED")
+    """)
+    out = env_registry.check(str(root), [rel])
+    assert not any("DELIBERATELY" in f.message for f in out)
+
+
+# --- metrics-drift -----------------------------------------------------
+
+def test_undocumented_metric_triggers(lint_tree):
+    root, write = lint_tree
+    rel = write("horovod_tpu/mod.py", """
+        from horovod_tpu import telemetry
+        telemetry.counter("hvd_ghost_total", "undocumented").inc()
+    """)
+    out = metrics_drift.check(str(root), [rel])
+    assert len(out) == 1 and "hvd_ghost_total" in out[0].message
+
+
+def test_documented_dead_series_triggers(lint_tree):
+    root, write = lint_tree
+    (root / "docs" / "metrics.md").write_text(
+        "| Metric | Type | Meaning |\n|---|---|---|\n"
+        "| `hvd_dead_total` | counter | gone |\n")
+    rel = write("horovod_tpu/mod.py", "x = 1\n")
+    out = metrics_drift.check(str(root), [rel])
+    assert len(out) == 1 and "hvd_dead_total" in out[0].message
+
+
+def test_label_drift_triggers_and_documented_label_is_clean(lint_tree):
+    root, write = lint_tree
+    (root / "docs" / "metrics.md").write_text(
+        "| Metric | Type | Meaning |\n|---|---|---|\n"
+        "| `hvd_ops_total` | counter | ops, labeled `op=` |\n")
+    rel = write("horovod_tpu/mod.py", """
+        from horovod_tpu import telemetry
+        telemetry.counter("hvd_ops_total", "ok", op="x").inc()
+        telemetry.counter("hvd_ops_total", "bad", plane="y").inc()
+    """)
+    out = metrics_drift.check(str(root), [rel])
+    assert len(out) == 1 and "plane" in out[0].message
+
+
+def test_forwarder_resolution_counts_emission(lint_tree):
+    root, write = lint_tree
+    (root / "docs" / "metrics.md").write_text(
+        "| Metric | Type | Meaning |\n|---|---|---|\n"
+        "| `hvd_fwd_total` | counter | via forwarder |\n")
+    rel = write("horovod_tpu/mod.py", """
+        from horovod_tpu import telemetry
+        def bump(name, help_, d, **labels):
+            telemetry.counter(name, help_, **labels).inc(d)
+        def tick():
+            bump("hvd_fwd_total", "h", 1)
+    """)
+    assert metrics_drift.check(str(root), [rel]) == []
+
+
+def test_dynamic_labels_skip_label_check(lint_tree):
+    root, write = lint_tree
+    (root / "docs" / "metrics.md").write_text(
+        "| Metric | Type | Meaning |\n|---|---|---|\n"
+        "| `hvd_dyn_total` | counter | dynamic labels |\n")
+    rel = write("horovod_tpu/mod.py", """
+        from horovod_tpu import telemetry
+        def rec(**labels):
+            telemetry.counter("hvd_dyn_total", "h", **labels).inc()
+    """)
+    assert metrics_drift.check(str(root), [rel]) == []
+
+
+# --- the CLI and the shipped tree --------------------------------------
+
+def test_unknown_rule_raises():
+    with pytest.raises(KeyError):
+        hvdlint.run(REPO, rules=["no-such-rule"])
+
+
+def test_shipped_tree_is_lint_clean():
+    """The repo gates CI on `python -m tools.hvdlint`; keep it true."""
+    findings = hvdlint.run(REPO)
+    assert findings == [], "\n".join(str(f) for f in findings)

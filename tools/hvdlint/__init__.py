@@ -1,0 +1,52 @@
+"""hvdlint — distributed-correctness static analysis for horovod-tpu.
+
+Run as ``python -m tools.hvdlint`` (or ``make lint``).  Four rules:
+
+* ``rank-divergent`` — eager collectives reachable only under
+  rank-dependent control flow or inside lax.cond/while_loop bodies
+  (submission-order divergence deadlocks the coordinator);
+* ``env-registry`` — every ``HOROVOD_*`` environment read (Python and
+  native C++) must go through / be declared in ``horovod_tpu/config.py``;
+* ``metrics-drift`` — every emitted ``hvd_*`` telemetry series must have
+  a ``docs/metrics.md`` row with matching labels, and vice versa.
+
+The fourth gate — the native concurrency sanitizers — is dynamic, not
+static: ``ci/run_sanitizer.sh`` (see ``docs/static_analysis.md``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from tools.hvdlint import env_registry, metrics_drift, rank_divergence
+from tools.hvdlint.common import Finding, iter_py_files
+
+__all__ = ["RULES", "Finding", "run"]
+
+# slug -> checker module; each module exposes RULE and check(root, files).
+RULES: Dict[str, object] = {
+    rank_divergence.RULE: rank_divergence,
+    env_registry.RULE: env_registry,
+    metrics_drift.RULE: metrics_drift,
+}
+
+
+def run(root: str, rules: Optional[Sequence[str]] = None,
+        files: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the selected rules (default: all) over the tree at ``root``.
+
+    ``files`` restricts the Python scan set (repo-relative paths); the
+    env-registry rule still reads the C++ sources and the metrics rule
+    still reads docs/metrics.md regardless.
+    """
+    selected = list(rules) if rules else list(RULES)
+    unknown = [r for r in selected if r not in RULES]
+    if unknown:
+        raise KeyError(f"unknown rule(s): {', '.join(unknown)} "
+                       f"(known: {', '.join(sorted(RULES))})")
+    py_files = list(files) if files is not None else list(iter_py_files(root))
+    findings: List[Finding] = []
+    for slug in selected:
+        findings.extend(RULES[slug].check(root, py_files))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -1,0 +1,83 @@
+"""CLI for hvdlint: ``python -m tools.hvdlint [paths...]``.
+
+Exits 0 when the tree is clean, 1 when any finding survives, 2 on usage
+errors — so CI can gate on it directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from tools.hvdlint import RULES, run
+from tools.hvdlint.common import repo_root
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.hvdlint",
+        description="Distributed-correctness static analysis for "
+                    "horovod-tpu (see docs/static_analysis.md).")
+    parser.add_argument(
+        "paths", nargs="*",
+        help="restrict the Python scan to these files/directories "
+             "(repo-relative); default scans the whole tree")
+    parser.add_argument(
+        "--root", default=None,
+        help="repo root (default: auto-detected from cwd)")
+    parser.add_argument(
+        "--rule", action="append", dest="rules", metavar="SLUG",
+        choices=sorted(RULES),
+        help="run only this rule (repeatable); known: %(choices)s")
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule slugs and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for slug in sorted(RULES):
+            print(slug)
+        return 0
+
+    try:
+        root = os.path.abspath(args.root) if args.root else repo_root()
+    except FileNotFoundError as e:
+        print(e, file=sys.stderr)
+        return 2
+
+    files = None
+    if args.paths:
+        files = []
+        for p in args.paths:
+            full = p if os.path.isabs(p) else os.path.join(root, p)
+            rel = os.path.relpath(full, root)
+            if os.path.isdir(full):
+                for dirpath, dirnames, filenames in os.walk(full):
+                    dirnames[:] = sorted(
+                        d for d in dirnames if not d.startswith((".", "__")))
+                    files.extend(
+                        os.path.relpath(os.path.join(dirpath, f), root)
+                        for f in sorted(filenames) if f.endswith(".py"))
+            elif os.path.isfile(full):
+                files.append(rel)
+            else:
+                print(f"hvdlint: no such path: {p}", file=sys.stderr)
+                return 2
+
+    findings = run(root, rules=args.rules, files=files)
+    for f in findings:
+        print(f)
+    n = len(findings)
+    if n:
+        print(f"\nhvdlint: {n} finding{'s' if n != 1 else ''} "
+              f"({', '.join(sorted({f.rule for f in findings}))})",
+              file=sys.stderr)
+        return 1
+    print("hvdlint: clean", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

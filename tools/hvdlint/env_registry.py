@@ -1,0 +1,156 @@
+"""Env-var registry checker.
+
+``horovod_tpu/config.py`` is the declarative registry of every
+``HOROVOD_*`` environment variable (name, type, default, doc, whether
+the native runtime reads it).  This rule fails on three kinds of drift:
+
+* **unregistered read** — an ``os.environ`` / ``os.getenv`` /
+  ``_env_*`` helper read of a ``HOROVOD_*`` name with no registry entry
+  (a knob nobody can discover or document);
+* **orphan entry** — a registry entry whose name appears nowhere in the
+  scanned Python or C++ sources (a knob that no longer does anything);
+* **native drift** — a ``HOROVOD_*`` name read by ``native/cc`` via
+  ``EnvInt``/``EnvDouble``/``EnvStr``/``EnvBool``/``getenv`` that is
+  unregistered or not flagged ``native=True``, and registry entries
+  flagged ``native=True`` that the C++ sources no longer read.
+
+The registry itself is loaded by file path (stdlib-only module), never
+through ``import horovod_tpu`` — linting must not initialize jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+import re
+from typing import Dict, List, Optional, Set
+
+from tools.hvdlint.common import (
+    Finding, Source, dotted_name, iter_native_files, iter_py_files,
+    module_str_consts, str_const,
+)
+
+RULE = "env-registry"
+
+# Call targets that read the environment.  Terminal-name match for the
+# local typed helpers (_env_int and friends, config.env_*), dotted match
+# for the stdlib paths.
+_ENV_CALL_TAILS = re.compile(
+    r"^_?env_?(int|float|bool|str|raw|truthy|interval|double)?$")
+_ENV_DOTTED = re.compile(
+    r"(^|\.)(environ\.(get|setdefault|pop)|getenv)$")
+
+_CC_READ = re.compile(
+    r"(?:Env(?:Int|Double|Str|Bool)|getenv)\(\s*\"(HOROVOD_[A-Z0-9_]+)\"")
+_CC_ANY = re.compile(r"HOROVOD_[A-Z0-9_]+")
+_PY_ANY = re.compile(r"HOROVOD_[A-Z0-9_]{2,}")
+
+
+def load_registry(root: str) -> Dict[str, object]:
+    """horovod_tpu/config.py's REGISTRY, loaded standalone."""
+    path = os.path.join(root, "horovod_tpu", "config.py")
+    spec = importlib.util.spec_from_file_location("_hvdlint_config", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)           # type: ignore[union-attr]
+    return dict(mod.REGISTRY)
+
+
+def _env_reads(src: Source) -> List[tuple]:
+    """(name, line) for every HOROVOD_* environment read in one file."""
+    consts = module_str_consts(src.tree)
+    reads: List[tuple] = []
+    for node in ast.walk(src.tree):
+        name: Optional[str] = None
+        if isinstance(node, ast.Call):
+            dn = dotted_name(node.func) or ""
+            tail = dn.split(".")[-1]
+            if _ENV_DOTTED.search(dn) or _ENV_CALL_TAILS.match(tail):
+                name = str_const(node.args[0], consts) if node.args else None
+        elif isinstance(node, ast.Subscript) and \
+                isinstance(node.ctx, ast.Load):
+            dn = dotted_name(node.value) or ""
+            if dn.endswith("environ"):
+                name = str_const(node.slice, consts)
+        if name and name.startswith("HOROVOD_"):
+            reads.append((name, node.lineno))
+    return reads
+
+
+def check(root: str, files=None) -> List[Finding]:
+    findings: List[Finding] = []
+    try:
+        registry = load_registry(root)
+    except (OSError, AttributeError) as e:
+        return [Finding(RULE, "horovod_tpu/config.py", 0,
+                        f"cannot load the env registry: {e}")]
+
+    py_files = list(files) if files is not None else \
+        list(iter_py_files(root))
+
+    # Every HOROVOD_* mention anywhere (reads, launcher writes, doc
+    # strings in code) — the orphan check's usage universe.
+    mentioned: Set[str] = set()
+
+    for rel in py_files:
+        try:
+            src = Source.load(root, rel)
+        except (SyntaxError, UnicodeDecodeError):
+            continue
+        if rel != os.path.join("horovod_tpu", "config.py"):
+            mentioned.update(_PY_ANY.findall(src.text))
+        for name, line in _env_reads(src):
+            if name not in registry and \
+                    not src.allowed(RULE, line):
+                findings.append(Finding(
+                    RULE, rel, line,
+                    f"environment read of {name} which has no entry in "
+                    f"horovod_tpu/config.py's registry — register it "
+                    f"(name, type, default, doc) so it is documented "
+                    f"and discoverable"))
+
+    # Native side: shell scripts exporting vars count as mentions too.
+    for rel in ("ci/run_tests.sh", "ci/run_sanitizer.sh", "ci/fake_ssh.sh",
+                "Makefile", "horovod_tpu/native/cc/Makefile"):
+        p = os.path.join(root, rel)
+        if os.path.isfile(p):
+            with open(p, encoding="utf-8", errors="replace") as f:
+                mentioned.update(_PY_ANY.findall(f.read()))
+
+    cc_reads: Dict[str, tuple] = {}
+    for rel in iter_native_files(root):
+        with open(os.path.join(root, rel), encoding="utf-8",
+                  errors="replace") as f:
+            text = f.read()
+        mentioned.update(_CC_ANY.findall(text))
+        for i, line in enumerate(text.splitlines(), start=1):
+            for m in _CC_READ.finditer(line):
+                cc_reads.setdefault(m.group(1), (rel, i))
+
+    for name, (rel, line) in sorted(cc_reads.items()):
+        entry = registry.get(name)
+        if entry is None:
+            findings.append(Finding(
+                RULE, rel, line,
+                f"native getenv of {name} which has no entry in "
+                f"horovod_tpu/config.py's registry"))
+        elif not entry.native:
+            findings.append(Finding(
+                RULE, rel, line,
+                f"native getenv of {name} but its registry entry is not "
+                f"flagged native=True (registry/C++ drift)"))
+
+    config_rel = os.path.join("horovod_tpu", "config.py")
+    for name, entry in sorted(registry.items()):
+        if name not in mentioned:
+            findings.append(Finding(
+                RULE, config_rel, 0,
+                f"registry entry {name} is read nowhere in the scanned "
+                f"Python or C++ sources — delete the orphan entry or "
+                f"wire the knob up"))
+        elif entry.native and name not in cc_reads:
+            findings.append(Finding(
+                RULE, config_rel, 0,
+                f"registry entry {name} is flagged native=True but "
+                f"native/cc never reads it (registry/C++ drift)"))
+    return findings

@@ -1,0 +1,193 @@
+"""Telemetry drift checker.
+
+Every ``hvd_*`` series the code can emit must have a row in
+``docs/metrics.md``, and every documented row must still have an
+emission site — otherwise dashboards rot silently (generalizes the
+artifact-level ``tools/check_metrics.py`` gate to the whole catalogue).
+Label sets are checked too: a label key used at an emission site must be
+named (as ``key=``) in the series' doc row.
+
+Emission sites are found by AST:
+
+* direct calls — ``telemetry.counter("hvd_x", help, op=...)`` (and
+  ``gauge``/``histogram``; bare names inside ``horovod_tpu/telemetry``);
+* forwarders — a local ``def f(name, ...)`` whose body passes its first
+  parameter on to a telemetry call (``native/runtime.py``'s ``bump``):
+  calls ``f("hvd_x", ..., level=...)`` count as emissions of ``hvd_x``;
+* dynamic labels (``**labels``) skip the label-set comparison for that
+  site.
+
+Doc rows are the ``| `hvd_*` | type | meaning |`` table lines of
+``docs/metrics.md``; a row documents a label key by mentioning
+``key=`` anywhere in the row (catalogue convention: "labeled
+``op=...``").
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.hvdlint.common import Finding, Source, dotted_name
+
+RULE = "metrics-drift"
+
+_TELEMETRY_FUNCS = {"counter", "gauge", "histogram"}
+_NON_LABEL_KWARGS = {"help_text", "bounds"}
+
+_DOC_ROW = re.compile(r"^\|\s*(`[^|]*`(?:\s*/\s*`[^|]*`)*)\s*\|")
+_DOC_NAME = re.compile(r"`(hvd_[a-z0-9_]+)")
+_DOC_LABEL = re.compile(r"[`{,\s]([a-z_]+)=")
+
+
+class _Emission:
+    __slots__ = ("name", "path", "line", "labels", "dynamic")
+
+    def __init__(self, name, path, line, labels, dynamic):
+        self.name, self.path, self.line = name, path, line
+        self.labels, self.dynamic = labels, dynamic
+
+
+def _telemetry_call(node: ast.Call, bare_ok: bool) -> Optional[str]:
+    """The metric type when this call is telemetry.counter/gauge/
+    histogram (dotted always; bare names only inside the telemetry
+    package itself)."""
+    dn = dotted_name(node.func) or ""
+    parts = dn.split(".")
+    tail = parts[-1]
+    if tail not in _TELEMETRY_FUNCS:
+        return None
+    if len(parts) > 1:
+        return tail if parts[-2] in ("telemetry", "_registry", "registry",
+                                     "metrics") else None
+    return tail if bare_ok else None
+
+
+def _forwarder_names(tree: ast.Module, bare_ok: bool) -> Set[str]:
+    """Local functions that forward their first parameter as a metric
+    name (``def bump(name, ...): telemetry.counter(name, ...)``)."""
+    out: Set[str] = set()
+    for fn in ast.walk(tree):
+        if not isinstance(fn, ast.FunctionDef) or not fn.args.args:
+            continue
+        first = fn.args.args[0].arg
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    _telemetry_call(node, bare_ok) and node.args and \
+                    isinstance(node.args[0], ast.Name) and \
+                    node.args[0].id == first:
+                out.add(fn.name)
+                break
+    return out
+
+
+def _collect_emissions(src: Source) -> List[_Emission]:
+    bare_ok = src.path.replace(os.sep, "/").startswith(
+        "horovod_tpu/telemetry/")
+    forwarders = _forwarder_names(src.tree, bare_ok)
+    out: List[_Emission] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        is_direct = _telemetry_call(node, bare_ok) is not None
+        dn = dotted_name(node.func) or ""
+        is_forward = dn.split(".")[-1] in forwarders and "." not in dn
+        if not (is_direct or is_forward):
+            continue
+        if not node.args:
+            continue
+        arg0 = node.args[0]
+        if not (isinstance(arg0, ast.Constant) and
+                isinstance(arg0.value, str)):
+            continue   # dynamic name: resolved through a forwarder or
+            #            covered by the forwarder's own call sites
+        name = arg0.value
+        if not name.startswith("hvd_"):
+            continue
+        labels = {kw.arg for kw in node.keywords
+                  if kw.arg and kw.arg not in _NON_LABEL_KWARGS}
+        dynamic = any(kw.arg is None for kw in node.keywords)
+        out.append(_Emission(name, src.path, node.lineno, labels, dynamic))
+    return out
+
+
+def _doc_rows(root: str) -> Dict[str, Tuple[int, Set[str]]]:
+    """series name -> (first row's line, union of documented label keys)
+    from docs/metrics.md.  A metric may have rows in several sections
+    (``hvd_collective_bytes_total`` appears per plane); the documented
+    label set is the union over all of them."""
+    rows: Dict[str, Tuple[int, Set[str]]] = {}
+    path = os.path.join(root, "docs", "metrics.md")
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, start=1):
+            m = _DOC_ROW.match(line)
+            if not m:
+                continue
+            labels = set(_DOC_LABEL.findall(line))
+            for name in _DOC_NAME.findall(m.group(1)):
+                if name in rows:
+                    rows[name][1].update(labels)
+                else:
+                    rows[name] = (i, labels)
+    return rows
+
+
+def check(root: str, files=None) -> List[Finding]:
+    from tools.hvdlint.common import iter_py_files
+    findings: List[Finding] = []
+    doc_rel = os.path.join("docs", "metrics.md")
+    try:
+        rows = _doc_rows(root)
+    except OSError as e:
+        return [Finding(RULE, doc_rel, 0, f"cannot read the catalogue: {e}")]
+
+    emissions: List[_Emission] = []
+    py_files = files if files is not None else iter_py_files(
+        root, dirs=("horovod_tpu",))
+    # Only the library itself emits the catalogue's series; a test
+    # helper calling telemetry must not mask a dead series.
+    py_files = [p for p in py_files
+                if p.replace(os.sep, "/").startswith("horovod_tpu/")]
+    for rel in py_files:
+        try:
+            src = Source.load(root, rel)
+        except (SyntaxError, UnicodeDecodeError):
+            continue
+        for em in _collect_emissions(src):
+            if not src.allowed(RULE, em.line):
+                emissions.append(em)
+
+    emitted: Dict[str, List[_Emission]] = {}
+    for em in emissions:
+        emitted.setdefault(em.name, []).append(em)
+
+    for name, ems in sorted(emitted.items()):
+        if name not in rows:
+            em = ems[0]
+            findings.append(Finding(
+                RULE, em.path, em.line,
+                f"metric {name} is emitted here but has no row in "
+                f"docs/metrics.md — document it (or drop the series)"))
+            continue
+        row_line, documented_labels = rows[name]
+        for em in ems:
+            missing = {k for k in em.labels
+                       if k not in documented_labels}
+            if missing and not em.dynamic:
+                findings.append(Finding(
+                    RULE, em.path, em.line,
+                    f"metric {name} is emitted with label(s) "
+                    f"{', '.join(sorted(missing))} not named in its "
+                    f"docs/metrics.md row (line {row_line}) — mention "
+                    f"each key as `key=` in the row"))
+
+    for name, (line, _) in sorted(rows.items()):
+        if name not in emitted:
+            findings.append(Finding(
+                RULE, doc_rel, line,
+                f"docs/metrics.md documents {name} but no emission site "
+                f"exists in horovod_tpu/ — delete the stale row or "
+                f"restore the series"))
+    return findings

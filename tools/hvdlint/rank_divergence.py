@@ -1,0 +1,266 @@
+"""Collective-order / rank-divergence checker.
+
+Every eager collective is a synchronization point: all ranks of the
+process set must submit it, in the same order, or the coordinator's
+pending table never fills and the job deadlocks (the stall inspector
+eventually names the tensor, but only after the deadline).  The two ways
+repos grow that bug:
+
+* an eager collective reachable only under rank-dependent control flow
+  (``if hvd.rank() == 0: hvd.allreduce(...)``, leader-only branches,
+  local_rank guards) — the guarded ranks wait forever;
+* an eager collective inside a ``lax.cond`` / ``lax.while_loop`` /
+  ``lax.switch`` branch — under SPMD the predicate may diverge per rank,
+  and even when it cannot, collectives inside conditional branches trace
+  divergent programs (the exact pitfall PR 4's step guard had to design
+  around with psum + where instead of cond).
+
+Legitimate rank-0-only sites (checkpoint metadata writes paired with a
+success broadcast, broadcast-root preparation) annotate with::
+
+    # hvdlint: allow(rank-divergent)
+
+on the collective's line, the line above it, or the guarding ``if``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from tools.hvdlint.common import Finding, Source, dotted_name
+
+RULE = "rank-divergent"
+
+# Eager collective entry points (ops/collective.py) plus the fused /
+# compressed drivers that submit them (ops/fusion.py, ops/compression.py).
+COLLECTIVES: Set[str] = {
+    "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
+    "grouped_allreduce",
+    "allgather", "allgather_async", "allgather_object",
+    "broadcast", "broadcast_", "broadcast_async", "broadcast_async_",
+    "broadcast_object", "broadcast_variables", "broadcast_parameters",
+    "broadcast_optimizer_state",
+    "alltoall", "alltoall_ragged",
+    "reducescatter", "barrier", "join",
+    "fused_psum", "fused_pytree_mean", "fused_reduce_scatter",
+    "fused_all_gather", "fused_hierarchical_reduce_scatter",
+    "compressed_reduce_scatter", "compressed_all_gather",
+    "compressed_allreduce", "cross_level_psum",
+}
+
+# Attribute bases that own same-named NON-collective functions
+# (lax.broadcast, np.broadcast, torch.distributed.*...).  A dotted call
+# whose root is one of these is never ours.
+_FOREIGN_BASES = {
+    "lax", "jax", "jnp", "np", "numpy", "tf", "tensorflow", "torch",
+    "dist", "mx", "keras", "math", "itertools", "mpi", "MPI", "comm",
+    "os", "posixpath", "ntpath", "pathlib", "shutil", "threading",
+    "multiprocessing", "asyncio", "str",
+}
+
+# Names so common on unrelated objects (str.join, Thread.join,
+# os.path.join) that an attribute call only counts when the base is a
+# known horovod_tpu alias.
+_AMBIGUOUS_ATTRS = {"join"}
+
+# Names whose value is (a function of) this process's identity.
+_RANK_CALLS = {"rank", "local_rank", "cross_rank", "node_rank",
+               "process_index"}
+_RANK_ATTRS = {"is_leader", "rank", "local_rank", "cross_rank"}
+_RANK_NAMES = {"rank", "local_rank", "cross_rank", "my_rank",
+               "world_rank", "is_leader", "leader"}
+
+_COND_FUNCS = {"cond", "while_loop", "switch"}
+
+
+def _horovod_import_bindings(tree: ast.Module) -> Tuple[Set[str], Set[str]]:
+    """(bare, aliases): names this module binds from horovod_tpu
+    (``from horovod_tpu.ops import allreduce`` makes the bare name
+    ``allreduce`` ours) and aliases of the package / its modules
+    (``import horovod_tpu as hvd``)."""
+    bare: Set[str] = set()
+    aliases: Set[str] = {"hvd", "horovod_tpu", "collective", "hvd_tpu"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and \
+                node.module.startswith("horovod_tpu"):
+            for a in node.names:
+                bare.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.startswith("horovod_tpu"):
+                    aliases.add(a.asname or a.name.split(".")[0])
+    return bare, aliases
+
+
+def _is_rank_dependent(test: ast.AST) -> bool:
+    """True when the expression's value depends on this process's rank."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            dn = dotted_name(node.func)
+            if dn and dn.split(".")[-1].rstrip("()") in _RANK_CALLS:
+                return True
+        elif isinstance(node, ast.Attribute):
+            if node.attr in _RANK_ATTRS:
+                return True
+        elif isinstance(node, ast.Name):
+            if node.id in _RANK_NAMES:
+                return True
+    return False
+
+
+class _Checker(ast.NodeVisitor):
+    def __init__(self, src: Source):
+        self.src = src
+        self.findings: List[Finding] = []
+        bare, aliases = _horovod_import_bindings(src.tree)
+        self.bare_collectives = bare | aliases
+        self.hvd_aliases = aliases
+        # Stack of (kind, line) divergent contexts the walk is inside:
+        # kind is "rank" (rank-conditional branch) or "cond" (lax.cond/
+        # while_loop/switch body).
+        self.stack: List[Tuple[str, int]] = []
+        # FunctionDefs by name, for resolving `lax.cond(p, fn_a, fn_b)`.
+        self.fn_defs = {n.name: n for n in ast.walk(src.tree)
+                        if isinstance(n, ast.FunctionDef)}
+        self.cond_flagged: Set[int] = set()
+
+    # -- collective detection ------------------------------------------
+
+    def _collective_name(self, call: ast.Call) -> Optional[str]:
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            if f.attr not in COLLECTIVES:
+                return None
+            root = f.value
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if f.attr in _AMBIGUOUS_ATTRS:
+                # os.path.join, "-".join, thread.join: ours only when
+                # the base is recognizably horovod_tpu.
+                return f.attr if isinstance(root, ast.Name) and \
+                    root.id in self.hvd_aliases else None
+            if isinstance(root, ast.Name) and root.id in _FOREIGN_BASES:
+                return None
+            return f.attr
+        if isinstance(f, ast.Name):
+            if f.id in COLLECTIVES and f.id in self.bare_collectives:
+                return f.id
+            return None
+        return None
+
+    # -- divergent-context plumbing ------------------------------------
+
+    def _visit_branch(self, kind: str, line: int, body) -> None:
+        self.stack.append((kind, line))
+        for stmt in body:
+            self.visit(stmt)
+        self.stack.pop()
+
+    def visit_If(self, node: ast.If) -> None:
+        if _is_rank_dependent(node.test):
+            # Both arms diverge: the else branch runs exactly on the
+            # complement set of ranks.
+            self._visit_branch("rank", node.lineno, node.body)
+            self._visit_branch("rank", node.lineno, node.orelse)
+        else:
+            self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        if _is_rank_dependent(node.test):
+            self._visit_branch("rank", node.lineno, node.body)
+            for stmt in node.orelse:
+                self.visit(stmt)
+        else:
+            self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        if _is_rank_dependent(node.test):
+            self.stack.append(("rank", node.lineno))
+            self.visit(node.body)
+            self.visit(node.orelse)
+            self.stack.pop()
+            self.visit(node.test)
+        else:
+            self.generic_visit(node)
+
+    def visit_BoolOp(self, node: ast.BoolOp) -> None:
+        # `rank() == 0 and hvd.barrier()` short-circuits per rank.
+        if any(_is_rank_dependent(v) for v in node.values[:-1]):
+            self.stack.append(("rank", node.lineno))
+            self.generic_visit(node)
+            self.stack.pop()
+        else:
+            self.generic_visit(node)
+
+    # -- call sites ----------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = self._collective_name(node)
+        if name and self.stack:
+            kind, ctx_line = self.stack[-1]
+            if not self.src.allowed(RULE, node.lineno, ctx_line):
+                if kind == "rank":
+                    msg = (f"eager collective {name}() is reachable only "
+                           f"under rank-dependent control flow (guard at "
+                           f"line {ctx_line}); every rank of the process "
+                           f"set must submit it or the job deadlocks — "
+                           f"hoist it out of the branch or annotate the "
+                           f"legitimate rank-0 site with "
+                           f"'# hvdlint: allow(rank-divergent)'")
+                else:
+                    msg = (f"eager collective {name}() inside a lax.cond/"
+                           f"while_loop/switch body (traced at line "
+                           f"{ctx_line}); conditional branches may not "
+                           f"execute on every rank — submit it outside "
+                           f"the traced conditional")
+                self.findings.append(
+                    Finding(RULE, self.src.path, node.lineno, msg))
+
+        # lax.cond / lax.while_loop / lax.switch: their function args are
+        # conditionally-executed bodies.
+        dn = dotted_name(node.func)
+        if dn and dn.split(".")[-1] in _COND_FUNCS and \
+                (dn.startswith(("lax.", "jax.lax.")) or dn in _COND_FUNCS):
+            rest = []
+            for arg in node.args:
+                target: Optional[ast.AST] = None
+                if isinstance(arg, ast.Lambda):
+                    target = arg.body
+                elif isinstance(arg, ast.Name) and arg.id in self.fn_defs:
+                    fn = self.fn_defs[arg.id]
+                    if fn.lineno not in self.cond_flagged:
+                        self.cond_flagged.add(fn.lineno)
+                        target = ast.Module(body=fn.body, type_ignores=[])
+                if target is not None:
+                    self.stack.append(("cond", node.lineno))
+                    self.visit(target)
+                    self.stack.pop()
+                else:
+                    rest.append(arg)
+            # Branch bodies were walked with the cond context above;
+            # visit only the remaining children normally.
+            for arg in rest:
+                self.visit(arg)
+            for kw in node.keywords:
+                self.visit(kw)
+            self.visit(node.func)
+            return
+        self.generic_visit(node)
+
+
+def check_source(src: Source) -> List[Finding]:
+    checker = _Checker(src)
+    checker.visit(src.tree)
+    return checker.findings
+
+
+def check(root: str, files) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in files:
+        try:
+            src = Source.load(root, rel)
+        except (SyntaxError, UnicodeDecodeError):
+            continue   # not this rule's business
+        findings.extend(check_source(src))
+    return findings
