@@ -116,4 +116,40 @@ if [ "$OURS" -gt 0 ]; then
   echo "$VARIANT lane FAILED (logs archived in $ART)" >&2
   exit 1
 fi
+
+# --- suppression-creep guard -------------------------------------------
+# Surviving frames (post-suppression, not ours) are tolerated noise from
+# uninstrumented deps — but only the frames already on the checked-in
+# baseline.  A NEW frame must be triaged in the PR that introduces it
+# (fix the bug, or extend the baseline/suppressions with justification),
+# never silently absorbed into an ever-growing pile.  Frames are
+# normalized (module load offsets change per build) before the diff.
+BASELINE="ci/artifacts/sanitizer/$VARIANT/baseline_frames.txt"
+FRAMES="$ART/frames.txt"
+if [ "${#LOGS[@]}" -gt 0 ]; then
+  grep -h "^SUMMARY:" "${LOGS[@]}" \
+    | sed -E 's/\([^()]*\+0x[0-9a-f]+\)//g; s/0x[0-9a-f]+//g; s/  +/ /g' \
+    | sort -u > "$FRAMES"
+else
+  : > "$FRAMES"
+fi
+if [ -f "$BASELINE" ]; then
+  NEW_FRAMES=$(comm -23 "$FRAMES" <(grep -v '^#' "$BASELINE" | sort -u))
+  if [ -n "$NEW_FRAMES" ]; then
+    echo "--- $VARIANT: NEW sanitizer frame(s) not in $BASELINE:" >&2
+    echo "$NEW_FRAMES" >&2
+    echo "$VARIANT lane FAILED: suppression creep — triage the frame" >&2
+    echo "and either fix it or add it to the baseline in this PR with" >&2
+    echo "a justification (docs/static_analysis.md)" >&2
+    exit 1
+  fi
+  GONE=$(comm -13 "$FRAMES" <(grep -v '^#' "$BASELINE" | sort -u) | wc -l)
+  if [ "$GONE" -gt 0 ]; then
+    echo "note: $GONE baseline frame(s) no longer observed — consider" \
+         "pruning $BASELINE"
+  fi
+else
+  echo "note: no baseline at $BASELINE — frames archived in $FRAMES;" \
+       "commit them as the baseline to arm the creep guard"
+fi
 echo "$VARIANT lane OK (artifacts in $ART)"

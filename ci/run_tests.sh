@@ -35,8 +35,10 @@ echo "--- chaos lane (fault-injection harness; single host, subprocess
 JAX_PLATFORMS=cpu python -m pytest tests/ -x -q -m chaos
 
 echo "--- distributed op matrix under the launcher (the reference's
---- 'pytest under horovodrun' trick, gen-pipeline.sh:120-190)"
-JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+--- 'pytest under horovodrun' trick, gen-pipeline.sh:120-190).  The
+--- schedule verifier rides along armed: a valid suite must never trip
+--- it (false-abort regression gate, docs/static_analysis.md)"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" HOROVOD_SCHEDULE_CHECK=1 \
   python -m horovod_tpu.runner -np 2 \
   python -m pytest tests/distributed -x -q
 
@@ -128,6 +130,17 @@ echo "--- stalled-cached-tensor watchdog (2 ranks)"
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
   python -m horovod_tpu.runner -np 2 \
   python tests/distributed/stall_check_np2.py
+
+echo "--- schedule-divergence verifier (2 ranks): a rank-divergent
+--- signature must abort within one coordination cycle and divergent
+--- names within the quiet window, both with a first-divergence report
+--- (ranks, call index, field/name) — no stall timeout"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  python -m horovod_tpu.runner -np 2 \
+  python tests/distributed/schedule_check_np2.py field
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  python -m horovod_tpu.runner -np 2 \
+  python tests/distributed/schedule_check_np2.py order
 
 echo "--- telemetry gate (2 ranks): per-rank + merged metrics JSON with
 --- nonzero collective counters (docs/metrics.md)"

@@ -124,6 +124,16 @@ _var("HOROVOD_STALL_CHECK_TIME_SECONDS", "float", 60.0,
 _var("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", "float", 0.0,
      "Coordinator stall deadline after which the job aborts; 0 disables",
      native=True)
+_var("HOROVOD_SCHEDULE_CHECK", "bool", False,
+     "1 arms the collective-schedule contract verifier: the coordinator "
+     "matches every rank's submission records by name and aborts at the "
+     "first divergence (rank, call index, field) instead of stalling",
+     native=True)
+_var("HOROVOD_SCHEDULE_CHECK_QUIET_SECONDS", "float", 2.0,
+     "schedule-verifier quiet window: with the check armed, abort when "
+     "every rank has an unmatched submission and no rank has announced "
+     "anything for this long (raise on very bursty async pipelines)",
+     native=True)
 _var("HOROVOD_CYCLE_TIME", "float", 1.0,
      "Coordination loop cycle time in ms (autotune may override)",
      native=True)

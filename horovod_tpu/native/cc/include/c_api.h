@@ -45,6 +45,14 @@ int hvd_cache_enabled();
 int64_t hvd_cache_lookups();
 int64_t hvd_cache_hits();
 
+// Collective-schedule contract verifier (HOROVOD_SCHEDULE_CHECK):
+// enabled flag, submissions folded into this rank's schedule stream,
+// and coordinator-reported divergence aborts observed (both monotonic;
+// divergences is 0 or 1 per run — the first abort stops the loop).
+int hvd_schedule_check_enabled();
+int64_t hvd_schedule_check_submissions();
+int64_t hvd_schedule_check_divergences();
+
 // 1 when the bootstrap agreement verified a hierarchical-capable topology
 // (homogeneous block mapping, >1 host) — the autotuner may then flip the
 // hier_* routing even if the env flags left it off.

@@ -114,6 +114,71 @@ class Controller {
   void Ingest(const RequestList& list, int from_rank);
   Response ConstructResponse(const std::string& key);
 
+  // ---- Collective-schedule contract verifier (HOROVOD_SCHEDULE_CHECK) ----
+  // Coordinator-side: match each rank's submission records (RequestList::
+  // sched) BY NAME within each process set — the negotiation is
+  // name-keyed and async submission pools make cross-rank ORDER legal to
+  // differ — and report the first divergence: (a) two ranks submitting
+  // the same name with different signatures poisons that tensor's
+  // pending entry, so the normal error-response path delivers the
+  // diagnostic within one cycle and the job survives; (b) every live
+  // rank blocked on submissions no peer matched while the job is quiet
+  // aborts the whole job (the silent-hang shape, caught in ~quiet-window
+  // instead of the stall timeout).
+  struct SchedRef {
+    Request req;               // first-arrival record (the reference)
+    int owner;                 // rank that submitted it first
+    uint64_t idx;              // owner's per-set submission index (call #)
+    std::vector<bool> seen;    // ranks whose matching record arrived
+    int seen_count = 0;
+  };
+  struct SchedStream {
+    // name -> FIFO of pending refs (a deque, not a single slot: steady-
+    // state training resubmits the same name every step, and a fast
+    // rank's step-N+1 record can land in the same coordinator cycle as a
+    // slow rank's step-N record).
+    std::map<std::string, std::deque<SchedRef>> by_name;
+    std::vector<uint64_t> next_idx;   // per rank: submissions so far
+  };
+  // Fold one rank's cycle records into the per-set reference tables;
+  // fills sched_abort_ with the first-divergence report on a signature
+  // mismatch.
+  void VerifySchedule(const RequestList& list, int from_rank);
+  // End-of-cycle checks: the all-ranks-blocked quiescence detector and
+  // the shutdown digest backstop.
+  void CheckScheduleProgress();
+  // A completed join resets every rank's stream (ranks reset their own
+  // digest/seq when they fold their kJoin announcement).
+  void ResetSchedule();
+
+  bool schedule_check_ = false;
+  double sched_quiet_s_ = 2.0;        // HOROVOD_SCHEDULE_CHECK_QUIET_SECONDS
+  std::map<int32_t, SchedStream> sched_streams_;   // set_id -> stream
+  // Table key -> first-divergence diagnostic for a same-name signature
+  // mismatch; attached to that tensor's (error) response when built.
+  std::map<std::string, std::string> sched_poison_;
+  std::vector<bool> sched_joined_;    // rank sent kJoin this epoch
+  // Per rank: refs this rank contributed to that are still incomplete —
+  // >0 on EVERY live rank means everyone is waiting on a collective some
+  // peer never matched (compute skew never looks like this: the slow
+  // rank has nothing pending).
+  std::vector<int> sched_unmatched_;
+  // Last reported per-rank seq + order-insensitive digest (set 0):
+  // compared when shutdown is agreed — equal multisets of submissions
+  // must yield equal digests (warns, never aborts: a rank may abandon
+  // async handles at exit).
+  std::vector<uint64_t> sched_seq_seen_;
+  std::vector<uint64_t> sched_digest_seen_;
+  bool sched_epoch_mixed_ = false;    // some ranks joined, some not:
+                                      // quiescence + digest suspended
+  bool sched_reported_ = false;       // a divergence was already reported
+                                      // this epoch: skip the shutdown
+                                      // digest warning (it would restate
+                                      // the known divergence)
+  bool sched_cycle_records_ = false;  // this cycle carried any record
+  std::chrono::steady_clock::time_point sched_quiet_since_;
+  std::string sched_abort_;           // non-empty: divergence detected
+
   int rank_ = 0;
   int size_ = 1;
   TcpSocket listener_;

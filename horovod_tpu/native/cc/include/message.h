@@ -40,6 +40,18 @@ struct RequestList {
   bool shutdown = false;
   std::vector<uint64_t> cache_hits;   // response-cache bit vector
 
+  // Collective-schedule contract verifier (HOROVOD_SCHEDULE_CHECK=1):
+  // this rank's submission records for the cycle, captured at announce
+  // time — BEFORE cache bit-compression, so the true submissions
+  // survive even for bit-announced tensors — plus an order-insensitive
+  // rolling digest and count of every global-set submission since init
+  // (reset when this rank submits kJoin).  All empty/zero when the
+  // check is off, costing ~17 bytes per cycle on the wire and nothing
+  // else.
+  std::vector<Request> sched;
+  uint64_t sched_seq = 0;
+  uint64_t sched_digest = 0;
+
   std::string Serialize() const;
   static Status Parse(const std::string& buf, RequestList* out);
 };
@@ -65,6 +77,15 @@ struct Response {
   std::vector<int64_t> first_dims;
 };
 
+// Rolling schedule digest (FNV-1a): fold one submission's signature
+// (op, dtype, arg, set, name, shape, splits presence) into the rank's
+// running digest via XOR of per-record FNV-1a hashes: equal submission
+// MULTISETS yield equal digests regardless of order (async submission
+// pools make cross-rank order legal to differ).  The digest is the
+// cheap backstop, the sched records give the precise report.
+constexpr uint64_t kSchedDigestInit = 1469598103934665603ULL;
+uint64_t SchedFold(uint64_t digest, const Request& r);
+
 struct ResponseList {
   std::vector<Response> responses;
   bool shutdown = false;
@@ -74,6 +95,13 @@ struct ResponseList {
   // when processing THIS list, so fusion walks and cache gating change at
   // the same point in the response stream everywhere.
   TunedParams params;
+
+  // Non-empty = the coordinator detected a cross-rank schedule
+  // divergence (HOROVOD_SCHEDULE_CHECK): the first-divergence report
+  // naming the ranks, call index and mismatched field.  Every rank
+  // fails its pending work with this message and stops its background
+  // loop — instant, actionable abort instead of a stall timeout.
+  std::string abort_message;
 
   std::string Serialize() const;
   static Status Parse(const std::string& buf, ResponseList* out);

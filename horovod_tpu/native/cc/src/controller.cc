@@ -33,6 +33,49 @@ std::string TableKey(int32_t set_id, const std::string& name) {
   return std::to_string(set_id) + "\x01" + name;
 }
 
+// One-line human description of a submission record for the
+// first-divergence report.
+std::string SchedDescribe(const Request& r) {
+  std::ostringstream os;
+  os << OpTypeName(r.op_type) << "('" << r.name << "', "
+     << DataTypeName(r.dtype) << ", shape=" << ShapeStr(r.shape);
+  if (r.op_type == OpType::kBroadcast) os << ", root=" << r.arg;
+  if (!r.splits.empty()) os << ", splits=" << ShapeStr(r.splits);
+  os << ")";
+  return os.str();
+}
+
+// Op-aware record comparison: returns the name of the first mismatched
+// field, or "" when the records agree.  Mirrors what ConstructResponse
+// would accept — fields that legitimately differ per rank (allgather /
+// alltoallv first dims, alltoallv split values) are not compared, so
+// the verifier adds no false aborts on valid programs.
+std::string SchedMismatch(const Request& a, const Request& b) {
+  if (a.op_type != b.op_type) return "operation type";
+  if (a.name != b.name) return "tensor name";
+  if (a.dtype != b.dtype) return "dtype";
+  if (a.arg != b.arg)
+    return a.op_type == OpType::kBroadcast ? "root rank"
+                                           : "reduce-op argument";
+  switch (a.op_type) {
+    case OpType::kAllgather:
+    case OpType::kAlltoall:
+      if (a.shape.size() != b.shape.size()) return "tensor rank (ndims)";
+      for (size_t i = 1; i < a.shape.size(); ++i)
+        if (a.shape[i] != b.shape[i]) return "non-first shape dims";
+      if (a.op_type == OpType::kAlltoall &&
+          a.splits.empty() != b.splits.empty())
+        return "splits presence";
+      break;
+    case OpType::kProcessSet:
+      if (a.splits != b.splits) return "process-set member list";
+      break;
+    default:
+      if (a.shape != b.shape) return "shape";
+  }
+  return "";
+}
+
 }  // namespace
 
 Status Controller::Init(int rank, int size, const std::string& master_addr,
@@ -44,8 +87,15 @@ Status Controller::Init(int rank, int size, const std::string& master_addr,
   cache_ = cache;
   fusion_threshold_ =
       EnvInt("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024);
+  schedule_check_ = EnvBool("HOROVOD_SCHEDULE_CHECK", false);
+  sched_quiet_s_ = EnvDouble("HOROVOD_SCHEDULE_CHECK_QUIET_SECONDS", 2.0);
   shutdown_ranks_.assign(size, false);
   joined_.assign(size, false);
+  sched_joined_.assign(size, false);
+  sched_unmatched_.assign(size, 0);
+  sched_seq_seen_.assign(size, 0);
+  sched_digest_seen_.assign(size, 0);
+  sched_quiet_since_ = std::chrono::steady_clock::now();
   peers_out->assign(size, PeerAddr{});
 
   const std::string key = JobKey();
@@ -178,6 +228,7 @@ Status Controller::MasterCycle(const RequestList& mine, ResponseList* out,
   // Gather every worker's announcements (reference RecvReadyTensors /
   // MPI_Gather, mpi_controller.cc:107-150).  Lock-step: every rank sends
   // exactly one list per cycle.
+  if (schedule_check_) VerifySchedule(mine, 0);
   Ingest(mine, 0);
   for (int r = 1; r < size_; ++r) {
     std::string buf;
@@ -186,12 +237,36 @@ Status Controller::MasterCycle(const RequestList& mine, ResponseList* out,
     if (!s.ok()) return s;
     s = RequestList::Parse(buf, &rl);
     if (!s.ok()) return s;
+    // Verify BEFORE ingesting: a diverged submission must be reported,
+    // never negotiated (the ingest path would park it in the pending
+    // table and start the stall clock instead).
+    if (schedule_check_) VerifySchedule(rl, r);
     Ingest(rl, r);
   }
 
   out->responses.clear();
   out->shutdown = false;
   if (tuned != nullptr) out->params = *tuned;
+
+  if (schedule_check_) {
+    CheckScheduleProgress();
+    if (!sched_abort_.empty()) {
+      // Schedule divergence wins over everything this cycle: suppress
+      // verdicts (the pending work IS the diverged work) and broadcast
+      // the first-divergence report so every rank aborts immediately
+      // instead of riding the stall timeout.
+      out->abort_message = sched_abort_;
+      LOG(Error) << sched_abort_;
+      if (size_ > 1) {
+        std::string payload = out->Serialize();
+        for (int r = 1; r < size_; ++r) {
+          Status s = workers_[r].SendFrame(payload);
+          if (!s.ok()) return s;
+        }
+      }
+      return Status::OK();
+    }
+  }
 
   // Ready tensors -> validated responses, in the master-defined order.
   // Joins are ordered LAST within the cycle: executing a join resets the
@@ -202,6 +277,19 @@ Status Controller::MasterCycle(const RequestList& mine, ResponseList* out,
     std::string key = ready_.front();
     ready_.pop_front();
     Response r = ConstructResponse(key);
+    if (schedule_check_) {
+      // A schedule-verifier signature mismatch upgrades (or creates) the
+      // error response with the first-divergence diagnostic; validation
+      // normally catches the same mismatch, so this usually appends.
+      auto pit = sched_poison_.find(key);
+      if (pit != sched_poison_.end()) {
+        r.error = true;
+        r.cacheable = false;
+        r.error_message = r.error_message.empty()
+            ? pit->second : r.error_message + " " + pit->second;
+        sched_poison_.erase(pit);
+      }
+    }
     table_.erase(key);
     if (!r.error && r.op_type == OpType::kJoin)
       joins.push_back(std::move(r));
@@ -209,10 +297,14 @@ Status Controller::MasterCycle(const RequestList& mine, ResponseList* out,
       out->responses.push_back(std::move(r));
   }
   for (auto& r : joins) out->responses.push_back(std::move(r));
-  if (!joins.empty())
+  if (!joins.empty()) {
     // Join completed: reset so training can continue past the sync point
     // (Horovod's join is used per-epoch with uneven data).
     joined_.assign(size_, false);
+    // Schedule streams restart with the new epoch; ranks reset their own
+    // digest/seq when they fold their kJoin announcement.
+    if (schedule_check_) ResetSchedule();
+  }
 
   // Stall inspection over still-pending tensors (reference
   // CheckForStalledTensors, stall_inspector.cc:26).
@@ -252,6 +344,10 @@ Status Controller::MasterCycle(const RequestList& mine, ResponseList* out,
         "Stalled collective: tensor " + name +
         " exceeded HOROVOD_STALL_SHUTDOWN_TIME_SECONDS without being "
         "submitted on all ranks.";
+    if (!schedule_check_)
+      r.error_message +=
+          " Rerun with HOROVOD_SCHEDULE_CHECK=1 to pinpoint the first "
+          "diverging submission (rank, call index, field).";
     out->responses.push_back(std::move(r));
     table_.erase(key);
   }
@@ -345,6 +441,174 @@ void Controller::Ingest(const RequestList& list, int from_rank) {
     std::sort(newly.begin(), newly.end());
     for (auto& kv : newly) ready_.push_back(kv.second);
   }
+}
+
+void Controller::VerifySchedule(const RequestList& list, int from_rank) {
+  // kJoin travels in `requests`, never in `sched`: ranks legitimately
+  // join at different points (that is the op's purpose) — it terminates
+  // the rank's stream and suspends the quiescence detector and digest
+  // backstop until the epoch turns over.
+  for (const auto& r : list.requests)
+    if (r.op_type == OpType::kJoin && !sched_joined_[from_rank]) {
+      sched_joined_[from_rank] = true;
+      sched_epoch_mixed_ = true;
+    }
+
+  if (!list.sched.empty()) sched_cycle_records_ = true;
+  for (const auto& req : list.sched) {
+    auto& st = sched_streams_[req.set_id];
+    if (st.next_idx.empty()) st.next_idx.assign(size_, 0);
+    const uint64_t idx = st.next_idx[from_rank]++;
+    auto& q = st.by_name[req.name];
+    // Oldest pending ref of this name this rank hasn't contributed to
+    // (FIFO: pipelined reuse of a name matches in submission order).
+    auto it = q.begin();
+    while (it != q.end() && it->seen[from_rank]) ++it;
+    if (it == q.end()) {
+      SchedRef ref;
+      ref.req = req;
+      ref.owner = from_rank;
+      ref.idx = idx;
+      ref.seen.assign(size_, false);
+      ref.seen[from_rank] = true;
+      ref.seen_count = 1;
+      q.push_back(std::move(ref));
+      it = std::prev(q.end());
+      ++sched_unmatched_[from_rank];
+    } else {
+      const std::string field = SchedMismatch(it->req, req);
+      if (!field.empty()) {
+        // Poison, don't abort: the record still contributes to the ref
+        // below, so the pending entry reaches ConstructResponse and the
+        // diagnostic rides the normal per-tensor error response — the
+        // job survives a signature mismatch exactly like the unarmed
+        // runtime, just with the first-divergence report attached.
+        const std::string key = TableKey(req.set_id, req.name);
+        if (sched_poison_.find(key) == sched_poison_.end()) {
+          std::ostringstream os;
+          os << "HOROVOD_SCHEDULE_CHECK: collective schedule divergence "
+             << "at call #" << it->idx;
+          if (req.set_id != 0) os << " of process set " << req.set_id;
+          os << ": rank " << it->owner << " submitted "
+             << SchedDescribe(it->req) << " but rank " << from_rank
+             << " (call #" << idx << ") submitted " << SchedDescribe(req)
+             << " -- mismatched field: " << field
+             << ". Every rank must submit each named collective with "
+                "matching ops, dtypes and arguments; run `python -m "
+                "tools.hvdlint` to locate the rank-divergent call site.";
+          sched_poison_[key] = os.str();
+          sched_reported_ = true;
+        }
+      }
+      it->seen[from_rank] = true;
+      ++it->seen_count;
+      ++sched_unmatched_[from_rank];
+    }
+    // Complete once every participant contributed: the global set waits
+    // on all ranks, a subset stream only on its members — a SINGLE-member
+    // set completes at creation (an unregistered set conservatively waits
+    // on all ranks and is cleared on reset).
+    const GroupInfo gi = ResolveGroup(req.set_id);
+    if (it->seen_count >= gi.gsize) {
+      for (int r2 = 0; r2 < size_; ++r2)
+        if (it->seen[r2]) --sched_unmatched_[r2];
+      q.erase(it);
+    }
+  }
+
+  // Latest per-rank seq + order-insensitive digest: compared at shutdown
+  // agreement by CheckScheduleProgress.
+  sched_seq_seen_[from_rank] = list.sched_seq;
+  sched_digest_seen_[from_rank] = list.sched_digest;
+}
+
+void Controller::CheckScheduleProgress() {
+  const auto now = std::chrono::steady_clock::now();
+
+  // Quiescence detector: no rank announced anything for a full quiet
+  // window AND every rank has a submission no peer ever matched.  That
+  // is the silent-hang shape — ordinary compute skew never looks like
+  // this, because the slow rank has nothing pending of its own, and
+  // in-flight async batches keep producing records (which reset the
+  // window).  Suspended across join epochs: a joined rank legitimately
+  // stops matching its peers' submissions.
+  bool stuck = !sched_cycle_records_ && !sched_epoch_mixed_;
+  if (stuck)
+    for (int r = 0; r < size_; ++r)
+      if (sched_unmatched_[r] <= 0) { stuck = false; break; }
+  if (!stuck) {
+    sched_quiet_since_ = now;
+  } else if (sched_abort_.empty() &&
+             std::chrono::duration<double>(now - sched_quiet_since_)
+                     .count() >= sched_quiet_s_) {
+    std::ostringstream os;
+    os << "HOROVOD_SCHEDULE_CHECK: collective schedule divergence: every "
+          "rank is blocked on a collective no peer submitted (job quiet "
+          "for " << sched_quiet_s_ << "s)";
+    int listed = 0;
+    for (const auto& skv : sched_streams_) {
+      const GroupInfo gi = ResolveGroup(skv.first);
+      for (const auto& nkv : skv.second.by_name) {
+        for (const auto& ref : nkv.second) {
+          if (listed >= 4) break;
+          os << (listed == 0 ? ": " : "; ") << "rank " << ref.owner
+             << " submitted " << SchedDescribe(ref.req) << " at call #"
+             << ref.idx;
+          if (skv.first != 0) os << " of process set " << skv.first;
+          os << ", never matched by rank(s)";
+          if (gi.members == nullptr) {
+            for (int r = 0; r < size_; ++r)
+              if (!ref.seen[r]) os << " " << r;
+          } else {
+            for (int32_t m : *gi.members)
+              if (!ref.seen[m]) os << " " << m;
+          }
+          ++listed;
+        }
+      }
+    }
+    os << ". Every rank must submit the same set of named collectives; "
+          "run `python -m tools.hvdlint` to locate the rank-divergent "
+          "call site (window: HOROVOD_SCHEDULE_CHECK_QUIET_SECONDS).";
+    sched_abort_ = os.str();
+  }
+  sched_cycle_records_ = false;
+
+  // Digest backstop: once shutdown is agreed every rank's set-0
+  // submission multiset must match (the fold is order-insensitive), so
+  // equal digests cross-check the record mechanism itself.  Warn-only:
+  // a rank abandoning unsynchronized async handles at exit is leaky but
+  // legal.
+  if (sched_abort_.empty() && !sched_epoch_mixed_ && !sched_reported_ &&
+      std::all_of(shutdown_ranks_.begin(), shutdown_ranks_.end(),
+                  [](bool b) { return b; })) {
+    for (int r = 1; r < size_; ++r) {
+      if (sched_seq_seen_[r] == sched_seq_seen_[0] &&
+          sched_digest_seen_[r] == sched_digest_seen_[0])
+        continue;
+      LOG(Warning) << "HOROVOD_SCHEDULE_CHECK: schedule digests differ at "
+                   << "shutdown: rank 0 folded " << sched_seq_seen_[0]
+                   << " submissions (digest 0x" << std::hex
+                   << sched_digest_seen_[0] << std::dec << ") but rank "
+                   << r << " folded " << sched_seq_seen_[r] << " (digest 0x"
+                   << std::hex << sched_digest_seen_[r] << std::dec
+                   << ") -- the ranks did not submit the same set of "
+                      "collectives (e.g. abandoned async handles).";
+      break;
+    }
+  }
+}
+
+void Controller::ResetSchedule() {
+  sched_streams_.clear();
+  sched_poison_.clear();
+  sched_joined_.assign(size_, false);
+  sched_unmatched_.assign(size_, 0);
+  sched_seq_seen_.assign(size_, 0);
+  sched_digest_seen_.assign(size_, 0);
+  sched_epoch_mixed_ = false;
+  sched_reported_ = false;
+  sched_quiet_since_ = std::chrono::steady_clock::now();
 }
 
 Response Controller::ConstructResponse(const std::string& key) {

@@ -61,23 +61,76 @@ Status Malformed(const char* what) {
   return Status::InvalidArgument(std::string("malformed message: ") + what);
 }
 
+void PutRequest(std::string* buf, const Request& r) {
+  PutPod<int32_t>(buf, r.rank);
+  PutPod<int32_t>(buf, static_cast<int32_t>(r.op_type));
+  PutPod<int32_t>(buf, static_cast<int32_t>(r.dtype));
+  PutPod<int32_t>(buf, r.arg);
+  PutPod<int32_t>(buf, r.set_id);
+  PutStr(buf, r.name);
+  PutVec(buf, r.shape);
+  PutVec(buf, r.splits);
+}
+
+bool GetRequest(Reader* rd, Request* r) {
+  int32_t op, dt;
+  if (!rd->GetPod(&r->rank) || !rd->GetPod(&op) || !rd->GetPod(&dt) ||
+      !rd->GetPod(&r->arg) || !rd->GetPod(&r->set_id) ||
+      !rd->GetStr(&r->name) || !rd->GetVec(&r->shape) ||
+      !rd->GetVec(&r->splits))
+    return false;
+  r->op_type = static_cast<OpType>(op);
+  r->dtype = static_cast<DataType>(dt);
+  return true;
+}
+
 }  // namespace
+
+uint64_t SchedFold(uint64_t digest, const Request& r) {
+  // Each record is hashed independently (FNV-1a) and XOR-combined into
+  // the running digest: the negotiation is name-keyed and async
+  // submission pools make cross-rank submission ORDER legal to differ,
+  // so the digest must be order-insensitive — equal multisets of
+  // submissions yield equal digests.
+  constexpr uint64_t kPrime = 1099511628211ULL;
+  uint64_t h = kSchedDigestInit;
+  auto fold = [&](uint64_t v) {
+    for (int i = 0; i < 8; ++i) {
+      h ^= (v >> (i * 8)) & 0xff;
+      h *= kPrime;
+    }
+  };
+  fold(static_cast<uint64_t>(r.op_type));
+  fold(static_cast<uint64_t>(r.dtype));
+  fold(static_cast<uint64_t>(static_cast<int64_t>(r.arg)));
+  fold(static_cast<uint64_t>(static_cast<int64_t>(r.set_id)));
+  for (char c : r.name) {
+    h ^= static_cast<unsigned char>(c);
+    h *= kPrime;
+  }
+  // Shapes legitimately differ per rank on dim 0 for allgather /
+  // alltoallv; the digest folds only what must agree everywhere (the
+  // records carry full shapes for the op-aware precise comparison).
+  size_t start = (r.op_type == OpType::kAllgather ||
+                  r.op_type == OpType::kAlltoall) ? 1 : 0;
+  fold(r.shape.size());
+  for (size_t i = start; i < r.shape.size(); ++i)
+    fold(static_cast<uint64_t>(r.shape[i]));
+  fold(r.op_type == OpType::kAlltoall ? (r.splits.empty() ? 0 : 1)
+                                      : r.splits.size());
+  return digest ^ h;
+}
 
 std::string RequestList::Serialize() const {
   std::string buf;
   PutPod<uint8_t>(&buf, shutdown ? 1 : 0);
   PutVec(&buf, cache_hits);
   PutPod<uint32_t>(&buf, static_cast<uint32_t>(requests.size()));
-  for (const auto& r : requests) {
-    PutPod<int32_t>(&buf, r.rank);
-    PutPod<int32_t>(&buf, static_cast<int32_t>(r.op_type));
-    PutPod<int32_t>(&buf, static_cast<int32_t>(r.dtype));
-    PutPod<int32_t>(&buf, r.arg);
-    PutPod<int32_t>(&buf, r.set_id);
-    PutStr(&buf, r.name);
-    PutVec(&buf, r.shape);
-    PutVec(&buf, r.splits);
-  }
+  for (const auto& r : requests) PutRequest(&buf, r);
+  PutPod<uint64_t>(&buf, sched_seq);
+  PutPod<uint64_t>(&buf, sched_digest);
+  PutPod<uint32_t>(&buf, static_cast<uint32_t>(sched.size()));
+  for (const auto& r : sched) PutRequest(&buf, r);
   return buf;
 }
 
@@ -90,16 +143,14 @@ Status RequestList::Parse(const std::string& buf, RequestList* out) {
   uint32_t n;
   if (!rd.GetPod(&n)) return Malformed("count");
   out->requests.resize(n);
-  for (auto& r : out->requests) {
-    int32_t op, dt;
-    if (!rd.GetPod(&r.rank) || !rd.GetPod(&op) || !rd.GetPod(&dt) ||
-        !rd.GetPod(&r.arg) || !rd.GetPod(&r.set_id) ||
-        !rd.GetStr(&r.name) || !rd.GetVec(&r.shape) ||
-        !rd.GetVec(&r.splits))
-      return Malformed("request");
-    r.op_type = static_cast<OpType>(op);
-    r.dtype = static_cast<DataType>(dt);
-  }
+  for (auto& r : out->requests)
+    if (!GetRequest(&rd, &r)) return Malformed("request");
+  if (!rd.GetPod(&out->sched_seq) || !rd.GetPod(&out->sched_digest))
+    return Malformed("sched header");
+  if (!rd.GetPod(&n)) return Malformed("sched count");
+  out->sched.resize(n);
+  for (auto& r : out->sched)
+    if (!GetRequest(&rd, &r)) return Malformed("sched record");
   return Status::OK();
 }
 
@@ -130,6 +181,7 @@ std::string ResponseList::Serialize() const {
     PutPod<uint8_t>(&buf, params.hier_allreduce ? 1 : 0);
     PutPod<uint8_t>(&buf, params.hier_allgather ? 1 : 0);
   }
+  PutStr(&buf, abort_message);
   return buf;
 }
 
@@ -175,6 +227,7 @@ Status ResponseList::Parse(const std::string& buf, ResponseList* out) {
     out->params.hier_allreduce = har != 0;
     out->params.hier_allgather = hag != 0;
   }
+  if (!rd.GetStr(&out->abort_message)) return Malformed("abort_message");
   return Status::OK();
 }
 

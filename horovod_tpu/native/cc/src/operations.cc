@@ -85,6 +85,16 @@ struct GlobalState {
   std::atomic<uint64_t> cache_lookups{0};
   std::atomic<uint64_t> cache_hit_count{0};
 
+  // HOROVOD_SCHEDULE_CHECK contract verifier: `schedule_check` mirrors
+  // the env flag for the C introspection API; submissions/divergences
+  // feed the hvd_schedule_check_* telemetry series.  The rolling
+  // digest/seq are background-thread-only (folded at announce time).
+  std::atomic<bool> schedule_check{false};
+  std::atomic<uint64_t> sched_submissions{0};
+  std::atomic<uint64_t> sched_divergences{0};
+  uint64_t sched_digest_local = kSchedDigestInit;
+  uint64_t sched_seq_local = 0;
+
   // Wakes the background loop the moment work arrives, instead of letting
   // a fresh enqueue wait out the remainder of the cycle sleep — cuts
   // small-op latency from ~cycle_time to ~negotiation time (the reference
@@ -656,6 +666,9 @@ void BackgroundThread() {
     return;
   }
 
+  const bool sched_check = EnvBool("HOROVOD_SCHEDULE_CHECK", false);
+  g->schedule_check.store(sched_check);
+
   bool shutdown_seen = false;
   while (!shutdown_seen) {
     auto cycle_start = std::chrono::steady_clock::now();
@@ -665,6 +678,23 @@ void BackgroundThread() {
     for (auto& r : g->queue.PopAnnouncements(g->rank)) {
       if (r.op_type == OpType::kJoin) g->joined.store(true);
       g->timeline.NegotiateStart(r.name, r.op_type);
+      if (sched_check) {
+        if (r.op_type == OpType::kJoin) {
+          // Own join ends this rank's schedule epoch; the coordinator
+          // resets its streams when the join response is constructed.
+          g->sched_digest_local = kSchedDigestInit;
+          g->sched_seq_local = 0;
+        } else {
+          // Schedule record captured BEFORE the cache fast path below:
+          // the true submission order must survive bit-compression.
+          mine.sched.push_back(r);
+          g->sched_submissions.fetch_add(1, std::memory_order_relaxed);
+          if (r.set_id == 0) {
+            g->sched_digest_local = SchedFold(g->sched_digest_local, r);
+            ++g->sched_seq_local;
+          }
+        }
+      }
       // Steady state: a tensor whose params match the cache travels as one
       // bit instead of a serialized request (reference cached fast path,
       // controller.cc:165-179).  Allgather/alltoall included: the hit bit
@@ -681,6 +711,10 @@ void BackgroundThread() {
       }
     }
     mine.shutdown = g->shutting_down.load();
+    if (sched_check) {
+      mine.sched_seq = g->sched_seq_local;
+      mine.sched_digest = g->sched_digest_local;
+    }
 
     ResponseList responses;
     TunedParams tuned;
@@ -691,6 +725,16 @@ void BackgroundThread() {
       LOG(Error) << "controller cycle failed: " << s.reason;
       SetLastError(s.reason);
       g->queue.FailAll(Status::Aborted(s.reason));
+      break;
+    }
+    if (!responses.abort_message.empty()) {
+      // Coordinator-verified schedule divergence: every rank receives the
+      // same first-divergence report at the same stream position, fails
+      // its pending work with it and stops — no stall timeout involved.
+      LOG(Error) << responses.abort_message;
+      g->sched_divergences.fetch_add(1, std::memory_order_relaxed);
+      SetLastError(responses.abort_message);
+      g->queue.FailAll(Status::Aborted(responses.abort_message));
       break;
     }
     // Apply autotuned knobs delivered with THIS list before fusing it —
@@ -860,6 +904,20 @@ int64_t hvd_cache_lookups() {
 int64_t hvd_cache_hits() {
   return g ? static_cast<int64_t>(
                  g->cache_hit_count.load(std::memory_order_relaxed))
+           : 0;
+}
+
+int hvd_schedule_check_enabled() {
+  return g && g->schedule_check.load() ? 1 : 0;
+}
+int64_t hvd_schedule_check_submissions() {
+  return g ? static_cast<int64_t>(
+                 g->sched_submissions.load(std::memory_order_relaxed))
+           : 0;
+}
+int64_t hvd_schedule_check_divergences() {
+  return g ? static_cast<int64_t>(
+                 g->sched_divergences.load(std::memory_order_relaxed))
            : 0;
 }
 

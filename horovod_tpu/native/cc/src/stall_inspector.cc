@@ -21,12 +21,17 @@ bool StallInspector::Check(const std::string& name,
     std::ostringstream ready, missing;
     for (size_t r = 0; r < submitted.size(); ++r)
       (submitted[r] ? ready : missing) << r << " ";
+    const bool sched_check = EnvBool("HOROVOD_SCHEDULE_CHECK", false);
     LOG(Warning) << "One or more tensors were submitted to be reduced, "
                  << "gathered or broadcasted by subset of ranks and are "
                  << "waiting for remainder of ranks for more than "
                  << warn_s_ << " seconds. Tensor: " << name
                  << " ready ranks: [" << ready.str() << "] missing ranks: ["
-                 << missing.str() << "]";
+                 << missing.str() << "]"
+                 << (sched_check ? "" :
+                     " Rerun with HOROVOD_SCHEDULE_CHECK=1 to catch the "
+                     "first diverging submission (rank, call index, "
+                     "mismatched field) instead of waiting out the stall.");
   }
   return shutdown_s_ > 0 && age >= shutdown_s_;
 }

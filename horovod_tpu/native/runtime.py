@@ -202,6 +202,18 @@ class Runtime:
         self._cache_hits_fn = getattr(lib, "hvd_cache_hits", None)
         if self._cache_hits_fn is not None:
             self._cache_hits_fn.restype = ctypes.c_longlong
+        # Collective-schedule contract verifier (HOROVOD_SCHEDULE_CHECK).
+        self._sched_check_fn = getattr(
+            lib, "hvd_schedule_check_enabled", None)
+        self._sched_subs_fn = getattr(
+            lib, "hvd_schedule_check_submissions", None)
+        if self._sched_subs_fn is not None:
+            self._sched_subs_fn.restype = ctypes.c_longlong
+        self._sched_div_fn = getattr(
+            lib, "hvd_schedule_check_divergences", None)
+        if self._sched_div_fn is not None:
+            self._sched_div_fn.restype = ctypes.c_longlong
+        self._sched_published = {}  # sym -> last value already inc'd
         # Hierarchical-plane introspection (per-level byte/latency
         # counters + topology availability), all optional symbols.
         self._hier_avail_fn = getattr(
@@ -366,6 +378,7 @@ class Runtime:
         hvdrun --metrics-file summary; docs/metrics.md)."""
         if not telemetry.enabled():
             return
+        self._publish_schedule_check_metrics()
         cfg = self.tuned_config()
         if not cfg:
             return
@@ -394,6 +407,42 @@ class Runtime:
             "1 while the 2-level eager allgather routing is active",
         ).set(1.0 if cfg.get("hier_allgather") else 0.0)
         self._publish_hier_metrics()
+
+    def _publish_schedule_check_metrics(self) -> None:
+        """``hvd_schedule_check_*`` series (docs/metrics.md): whether the
+        collective-schedule contract verifier is armed, how many
+        submissions this rank folded into its schedule stream, and
+        whether a coordinator divergence abort was observed.  Native
+        counters are monotonic; each publish adds the delta."""
+        if self._sched_check_fn is None or self._lib is None:
+            return
+        telemetry.gauge(
+            "hvd_schedule_check_enabled",
+            "1 while HOROVOD_SCHEDULE_CHECK verification is active",
+        ).set(1.0 if self._sched_check_fn() else 0.0)
+
+        def delta(sym: str, fn) -> int:
+            if fn is None:
+                return 0
+            now = int(fn())
+            d = now - self._sched_published.get(sym, 0)
+            self._sched_published[sym] = now
+            return max(d, 0)
+
+        d = delta("submissions", self._sched_subs_fn)
+        if d:
+            telemetry.counter(
+                "hvd_schedule_check_submissions_total",
+                "Collective submissions folded into this rank's verified "
+                "schedule stream",
+            ).inc(d)
+        d = delta("divergences", self._sched_div_fn)
+        if d:
+            telemetry.counter(
+                "hvd_schedule_check_divergence_total",
+                "Coordinator-reported schedule divergence aborts observed "
+                "by this rank",
+            ).inc(d)
 
     def _publish_hier_metrics(self) -> None:
         """Mirror the native per-level counters into telemetry.
@@ -524,6 +573,14 @@ class Runtime:
                 f"{cfg['chunk_bytes']}"
                 + (", autotuner exploring" if cfg["exploring"] else "")
                 + ".")
+        sched_note = ""
+        if not (self._sched_check_fn is not None and self._sched_check_fn()):
+            sched_note = (
+                " If a divergent submission order is suspected, rerun "
+                "with HOROVOD_SCHEDULE_CHECK=1: the coordinator then "
+                "verifies every rank's submission stream and aborts at "
+                "the first divergence naming both ranks, the call index "
+                "and the mismatched field instead of stalling here.")
         return (
             f"Stalled eager op '{name}': submitted by rank {self.rank} "
             f"but not completed after {elapsed:.1f}s. One or more ranks "
@@ -532,7 +589,8 @@ class Runtime:
             f"coordinator's stall watchdog, HOROVOD_STALL_CHECK_TIME_"
             f"SECONDS, reports the authoritative list on rank 0). "
             f"Possible causes: a crashed or hung peer, a deadlocked "
-            f"submission order, or a network partition." + cfg_note)
+            f"submission order, or a network partition." + cfg_note
+            + sched_note)
 
     def _watchdog(self) -> None:
         """Background stall reporter for the default (no hard timeout)

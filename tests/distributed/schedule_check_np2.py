@@ -1,0 +1,84 @@
+"""Collective-schedule contract verifier gates (run: hvdrun -np 2, see
+ci/run_tests.sh; scenario picked by argv[1]: "field" or "order").
+
+A rank-divergent submission is SPMD's classic silent failure: each
+rank's collective parks in the coordinator's pending table waiting for
+the other, and the job dies minutes later on a stall timeout that names
+a tensor but not the divergence.  With ``HOROVOD_SCHEDULE_CHECK=1``
+every rank piggybacks its submission records (and an order-insensitive
+rolling digest) on the per-cycle coordination message; the coordinator
+matches the records by name and aborts at the FIRST divergence.
+
+Two divergence shapes, two scenarios:
+
+* ``field`` — both ranks submit the SAME name with a rank-dependent
+  argument (broadcast root).  Caught within one coordination cycle of
+  the second rank's record arriving; the report names both ranks, the
+  call index and the mismatched field.
+* ``order`` — the ranks submit DIFFERENT names and block forever.  No
+  name-keyed match can ever complete; the quiescence detector reports
+  it after the quiet window (~0.5s here) instead of the stall timeout,
+  naming each rank's unmatched call.
+
+Each scenario first completes a matching collective (the armed verifier
+must not false-abort a valid schedule).  The stall deadlines are set far
+beyond the assert window, so a pass can only come from the schedule
+verifier — never from the stall path.
+"""
+import os
+
+os.environ["HOROVOD_SCHEDULE_CHECK"] = "1"
+os.environ["HOROVOD_SCHEDULE_CHECK_QUIET_SECONDS"] = "0.5"
+os.environ["HOROVOD_STALL_CHECK_TIME_SECONDS"] = "300"
+os.environ["HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"] = "600"
+
+import sys
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+scenario = sys.argv[1] if len(sys.argv) > 1 else "field"
+assert scenario in ("field", "order"), scenario
+
+hvd.init()
+rank = hvd.rank()
+x = np.ones(4, np.float32)
+
+# Phase 1: a matching schedule completes under the armed verifier.
+out = hvd.allreduce(x, average=False, name="sched.ok")
+assert np.asarray(out).tolist() == [2.0] * 4
+
+# Phase 2: diverge.
+t0 = time.monotonic()
+try:
+    if scenario == "field":
+        # Same name, rank-dependent root: signature mismatch, caught the
+        # cycle the second rank's record arrives.
+        hvd.broadcast(x, root_rank=rank,  # hvdlint: allow(rank-divergent) — divergence is this gate's purpose
+                      name="sched.diverge")
+    else:
+        # Different names: neither can ever match; the quiescence
+        # detector fires after the quiet window.
+        hvd.allreduce(x, average=False,  # hvdlint: allow(rank-divergent) — divergence is this gate's purpose
+                      name=f"sched.diverge.{rank}")
+except RuntimeError as e:
+    elapsed = time.monotonic() - t0
+    msg = str(e)
+    assert "HOROVOD_SCHEDULE_CHECK" in msg, f"unexpected error: {e}"
+    assert "rank 0" in msg and "rank 1" in msg, msg
+    assert "call #1" in msg, msg
+    if scenario == "field":
+        assert "mismatched field: root rank" in msg, msg
+    else:
+        assert "no peer submitted" in msg, msg
+        assert "sched.diverge.0" in msg and "sched.diverge.1" in msg, msg
+    assert "Stalled" not in msg, msg
+    assert elapsed < 30, (
+        f"abort took {elapsed:.1f}s — the stall path is suspected to "
+        f"have fired instead of the schedule verifier")
+    print(f"schedule divergence ({scenario}) detected OK in "
+          f"{elapsed:.2f}s (rank {rank})")
+else:
+    raise SystemExit("expected a schedule-divergence abort")

@@ -12,7 +12,8 @@ import textwrap
 import pytest
 
 from tools import hvdlint
-from tools.hvdlint import env_registry, metrics_drift, rank_divergence
+from tools.hvdlint import (env_registry, metrics_drift, native_locks,
+                           rank_divergence, stale_pragma)
 from tools.hvdlint.common import Source, repo_root
 
 REPO = repo_root(os.path.dirname(__file__))
@@ -315,6 +316,169 @@ def test_dynamic_labels_skip_label_check(lint_tree):
             telemetry.counter("hvd_dyn_total", "h", **labels).inc()
     """)
     assert metrics_drift.check(str(root), [rel]) == []
+
+
+# --- interprocedural rank taint ----------------------------------------
+
+def test_helper_wrapped_rank_guard_triggers():
+    """The classic evasion of the syntactic rule: the guard lives in a
+    helper whose return value is rank-dependent."""
+    out = _rank_findings("""
+        import horovod_tpu as hvd
+        def is_chief():
+            return hvd.rank() == 0
+        def f():
+            if is_chief():
+                hvd.allreduce([1.0])
+    """)
+    assert len(out) == 1 and out[0].rule == "rank-divergent"
+    assert "allreduce" in out[0].message
+
+
+def test_taint_through_assignment_and_return():
+    out = _rank_findings("""
+        import horovod_tpu as hvd
+        def my_rank():
+            r = hvd.rank()
+            return r
+        def f():
+            who = my_rank()
+            if who == 0:
+                hvd.barrier()
+    """)
+    assert len(out) == 1 and "barrier" in out[0].message
+
+
+def test_taint_through_module_constant():
+    out = _rank_findings("""
+        import horovod_tpu as hvd
+        IS_CHIEF = hvd.rank() == 0
+        def f():
+            if IS_CHIEF:
+                hvd.allreduce([1.0])
+    """)
+    assert len(out) == 1
+
+
+def test_rank_tainted_key_argument_triggers():
+    out = _rank_findings("""
+        import horovod_tpu as hvd
+        def f():
+            root = hvd.rank()
+            hvd.broadcast([1.0], root_rank=root)
+    """)
+    assert len(out) == 1 and "root_rank" in out[0].message
+
+
+def test_tainted_arg_into_guarding_param_triggers():
+    out = _rank_findings("""
+        import horovod_tpu as hvd
+        def g(flag):
+            if flag == 0:
+                hvd.barrier()
+        def f():
+            g(hvd.rank())
+    """)
+    assert len(out) >= 1
+
+
+def test_collective_result_kills_taint():
+    """A collective's result is identical on every rank by construction:
+    branching on it must not be flagged."""
+    assert _rank_findings("""
+        import horovod_tpu as hvd
+        def f():
+            total = hvd.allreduce([hvd.rank() * 1.0])
+            if total[0] > 0:
+                hvd.barrier()
+    """) == []
+
+
+def test_uniform_helper_is_clean():
+    assert _rank_findings("""
+        import horovod_tpu as hvd
+        def world():
+            return hvd.size()
+        def f():
+            if world() > 1:
+                hvd.allreduce([1.0])
+    """) == []
+
+
+# --- stale-pragma -------------------------------------------------------
+
+def test_stale_pragma_triggers_and_live_pragma_is_clean(lint_tree):
+    root, write = lint_tree
+    stale = write("horovod_tpu/stale.py", """
+        import horovod_tpu as hvd
+        def f():
+            hvd.allreduce([1.0])  # hvdlint: allow(rank-divergent)
+    """)
+    live = write("horovod_tpu/live.py", """
+        import horovod_tpu as hvd
+        def f():
+            if hvd.rank() == 0:
+                hvd.allreduce([1.0])  # hvdlint: allow(rank-divergent)
+    """)
+    out = stale_pragma.check(str(root), [stale, live])
+    assert [f for f in out
+            if f.path == stale and "stale pragma" in f.message]
+    assert not [f for f in out if f.path == live]
+
+
+def test_unknown_slug_pragma_triggers(lint_tree):
+    root, write = lint_tree
+    rel = write("horovod_tpu/typo.py", """
+        import horovod_tpu as hvd
+        def f():
+            hvd.allreduce([1.0])  # hvdlint: allow(rank-divergnt)
+    """)
+    out = stale_pragma.check(str(root), [rel])
+    assert any("unknown rule" in f.message for f in out)
+
+
+# --- native-locks -------------------------------------------------------
+
+_LOCK_INVERTED = """
+void f() {
+  std::lock_guard<std::mutex> la(mu_a_);
+  {
+    std::lock_guard<std::mutex> lb(mu_b_);
+  }
+}
+void g() {
+  std::lock_guard<std::mutex> lb(mu_b_);
+  std::lock_guard<std::mutex> la(mu_a_);
+}
+"""
+
+_LOCK_CONSISTENT = """
+void f() {
+  std::lock_guard<std::mutex> la(mu_a_);
+  std::lock_guard<std::mutex> lb(mu_b_);
+}
+void g() {
+  std::lock_guard<std::mutex> la(mu_a_);
+  std::lock_guard<std::mutex> lb(mu_b_);
+}
+"""
+
+
+def _native_tree(tmp_path, code):
+    src = tmp_path / "horovod_tpu" / "native" / "cc" / "src"
+    src.mkdir(parents=True)
+    (src / "fixture.cc").write_text(code)
+    return str(tmp_path)
+
+
+def test_lock_order_inversion_triggers(tmp_path):
+    out = native_locks.check(_native_tree(tmp_path, _LOCK_INVERTED))
+    assert len(out) == 1 and out[0].rule == "native-locks"
+    assert "opposite order" in out[0].message
+
+
+def test_consistent_lock_order_is_clean(tmp_path):
+    assert native_locks.check(_native_tree(tmp_path, _LOCK_CONSISTENT)) == []
 
 
 # --- the CLI and the shipped tree --------------------------------------

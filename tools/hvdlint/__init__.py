@@ -1,24 +1,36 @@
 """hvdlint — distributed-correctness static analysis for horovod-tpu.
 
-Run as ``python -m tools.hvdlint`` (or ``make lint``).  Four rules:
+Run as ``python -m tools.hvdlint`` (or ``make lint``).  Five rules:
 
 * ``rank-divergent`` — eager collectives reachable only under
   rank-dependent control flow or inside lax.cond/while_loop bodies
-  (submission-order divergence deadlocks the coordinator);
+  (submission-order divergence deadlocks the coordinator); since
+  ISSUE 12 the rule is interprocedural within a module — provable rank
+  taint flows through assignments, helper returns, module constants and
+  function parameters (``tools/hvdlint/callgraph.py``);
 * ``env-registry`` — every ``HOROVOD_*`` environment read (Python and
   native C++) must go through / be declared in ``horovod_tpu/config.py``;
 * ``metrics-drift`` — every emitted ``hvd_*`` telemetry series must have
-  a ``docs/metrics.md`` row with matching labels, and vice versa.
+  a ``docs/metrics.md`` row with matching labels, and vice versa;
+* ``native-locks`` — inconsistent pairwise mutex acquisition order in
+  the native runtime (potential ABBA deadlock TSan only catches on
+  executed interleavings);
+* ``stale-pragma`` — ``# hvdlint: allow(...)`` comments that no longer
+  suppress anything (escape-hatch rot).
 
-The fourth gate — the native concurrency sanitizers — is dynamic, not
-static: ``ci/run_sanitizer.sh`` (see ``docs/static_analysis.md``).
+The dynamic complements — the native concurrency sanitizers and the
+``HOROVOD_SCHEDULE_CHECK`` collective-schedule verifier — live in
+``ci/run_sanitizer.sh`` and the native runtime (``docs/
+static_analysis.md``).
 """
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Sequence
 
-from tools.hvdlint import env_registry, metrics_drift, rank_divergence
+from tools.hvdlint import (env_registry, metrics_drift, native_locks,
+                           rank_divergence, stale_pragma)
 from tools.hvdlint.common import Finding, iter_py_files
 
 __all__ = ["RULES", "Finding", "run"]
@@ -28,16 +40,22 @@ RULES: Dict[str, object] = {
     rank_divergence.RULE: rank_divergence,
     env_registry.RULE: env_registry,
     metrics_drift.RULE: metrics_drift,
+    native_locks.RULE: native_locks,
+    stale_pragma.RULE: stale_pragma,
 }
 
 
 def run(root: str, rules: Optional[Sequence[str]] = None,
-        files: Optional[Sequence[str]] = None) -> List[Finding]:
+        files: Optional[Sequence[str]] = None,
+        timings: Optional[Dict[str, float]] = None) -> List[Finding]:
     """Run the selected rules (default: all) over the tree at ``root``.
 
     ``files`` restricts the Python scan set (repo-relative paths); the
     env-registry rule still reads the C++ sources and the metrics rule
-    still reads docs/metrics.md regardless.
+    still reads docs/metrics.md regardless.  When ``timings`` is a
+    dict it is filled with slug -> wall seconds per rule (the CLI
+    prints these so the interprocedural pass stays within its stated
+    budget, docs/static_analysis.md).
     """
     selected = list(rules) if rules else list(RULES)
     unknown = [r for r in selected if r not in RULES]
@@ -47,6 +65,9 @@ def run(root: str, rules: Optional[Sequence[str]] = None,
     py_files = list(files) if files is not None else list(iter_py_files(root))
     findings: List[Finding] = []
     for slug in selected:
+        t0 = time.perf_counter()
         findings.extend(RULES[slug].check(root, py_files))
+        if timings is not None:
+            timings[slug] = time.perf_counter() - t0
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings

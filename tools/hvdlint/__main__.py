@@ -66,9 +66,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(f"hvdlint: no such path: {p}", file=sys.stderr)
                 return 2
 
-    findings = run(root, rules=args.rules, files=files)
+    timings = {}
+    findings = run(root, rules=args.rules, files=files, timings=timings)
     for f in findings:
         print(f)
+    total = sum(timings.values())
+    print("hvdlint: rule timings: " +
+          ", ".join(f"{slug} {secs:.2f}s"
+                    for slug, secs in sorted(timings.items())) +
+          f" (total {total:.2f}s)", file=sys.stderr)
     n = len(findings)
     if n:
         print(f"\nhvdlint: {n} finding{'s' if n != 1 else ''} "

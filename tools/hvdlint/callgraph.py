@@ -1,0 +1,404 @@
+"""Module-level call graph + rank-taint dataflow for hvdlint.
+
+PR 10's ``rank-divergent`` rule is syntactic: it recognizes rank
+dependence only when a rank primitive (``hvd.rank()``, ``is_leader``, a
+name like ``rank``) appears *textually inside* the guard expression.
+Taint that flows through an assignment, a helper's return value, a
+module constant, or a function parameter is invisible to it::
+
+    def _my_id():
+        return hvd.rank()          # taint enters here ...
+
+    if _my_id() == 0:              # ... and guards a collective here
+        hvd.broadcast_object(cfg)  # PR 10 misses this
+
+This module closes that gap with a deliberately *provable* analysis: a
+name or expression is tainted only when the dataflow from a rank
+primitive to it can be demonstrated (assignment chains, returns, module
+constants, parameter positions).  The syntactic name heuristics
+(``_RANK_NAMES``) stay in ``rank_divergence`` — keeping the two notions
+separate means the interprocedural pass adds no new guesses, only new
+proofs, which is how the shipped tree stays clean without new pragmas.
+
+Scope: one module at a time (hvdlint has no import resolution), plain
+``Name`` callees only, monotone taint (a rebind to an untainted value
+does not clear taint — sound for a linter, and stable under the
+fixpoint).  Collective *results* are untainted by construction: an
+allreduce/allgather of a rank-dependent value is symmetric across ranks,
+so taint is killed at collective call boundaries.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Callable, Dict, FrozenSet, List, Optional, Set, Tuple
+
+# Rank primitives: calls whose result is this process's identity, and
+# attributes of the topology object.  Mirrors rank_divergence but kept
+# independent so the provable core has no name-heuristic entries.
+_RANK_CALLS = {"rank", "local_rank", "cross_rank", "node_rank",
+               "process_index"}
+_RANK_ATTRS = {"is_leader"}
+
+_EMPTY: FrozenSet[str] = frozenset()
+
+
+@dataclass
+class Taint:
+    """Taint of one expression: provably rank-dependent, and/or
+    dependent on the enclosing function's parameters (by name)."""
+    rank: bool = False
+    params: FrozenSet[str] = _EMPTY
+
+    def __or__(self, other: "Taint") -> "Taint":
+        if not (other.rank or other.params):
+            return self
+        return Taint(self.rank or other.rank, self.params | other.params)
+
+    def __bool__(self) -> bool:
+        return self.rank or bool(self.params)
+
+
+_UNTAINTED = Taint()
+_RANK = Taint(rank=True)
+
+
+@dataclass
+class FnSummary:
+    node: ast.FunctionDef
+    arg_names: List[str] = field(default_factory=list)
+    # Return value is provably rank-tainted.
+    returns_rank: bool = False
+    # Params whose value can flow into the return value.
+    return_params: Set[str] = field(default_factory=set)
+    # The body (transitively) submits an eager collective.
+    contains_collective: bool = False
+    # Params that, when rank-tainted at a call site, make a collective
+    # inside this function divergent (flow into a guard, a key argument,
+    # or a loop bound enclosing a collective).
+    divergence_params: Set[str] = field(default_factory=set)
+
+
+def _fn_arg_names(fn: ast.FunctionDef) -> List[str]:
+    a = fn.args
+    names = [x.arg for x in a.posonlyargs] + [x.arg for x in a.args]
+    names += [x.arg for x in a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _assigned_names(target: ast.AST) -> List[str]:
+    """Plain names bound by an assignment target (tuples unpacked)."""
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for elt in target.elts:
+            out.extend(_assigned_names(elt))
+        return out
+    if isinstance(target, ast.Starred):
+        return _assigned_names(target.value)
+    return []
+
+
+class ModuleTaint:
+    """Provable rank-taint facts for one parsed module.
+
+    ``is_collective(call)`` is rank_divergence's collective recognizer
+    (returns the collective name or None) — injected to avoid a module
+    cycle and so both rules agree on what a collective is.
+    """
+
+    def __init__(self, tree: ast.Module,
+                 is_collective: Callable[[ast.Call], Optional[str]]):
+        self.is_collective = is_collective
+        # name -> FunctionDef for plain-name callee resolution.  Walk the
+        # whole tree so nested helpers participate; on duplicate names
+        # the first (outermost) wins, matching Python's common layout of
+        # one top-level def per name.
+        self.fn_defs: Dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.fn_defs.setdefault(node.name, node)  # type: ignore[arg-type]
+        self.summaries: Dict[str, FnSummary] = {
+            name: FnSummary(node=fn, arg_names=_fn_arg_names(fn))
+            for name, fn in self.fn_defs.items()}
+        # Module-level names provably assigned a rank-dependent value
+        # (e.g. ``IS_LEADER = hvd.rank() == 0``).
+        self.module_tainted: Set[str] = set()
+        # FunctionDef node -> its locals' taint environment.
+        self._fn_envs: Dict[ast.FunctionDef, Dict[str, Taint]] = {}
+        self._solve(tree)
+
+    # -- public queries -------------------------------------------------
+
+    def expr_taint(self, expr: ast.AST,
+                   fn: Optional[ast.FunctionDef]) -> Taint:
+        """Provable taint of ``expr`` in the scope of ``fn`` (or the
+        module body when fn is None).  ``.rank`` means rank-dependent on
+        this process; ``.params`` lists enclosing-function parameters the
+        value depends on."""
+        env = self._fn_envs.get(fn, {}) if fn else {}
+        params = set(_fn_arg_names(fn)) if fn else set()
+        return self._eval(expr, env, params)
+
+    def expr_rank_tainted(self, expr: ast.AST,
+                          fn: Optional[ast.FunctionDef]) -> bool:
+        return self.expr_taint(expr, fn).rank
+
+    def summary(self, callee: str) -> Optional[FnSummary]:
+        return self.summaries.get(callee)
+
+    def call_arg_taints(self, call: ast.Call, summary: FnSummary,
+                        fn: Optional[ast.FunctionDef]
+                        ) -> List[Tuple[str, ast.AST, Taint]]:
+        """(param name, arg expr, taint) for each argument the call
+        binds to one of the callee's named parameters."""
+        out: List[Tuple[str, ast.AST, Taint]] = []
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                continue
+            if i < len(summary.arg_names):
+                out.append((summary.arg_names[i], arg,
+                            self.expr_taint(arg, fn)))
+        for kw in call.keywords:
+            if kw.arg and kw.arg in summary.arg_names:
+                out.append((kw.arg, kw.value, self.expr_taint(kw.value, fn)))
+        return out
+
+    # -- expression evaluation ------------------------------------------
+
+    def _eval(self, node: ast.AST, env: Dict[str, Taint],
+              params: Set[str]) -> Taint:
+        if isinstance(node, ast.Constant):
+            return _UNTAINTED
+        if isinstance(node, ast.Name):
+            t = env.get(node.id, _UNTAINTED)
+            if node.id in self.module_tainted:
+                t = t | _RANK
+            if node.id in params:
+                t = t | Taint(params=frozenset({node.id}))
+            return t
+        if isinstance(node, ast.Attribute):
+            if node.attr in _RANK_ATTRS:
+                return _RANK
+            return self._eval(node.value, env, params)
+        if isinstance(node, ast.Call):
+            fname = node.func
+            if isinstance(fname, ast.Attribute) and \
+                    fname.attr in _RANK_CALLS:
+                return _RANK
+            if isinstance(fname, ast.Name) and fname.id in _RANK_CALLS:
+                return _RANK
+            # Collective results are symmetric across ranks: taint dies.
+            if self.is_collective(node) is not None:
+                return _UNTAINTED
+            callee = fname.id if isinstance(fname, ast.Name) else None
+            summ = self.summaries.get(callee) if callee else None
+            if summ is not None:
+                t = _RANK if summ.returns_rank else _UNTAINTED
+                for pname, _arg, at in self.call_arg_taints_env(
+                        node, summ, env, params):
+                    if pname in summ.return_params:
+                        t = t | at
+                return t
+            # Unknown callee: taint flows through (str(r), min(r, 3)...).
+            t = _UNTAINTED
+            for arg in node.args:
+                t = t | self._eval(arg, env, params)
+            for kw in node.keywords:
+                t = t | self._eval(kw.value, env, params)
+            return t
+        # Generic expression: union over child expressions.
+        t = _UNTAINTED
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.expr, ast.comprehension,
+                                  ast.keyword)):
+                t = t | self._eval(child, env, params)
+            elif isinstance(child, ast.FormattedValue):
+                t = t | self._eval(child.value, env, params)
+        return t
+
+    def call_arg_taints_env(self, call: ast.Call, summary: FnSummary,
+                            env: Dict[str, Taint], params: Set[str]
+                            ) -> List[Tuple[str, ast.AST, Taint]]:
+        out: List[Tuple[str, ast.AST, Taint]] = []
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                continue
+            if i < len(summary.arg_names):
+                out.append((summary.arg_names[i], arg,
+                            self._eval(arg, env, params)))
+        for kw in call.keywords:
+            if kw.arg and kw.arg in summary.arg_names:
+                out.append((kw.arg, kw.value,
+                            self._eval(kw.value, env, params)))
+        return out
+
+    # -- fixpoint solver ------------------------------------------------
+
+    def _solve(self, tree: ast.Module) -> None:
+        # Interleave module-constant discovery, per-function local
+        # environments and summaries until nothing changes.  Module
+        # taint can feed function bodies and vice versa (a module const
+        # assigned from a helper's return), so everything iterates
+        # together; the lattice is finite and monotone, so this
+        # terminates — the cap is a safety net only.
+        for _ in range(8):
+            changed = False
+            changed |= self._pass_module_consts(tree)
+            for name, summ in self.summaries.items():
+                changed |= self._pass_function(summ)
+            changed |= self._pass_contains_collective()
+            if not changed:
+                break
+
+    def _pass_module_consts(self, tree: ast.Module) -> bool:
+        changed = False
+        for node in tree.body:
+            targets: List[str] = []
+            value: Optional[ast.AST] = None
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    targets.extend(_assigned_names(tgt))
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = _assigned_names(node.target)
+                value = node.value
+            if not targets or value is None:
+                continue
+            if self._eval(value, {}, set()).rank:
+                for t in targets:
+                    if t not in self.module_tainted:
+                        self.module_tainted.add(t)
+                        changed = True
+        return changed
+
+    def _pass_function(self, summ: FnSummary) -> bool:
+        fn = summ.node
+        params = set(summ.arg_names)
+        env = self._fn_envs.setdefault(fn, {})
+        changed = self._flow_stmts(fn.body, env, params)
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                t = self._eval(node.value, env, params)
+                if t.rank and not summ.returns_rank:
+                    summ.returns_rank = True
+                    changed = True
+                new_params = set(t.params) - summ.return_params
+                if new_params:
+                    summ.return_params |= new_params
+                    changed = True
+        return changed
+
+    def _flow_stmts(self, body: List[ast.stmt], env: Dict[str, Taint],
+                    params: Set[str]) -> bool:
+        """One monotone pass binding assignment targets to the taint of
+        their values, recursing into nested statement bodies."""
+        changed = False
+
+        def bind(names: List[str], t: Taint) -> None:
+            nonlocal changed
+            if not t:
+                return
+            for n in names:
+                old = env.get(n, _UNTAINTED)
+                new = old | t
+                if new.rank != old.rank or new.params != old.params:
+                    env[n] = new
+                    changed = True
+
+        for stmt in body:
+            if isinstance(stmt, ast.Assign):
+                t = self._eval(stmt.value, env, params)
+                for tgt in stmt.targets:
+                    bind(_assigned_names(tgt), t)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                bind(_assigned_names(stmt.target),
+                     self._eval(stmt.value, env, params))
+            elif isinstance(stmt, ast.AugAssign):
+                bind(_assigned_names(stmt.target),
+                     self._eval(stmt.value, env, params))
+            elif isinstance(stmt, ast.For):
+                bind(_assigned_names(stmt.target),
+                     self._eval(stmt.iter, env, params))
+                changed |= self._flow_stmts(stmt.body, env, params)
+                changed |= self._flow_stmts(stmt.orelse, env, params)
+            elif isinstance(stmt, ast.While):
+                changed |= self._flow_stmts(stmt.body, env, params)
+                changed |= self._flow_stmts(stmt.orelse, env, params)
+            elif isinstance(stmt, ast.If):
+                changed |= self._flow_stmts(stmt.body, env, params)
+                changed |= self._flow_stmts(stmt.orelse, env, params)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    if item.optional_vars is not None:
+                        bind(_assigned_names(item.optional_vars),
+                             self._eval(item.context_expr, env, params))
+                changed |= self._flow_stmts(stmt.body, env, params)
+            elif isinstance(stmt, ast.Try):
+                changed |= self._flow_stmts(stmt.body, env, params)
+                for h in stmt.handlers:
+                    changed |= self._flow_stmts(h.body, env, params)
+                changed |= self._flow_stmts(stmt.orelse, env, params)
+                changed |= self._flow_stmts(stmt.finalbody, env, params)
+            # Nested defs get their own environment via their summary.
+        return changed
+
+    def _pass_contains_collective(self) -> bool:
+        changed = False
+        for name, summ in self.summaries.items():
+            if summ.contains_collective:
+                continue
+            env = self._fn_envs.get(summ.node, {})
+            params = set(summ.arg_names)
+            for node in ast.walk(summ.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                hit = self.is_collective(node) is not None
+                if not hit and isinstance(node.func, ast.Name):
+                    callee = self.summaries.get(node.func.id)
+                    hit = callee is not None and callee.contains_collective \
+                        and callee.node is not summ.node
+                if hit:
+                    summ.contains_collective = True
+                    changed = True
+                    break
+            if not summ.contains_collective:
+                continue
+            # With a collective inside, params that reach a guard or a
+            # collective key argument make call-site taint dangerous.
+            new = self._divergence_params(summ, env, params)
+            if new - summ.divergence_params:
+                summ.divergence_params |= new
+                changed = True
+        return changed
+
+    # Keyword arguments whose cross-rank divergence breaks the schedule
+    # contract (controller.cc validates exactly these fields).
+    KEY_ARGS = {"name", "root_rank", "splits", "process_set", "set_id",
+                "root"}
+
+    def _divergence_params(self, summ: FnSummary, env: Dict[str, Taint],
+                           params: Set[str]) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(summ.node):
+            if isinstance(node, (ast.If, ast.While)):
+                t = self._eval(node.test, env, params)
+                if t.params and any(
+                        self.is_collective(c) is not None
+                        for b in (node.body, getattr(node, "orelse", []))
+                        for s in b for c in ast.walk(s)
+                        if isinstance(c, ast.Call)):
+                    out |= t.params
+            elif isinstance(node, ast.Call) and \
+                    self.is_collective(node) is not None:
+                for kw in node.keywords:
+                    if kw.arg in self.KEY_ARGS:
+                        out |= self._eval(kw.value, env, params).params
+        return out

@@ -15,10 +15,12 @@ slugs (``rank-divergent``, ``env-registry``, ``metrics-drift``).
 from __future__ import annotations
 
 import ast
+import io
 import os
 import re
+import tokenize
 from dataclasses import dataclass
-from typing import Dict, Iterator, List, Optional, Sequence, Set
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
 # Directories scanned by default, relative to the repo root (ISSUE 10:
 # the correctness surface is the library, its tests and the examples).
@@ -28,6 +30,22 @@ DEFAULT_SCAN_DIRS = ("horovod_tpu", "tests", "examples", "tools", "ci",
 _SKIP_PARTS = {"__pycache__", ".git", ".pytest_cache", "build", "node_modules"}
 
 _PRAGMA_RE = re.compile(r"#\s*hvdlint:\s*allow\(([^)]*)\)")
+
+# Every pragma that actually suppressed a finding during a rule run is
+# recorded here as (repo-relative path, pragma line, rule slug).  The
+# ``stale-pragma`` rule re-runs the pragma-consuming rules against a
+# cleared registry and reports the pragmas that were never consulted —
+# escape-hatch rot.  Rules record via Source.allowed() (Python) or
+# record_pragma_hit() directly (the native C++ scanner).
+PRAGMA_HITS: Set[Tuple[str, int, str]] = set()
+
+
+def record_pragma_hit(path: str, line: int, rule: str) -> None:
+    PRAGMA_HITS.add((path, line, rule))
+
+
+def clear_pragma_hits() -> None:
+    PRAGMA_HITS.clear()
 
 
 @dataclass(frozen=True)
@@ -92,13 +110,28 @@ class Source:
         self.text = text
         self.lines = text.splitlines()
         self.tree = ast.parse(text, filename=path)
-        # line -> set of allowed rule slugs
+        # line -> set of allowed rule slugs.  Pragmas are COMMENTS: scan
+        # tokenized comment text, not raw lines, so a pragma inside a
+        # string literal (e.g. a lint-test fixture) is not one.
         self.pragmas: Dict[int, Set[str]] = {}
-        for i, line in enumerate(self.lines, start=1):
-            m = _PRAGMA_RE.search(line)
+        for line_no, comment in self._iter_comments(text):
+            m = _PRAGMA_RE.search(comment)
             if m:
                 rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
-                self.pragmas[i] = rules
+                self.pragmas.setdefault(line_no, set()).update(rules)
+
+    @staticmethod
+    def _iter_comments(text: str) -> Iterator[Tuple[int, str]]:
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+                if tok.type == tokenize.COMMENT:
+                    yield tok.start[0], tok.string
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            # Unterminated constructs etc.: fall back to raw-line scan
+            # (over-approximates, which only makes pragmas more lenient).
+            for i, line in enumerate(text.splitlines(), start=1):
+                if "#" in line:
+                    yield i, line
 
     @classmethod
     def load(cls, root: str, rel: str) -> "Source":
@@ -107,11 +140,17 @@ class Source:
 
     def allowed(self, rule: str, *lines: int) -> bool:
         """True if any of the given lines (or the line above the first)
-        carries ``# hvdlint: allow(<rule>)``."""
+        carries ``# hvdlint: allow(<rule>)``.  Every pragma line that
+        matches is recorded in PRAGMA_HITS (stale-pragma bookkeeping)."""
         candidates = set(lines)
         if lines:
             candidates.add(lines[0] - 1)
-        return any(rule in self.pragmas.get(ln, ()) for ln in candidates)
+        hit = False
+        for ln in candidates:
+            if rule in self.pragmas.get(ln, ()):
+                record_pragma_hit(self.path, ln, rule)
+                hit = True
+        return hit
 
 
 def dotted_name(node: ast.AST) -> Optional[str]:

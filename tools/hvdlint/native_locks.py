@@ -1,0 +1,205 @@
+"""Native lock-order lint (ABBA deadlock risk).
+
+TSan's ``lock-order-inversion`` detector only fires on interleavings the
+test run actually executes; a latent ABBA pair between, say, ``g_mu``
+and ``wake_mu`` survives CI until the two paths race in production.
+This rule finds the hazard statically: it scans the native runtime's
+C++ sources (``horovod_tpu/native/cc/src``) for RAII acquisitions
+(``std::lock_guard`` / ``std::unique_lock`` / ``std::scoped_lock``),
+tracks which mutexes are held at each acquisition via brace-scope
+nesting, and flags any mutex pair acquired in both orders anywhere in
+the tree.
+
+Approximations (documented in ``docs/static_analysis.md``):
+
+* textual scope tracking, not a real C++ parse — good enough for the
+  runtime's style (one RAII guard per statement, no macro-generated
+  locks);
+* mutex identity is the normalized initializer expression
+  (``this->`` dropped, ``->`` folded to ``.``); bare member names
+  (``mu_``) are qualified by the enclosing ``Class::`` from the method
+  signature so unrelated classes' ``mu_`` never alias, and every
+  identity is file-qualified — cross-file inversions on the same global
+  are still caught within each file that names it the same way;
+* ``std::scoped_lock`` acquires its arguments atomically (deadlock-free
+  by construction), so it contributes held-set edges but no internal
+  ordering.
+
+Escape hatch: ``// hvdlint: allow(native-locks)`` on the acquisition
+line or the line above.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Set, Tuple
+
+from tools.hvdlint import common
+from tools.hvdlint.common import Finding
+
+RULE = "native-locks"
+
+_LOCK_RE = re.compile(
+    r"\bstd::(lock_guard|unique_lock|scoped_lock)\s*(?:<[^<>]*>)?\s*"
+    r"[A-Za-z_]\w*\s*\(([^()]*(?:\([^()]*\)[^()]*)*)\)")
+
+# `ReturnType Class::Method(` — the enclosing class qualifies bare
+# member mutexes.
+_METHOD_RE = re.compile(r"\b([A-Za-z_]\w*)::~?[A-Za-z_]\w*\s*\(")
+
+_CPP_PRAGMA_RE = re.compile(r"//\s*hvdlint:\s*allow\(([^)]*)\)")
+
+
+def _strip_code(line: str) -> Tuple[str, bool]:
+    """Drop string/char literals and // comments; returns (code, had
+    line comment).  Keeps braces countable without literal noise."""
+    out: List[str] = []
+    i, n = 0, len(line)
+    while i < n:
+        c = line[i]
+        if c == "/" and i + 1 < n and line[i + 1] == "/":
+            return "".join(out), True
+        if c in "\"'":
+            quote = c
+            i += 1
+            while i < n:
+                if line[i] == "\\":
+                    i += 2
+                    continue
+                if line[i] == quote:
+                    break
+                i += 1
+        else:
+            out.append(c)
+        i += 1
+    return "".join(out), False
+
+
+def _mutex_ids(kind: str, args: str, cls: str, path: str) -> List[str]:
+    """Normalized identities of the mutexes a declaration acquires."""
+    parts = [a.strip() for a in args.split(",") if a.strip()]
+    if kind != "scoped_lock":
+        # unique_lock's trailing std::defer_lock / adopt_lock tags are
+        # not mutexes; the mutex is always the first argument.
+        parts = parts[:1]
+    out: List[str] = []
+    for p in parts:
+        if p.startswith("std::") or p.endswith("_lock"):
+            continue  # defer_lock / try_to_lock tags
+        ident = re.sub(r"\s+", "", p).replace("this->", "")
+        ident = ident.replace("->", ".")
+        if re.fullmatch(r"\w+", ident) and ident.endswith("_") and cls:
+            ident = f"{cls}::{ident}"
+        out.append(f"{os.path.basename(path)}:{ident}")
+    return out
+
+
+class _Acq:
+    __slots__ = ("mutex", "depth", "path", "line")
+
+    def __init__(self, mutex: str, depth: int, path: str, line: int):
+        self.mutex = mutex
+        self.depth = depth
+        self.path = path
+        self.line = line
+
+
+def _scan_file(root: str, rel: str,
+               edges: Dict[Tuple[str, str], List[Tuple[str, int]]]) -> None:
+    with open(os.path.join(root, rel), encoding="utf-8",
+              errors="replace") as f:
+        lines = f.read().splitlines()
+
+    depth = 0
+    in_block_comment = False
+    cls = ""
+    held: List[_Acq] = []
+    pragma_lines: Dict[int, Set[str]] = {}
+    for i, raw in enumerate(lines, start=1):
+        m = _CPP_PRAGMA_RE.search(raw)
+        if m:
+            pragma_lines[i] = {r.strip() for r in m.group(1).split(",")
+                               if r.strip()}
+
+    def allowed(line: int) -> bool:
+        for ln in (line, line - 1):
+            if RULE in pragma_lines.get(ln, ()):
+                common.record_pragma_hit(rel, ln, RULE)
+                return True
+        return False
+
+    for lineno, raw in enumerate(lines, start=1):
+        line = raw
+        if in_block_comment:
+            end = line.find("*/")
+            if end < 0:
+                continue
+            line = line[end + 2:]
+            in_block_comment = False
+        while True:
+            start = line.find("/*")
+            if start < 0:
+                break
+            end = line.find("*/", start + 2)
+            if end < 0:
+                line = line[:start]
+                in_block_comment = True
+                break
+            line = line[:start] + line[end + 2:]
+        code, _ = _strip_code(line)
+
+        mm = _METHOD_RE.search(code)
+        if mm and depth <= 1 and "(" in code:
+            cls = mm.group(1)
+
+        for lm in _LOCK_RE.finditer(code):
+            if allowed(lineno):
+                continue
+            # Depth at the declaration point, counting braces earlier
+            # on the same line.
+            prefix = code[:lm.start()]
+            decl_depth = depth + prefix.count("{") - prefix.count("}")
+            for mutex in _mutex_ids(lm.group(1), lm.group(2), cls, rel):
+                for h in held:
+                    if h.mutex != mutex:
+                        edges.setdefault((h.mutex, mutex), []).append(
+                            (rel, lineno))
+                held.append(_Acq(mutex, decl_depth, rel, lineno))
+
+        depth += code.count("{") - code.count("}")
+        if depth < 0:
+            depth = 0
+        # A guard declared at depth d dies when its scope closes, i.e.
+        # the moment depth drops below d.
+        held = [h for h in held if depth >= h.depth]
+        if depth == 0:
+            held = []
+
+
+def check(root: str, files=None) -> List[Finding]:
+    edges: Dict[Tuple[str, str], List[Tuple[str, int]]] = {}
+    for rel in common.iter_native_files(root):
+        if rel.endswith(".cc") and "/src/" in rel.replace(os.sep, "/"):
+            _scan_file(root, rel, edges)
+
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, str]] = set()
+    for (a, b), sites in sorted(edges.items()):
+        if (b, a) not in edges or (b, a) in seen:
+            continue
+        seen.add((a, b))
+        rev = edges[(b, a)]
+        path, line = sites[0]
+        rpath, rline = rev[0]
+        short_a = a.split(":", 1)[1]
+        short_b = b.split(":", 1)[1]
+        findings.append(Finding(
+            RULE, path, line,
+            f"mutex '{short_b}' acquired while holding '{short_a}' "
+            f"here, but the opposite order at {rpath}:{rline} — "
+            f"inconsistent lock ordering is a potential ABBA deadlock "
+            f"TSan only catches on executed interleavings; pick one "
+            f"order (or annotate a provably-safe site with "
+            f"'// hvdlint: allow(native-locks)')"))
+    return findings

@@ -15,6 +15,21 @@ repos grow that bug:
   divergent programs (the exact pitfall PR 4's step guard had to design
   around with psum + where instead of cond).
 
+Since ISSUE 12 the rule is *interprocedural within a module*: a
+callgraph + dataflow pass (``tools/hvdlint/callgraph.py``) propagates
+provable rank taint through assignments, helper returns, module
+constants and function parameters, so it also catches
+
+* guards tainted through dataflow (``r = hvd.rank(); if r == 0: ...``,
+  ``def my_id(): return hvd.rank()``, ``LEADER = hvd.rank() == 0``);
+* helper calls that (transitively) submit a collective, reachable only
+  under a rank-dependent guard;
+* rank-tainted key arguments (``name=``, ``root_rank=``, ``splits=``,
+  ``process_set=``) — the exact fields the controller validates — and
+  rank-tainted loop bounds enclosing a collective;
+* call sites passing a rank-tainted value into a parameter that guards
+  or keys a collective inside the callee.
+
 Legitimate rank-0-only sites (checkpoint metadata writes paired with a
 success broadcast, broadcast-root preparation) annotate with::
 
@@ -28,6 +43,7 @@ from __future__ import annotations
 import ast
 from typing import List, Optional, Set, Tuple
 
+from tools.hvdlint import callgraph
 from tools.hvdlint.common import Finding, Source, dotted_name
 
 RULE = "rank-divergent"
@@ -124,6 +140,10 @@ class _Checker(ast.NodeVisitor):
         self.fn_defs = {n.name: n for n in ast.walk(src.tree)
                         if isinstance(n, ast.FunctionDef)}
         self.cond_flagged: Set[int] = set()
+        # Interprocedural provable-taint facts for this module.
+        self.taint = callgraph.ModuleTaint(src.tree, self._collective_name)
+        # Enclosing function defs, for scope-correct taint queries.
+        self.fn_stack: List[ast.FunctionDef] = []
 
     # -- collective detection ------------------------------------------
 
@@ -151,14 +171,40 @@ class _Checker(ast.NodeVisitor):
 
     # -- divergent-context plumbing ------------------------------------
 
+    def _cur_fn(self) -> Optional[ast.FunctionDef]:
+        return self.fn_stack[-1] if self.fn_stack else None
+
+    def _divergent_test(self, test: ast.AST) -> bool:
+        """Syntactic rank dependence (PR 10 heuristics) OR provable
+        rank taint through the module's dataflow (ISSUE 12)."""
+        return _is_rank_dependent(test) or \
+            self.taint.expr_rank_tainted(test, self._cur_fn())
+
     def _visit_branch(self, kind: str, line: int, body) -> None:
         self.stack.append((kind, line))
         for stmt in body:
             self.visit(stmt)
         self.stack.pop()
 
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.fn_stack.append(node)
+        self.generic_visit(node)
+        self.fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_For(self, node: ast.For) -> None:
+        # `for _ in range(hvd.rank()):` — the body runs a rank-dependent
+        # number of times, so any collective inside diverges.
+        if self._divergent_test(node.iter):
+            self._visit_branch("rank", node.lineno, node.body)
+            for stmt in node.orelse:
+                self.visit(stmt)
+        else:
+            self.generic_visit(node)
+
     def visit_If(self, node: ast.If) -> None:
-        if _is_rank_dependent(node.test):
+        if self._divergent_test(node.test):
             # Both arms diverge: the else branch runs exactly on the
             # complement set of ranks.
             self._visit_branch("rank", node.lineno, node.body)
@@ -167,7 +213,7 @@ class _Checker(ast.NodeVisitor):
             self.generic_visit(node)
 
     def visit_While(self, node: ast.While) -> None:
-        if _is_rank_dependent(node.test):
+        if self._divergent_test(node.test):
             self._visit_branch("rank", node.lineno, node.body)
             for stmt in node.orelse:
                 self.visit(stmt)
@@ -175,7 +221,7 @@ class _Checker(ast.NodeVisitor):
             self.generic_visit(node)
 
     def visit_IfExp(self, node: ast.IfExp) -> None:
-        if _is_rank_dependent(node.test):
+        if self._divergent_test(node.test):
             self.stack.append(("rank", node.lineno))
             self.visit(node.body)
             self.visit(node.orelse)
@@ -186,7 +232,7 @@ class _Checker(ast.NodeVisitor):
 
     def visit_BoolOp(self, node: ast.BoolOp) -> None:
         # `rank() == 0 and hvd.barrier()` short-circuits per rank.
-        if any(_is_rank_dependent(v) for v in node.values[:-1]):
+        if any(self._divergent_test(v) for v in node.values[:-1]):
             self.stack.append(("rank", node.lineno))
             self.generic_visit(node)
             self.stack.pop()
@@ -216,6 +262,11 @@ class _Checker(ast.NodeVisitor):
                            f"the traced conditional")
                 self.findings.append(
                     Finding(RULE, self.src.path, node.lineno, msg))
+
+        if name:
+            self._check_tainted_args(node, name)
+        else:
+            self._check_helper_call(node)
 
         # lax.cond / lax.while_loop / lax.switch: their function args are
         # conditionally-executed bodies.
@@ -247,6 +298,77 @@ class _Checker(ast.NodeVisitor):
             self.visit(node.func)
             return
         self.generic_visit(node)
+
+
+    # -- interprocedural checks (ISSUE 12) -----------------------------
+
+    def _check_tainted_args(self, node: ast.Call, name: str) -> None:
+        """Rank-tainted key arguments on a collective call: the fields
+        the controller compares across ranks (name, root, splits,
+        process set) must be identical on every member."""
+        fn = self._cur_fn()
+        suspects: List[Tuple[str, ast.AST]] = []
+        for kw in node.keywords:
+            if kw.arg in callgraph.ModuleTaint.KEY_ARGS:
+                suspects.append((f"{kw.arg}=", kw.value))
+        if name.startswith("broadcast") and len(node.args) >= 2 and \
+                not isinstance(node.args[1], ast.Starred):
+            suspects.append(("root_rank", node.args[1]))
+        for label, expr in suspects:
+            if self.taint.expr_rank_tainted(expr, fn) and \
+                    not self.src.allowed(RULE, node.lineno):
+                self.findings.append(Finding(
+                    RULE, self.src.path, node.lineno,
+                    f"eager collective {name}() takes a rank-dependent "
+                    f"{label} argument; the coordinator compares this "
+                    f"field across ranks, so divergent values abort (or "
+                    f"stall) the job — pass the same value on every "
+                    f"rank or annotate the deliberate site with "
+                    f"'# hvdlint: allow(rank-divergent)'"))
+
+    def _check_helper_call(self, node: ast.Call) -> None:
+        """Calls to module helpers that (transitively) submit an eager
+        collective: flagged when reachable only under a rank-dependent
+        guard, or when a rank-tainted argument flows into a parameter
+        that guards / keys the collective inside the helper."""
+        f = node.func
+        if not isinstance(f, ast.Name):
+            return
+        summ = self.taint.summary(f.id)
+        if summ is None or not summ.contains_collective:
+            return
+        fn = self._cur_fn()
+        if summ.node is fn:
+            return  # recursive call; the body is checked in its own scope
+        if self.stack:
+            kind, ctx_line = self.stack[-1]
+            if not self.src.allowed(RULE, node.lineno, ctx_line):
+                where = ("rank-dependent control flow (guard at line "
+                         f"{ctx_line})") if kind == "rank" else \
+                    (f"a lax.cond/while_loop/switch body (traced at "
+                     f"line {ctx_line})")
+                self.findings.append(Finding(
+                    RULE, self.src.path, node.lineno,
+                    f"call to {f.id}() (defined at line "
+                    f"{summ.node.lineno}) submits an eager collective "
+                    f"and is reachable only under {where}; every rank "
+                    f"must submit it or the job deadlocks — hoist the "
+                    f"call or annotate the legitimate site with "
+                    f"'# hvdlint: allow(rank-divergent)'"))
+        if summ.divergence_params:
+            for pname, _arg, t in self.taint.call_arg_taints(
+                    node, summ, fn):
+                if t.rank and pname in summ.divergence_params and \
+                        not self.src.allowed(RULE, node.lineno):
+                    self.findings.append(Finding(
+                        RULE, self.src.path, node.lineno,
+                        f"rank-dependent value flows into parameter "
+                        f"'{pname}' of {f.id}() (defined at line "
+                        f"{summ.node.lineno}), which guards or keys an "
+                        f"eager collective inside the helper; the "
+                        f"collective's schedule then diverges across "
+                        f"ranks — pass a rank-uniform value or annotate "
+                        f"with '# hvdlint: allow(rank-divergent)'"))
 
 
 def check_source(src: Source) -> List[Finding]:

@@ -1,0 +1,122 @@
+"""Escape-hatch rot guard: pragmas that no longer suppress anything.
+
+A ``# hvdlint: allow(<rule>)`` comment is a reviewed exception to a
+correctness rule.  When the code under it changes — the collective is
+hoisted, the env read goes through config.py, the metric gains a doc
+row — the pragma stays behind and silently licenses the *next* bug on
+that line.  This rule re-runs every pragma-consuming rule against a
+cleared hit registry (``common.PRAGMA_HITS``, recorded by
+``Source.allowed`` and the native scanner's equivalent) and reports
+each pragma (line, rule) pair that was never consulted-and-matched:
+it suppresses nothing and should be deleted.
+
+A pragma naming an unknown rule slug is always stale (likely a typo —
+it never suppressed anything).  The rule is self-contained: running
+``--rule stale-pragma`` alone re-runs the other checkers internally,
+discarding their findings.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Set, Tuple
+
+from tools.hvdlint import (env_registry, metrics_drift, native_locks,
+                           rank_divergence)
+from tools.hvdlint import common
+from tools.hvdlint.common import Finding, Source
+
+RULE = "stale-pragma"
+
+_CPP_PRAGMA_RE = re.compile(r"//\s*hvdlint:\s*allow\(([^)]*)\)")
+
+# The rules whose pragma consultations we replay.  stale-pragma itself
+# is a known slug too: `# hvdlint: allow(stale-pragma)` keeps a pragma
+# that is deliberately dormant (e.g. guarding code behind a feature
+# flag) out of this report.
+_CONSUMING_RULES = (rank_divergence, env_registry, metrics_drift,
+                    native_locks)
+_KNOWN_SLUGS = {m.RULE for m in _CONSUMING_RULES} | {RULE}
+
+
+def _native_pragmas(root: str) -> Dict[str, Dict[int, Set[str]]]:
+    out: Dict[str, Dict[int, Set[str]]] = {}
+    for rel in common.iter_native_files(root):
+        path = os.path.join(root, rel)
+        with open(path, encoding="utf-8", errors="replace") as f:
+            for i, line in enumerate(f.read().splitlines(), start=1):
+                m = _CPP_PRAGMA_RE.search(line)
+                if m:
+                    rules = {r.strip() for r in m.group(1).split(",")
+                             if r.strip()}
+                    out.setdefault(rel, {}).setdefault(i, set()).update(rules)
+    return out
+
+
+def check(root: str, files) -> List[Finding]:
+    # Pragma consultations happen per source file, so the replay only
+    # needs the files that carry a pragma at all (a cheap text scan) —
+    # this keeps the replay an order of magnitude under a full lint run.
+    pragma_files: List[str] = []
+    for rel in files:
+        try:
+            with open(os.path.join(root, rel), encoding="utf-8") as f:
+                if "hvdlint:" in f.read():
+                    pragma_files.append(rel)
+        except OSError:
+            continue
+
+    saved = set(common.PRAGMA_HITS)
+    common.clear_pragma_hits()
+    hits: Set[Tuple[str, int, str]] = set()
+    try:
+        for mod in _CONSUMING_RULES:
+            try:
+                mod.check(root, pragma_files)  # findings discarded; we
+            except Exception:                  # only want the pragma
+                pass                           # consultations
+        hits = set(common.PRAGMA_HITS)
+    finally:
+        common.PRAGMA_HITS.clear()
+        common.PRAGMA_HITS.update(saved | hits)
+
+    findings: List[Finding] = []
+
+    def report(src_pragmas: Dict[int, Set[str]], rel: str,
+               self_allowed) -> None:
+        for line, rules in sorted(src_pragmas.items()):
+            for rule in sorted(rules):
+                if rule == RULE:
+                    continue
+                if (rel, line, rule) in hits:
+                    continue
+                if self_allowed(line):
+                    continue
+                if rule not in _KNOWN_SLUGS:
+                    msg = (f"pragma allows unknown rule '{rule}' "
+                           f"(known: {', '.join(sorted(_KNOWN_SLUGS))}) "
+                           f"— it has never suppressed anything; fix "
+                           f"the slug or delete it")
+                else:
+                    msg = (f"stale pragma: 'allow({rule})' no longer "
+                           f"suppresses any {rule} finding on this or "
+                           f"the next line — delete it (dead escape "
+                           f"hatches silently license the next bug "
+                           f"here)")
+                findings.append(Finding(RULE, rel, line, msg))
+
+    for rel in pragma_files:
+        try:
+            src = Source.load(root, rel)
+        except (SyntaxError, UnicodeDecodeError, OSError):
+            continue
+        if not src.pragmas:
+            continue
+        report(src.pragmas, rel,
+               lambda ln, s=src: RULE in s.pragmas.get(ln, ()))
+
+    for rel, pragmas in sorted(_native_pragmas(root).items()):
+        report(pragmas, rel,
+               lambda ln, p=pragmas: RULE in p.get(ln, ()))
+    return findings
