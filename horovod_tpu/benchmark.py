@@ -1020,6 +1020,95 @@ def run_hierarchical_benchmark(np_ranks: int = 4,
     return result
 
 
+def run_serving_benchmark(out: Optional[str] = None, *,
+                          num_requests: int = 64,
+                          tokens_per_request: int = 8,
+                          step_time: float = 0.002,
+                          verbose: bool = False):
+    """Offered load vs latency for the continuous-batching router
+    (``horovod_tpu/serving/``), A/B-ing two batch policies: no batching
+    (``max_batch=1``, one sequence per replica step) against continuous
+    batching at ``max_batch=8``.
+
+    The rig runs on a virtual clock — two in-process replicas, zero real
+    sleeps, time advanced by a fixed simulated decode-step cost — so the
+    lane is deterministic and finishes in milliseconds while still
+    exercising the real router (queues, round-robin fill, join/leave at
+    step boundaries).  Reported tokens/s and latencies are therefore
+    properties of the BATCHING POLICY under the modeled step cost, not
+    of any accelerator."""
+    import json
+    from horovod_tpu.serving import (LocalReplicaHandle, ReplicaWorker,
+                                     Router, TenantConfig, ToyModel)
+
+    rows = []
+    for policy in (1, 8):
+        for offered_rps in (50.0, 200.0, 800.0):
+            vt = [0.0]  # virtual seconds; advanced per decode step
+            replicas = [
+                LocalReplicaHandle(ReplicaWorker(ToyModel(),
+                                                 replica_id=f"r{i}"))
+                for i in range(2)]
+            router = Router(replicas,
+                            [TenantConfig("bench", quota=1 << 30,
+                                          slo_ms=0.0)],
+                            max_batch=policy, clock=lambda: vt[0])
+            arrivals = [i / offered_rps for i in range(num_requests)]
+            pending = {}
+            lats = []
+            done = 0
+            nxt = 0
+            while done < num_requests:
+                while nxt < num_requests and arrivals[nxt] <= vt[0]:
+                    h = router.submit("bench", prompt_token=nxt,
+                                      max_new_tokens=tokens_per_request)
+                    assert h.rejected is None, h.rejected
+                    pending[h.request_id] = (h, arrivals[nxt])
+                    nxt += 1
+                router.step()
+                vt[0] += step_time
+                for rid, (h, t0) in list(pending.items()):
+                    if h.completed:
+                        lats.append(vt[0] - t0)
+                        done += 1
+                        del pending[rid]
+            router.close()
+            lats.sort()
+            rows.append({
+                "policy_max_batch": policy,
+                "offered_rps": offered_rps,
+                "p50_ms": round(lats[len(lats) // 2] * 1e3, 3),
+                "p99_ms": round(
+                    lats[min(len(lats) - 1,
+                             int(0.99 * len(lats)))] * 1e3, 3),
+                "tokens_per_s": round(
+                    num_requests * tokens_per_request / vt[0], 1),
+            })
+            if verbose:
+                r = rows[-1]
+                print(f"serving max_batch={policy} "
+                      f"{offered_rps:g} req/s: p50 {r['p50_ms']} ms, "
+                      f"p99 {r['p99_ms']} ms, "
+                      f"{r['tokens_per_s']} tok/s", flush=True)
+    result = {
+        "metric": "serving_continuous_batching",
+        "replicas": 2,
+        "num_requests": num_requests,
+        "tokens_per_request": tokens_per_request,
+        "step_time_ms": step_time * 1e3,
+        "rows": rows,
+        "note": "virtual-clock rig: two in-process replicas with a "
+                "fixed modeled decode-step cost; numbers compare "
+                "batching policies, not hardware",
+    }
+    print("BENCH " + json.dumps(result), flush=True)
+    if out:
+        with open(out, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+    return result
+
+
 def _main():
     import argparse
     parser = argparse.ArgumentParser(
@@ -1062,6 +1151,11 @@ def _main():
                              "runs; prints a BENCH JSON row (inside a "
                              "launched rank this flag selects the "
                              "worker half instead)")
+    parser.add_argument("--serving", action="store_true",
+                        help="offered load vs p50/p99 latency and "
+                             "tokens/s for the continuous-batching "
+                             "router at max_batch 1 vs 8 (virtual-clock "
+                             "rig, no accelerator needed)")
     parser.add_argument("--out", default=None, metavar="FILE",
                         help="also write the BENCH result dict to FILE "
                              "(e.g. BENCH_hier.json)")
@@ -1075,6 +1169,9 @@ def _main():
                   num_warmup_batches=args.num_warmup_batches,
                   num_batches_per_iter=args.num_batches_per_iter,
                   num_iters=args.num_iters)
+    if args.serving:
+        run_serving_benchmark(out=args.out, verbose=True)
+        return
     if args.hierarchical:
         if "HOROVOD_RANK" in os.environ:
             run_hierarchical_worker()

@@ -359,6 +359,46 @@ def restore(ckpt_dir: str, state_template: Any,
     return state
 
 
+def load_local(ckpt_dir: str, state_template: Any,
+               step: Optional[int] = None):
+    """Restore the latest (or ``step``-th) intact checkpoint from local
+    disk WITHOUT any collective — the serving-replica half of the
+    checkpoint plane (:func:`horovod_tpu.serving.replica
+    .load_replica_model`), where every process reads its own copy
+    instead of rank 0 broadcasting one.
+
+    Returns ``(state, used_step)``; ``used_step`` is None (and ``state``
+    is the template, unchanged) when nothing restorable exists.  Only
+    replicated states round-trip here: ZeRO-sharded training states are
+    ``restore``'s job — it owns the gather/scatter relayout, which needs
+    the training mesh this path deliberately runs without.  Shares
+    :func:`restore`'s skip-and-warn contract for half-written or corrupt
+    step directories."""
+    if not os.path.isdir(ckpt_dir):
+        return state_template, None
+    import orbax.checkpoint as ocp
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    candidates = ([step] if step is not None
+                  else list(reversed(_valid_steps(ckpt_dir))))
+    for use_step in candidates:
+        try:
+            with ocp.CheckpointManager(ckpt_dir) as mgr:
+                state = mgr.restore(
+                    use_step,
+                    args=ocp.args.StandardRestore(state_template))
+            log.info("loaded checkpoint step %s locally from %s",
+                     use_step, ckpt_dir)
+            return state, int(use_step)
+        except Exception as e:  # noqa: BLE001 — skip-and-warn contract
+            log.warning(
+                "skipping unrestorable checkpoint step %s in %s "
+                "(%s: %s); %s", use_step, ckpt_dir,
+                type(e).__name__, e,
+                "trying the next older step" if step is None
+                else "starting fresh")
+    return state_template, None
+
+
 def latest_step(ckpt_dir: str) -> Optional[int]:
     """Highest INTACT checkpoint step present in ``ckpt_dir`` (local
     read; no collective).  Half-written or corrupt step directories are

@@ -267,6 +267,26 @@ _var("HOROVOD_HIER_GATE_DIR", "str", None,
      "Scratch dir handshake for the np=4 hierarchical CI gate "
      "(tests/distributed/hierarchical_np4.py only)")
 
+# ---------------------------------------------------------------------------
+# Serving plane (horovod_tpu/serving/, docs/serving.md)
+# ---------------------------------------------------------------------------
+_var("HOROVOD_SERVING_MAX_BATCH", "int", 8,
+     "Continuous-batching cap: max sequences per replica decode step")
+_var("HOROVOD_SERVING_QUOTA", "int", 64,
+     "Default per-tenant quota (queued + in-flight requests) when the "
+     "TenantConfig leaves it unset")
+_var("HOROVOD_SERVING_SLO_MS", "float", 0.0,
+     "Default per-tenant SLO for admission control: reject when the "
+     "estimated queue wait exceeds this; 0 disables")
+_var("HOROVOD_SERVING_STATS", "str", None,
+     "Path where the router publishes its stats snapshot (injected by "
+     "the fleet controller for type=serving jobs; drives autoscaling)")
+_var("HOROVOD_SERVING_STATS_INTERVAL", "float", 1.0,
+     "Seconds between router stats-file publishes in Router.serve")
+_var("HOROVOD_SERVING_GATE_DIR", "str", None,
+     "Scratch dir handshake for the serving CI gates "
+     "(tests/distributed/serving_*.py only)")
+
 
 # ---------------------------------------------------------------------------
 # Typed accessors: the read path basics.py / runner/ / native/runtime.py

@@ -61,7 +61,15 @@ Keys:
              error-feedback residual state before N steps — default 1 —
              the lost-residual simulation: convergence must degrade
              gracefully, never corrupt; fires at :func:`drop_residual`,
-             site ``compression``).
+             site ``compression``),
+             ``replica_crash[:N]`` (serving plane: kill N serving
+             replicas — default 1 — mid-decode with no RPC response;
+             the router must retry the in-flight requests on a healthy
+             replica, idempotent by request id),
+             ``request_storm[:N]`` (serving plane: flood the router
+             with a burst of N synthetic requests — default 8 — per
+             firing; the traffic-spike simulation the fleet autoscaler
+             must absorb by growing the serving job).
 ``count``    maximum number of firings (default: unlimited for
              ``delay``/``error``/``nan``/``corrupt``/
              ``heartbeat_drop``/``spill_corrupt`` — chaos tests that
@@ -76,10 +84,12 @@ happen after the real collective ran.  Likewise the plane kinds
 hooks — :func:`drop_heartbeat` in the heartbeat sender (site
 ``heartbeat``), :func:`mangle_spill` in the spill writer (site
 ``spill``) and :func:`drop_residual` in the compressed training step
-(site ``compression``) — never at :func:`inject`; and the fleet kinds
+(site ``compression``) — never at :func:`inject`; the fleet kinds
 (``preempt_storm``/``host_flap``) fire only at :func:`fleet_chaos`,
 which the fleet controller polls once per scheduler tick (site
-``fleet``).
+``fleet``); and the serving kinds (``replica_crash``/``request_storm``)
+fire only at :func:`crash_replica` (replica decode loop) and
+:func:`storm_requests` (router scheduler pass), both site ``serving``.
 ``attempt``  only fire when ``HOROVOD_RESTART_ATTEMPT`` equals this
              value — lets an elastic-restart test kill attempt 0 and
              let attempt 1 run clean.
@@ -104,7 +114,7 @@ ENV_VAR = "HOROVOD_FAULT_SPEC"
 
 _KINDS = ("crash", "exit", "hang", "delay", "error", "nan", "corrupt",
           "heartbeat_drop", "spill_corrupt", "preempt_storm", "host_flap",
-          "residual_drop")
+          "residual_drop", "replica_crash", "request_storm")
 
 # Kinds that mutate an op's *output value* instead of disrupting control
 # flow; they fire at corrupt_output(), never at inject().
@@ -119,10 +129,16 @@ PLANE_KINDS = ("heartbeat_drop", "spill_corrupt", "residual_drop")
 # fleet_chaos(), never at inject()/corrupt_output().
 FLEET_KINDS = ("preempt_storm", "host_flap")
 
+# Kinds owned by the serving plane (site ``serving``); they fire at
+# their dedicated hooks — crash_replica() polled per decode step by the
+# replica worker, storm_requests() polled per scheduler pass by the
+# request router — never at inject()/corrupt_output().
+SERVING_KINDS = ("replica_crash", "request_storm")
+
 SITES = (
     "allreduce", "allgather", "broadcast", "alltoall", "reducescatter",
     "barrier", "native_submit", "native_wait", "rpc", "spawn",
-    "heartbeat", "spill", "fleet", "compression",
+    "heartbeat", "spill", "fleet", "compression", "serving",
 )
 
 
@@ -333,6 +349,18 @@ def parse_spec(spec: str) -> List[FaultRule]:
                             raise FaultSpecError(
                                 f"kind {kind}:{arg} must fire on "
                                 f">= 1 tick")
+                    elif kind == "replica_crash":
+                        arg = int(kind_arg) if kind_arg else None
+                        if arg is not None and arg < 1:
+                            raise FaultSpecError(
+                                f"kind replica_crash:{arg} must crash "
+                                f">= 1 replica")
+                    elif kind == "request_storm":
+                        arg = int(kind_arg) if kind_arg else None
+                        if arg is not None and arg < 1:
+                            raise FaultSpecError(
+                                f"kind request_storm:{arg} must inject "
+                                f">= 1 request per firing")
                     elif kind_arg:
                         raise FaultSpecError(
                             f"kind {kind!r} takes no argument "
@@ -365,6 +393,14 @@ def parse_spec(spec: str) -> List[FaultRule]:
             # default to one preemption / one out+in blacklist cycle.
             count = arg if arg is not None else \
                 (1 if kind == "preempt_storm" else 2)
+        # replica_crash:N is shorthand for count=N (N crashed replicas);
+        # request_storm:N instead sizes each BURST (count says how many
+        # bursts).  Both default to one firing so a chaos episode can
+        # settle and recovery stays observable.
+        if kind == "replica_crash" and count is None:
+            count = arg if arg is not None else 1
+        if kind == "request_storm" and count is None:
+            count = 1
         if site is not None and site not in SITES:
             raise FaultSpecError(
                 f"unknown fault site {site!r}; shipped sites: "
@@ -433,7 +469,8 @@ def inject(site: str, detail: Optional[str] = None,
     ctx_rank = _context_rank(rank)
     for rule in plan:
         if (rule.kind in VALUE_KINDS or rule.kind in PLANE_KINDS
-                or rule.kind in FLEET_KINDS):
+                or rule.kind in FLEET_KINDS
+                or rule.kind in SERVING_KINDS):
             continue
         if rule.arm(site, ctx_rank):
             rule.execute(site, detail, ctx_rank)
@@ -529,6 +566,56 @@ def fleet_chaos() -> List[str]:
             rule._announce("fleet", None, None)
             fired.append(rule.kind)
     return fired
+
+
+def crash_replica(rank: Optional[int] = None) -> bool:
+    """Serving-replica hook, polled once per decode step: True when an
+    armed ``replica_crash`` rule says THIS replica must die now.  The
+    worker owns the death (mark dead, shut its RPC listener, leave the
+    in-flight request unanswered — :mod:`horovod_tpu.serving.replica`);
+    this hook only arms and logs.  Same zero-overhead contract as
+    :func:`inject` when no spec is set."""
+    plan = _plan
+    if plan is _UNSET:
+        plan = load()
+    if plan is None:
+        return False
+    ctx_rank = _context_rank(rank)
+    fired = False
+    for rule in plan:
+        if rule.kind != "replica_crash":
+            continue
+        if rule.arm("serving", ctx_rank):
+            rule._announce("serving", None, ctx_rank,
+                           note=" (replica crashed)")
+            fired = True
+    return fired
+
+
+def storm_requests(rank: Optional[int] = None) -> int:
+    """Request-router hook, polled once per scheduler pass: the number
+    of synthetic burst requests an armed ``request_storm`` rule injects
+    on this pass (``request_storm:N`` sizes the burst, default 8; 0 =
+    no storm).  The router owns the flood — it submits the requests
+    under its implicit storm tenant so the queue-pressure episode the
+    fleet autoscaler reacts to is indistinguishable from real traffic.
+    Same zero-overhead contract as :func:`inject` when no spec is set."""
+    plan = _plan
+    if plan is _UNSET:
+        plan = load()
+    if plan is None:
+        return 0
+    ctx_rank = _context_rank(rank)
+    burst = 0
+    for rule in plan:
+        if rule.kind != "request_storm":
+            continue
+        if rule.arm("serving", ctx_rank):
+            size = int(rule.arg) if rule.arg is not None else 8
+            rule._announce("serving", None, ctx_rank,
+                           note=f" (storm of {size} requests)")
+            burst += size
+    return burst
 
 
 def mangle_spill(path: str, rank: Optional[int] = None) -> bool:

@@ -25,6 +25,13 @@ fleet controller is the arbiter that makes concurrent jobs safe:
   ``HOROVOD_ELASTIC_PREV_SIZE`` continuity); capacity loss (host
   demotion, a bigger job's admission) shrinks it the same way, never
   below ``min_np``.
+* **Serving autoscaling** — ``type=serving`` jobs (the inference plane,
+  :mod:`horovod_tpu.serving`) admit at ``min_np`` and are resized by
+  queue-depth / p99-latency telemetry their router publishes through a
+  per-job stats file (``HOROVOD_SERVING_STATS``): pressure grows them
+  toward ``max_np`` — preempting lower-priority batch training when no
+  slots are free — and sustained calm shrinks them back, returning the
+  capacity (``--serving-scale-up-depth``, ``--serving-scale-down-idle``).
 * **Shared blame** — one :class:`~horovod_tpu.runner.hosts.HostBlacklist`
   spans all jobs: a host demoted under job A is avoided by job B.
 * **Isolation** — per job: fresh secret, own rendezvous port, own spill
@@ -82,6 +89,7 @@ class JobSpec:
     command: List[str]
     after: float = 0.0        # submit delay (seconds from fleet start)
     restarts: int = 2         # failure-restart budget (preemptions free)
+    type: str = "batch"       # "batch" | "serving" (autoscaled replicas)
     env: Dict[str, str] = field(default_factory=dict)
 
 
@@ -136,12 +144,18 @@ def parse_job_spec(line: str) -> JobSpec:
             spec.after = float(value)
         elif key == "restarts":
             spec.restarts = int(value)
+        elif key == "type":
+            if value not in ("batch", "serving"):
+                raise ValueError(
+                    f"job {name}: unknown job type {value!r} (valid: "
+                    f"batch, serving)")
+            spec.type = value
         elif key.startswith("env:") and len(key) > 4:
             spec.env[key[4:]] = value
         else:
             raise ValueError(
                 f"job {name}: unknown metadata key {key!r} (valid: "
-                f"after=, restarts=, env:KEY=)")
+                f"after=, restarts=, type=, env:KEY=)")
     return spec
 
 
@@ -156,6 +170,7 @@ class _Job:
         self.dir = os.path.join(fleet_dir, "jobs", spec.name)
         self.spill_dir = os.path.join(self.dir, "spill")
         self.metrics_base = os.path.join(self.dir, "metrics.json")
+        self.stats_path = os.path.join(self.dir, "serving_stats.json")
         self.secret = config_parser.job_secret()
         self.queued_at = 0.0        # set on (re)queue by the controller
         self.eligible_at = 0.0
@@ -168,6 +183,10 @@ class _Job:
         self.preempted = False      # queued-for-resume (vs never-started)
         self.resizing = False       # current PREEMPTING is a resize, not
                                     # a scheduler/chaos preemption
+        self.target_np = None       # autoscaler-chosen size for the next
+                                    # admission (serving resizes only)
+        self.calm_since = 0.0       # start of the current low-pressure
+                                    # window (serving scale-down timer)
         self.preemptions = 0
         self.rc: Optional[int] = None
         self.infos: List[hosts.RankInfo] = []
@@ -203,6 +222,8 @@ class FleetController:
                  *, starvation_deadline: float = 30.0,
                  tick_interval: float = 0.25,
                  grow_after: float = 15.0,
+                 serving_scale_up_depth: float = 8.0,
+                 serving_scale_down_idle: float = 10.0,
                  blacklist: Optional[hosts.HostBlacklist] = None,
                  blacklist_cooldown: Optional[float] = None,
                  fleet_dir: Optional[str] = None,
@@ -229,6 +250,8 @@ class FleetController:
         self.starvation_deadline = float(starvation_deadline)
         self.tick_interval = float(tick_interval)
         self.grow_after = float(grow_after)
+        self.serving_scale_up_depth = float(serving_scale_up_depth)
+        self.serving_scale_down_idle = float(serving_scale_down_idle)
         self.blacklist = blacklist or hosts.HostBlacklist(
             cooldown=blacklist_cooldown)
         self._permanent_blacklist = (blacklist is None and
@@ -295,6 +318,7 @@ class FleetController:
         self._reap()
         if not self._stopping:
             self._apply_chaos()
+            self._autoscale_serving()
             self._check_starvation()
             self._admit()
             self._maybe_grow()
@@ -484,6 +508,109 @@ class FleetController:
             # preemption to every heartbeating rank end-to-end.
             job.health.request_preempt()
 
+    # -- serving autoscaler ------------------------------------------------
+
+    def _read_serving_stats(self, job: _Job) -> Optional[dict]:
+        """The job's router stats snapshot (written atomically by
+        :meth:`horovod_tpu.serving.router.Router.write_stats` to the
+        ``HOROVOD_SERVING_STATS`` path this controller injected), or
+        None before the first publish.  Staleness across attempts is a
+        non-issue: :meth:`_start_job` deletes the file on every
+        (re)admission."""
+        try:
+            with open(job.stats_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _autoscale_serving(self) -> None:
+        """Elastic replica autoscaling for ``type=serving`` jobs, driven
+        by the router's queue-depth / p99-latency telemetry:
+
+        * **pressure** (queue depth >= ``serving_scale_up_depth``, or
+          p99 over the job's SLO) grows the job toward ``max_np`` via
+          the resize path; with no free slots it preempts the lowest
+          strictly-lower-priority running job first — latency-sensitive
+          serving takes capacity from batch training during a spike;
+        * **calm** for ``serving_scale_down_idle`` continuous seconds
+          shrinks back toward ``min_np``, returning the capacity.
+
+        One resize in flight fleet-wide (the `_maybe_grow` invariant);
+        the grown-toward slots are reserved against lower-priority
+        admission by :meth:`_reserved_slots` while the resize is in
+        flight."""
+        for job in self._running():
+            if job.spec.type != "serving":
+                continue
+            stats = self._read_serving_stats(job)
+            if stats is None:
+                continue
+            depth = float(stats.get("queue_depth", 0) or 0)
+            p99 = float(stats.get("p99_ms", 0) or 0)
+            slo = float(stats.get("slo_ms", 0) or 0)
+            telemetry.gauge(
+                "hvd_fleet_serving_queue_depth",
+                "Router queue depth last reported by this serving job",
+                job=job.name).set(depth)
+            telemetry.gauge(
+                "hvd_fleet_serving_p99_ms",
+                "Router p99 request latency (ms) last reported by this "
+                "serving job", job=job.name).set(p99)
+            pressure = depth >= self.serving_scale_up_depth or \
+                (slo > 0.0 and p99 > slo)
+            now = self._clock()
+            resize_busy = any(j.state == PREEMPTING for j in self.jobs)
+            if pressure:
+                job.calm_since = 0.0
+                if job.np >= job.spec.max_np or resize_busy:
+                    continue
+                free = sum(h.slots for h in self._free_hosts())
+                if free > 0:
+                    target = min(job.spec.max_np, job.np + free)
+                    job.target_np = target
+                    telemetry.counter(
+                        "hvd_fleet_serving_scale_events_total",
+                        "Serving autoscaler resize decisions",
+                        job=job.name, direction="grow").inc()
+                    self._preempt(
+                        job,
+                        f"serving scale-up {job.np}->{target} (queue "
+                        f"depth {depth:g}, p99 {p99:g}ms)", resize=True)
+                else:
+                    victims = [j for j in self._running()
+                               if j.priority < job.priority]
+                    if not victims:
+                        continue
+                    victim = min(victims,
+                                 key=lambda j: (j.priority,
+                                                -j.started_at))
+                    self._log(f"serving job {job.name} under pressure "
+                              f"(queue depth {depth:g}, p99 {p99:g}ms) "
+                              f"with no free slots")
+                    self._preempt(
+                        victim,
+                        f"serving job {job.name} needs capacity (queue "
+                        f"depth {depth:g})")
+            else:
+                if job.calm_since == 0.0:
+                    job.calm_since = now
+                    continue
+                if now - job.calm_since < self.serving_scale_down_idle:
+                    continue
+                if job.np <= job.spec.min_np or resize_busy:
+                    continue
+                job.target_np = job.spec.min_np
+                job.calm_since = 0.0
+                telemetry.counter(
+                    "hvd_fleet_serving_scale_events_total",
+                    "Serving autoscaler resize decisions",
+                    job=job.name, direction="shrink").inc()
+                self._preempt(
+                    job,
+                    f"serving scale-down {job.np}->{job.spec.min_np} "
+                    f"(calm {self.serving_scale_down_idle:g}s)",
+                    resize=True)
+
     def _check_starvation(self) -> None:
         queue = self._queued()
         if not queue:
@@ -529,16 +656,39 @@ class FleetController:
 
     # -- admission ---------------------------------------------------------
 
+    def _reserved_slots(self, job: _Job) -> int:
+        """Slots a grow-resize in flight will need on re-admission, held
+        back from equal-or-lower-priority queued jobs so the grown job
+        doesn't bounce back at its old size."""
+        return sum(
+            max(0, j.target_np - j.np) for j in self.jobs
+            if j is not job and j.target_np is not None
+            and j.state == PREEMPTING and j.priority >= job.priority)
+
+    def _admit_np(self, job: _Job, cap: int) -> int:
+        """World size to admit ``job`` at given ``cap`` free slots.
+        Batch jobs stretch to ``max_np`` (elastic; `_maybe_grow` resizes
+        them up later).  Serving jobs start at ``min_np`` — the
+        autoscaler owns their size — unless a resize set ``target_np``
+        or a prior attempt already ran wider."""
+        if job.spec.type == "serving":
+            want = job.target_np or job.prev_np or job.spec.min_np
+            want = min(want, job.spec.max_np)
+        else:
+            want = job.spec.max_np
+        return min(want, cap)
+
     def _admit(self) -> None:
         for job in self._queued():
             free_list = self._free_hosts()
             cap = sum(h.slots for h in free_list)
+            cap -= self._reserved_slots(job)
             if cap < job.spec.min_np:
                 # Strict priority: nothing behind this job may backfill
                 # past it, or small low-priority jobs would starve it
                 # forever — the exact inversion the fleet exists to stop.
                 break
-            self._start_job(job, min(job.spec.max_np, cap), free_list)
+            self._start_job(job, self._admit_np(job, cap), free_list)
 
     def _start_job(self, job: _Job, np_: int,
                    free_list: List[hosts.HostSlots]) -> None:
@@ -565,6 +715,15 @@ class FleetController:
         job.np = np_
         job.infos = infos
         job.started_at = now
+        if job.spec.type == "serving":
+            # Fresh telemetry epoch: a stale stats file from the
+            # pre-resize attempt would re-trigger (or mask) pressure.
+            job.target_np = None
+            job.calm_since = 0.0
+            try:
+                os.remove(job.stats_path)
+            except OSError:
+                pass
         remote_preempt = None
         if self.heartbeat_interval:
             # Resolved at call time: job.health is created in
@@ -605,6 +764,11 @@ class FleetController:
         extra["HOROVOD_SPILL_DIR"] = job.spill_dir
         extra["HOROVOD_FLEET_JOB"] = job.name
         extra["HOROVOD_RESTART_ATTEMPT"] = str(job.attempt)
+        if job.spec.type == "serving":
+            # Stats handshake: the job's router publishes queue depth /
+            # p99 here (serving.router.Router.serve), the autoscaler
+            # reads it each tick (_autoscale_serving).
+            extra["HOROVOD_SERVING_STATS"] = job.stats_path
         if job.prev_np and job.prev_np != job.np:
             extra["HOROVOD_ELASTIC_PREV_SIZE"] = str(job.prev_np)
         else:
@@ -696,7 +860,8 @@ class FleetController:
         now = self._clock()
         candidates = [
             j for j in self._running()
-            if j.np < j.spec.max_np and
+            if j.spec.type != "serving" and  # autoscaler owns serving size
+            j.np < j.spec.max_np and
             now - j.started_at >= self.grow_after
         ]
         if not candidates:
@@ -814,6 +979,7 @@ class FleetController:
                          for k, r in ranks.items()}
             jobs_doc[job.name] = {
                 "state": job.state,
+                "type": job.spec.type,
                 "priority": job.priority,
                 "min_np": job.spec.min_np,
                 "max_np": job.spec.max_np,
@@ -872,8 +1038,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="file with one 'host slots=N' per line")
     p.add_argument("--job", action="append", default=[], metavar="SPEC",
                    help="job spec: 'name priority min_np[:max_np] "
-                        "[after=S] [restarts=N] [env:K=V ...] -- cmd...' "
-                        "(repeatable)")
+                        "[after=S] [restarts=N] [type=T] [env:K=V ...] "
+                        "-- cmd...' (repeatable)")
     p.add_argument("--jobs-file", default=None,
                    help="file with one job spec per line (# comments ok)")
     p.add_argument("--starvation-deadline", type=float, default=30.0,
@@ -885,6 +1051,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--grow-after", type=float, default=15.0,
                    help="seconds a job must run undisturbed before spare "
                         "capacity may grow it toward max_np (default 15)")
+    p.add_argument("--serving-scale-up-depth", type=float, default=8.0,
+                   help="router queue depth at which a type=serving job "
+                        "scales up (default 8)")
+    p.add_argument("--serving-scale-down-idle", type=float, default=10.0,
+                   help="seconds a type=serving job must stay calm before "
+                        "it shrinks back to min_np (default 10)")
     p.add_argument("--blacklist-cooldown", type=float, default=None,
                    help="seconds until a demoted host re-enters the "
                         "shared pool (default: demoted for good)")
@@ -953,6 +1125,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         starvation_deadline=args.starvation_deadline,
         tick_interval=args.tick_interval,
         grow_after=args.grow_after,
+        serving_scale_up_depth=args.serving_scale_up_depth,
+        serving_scale_down_idle=args.serving_scale_down_idle,
         blacklist_cooldown=args.blacklist_cooldown,
         fleet_dir=args.fleet_dir,
         metrics_file=args.metrics_file,

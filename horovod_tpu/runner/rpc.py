@@ -63,17 +63,22 @@ def _recv_msg(sock: socket.socket, key: bytes,
 class RpcServer:
     """Threaded TCP server dispatching authenticated pickled requests.
 
-    ``handler(request) -> response`` runs under a lock (launcher services
-    mutate shared registration state).  Unauthenticated or malformed
-    requests are dropped without a reply; the connection is one-shot
-    (request → response → close), matching the reference's usage pattern.
+    ``handler(request) -> response`` runs under a lock by default
+    (launcher services mutate shared registration state).  Pass
+    ``serialize=False`` for handlers that do their own finer-grained
+    locking and must stay responsive to probes while a slow request
+    runs — the serving replica's decode path
+    (:mod:`horovod_tpu.serving.replica`) is the canonical user.
+    Unauthenticated or malformed requests are dropped without a reply;
+    the connection is one-shot (request → response → close), matching
+    the reference's usage pattern.
     """
 
     def __init__(self, key: bytes, handler: Callable[[Any], Any],
-                 bind: str = "0.0.0.0"):
+                 bind: str = "0.0.0.0", serialize: bool = True):
         self._key = key
         self._handler = handler
-        self._lock = threading.Lock()
+        self._lock = threading.Lock() if serialize else None
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
@@ -83,7 +88,10 @@ class RpcServer:
                 except (AuthError, ConnectionError, pickle.PickleError,
                         struct.error):
                     return  # drop silently: scanner resilience
-                with outer._lock:
+                if outer._lock is not None:
+                    with outer._lock:
+                        resp = outer._handler(req)
+                else:
                     resp = outer._handler(req)
                 _send_msg(self.request, pickle.dumps(resp), outer._key)
 

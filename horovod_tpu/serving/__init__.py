@@ -1,0 +1,25 @@
+"""Multi-tenant serving plane: continuous-batching inference on the
+fleet with hot weight updates.
+
+Architecture (``docs/serving.md``):
+
+* :mod:`~horovod_tpu.serving.model` — the three-method decode contract
+  replicas drive, plus the deterministic :class:`ToyModel` the CI gates
+  assert exact tokens against;
+* :mod:`~horovod_tpu.serving.replica` — one weight copy served over the
+  authenticated RPC plane, hot weight updates staged via the broadcast
+  plane and applied at decode-step boundaries (no restart, no drops);
+* :mod:`~horovod_tpu.serving.router` — per-tenant queues, token-level
+  continuous batching, quota/SLO admission, idempotent crash retry, and
+  the stats handshake the fleet autoscaler
+  (``runner/fleet.py``, job type ``serving``) scales replicas on.
+"""
+
+from horovod_tpu.serving.model import DecodeModel, ToyModel  # noqa: F401
+from horovod_tpu.serving.replica import (  # noqa: F401
+    ReplicaCrashed, ReplicaWorker, broadcast_weights, load_replica_model,
+)
+from horovod_tpu.serving.router import (  # noqa: F401
+    LocalReplicaHandle, ReplicaHandle, RequestHandle, Router,
+    RpcReplicaHandle, TenantConfig, stats_path_from_env,
+)

@@ -1,0 +1,85 @@
+"""Decode models for the serving plane.
+
+The serving plane is model-agnostic: a replica worker drives anything
+implementing the three-method :class:`DecodeModel` contract below.
+:class:`ToyModel` is the contract's reference implementation — a
+deterministic next-token function of (previous token, position, weight
+checksum) — chosen so every serving test can assert exact tokens AND
+observe a hot weight update: changing the weight generation visibly
+changes every subsequent token, which is how the np=2 CI gate proves an
+update landed mid-stream without dropping a request.
+
+Real deployments subclass :class:`DecodeModel` with a jitted forward
+pass; the router/replica layers never look inside ``decode_step``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+class DecodeModel:
+    """Contract a serving replica drives.
+
+    ``decode_step`` consumes one ``(last_token, position)`` pair per
+    live sequence and returns the next token for each — one token-level
+    step of the whole running batch, the granularity continuous
+    batching joins and leaves at (Orca, OSDI '22).
+
+    ``weights``/``generation`` expose the hot-update surface: the
+    replica swaps both atomically at a step boundary, never mid-step.
+    """
+
+    #: Monotonic deployment counter; bumped by every hot weight update.
+    generation: int = 0
+
+    def decode_step(self, batch: Sequence[Tuple[int, int]]) -> List[int]:
+        raise NotImplementedError
+
+    def set_weights(self, weights, generation: int) -> None:
+        raise NotImplementedError
+
+    def get_weights(self):
+        raise NotImplementedError
+
+
+class ToyModel(DecodeModel):
+    """Deterministic decode: ``next = (31*token + 7*pos + checksum(w))
+    % vocab``.
+
+    Properties the serving tests lean on:
+
+    * fully deterministic — a retried step on another replica yields the
+      SAME token, which is what makes router-side crash retry idempotent;
+    * generation-sensitive — the weight checksum feeds every token, so a
+      hot update flips the stream observably;
+    * stateless across steps — a sequence is just its last token and
+      position, so it can migrate between replicas freely.
+    """
+
+    VOCAB = 50257
+
+    def __init__(self, weights=None, generation: int = 0):
+        if weights is None:
+            weights = np.arange(8, dtype=np.float32)
+        self._weights = np.asarray(weights, np.float32)
+        self.generation = int(generation)
+
+    def _checksum(self) -> int:
+        # Integer-valued float32 sums are exact at this scale, so the
+        # checksum is bit-stable across replicas and retries.
+        return int(abs(float(self._weights.sum()))) % self.VOCAB
+
+    def decode_step(self, batch: Sequence[Tuple[int, int]]) -> List[int]:
+        c = self._checksum()
+        return [(31 * int(tok) + 7 * int(pos) + c) % self.VOCAB
+                for tok, pos in batch]
+
+    def set_weights(self, weights, generation: int) -> None:
+        self._weights = np.asarray(weights, np.float32)
+        self.generation = int(generation)
+
+    def get_weights(self):
+        return self._weights

@@ -1,0 +1,221 @@
+"""Replica worker: one model instance served over the RPC plane.
+
+A replica is the unit the fleet autoscales — one rank of a
+``type=serving`` fleet job, holding one copy of the weights and
+answering token-level ``decode`` requests from the router
+(:mod:`horovod_tpu.serving.router`).  Three properties carry the whole
+serving story:
+
+* **Authenticated transport** — the worker attaches to the PR-1 RPC
+  plane (:class:`horovod_tpu.runner.rpc.RpcServer`) under the per-job
+  HMAC secret, with ``serialize=False`` so ``ping``/``stats`` probes
+  answer while a decode step runs; weight swaps take the worker's own
+  lock instead.
+* **Hot weight updates** — :func:`broadcast_weights` distributes a new
+  weight generation through the eager broadcast plane (every rank of
+  the serving job calls it collectively; non-root ranks block in the
+  collective while their RPC threads keep serving).  The update is
+  *staged* (:meth:`ReplicaWorker.stage_update`) and applied atomically
+  at the next decode-step boundary — never mid-step, never with a
+  replica restart, so no in-flight request is dropped.
+* **Chaos surface** — every decode step polls
+  :func:`horovod_tpu.faults.crash_replica` (site ``serving``, kind
+  ``replica_crash``); a firing kills the replica mid-request exactly
+  like a real crash (the in-flight RPC gets no response), which is what
+  the router's idempotent retry path is tested against.
+
+Weights load via :func:`horovod_tpu.checkpoint.load_local` — the
+non-collective local-disk half of the checkpoint plane — so a replica
+can come up from the same directory a concurrently-training job
+checkpoints into.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from horovod_tpu import faults, telemetry
+from horovod_tpu.serving.model import DecodeModel
+
+DECODE_TIME_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                       0.1, 0.25, 0.5, 1.0, 2.5)
+
+
+class ReplicaCrashed(RuntimeError):
+    """Raised inside the RPC handler when a ``replica_crash`` chaos rule
+    fires: the connection closes without a response, so the router sees
+    exactly what a real crash looks like."""
+
+
+class ReplicaWorker:
+    """One serving replica: model + staged-update slot + RPC handler.
+
+    ``step_time`` adds a simulated per-step cost (benchmark rigs);
+    ``on_crash`` runs after a chaos crash marked the worker dead
+    (standalone processes pass ``os._exit``; embedded workers leave the
+    default, which also shuts down an attached RPC server).
+    """
+
+    def __init__(self, model: DecodeModel, *, replica_id: str = "r0",
+                 step_time: float = 0.0,
+                 on_crash: Optional[Callable[[], None]] = None):
+        self.model = model
+        self.replica_id = replica_id
+        self.step_time = float(step_time)
+        self._on_crash = on_crash
+        self._lock = threading.Lock()
+        self._pending = None          # staged (weights, generation)
+        self._decode_steps = 0
+        self._dead = False
+        self._server = None
+
+    # -- hot updates -------------------------------------------------------
+
+    def stage_update(self, weights, generation: int) -> int:
+        """Stage a new weight generation; it becomes live at the next
+        decode-step boundary (or immediately if the worker is idle
+        between steps).  Returns the staged generation."""
+        gen = int(generation)
+        with self._lock:
+            self._pending = (np.asarray(weights, np.float32), gen)
+        if telemetry.enabled():
+            telemetry.counter(
+                "hvd_serving_weight_updates_total",
+                "Hot weight updates staged on this replica").inc()
+        return gen
+
+    def _apply_pending_locked(self) -> None:
+        if self._pending is None:
+            return
+        weights, gen = self._pending
+        self._pending = None
+        self.model.set_weights(weights, gen)
+        if telemetry.enabled():
+            telemetry.gauge(
+                "hvd_serving_weight_generation",
+                "Live weight generation on this replica").set(float(gen))
+
+    # -- decode ------------------------------------------------------------
+
+    def _crash(self) -> None:
+        with self._lock:
+            self._dead = True
+        if telemetry.enabled():
+            telemetry.counter(
+                "hvd_serving_replica_crashes_total",
+                "Chaos replica_crash firings on this replica").inc()
+        if self._on_crash is not None:
+            self._on_crash()
+        elif self._server is not None:
+            # Shut the listener down from a helper thread: shutdown()
+            # joins the serve_forever loop, and this may run on one of
+            # its request threads.
+            srv = self._server
+            threading.Thread(target=srv.shutdown, daemon=True).start()
+        raise ReplicaCrashed(f"replica {self.replica_id} chaos crash")
+
+    def decode(self, seqs) -> Dict[str, Any]:
+        """One continuous-batching step: ``seqs`` is a list of
+        ``(request_id, last_token, position)``; returns per-request next
+        tokens.  Pending weight updates apply here, at the boundary."""
+        if faults.crash_replica():
+            self._crash()
+        with self._lock:
+            if self._dead:
+                raise ReplicaCrashed(
+                    f"replica {self.replica_id} is dead")
+            self._apply_pending_locked()
+            t0 = telemetry.clock()
+            tokens = self.model.decode_step(
+                [(tok, pos) for _, tok, pos in seqs])
+            if self.step_time:
+                time.sleep(self.step_time)
+            self._decode_steps += 1
+            gen = self.model.generation
+        if telemetry.enabled():
+            telemetry.counter(
+                "hvd_serving_decode_steps_total",
+                "Token-level decode steps executed by this replica").inc()
+            telemetry.histogram(
+                "hvd_serving_decode_seconds",
+                "Wall time of one batched decode step",
+                bounds=DECODE_TIME_BUCKETS).observe(
+                telemetry.clock() - t0)
+        return {"ok": True, "generation": gen,
+                "tokens": {rid: tok for (rid, _, _), tok
+                           in zip(seqs, tokens)}}
+
+    # -- RPC surface -------------------------------------------------------
+
+    def handle(self, req: Any) -> Any:
+        """RPC dispatch (request = ``{"kind": ...}``).  Kinds: ``ping``,
+        ``stats``, ``decode``, ``update_weights``."""
+        kind = req.get("kind") if isinstance(req, dict) else None
+        if kind == "ping":
+            return {"ok": True, "replica": self.replica_id,
+                    "generation": self.model.generation}
+        if kind == "stats":
+            with self._lock:
+                return {"ok": True, "replica": self.replica_id,
+                        "generation": self.model.generation,
+                        "decode_steps": self._decode_steps,
+                        "dead": self._dead}
+        if kind == "decode":
+            return self.decode(req["seqs"])
+        if kind == "update_weights":
+            gen = self.stage_update(req["weights"], req["generation"])
+            return {"ok": True, "replica": self.replica_id,
+                    "generation": gen}
+        return {"ok": False, "error": f"unknown kind {kind!r}"}
+
+    def attach(self, key: bytes, bind: str = "127.0.0.1"):
+        """Serve :meth:`handle` on an authenticated
+        :class:`~horovod_tpu.runner.rpc.RpcServer` (concurrent handlers:
+        probes must answer while a decode runs).  Returns the server."""
+        from horovod_tpu.runner import rpc
+        self._server = rpc.RpcServer(key, self.handle, bind=bind,
+                                     serialize=False)
+        return self._server
+
+
+def broadcast_weights(weights, generation: int, root_rank: int = 0,
+                      name: str = "hvd.serving.weights"):
+    """Distribute a weight generation through the broadcast plane.
+
+    Collective: EVERY rank of the serving job calls this with
+    same-shaped ``weights`` (non-root ranks pass their current copy and
+    receive the root's).  Returns ``(weights, generation)`` as seen by
+    ``root_rank`` — stage the result with
+    :meth:`ReplicaWorker.stage_update`.  The collective doubles as the
+    synchronization barrier of the hot-update protocol: non-root ranks
+    may sit in it while their RPC threads keep serving decode steps.
+    """
+    import horovod_tpu as hvd
+    gen = np.asarray([int(generation)], np.int64)
+    gen = np.asarray(hvd.broadcast(gen, root_rank=root_rank,
+                                   name=f"{name}.gen"))
+    live_gen = int(gen[0])
+    out = np.asarray(hvd.broadcast(
+        np.asarray(weights, np.float32), root_rank=root_rank,
+        name=f"{name}.g{live_gen}"))
+    return out, live_gen
+
+
+def load_replica_model(ckpt_dir: str, weights_template=None):
+    """Build a :class:`~horovod_tpu.serving.model.ToyModel` from the
+    newest intact checkpoint in ``ckpt_dir`` (local read, no collective
+    — see :func:`horovod_tpu.checkpoint.load_local`); falls back to the
+    template/seed weights when no checkpoint exists.  The checkpoint
+    step becomes the starting weight generation, so continuous
+    deployment from a training job is monotonic."""
+    from horovod_tpu import checkpoint
+    from horovod_tpu.serving.model import ToyModel
+    if weights_template is None:
+        weights_template = np.arange(8, dtype=np.float32)
+    template = {"w": np.asarray(weights_template, np.float32)}
+    state, step = checkpoint.load_local(ckpt_dir, template)
+    return ToyModel(state["w"], generation=0 if step is None else step)

@@ -1,0 +1,93 @@
+"""Fleet-serving gate workload: a ``type=serving`` job the autoscaler
+resizes through a chaos request storm (ci/run_tests.sh fleet-serving
+lane).
+
+Every rank is one replica of the serving job.  Rank 0 runs the router
+over its local replica, trickles background traffic through it, and
+publishes stats to the fleet-injected ``HOROVOD_SERVING_STATS`` path
+after every scheduler pass — the queue-depth telemetry the controller's
+``_autoscale_serving`` acts on.  A ``request_storm`` chaos rule
+(attempt 0 only, so the post-resize relaunch comes up calm) floods the
+queues mid-run; the expected fleet episode is:
+
+  storm -> stats show pressure -> controller preempts the lower-priority
+  training job -> grows this job into the freed slots -> calm stats ->
+  shrinks it back to min_np -> training resumes into the gap.
+
+Each resize relaunches this workload (rc-75 preemption -> re-admission
+at the new np), so the episode deadline lives in a file under
+``HOROVOD_SERVING_GATE_DIR`` — the first attempt sets it, later
+attempts inherit it, and every attempt serves until it passes, then
+exits 0 with ``SERVING_FLEET_OK``.
+"""
+import os
+import sys
+import time
+
+import horovod_tpu as hvd
+from horovod_tpu import resilience
+from horovod_tpu.serving import (
+    LocalReplicaHandle, ReplicaWorker, Router, TenantConfig, ToyModel,
+    stats_path_from_env,
+)
+
+GATE_DIR = os.environ["HOROVOD_SERVING_GATE_DIR"]
+SECONDS = float(os.environ.get("SERVING_GATE_SECONDS", "20"))
+
+hvd.init()
+rank, size = hvd.rank(), hvd.size()
+resilience.install_preemption_handler()
+os.makedirs(GATE_DIR, exist_ok=True)
+
+deadline_file = os.path.join(GATE_DIR, "deadline")
+try:
+    with open(deadline_file, "x") as f:
+        f.write(str(time.time() + SECONDS))
+except FileExistsError:
+    pass
+while True:
+    with open(deadline_file) as f:
+        raw = f.read().strip()
+    if raw:
+        deadline = float(raw)
+        break
+    time.sleep(0.01)
+
+attempt = os.environ.get("HOROVOD_RESTART_ATTEMPT", "0")
+print(f"SERVING_FLEET_UP rank={rank} size={size} attempt={attempt}",
+      flush=True)
+
+if rank != 0:
+    # Added replica capacity: hold the slot, honour preemption.
+    while time.time() < deadline:
+        if resilience.preemption_requested():
+            resilience.exit_preempted()
+        time.sleep(0.05)
+else:
+    stats_path = stats_path_from_env()
+    assert stats_path, "fleet did not inject HOROVOD_SERVING_STATS"
+    # step_time paces decode so a storm's queue pressure stays visible
+    # across several controller ticks instead of draining instantly.
+    worker = ReplicaWorker(ToyModel(), step_time=0.08)
+    router = Router([LocalReplicaHandle(worker)],
+                    [TenantConfig("trickle", quota=1 << 30, slo_ms=0.0)],
+                    max_batch=8)
+    i = 0
+    while time.time() < deadline:
+        if resilience.preemption_requested():
+            router.write_stats(stats_path)
+            resilience.exit_preempted()
+        router.submit("trickle", i, max_new_tokens=2)
+        i += 1
+        router.step()   # also polls the request_storm chaos hook
+        router.write_stats(stats_path)
+    router.drain()
+    router.write_stats(stats_path)
+    print(f"SERVING_FLEET_STATS completed={router.completed} "
+          f"dropped={router.dropped}", flush=True)
+    assert router.dropped == 0
+
+print(f"SERVING_FLEET_OK rank={rank} size={size} attempt={attempt}",
+      flush=True)
+hvd.shutdown()
+sys.exit(0)

@@ -1,0 +1,153 @@
+"""Serving gate workload (ci/run_tests.sh serving lane, np=2).
+
+One replica worker per rank serves over the authenticated RPC plane;
+rank 0 additionally runs the router and drives the whole episode:
+
+1. every rank attaches a :class:`ReplicaWorker` (``serialize=False``
+   RPC server, per-job HMAC key) and publishes its port under
+   ``HOROVOD_SERVING_GATE_DIR``;
+2. rank 0 routes TWO tenants' streams over BOTH replicas concurrently
+   (phase 1 asserts exact generation-0 tokens, proving cross-rank
+   decode correctness);
+3. mid-stream, a new weight generation is distributed through the
+   broadcast plane — non-root ranks sit in the collective from the
+   start while their RPC threads keep serving — staged on every
+   replica, and applied at each replica's next step boundary.  Phase 2
+   asserts every in-flight stream switched generations exactly at the
+   pause point with ZERO dropped requests;
+4. direct probe decodes assert every replica reports generation 1.
+
+The CI lane then asserts the merged telemetry: both tenants completed,
+batch occupancy > 1, one weight update staged per rank, decode steps on
+every rank, and no drops (see ci/run_tests.sh).
+"""
+import os
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.runner import rpc
+from horovod_tpu.serving import (
+    ReplicaWorker, Router, RpcReplicaHandle, TenantConfig, ToyModel,
+    broadcast_weights,
+)
+
+GATE_DIR = os.environ["HOROVOD_SERVING_GATE_DIR"]
+NEW_WEIGHTS = np.arange(8, dtype=np.float32) + 100.0
+
+hvd.init()
+rank, size = hvd.rank(), hvd.size()
+key = rpc.job_key_bytes(os.environ.get("HOROVOD_SECRET_KEY"))
+
+worker = ReplicaWorker(ToyModel(), replica_id=f"r{rank}")
+server = worker.attach(key)
+os.makedirs(GATE_DIR, exist_ok=True)
+with open(os.path.join(GATE_DIR, f"port.{rank}.tmp"), "w") as f:
+    f.write(str(server.port))
+os.replace(os.path.join(GATE_DIR, f"port.{rank}.tmp"),
+           os.path.join(GATE_DIR, f"port.{rank}"))
+
+
+def wait_for_file(name, timeout=60.0):
+    path = os.path.join(GATE_DIR, name)
+    deadline = time.monotonic() + timeout
+    while not os.path.exists(path):
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"timed out waiting for {name}")
+        time.sleep(0.02)
+    return path
+
+
+def touch(name):
+    tmp = os.path.join(GATE_DIR, f"{name}.tmp")
+    with open(tmp, "w") as f:
+        f.write("ok\n")
+    os.replace(tmp, os.path.join(GATE_DIR, name))
+
+
+def expected_stream(prompt, n, weights=None, start_pos=0):
+    m = ToyModel(weights)
+    tok, out = prompt, []
+    for pos in range(start_pos, start_pos + n):
+        tok = m.decode_step([(tok, pos)])[0]
+        out.append(tok)
+    return out
+
+
+if rank != 0:
+    # Serve (on the RPC threads) while blocking in the hot-update
+    # collective on the main thread; stage the received generation,
+    # signal, keep serving until rank 0 finishes the episode.
+    weights, gen = broadcast_weights(worker.model.get_weights(), 0)
+    worker.stage_update(weights, gen)
+    touch(f"staged.{rank}")
+    wait_for_file("done")
+    print(f"SERVING_REPLICA_OK rank={rank} staged_gen={gen}", flush=True)
+else:
+    handles = []
+    for r in range(size):
+        with open(wait_for_file(f"port.{r}")) as f:
+            port = int(f.read().strip())
+        handles.append(RpcReplicaHandle("127.0.0.1", port, key,
+                                        timeout=30.0))
+    router = Router(handles,
+                    [TenantConfig("alice", quota=64, slo_ms=0.0),
+                     TenantConfig("bob", quota=64, slo_ms=0.0)],
+                    max_batch=4)
+
+    # Phase 1: both tenants stream concurrently over both replicas;
+    # exact generation-0 tokens.
+    phase1 = {}
+    for i in range(4):
+        phase1[("alice", i)] = router.submit("alice", i, max_new_tokens=5)
+        phase1[("bob", i)] = router.submit("bob", 10 + i, max_new_tokens=3)
+    router.drain()
+    for (tenant, i), h in phase1.items():
+        assert h.completed, (tenant, i, h.rejected, h.dropped)
+        prompt = i if tenant == "alice" else 10 + i
+        assert h.tokens == expected_stream(prompt, len(h.tokens)), \
+            (tenant, i)
+
+    # Phase 2: long streams; pause mid-flight; hot-update every replica
+    # through the broadcast plane; finish.  Zero drops, and every
+    # stream flips generation exactly at its pause point.
+    phase2 = {}
+    for i in range(6):
+        phase2[i] = router.submit("alice" if i % 2 else "bob", 20 + i,
+                                  max_new_tokens=8)
+    while any(len(h.tokens) < 2 for h in phase2.values()):
+        router.step()
+    pause = {i: list(h.tokens) for i, h in phase2.items()}
+    weights, gen = broadcast_weights(NEW_WEIGHTS, 1)
+    assert gen == 1 and np.array_equal(weights, NEW_WEIGHTS)
+    worker.stage_update(weights, gen)
+    router.generation = gen
+    for r in range(1, size):
+        wait_for_file(f"staged.{r}")
+    # Every replica now has generation 1 staged: every further decode
+    # step applies it first, so the continuations are deterministic.
+    router.drain()
+    assert router.dropped == 0, router.stats()
+    for i, h in phase2.items():
+        assert h.completed and not h.dropped, (i, h.rejected)
+        head = pause[i]
+        k = len(head)
+        tail = expected_stream(head[-1], 8 - k, weights=NEW_WEIGHTS,
+                               start_pos=k)
+        assert h.tokens == head + tail, \
+            f"stream {i} did not switch generations at the pause point"
+        assert h.tokens != expected_stream(20 + i, 8), \
+            f"stream {i} never saw the new weights"
+
+    # Direct probes: every replica applied generation 1.
+    for r, handle in enumerate(handles):
+        resp = handle.decode([("probe", 1, 0)])
+        assert resp["generation"] == 1, (r, resp)
+
+    touch("done")
+    print(f"SERVING_OK rank=0 completed={router.completed} "
+          f"dropped={router.dropped} tenants=alice,bob", flush=True)
+
+server.shutdown()
+hvd.shutdown()

@@ -611,3 +611,68 @@ def test_chaos_residual_drop_training_tolerates(monkeypatch):
     finally:
         faults.reset()
         hvd.shutdown()
+
+
+def test_chaos_replica_crash_router_retries_idempotently(monkeypatch):
+    """Serving-plane chaos e2e (ISSUE satellite): two replica workers
+    serve over the real authenticated RPC plane; a ``replica_crash``
+    rule kills one mid-stream (its in-flight decode gets no response,
+    its listener shuts down).  The router must mark it unhealthy, retry
+    every in-flight sequence on the survivor, and — because decode is
+    deterministic in (token, position, weights) — produce EXACTLY the
+    token streams of an undisturbed run: retry is idempotent by request
+    id, with zero requests dropped."""
+    from horovod_tpu import faults, telemetry
+    from horovod_tpu.serving import (ReplicaWorker, Router,
+                                     RpcReplicaHandle, TenantConfig,
+                                     ToyModel)
+    from horovod_tpu.telemetry import aggregate
+
+    def expected_stream(prompt, n):
+        m, tok, out = ToyModel(), prompt, []
+        for pos in range(n):
+            tok = m.decode_step([(tok, pos)])[0]
+            out.append(tok)
+        return out
+
+    key = b"chaos-serving-key-chaos-serving!"
+    # Both workers poll faults.crash_replica per decode step; with two
+    # loaded replicas stepped r0-then-r1, after=3 fires on replica 1's
+    # second step — mid-stream, with both its sequences in flight.
+    monkeypatch.setenv("HOROVOD_FAULT_SPEC",
+                       "site=serving,kind=replica_crash,after=3")
+    faults.reset()
+    telemetry.registry().clear()
+    telemetry.configure(enabled_flag=True)
+    workers = [ReplicaWorker(ToyModel(), replica_id=f"r{i}")
+               for i in range(2)]
+    servers = [w.attach(key) for w in workers]
+    try:
+        router = Router(
+            [RpcReplicaHandle("127.0.0.1", s.port, key, timeout=10.0)
+             for s in servers],
+            [TenantConfig("t", quota=64, slo_ms=0.0)], max_batch=2)
+        handles = [router.submit("t", i, max_new_tokens=5)
+                   for i in range(4)]
+        router.drain()
+        crashed = [i for i, r in enumerate(router.replicas)
+                   if not r.healthy]
+        assert crashed == [1]
+        assert router.dropped == 0
+        for i, h in enumerate(handles):
+            assert h.completed and not h.dropped
+            assert h.tokens == expected_stream(i, 5)
+        snap = telemetry.metrics_snapshot()
+        assert aggregate.counter_total(
+            snap, "hvd_serving_retries_total") == 2
+        assert aggregate.counter_total(
+            snap, "hvd_serving_replica_crashes_total") == 1
+    finally:
+        telemetry.configure(enabled_flag=False)
+        telemetry.registry().clear()
+        faults.reset()
+        for s in servers:
+            try:
+                s.shutdown()
+            except Exception:
+                pass

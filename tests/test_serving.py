@@ -1,0 +1,557 @@
+"""Unit tests for the serving plane (horovod_tpu/serving/): the toy
+decode model contract, replica workers with hot weight updates, the
+continuous-batching router (quota/SLO admission, round-robin fairness,
+join-at-boundary, crash failover with idempotent retry), the stats
+handshake, the authenticated RPC surface, the serving chaos kinds, and
+the fleet controller's queue-pressure replica autoscaler.
+
+Router episodes run synchronously on an injected clock; fleet episodes
+reuse the tick-driven stub-runner harness from test_fleet.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu import faults, telemetry
+from horovod_tpu.runner import hosts, rpc
+from horovod_tpu.runner.fleet import (
+    PREEMPTING, QUEUED, RUNNING, parse_job_spec,
+)
+from horovod_tpu.serving import (
+    LocalReplicaHandle, ReplicaCrashed, ReplicaWorker, Router,
+    RpcReplicaHandle, TenantConfig, ToyModel,
+)
+from horovod_tpu.telemetry import aggregate
+from test_fleet import (
+    FakeClock, StubRunner, job, make_fleet, wait_for,
+)
+
+KEY = b"0123456789abcdef0123456789abcdef"
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    monkeypatch.delenv("HOROVOD_RANK", raising=False)
+    monkeypatch.delenv("HOROVOD_RESTART_ATTEMPT", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture()
+def metrics():
+    telemetry.registry().clear()
+    telemetry.configure(enabled_flag=True)
+    yield telemetry
+    telemetry.configure(enabled_flag=False)
+    telemetry.registry().clear()
+
+
+def expected_stream(prompt, n, weights=None, start_pos=0):
+    """Reference decode: what a ToyModel stream must produce."""
+    m = ToyModel(weights)
+    tok, out = prompt, []
+    for pos in range(start_pos, start_pos + n):
+        tok = m.decode_step([(tok, pos)])[0]
+        out.append(tok)
+    return out
+
+
+def make_router(n_replicas=1, tenants=("a",), **kw):
+    workers = [ReplicaWorker(ToyModel(), replica_id=f"r{i}")
+               for i in range(n_replicas)]
+    router = Router([LocalReplicaHandle(w) for w in workers],
+                    [TenantConfig(t, quota=64, slo_ms=0.0)
+                     for t in tenants], **kw)
+    return router, workers
+
+
+# -- model -------------------------------------------------------------------
+
+def test_toy_model_deterministic_and_generation_sensitive():
+    a, b = ToyModel(), ToyModel()
+    batch = [(3, 0), (7, 4)]
+    assert a.decode_step(batch) == b.decode_step(batch)
+    before = a.decode_step(batch)
+    a.set_weights(np.arange(8, dtype=np.float32) + 100.0, generation=1)
+    assert a.generation == 1
+    assert a.decode_step(batch) != before  # checksum feeds every token
+
+
+# -- replica worker ----------------------------------------------------------
+
+def test_worker_applies_staged_update_at_step_boundary():
+    w = ReplicaWorker(ToyModel())
+    r1 = w.decode([("x", 3, 0)])
+    assert r1["generation"] == 0
+    w.stage_update(np.ones(8, np.float32) * 50, generation=7)
+    assert w.model.generation == 0  # staged, not yet applied
+    r2 = w.decode([("x", 3, 0)])
+    assert r2["generation"] == 7
+    assert r2["tokens"]["x"] != r1["tokens"]["x"]
+
+
+def test_worker_rpc_roundtrip_and_concurrent_probe():
+    w = ReplicaWorker(ToyModel(), replica_id="rpc0")
+    server = w.attach(KEY)
+    try:
+        h = RpcReplicaHandle("127.0.0.1", server.port, KEY)
+        assert h.ping()["replica"] == "rpc0"
+        resp = h.decode([("q", 5, 0)])
+        assert resp["tokens"]["q"] == ToyModel().decode_step([(5, 0)])[0]
+        h.update_weights(np.zeros(8, np.float32).tolist(), 3)
+        assert h.decode([("q", 5, 1)])["generation"] == 3
+    finally:
+        server.shutdown()
+
+
+def test_worker_rpc_rejects_wrong_key():
+    w = ReplicaWorker(ToyModel())
+    server = w.attach(KEY)
+    try:
+        bad = RpcReplicaHandle("127.0.0.1", server.port, b"x" * 32,
+                               timeout=2.0)
+        with pytest.raises((ConnectionError, OSError)):
+            bad.ping()
+    finally:
+        server.shutdown()
+
+
+# -- router: continuous batching ---------------------------------------------
+
+def test_single_stream_exact_tokens():
+    router, _ = make_router()
+    h = router.submit("a", prompt_token=3, max_new_tokens=5)
+    router.drain()
+    assert h.completed and h.tokens == expected_stream(3, 5)
+
+
+def test_batch_occupancy_and_short_leaves_early():
+    router, _ = make_router(max_batch=4)
+    short = router.submit("a", 1, max_new_tokens=2)
+    long = router.submit("a", 2, max_new_tokens=6)
+    steps = 0
+    while router.pending():
+        router.step()
+        steps += 1
+    # Both ran in ONE batch: 6 steps total, not 2 + 6.
+    assert steps == 6
+    assert short.completed and long.completed
+    assert short.tokens == expected_stream(1, 2)
+    assert long.tokens == expected_stream(2, 6)
+
+
+def test_sequence_joins_running_batch_at_boundary():
+    router, _ = make_router(max_batch=4)
+    long = router.submit("a", 2, max_new_tokens=6)
+    router.step()
+    router.step()
+    late = router.submit("a", 9, max_new_tokens=2)
+    steps = 2
+    while router.pending():
+        router.step()
+        steps += 1
+    assert steps == 6  # the late request rode the existing batch
+    assert late.completed and late.tokens == expected_stream(9, 2)
+    assert long.tokens == expected_stream(2, 6)
+
+
+def test_round_robin_across_tenants():
+    router, _ = make_router(tenants=("a", "b"), max_batch=1)
+    ha1 = router.submit("a", 1, max_new_tokens=1)
+    ha2 = router.submit("a", 2, max_new_tokens=1)
+    hb1 = router.submit("b", 3, max_new_tokens=1)
+    order = []
+    for _ in range(3):
+        router.step()
+        for name, h in (("a1", ha1), ("a2", ha2), ("b1", hb1)):
+            if h.completed and name not in order:
+                order.append(name)
+    # b1 must not wait behind the whole of tenant a's queue.
+    assert order == ["a1", "b1", "a2"]
+
+
+def test_occupancy_histogram_exceeds_one(metrics):
+    router, _ = make_router(max_batch=8)
+    for i in range(4):
+        router.submit("a", i, max_new_tokens=3)
+    router.drain()
+    fam = telemetry.metrics_snapshot()["hvd_serving_batch_occupancy"]
+    (entry,) = fam["values"]
+    assert entry["sum"] / entry["count"] > 1.0
+
+
+# -- router: admission -------------------------------------------------------
+
+def test_unknown_tenant_raises():
+    router, _ = make_router()
+    with pytest.raises(KeyError):
+        router.submit("nope", 1)
+
+
+def test_quota_reject(metrics):
+    router, _ = make_router()
+    router._tenants["a"].quota = 2
+    assert router.submit("a", 1).rejected is None
+    assert router.submit("a", 2).rejected is None
+    h = router.submit("a", 3)
+    assert h.rejected == "quota" and not h.completed
+    snap = telemetry.metrics_snapshot()
+    assert aggregate.counter_total(
+        snap, "hvd_serving_rejects_total",
+        {"tenant": "a", "reason": "quota"}) == 1
+
+
+def test_slo_reject_uses_estimated_wait(metrics):
+    router, _ = make_router(max_batch=1)
+    router._tenants["a"].slo_ms = 10.0
+    router._step_ewma = 1.0            # measured: one second per step
+    assert router.submit("a", 1).rejected is None   # empty queue
+    h = router.submit("a", 2)          # est. wait 1000ms > 10ms SLO
+    assert h.rejected == "slo"
+    assert aggregate.counter_total(
+        telemetry.metrics_snapshot(), "hvd_serving_rejects_total",
+        {"tenant": "a", "reason": "slo"}) == 1
+
+
+def test_capacity_reject_when_no_healthy_replica():
+    router, _ = make_router()
+    router.replicas[0].healthy = False
+    assert router.submit("a", 1).rejected == "capacity"
+
+
+# -- router: hot weight updates ----------------------------------------------
+
+def test_hot_update_mid_stream_changes_tokens_zero_drops():
+    router, workers = make_router()
+    new_w = np.ones(8, np.float32) * 123
+    h = router.submit("a", 3, max_new_tokens=8)
+    for _ in range(3):
+        router.step()
+    assert router.push_weights(new_w, generation=1) == 1
+    router.drain()
+    assert h.completed and not h.dropped
+    assert workers[0].model.generation == 1
+    # First 3 tokens under gen 0, the rest under gen 1 — continuing the
+    # same (token, position) stream with the new checksum.
+    head = expected_stream(3, 3)
+    tail = expected_stream(head[-1], 5, weights=new_w, start_pos=3)
+    assert h.tokens == head + tail
+    assert h.tokens != expected_stream(3, 8)
+
+
+def test_push_weights_reaches_all_replicas():
+    router, workers = make_router(n_replicas=3)
+    assert router.push_weights(np.zeros(8, np.float32), 4) == 3
+    for w in workers:
+        w.decode([("warm", 1, 0)])   # boundary applies the staged update
+        assert w.model.generation == 4
+    assert router.generation == 4
+
+
+# -- router: crash failover --------------------------------------------------
+
+class FlakyHandle(LocalReplicaHandle):
+    """Delegates to a real worker but fails its Nth decode call."""
+
+    def __init__(self, worker, fail_on=1):
+        super().__init__(worker)
+        self.calls = 0
+        self.fail_on = fail_on
+
+    def decode(self, seqs):
+        self.calls += 1
+        if self.calls == self.fail_on:
+            raise ConnectionError("replica went away mid-step")
+        return super().decode(seqs)
+
+
+def test_crash_retry_is_idempotent_by_request_id(metrics):
+    # Control: two healthy replicas.
+    control, _ = make_router(n_replicas=2, max_batch=4)
+    expect = {}
+    for i in range(4):
+        expect[i] = control.submit("a", i, max_new_tokens=5)
+    control.drain()
+
+    flaky = FlakyHandle(ReplicaWorker(ToyModel(), replica_id="flaky"),
+                        fail_on=3)
+    good = LocalReplicaHandle(ReplicaWorker(ToyModel(), replica_id="ok"))
+    router = Router([flaky, good],
+                    [TenantConfig("a", quota=64, slo_ms=0.0)], max_batch=4)
+    handles = {}
+    for i in range(4):
+        handles[i] = router.submit("a", i, max_new_tokens=5)
+    router.drain()
+    assert not flaky.healthy
+    assert router.dropped == 0
+    for i in range(4):
+        assert handles[i].completed
+        assert handles[i].tokens == expect[i].tokens  # idempotent retry
+    snap = telemetry.metrics_snapshot()
+    assert aggregate.counter_total(snap, "hvd_serving_retries_total") > 0
+    assert aggregate.counter_total(snap, "hvd_serving_dropped_total") == 0
+
+
+def test_all_replicas_dead_drops_and_rejects(metrics):
+    flaky = FlakyHandle(ReplicaWorker(ToyModel()), fail_on=1)
+    router = Router([flaky], [TenantConfig("a", quota=64, slo_ms=0.0)])
+    h = router.submit("a", 1, max_new_tokens=3)
+    router.step()
+    assert h.dropped and not h.completed
+    assert router.dropped == 1
+    assert router.submit("a", 2).rejected == "capacity"
+    assert aggregate.counter_total(
+        telemetry.metrics_snapshot(), "hvd_serving_dropped_total",
+        {"tenant": "a"}) == 1
+
+
+# -- router: chaos kinds -----------------------------------------------------
+
+def test_parse_spec_serving_kinds():
+    (r,) = faults.parse_spec("site=serving,kind=replica_crash")
+    assert r.kind == "replica_crash" and r.count == 1
+    (r,) = faults.parse_spec("site=serving,kind=request_storm:40")
+    assert r.kind == "request_storm" and r.arg == 40 and r.count == 1
+    with pytest.raises(ValueError, match="must crash"):
+        faults.parse_spec("kind=replica_crash:0")
+    with pytest.raises(ValueError, match="must inject"):
+        faults.parse_spec("kind=request_storm:0")
+
+
+def test_crash_replica_hook_arms_after(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR,
+                       "site=serving,kind=replica_crash,after=2")
+    faults.reset()
+    assert [faults.crash_replica() for _ in range(4)] == \
+        [False, False, True, False]
+
+
+def test_replica_crash_kills_worker_mid_request(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "site=serving,kind=replica_crash")
+    faults.reset()
+    w = ReplicaWorker(ToyModel())
+    with pytest.raises(ReplicaCrashed):
+        w.decode([("x", 1, 0)])
+    with pytest.raises(ReplicaCrashed):   # dead stays dead
+        w.decode([("x", 1, 1)])
+
+
+def test_request_storm_floods_router(monkeypatch, metrics):
+    monkeypatch.setenv(faults.ENV_VAR,
+                       "site=serving,kind=request_storm:12")
+    faults.reset()
+    router, _ = make_router(max_batch=4)
+    router.step()
+    snap = telemetry.metrics_snapshot()
+    assert aggregate.counter_total(
+        snap, "hvd_serving_storm_requests_total") == 12
+    assert aggregate.counter_total(
+        snap, "hvd_serving_requests_total", {"tenant": "storm"}) == 12
+    router.drain()
+    assert router.completed == 12
+
+
+# -- router: stats handshake -------------------------------------------------
+
+def test_stats_and_atomic_write(tmp_path):
+    router, _ = make_router(tenants=("a", "b"))
+    router._tenants["b"].slo_ms = 250.0
+    for i in range(3):
+        router.submit("a", i, max_new_tokens=2)
+    doc = router.stats()
+    assert doc["schema"] == "horovod_tpu.serving.stats.v1"
+    assert doc["queue_depth"] == 3 and doc["healthy_replicas"] == 1
+    assert doc["slo_ms"] == 250.0
+    path = tmp_path / "stats.json"
+    router.write_stats(str(path))
+    assert json.loads(path.read_text())["queue_depth"] == 3
+    assert [p for p in os.listdir(tmp_path)
+            if p.startswith("stats.json.tmp")] == []
+
+
+def test_serve_thread_publishes_stats(tmp_path, monkeypatch):
+    monkeypatch.setenv("HOROVOD_SERVING_STATS_INTERVAL", "0.01")
+    path = tmp_path / "s.json"
+    router, _ = make_router()
+    router.serve(stats_path=str(path))
+    try:
+        h = router.submit("a", 1, max_new_tokens=3)
+        assert h.wait(timeout=5.0) and h.completed
+        wait_for(path.exists, msg="stats publish")
+    finally:
+        router.close()
+    assert json.loads(path.read_text())["completed"] >= 1
+
+
+# -- fleet: serving job type and autoscaler ----------------------------------
+
+def serving_spec(line="serve 2 1:3 type=serving -- sleep inf"):
+    return parse_job_spec(line)
+
+
+def test_parse_job_spec_type():
+    s = serving_spec()
+    assert s.type == "serving" and (s.min_np, s.max_np) == (1, 3)
+    assert parse_job_spec("a 1 2 -- x").type == "batch"
+    with pytest.raises(ValueError, match="unknown job type"):
+        parse_job_spec("a 1 2 type=webscale -- x")
+
+
+def write_stats(ctl, name, depth=0.0, p99=0.0, slo=0.0):
+    j = job(ctl, name)
+    os.makedirs(os.path.dirname(j.stats_path), exist_ok=True)
+    with open(j.stats_path, "w") as f:
+        json.dump({"queue_depth": depth, "p99_ms": p99,
+                   "slo_ms": slo}, f)
+
+
+def settle_resize(ctl, runner, name):
+    wait_for(lambda: job(ctl, name).result is not None, msg=f"{name} rc")
+    ctl.tick()     # reap -> requeue
+    ctl.tick()     # re-admit
+
+
+def test_serving_admits_at_min_np_and_env(tmp_path):
+    pool = hosts.parse_hosts("localhost:3")
+    ctl, clock, runner = make_fleet(tmp_path, pool, [serving_spec()])
+    ctl.tick()
+    wait_for(lambda: "serve" in runner.active)
+    assert runner.launches == [("serve", 1)]   # autoscaler owns growth
+    env0 = runner.envs["serve"][0][0]
+    assert env0["HOROVOD_SERVING_STATS"] == job(ctl, "serve").stats_path
+    ctl.stop()
+
+
+def test_autoscaler_grows_on_queue_depth(tmp_path):
+    telemetry.registry().clear()
+    telemetry.configure(enabled_flag=True)
+    try:
+        pool = hosts.parse_hosts("localhost:3")
+        ctl, clock, runner = make_fleet(tmp_path, pool, [serving_spec()])
+        ctl.tick()
+        wait_for(lambda: "serve" in runner.active)
+        write_stats(ctl, "serve", depth=20.0)
+        ctl.tick()
+        assert job(ctl, "serve").state == PREEMPTING
+        assert job(ctl, "serve").target_np == 3
+        settle_resize(ctl, runner, "serve")
+        assert job(ctl, "serve").state == RUNNING
+        assert runner.launches == [("serve", 1), ("serve", 3)]
+        # Stats from the np=1 epoch were cleared at re-admission.
+        assert not os.path.exists(job(ctl, "serve").stats_path)
+        snap = telemetry.metrics_snapshot()
+        assert aggregate.counter_total(
+            snap, "hvd_fleet_serving_scale_events_total",
+            {"job": "serve", "direction": "grow"}) == 1
+        ctl.stop()
+    finally:
+        telemetry.configure(enabled_flag=False)
+        telemetry.registry().clear()
+
+
+def test_autoscaler_grows_on_p99_over_slo(tmp_path):
+    pool = hosts.parse_hosts("localhost:2")
+    ctl, clock, runner = make_fleet(tmp_path, pool,
+                                    [serving_spec("s 2 1:2 type=serving"
+                                                  " -- x")])
+    ctl.tick()
+    wait_for(lambda: "s" in runner.active)
+    write_stats(ctl, "s", depth=0.0, p99=900.0, slo=250.0)
+    ctl.tick()
+    assert job(ctl, "s").state == PREEMPTING and job(ctl, "s").target_np == 2
+    ctl.stop()
+
+
+def test_autoscaler_preempts_training_then_returns_capacity(tmp_path):
+    """The full ISSUE episode at unit scale: storm pressure preempts the
+    batch job, serving grows into its slots, calm shrinks serving back,
+    and the batch job resumes."""
+    pool = hosts.parse_hosts("localhost:3")
+    specs = [serving_spec(), parse_job_spec("train 1 2:2 -- sleep inf")]
+    ctl, clock, runner = make_fleet(
+        tmp_path, pool, specs, serving_scale_down_idle=5.0,
+        grow_after=1e9)
+    ctl.tick()
+    wait_for(lambda: "serve" in runner.active and "train" in runner.active)
+    assert ("serve", 1) in runner.launches and \
+        ("train", 2) in runner.launches
+
+    # Pressure with zero free slots: train (priority 1 < 2) is evicted.
+    write_stats(ctl, "serve", depth=20.0)
+    ctl.tick()
+    assert job(ctl, "train").state == PREEMPTING
+    wait_for(lambda: job(ctl, "train").result is not None)
+    ctl.tick()   # reap train -> queued; serving resize-preempts itself
+    assert job(ctl, "train").state == QUEUED
+    assert job(ctl, "serve").state == PREEMPTING
+    assert job(ctl, "serve").target_np == 3
+    # While the resize is in flight its grown-toward slots are reserved:
+    # train must NOT bounce back into them.
+    assert job(ctl, "train").np == 0
+    settle_resize(ctl, runner, "serve")
+    assert job(ctl, "serve").np == 3
+    assert job(ctl, "train").state == QUEUED
+
+    # Calm: serving shrinks to min_np and train resumes into the gap.
+    write_stats(ctl, "serve", depth=0.0)
+    ctl.tick()                      # starts the calm timer
+    clock.advance(6.0)
+    ctl.tick()                      # idle deadline passed -> shrink
+    assert job(ctl, "serve").state == PREEMPTING
+    assert job(ctl, "serve").target_np == 1
+    wait_for(lambda: job(ctl, "serve").result is not None)
+    ctl.tick()
+    ctl.tick()
+    wait_for(lambda: job(ctl, "serve").state == RUNNING
+             and job(ctl, "train").state == RUNNING, msg="both resumed")
+    assert job(ctl, "serve").np == 1
+    assert job(ctl, "train").np == 2
+    assert job(ctl, "train").preemptions >= 1
+    ctl.stop()
+
+
+def test_autoscaler_ignores_stale_pressure_without_stats(tmp_path):
+    pool = hosts.parse_hosts("localhost:3")
+    ctl, clock, runner = make_fleet(tmp_path, pool, [serving_spec()])
+    ctl.tick()
+    wait_for(lambda: "serve" in runner.active)
+    ctl.tick()   # no stats file: no resize
+    assert job(ctl, "serve").state == RUNNING and job(ctl, "serve").np == 1
+    ctl.stop()
+
+
+def test_maybe_grow_leaves_serving_jobs_alone(tmp_path):
+    pool = hosts.parse_hosts("localhost:3")
+    ctl, clock, runner = make_fleet(tmp_path, pool, [serving_spec()],
+                                    grow_after=0.0)
+    ctl.tick()
+    wait_for(lambda: "serve" in runner.active)
+    clock.advance(100.0)
+    ctl.tick()
+    assert job(ctl, "serve").state == RUNNING and job(ctl, "serve").np == 1
+    ctl.stop()
+
+
+def test_summary_records_job_type(tmp_path):
+    pool = hosts.parse_hosts("localhost:3")
+    specs = [serving_spec(), parse_job_spec("train 1 1 -- x")]
+    ctl, clock, runner = make_fleet(
+        tmp_path, pool, specs,
+        metrics_file=str(tmp_path / "summary.json"))
+    ctl.tick()
+    wait_for(lambda: "serve" in runner.active and "train" in runner.active)
+    runner.finish("serve")
+    runner.finish("train")
+    wait_for(lambda: job(ctl, "serve").result is not None
+             and job(ctl, "train").result is not None)
+    assert ctl.run() == 0    # drains the reaps, then writes the summary
+    doc = json.loads((tmp_path / "summary.json").read_text())
+    assert doc["jobs"]["serve"]["type"] == "serving"
+    assert doc["jobs"]["train"]["type"] == "batch"
