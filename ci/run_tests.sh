@@ -236,6 +236,48 @@ grep -q "WARM_OK attempt=1 rank=0 size=1 source=spill committed=4" \
   "$WARM_DIR/out.log"
 rm -rf "$WARM_DIR"
 
+echo "--- coordination protocol simulator, fast lane (docs/
+--- control_plane.md): agreement safety, bounded fan-in, chaos
+--- convergence — pure-Python virtual network, no sockets"
+JAX_PLATFORMS=cpu python -m pytest tests/test_coordsim.py \
+  tests/test_coordination.py -x -q
+
+echo "--- coordinator-failover gate (np=4, 2 hosts over fake ssh): both
+--- ranks on the coordinator's host SIGKILL after committing step 4;
+--- the launcher must demote the host, expire the lease, elect the
+--- survivor (epoch 0->1), warm-restart from peer spill and converge —
+--- the merged metrics must count the election (docs/control_plane.md)"
+COORD_DIR="$(mktemp -d)"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  HOROVOD_SSH_CMD="ci/fake_ssh.sh" \
+  HOROVOD_METRICS_FILE="$COORD_DIR/metrics.json" \
+  HOROVOD_TERMINATE_GRACE_SECONDS=3 \
+  python -m horovod_tpu.runner -np 4 -H 127.0.1.1:2,localhost:2 \
+  --elastic-restarts 1 --min-np 2 \
+  python tests/distributed/coord_failover_np4.py \
+  2> "$COORD_DIR/err.log" | tee "$COORD_DIR/out.log"
+cat "$COORD_DIR/err.log" >&2
+grep -q "coordinator lease expired (host 127.0.1.1 gone); elected host localhost as coordinator epoch=1" \
+  "$COORD_DIR/err.log"
+grep -q "COORD_OK attempt=1 rank=0 size=2 epoch=1 source=spill committed=4" \
+  "$COORD_DIR/out.log"
+python - "$COORD_DIR/metrics.json" <<'PYEOF'
+import json, sys
+from horovod_tpu.telemetry import aggregate
+doc = json.load(open(sys.argv[1]))
+assert aggregate.counter_total(
+    doc["merged"], "hvd_coord_elections_total") >= 1, doc["merged"].keys()
+print("coordinator failover metrics OK")
+PYEOF
+rm -rf "$COORD_DIR"
+
+echo "--- tree-coordination gate (np=4, 2 hosts over fake ssh,
+--- HOROVOD_COORD_TREE=1): members wire to their host leader, leaders
+--- to the master; the collective matrix must be bit-identical and
+--- every rank must report tree mode active (docs/control_plane.md)"
+JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_chaos.py::test_chaos_tree_coordination_two_host_matrix -x -q
+
 echo "--- heartbeat gate (2 ranks): rank 1's heartbeats chaos-dropped;
 --- the health plane must SIGKILL it at the heartbeat deadline and
 --- elastic-restart on the surviving host — without the watchdog this
@@ -414,6 +456,12 @@ echo "--- hierarchical allreduce A/B (BENCH json; two hvdrun -np 4
 --- telemetry gate's exact 1/local_size byte ratio)"
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
   python -m horovod_tpu.benchmark --hierarchical --out BENCH_hier.json
+
+echo "--- coordination message complexity (BENCH json; tree vs flat
+--- per-tick fan-in at N in {8,64,256,1024} on the protocol simulator —
+--- tree must stay bounded while flat grows linearly)"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  python -m horovod_tpu.benchmark --coordsim --out BENCH_coord.json
 
 echo "--- sanitizer lane (TSAN build + np=2 distributed suite; races
 --- attributed to libhorovod_tpu.so fail CI, jaxlib/XLA noise is

@@ -45,6 +45,8 @@ from horovod_tpu.basics import (
     mesh,
     topology,
     Topology,
+    coordinator,
+    CoordinatorInfo,
     mpi_threads_supported,
     mpi_built,
     mpi_enabled,
@@ -122,6 +124,7 @@ __all__ = [
     "init", "shutdown", "is_initialized",
     "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",
     "num_devices", "local_devices", "mesh", "topology", "Topology",
+    "coordinator", "CoordinatorInfo",
     "mpi_threads_supported",
     "mpi_built", "mpi_enabled", "gloo_built", "gloo_enabled",
     "nccl_built", "ddl_built", "mlsl_built", "tpu_built", "tpu_enabled",

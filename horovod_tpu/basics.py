@@ -191,6 +191,15 @@ def init(comm=None, ranks: Optional[Sequence[int]] = None) -> None:
                   "devices=%d", _state.rank, _state.size, _state.local_rank,
                   _state.local_size, len(jax.local_devices()))
 
+    # Record the coordination epoch this rank is operating under — after a
+    # failover the merged metrics must show every rank on the new epoch
+    # (lazy import keeps telemetry out of the minimal init path).
+    from horovod_tpu import telemetry
+    telemetry.gauge(
+        "hvd_coord_epoch",
+        "Coordinator lease epoch this process is operating under").set(
+        float(config.env_int("HOROVOD_COORD_EPOCH")))
+
     if config.env_raw("HOROVOD_HEALTH_RPC"):
         # The hvdrun health plane is listening: start pushing heartbeats
         # as soon as the worker has a rank (lazy import keeps resilience
@@ -364,6 +373,29 @@ def topology() -> Topology:
     return _build_topology(_state.rank, _state.size, _state.local_rank,
                            _state.local_size, _state.cross_rank,
                            _state.cross_size)
+
+
+class CoordinatorInfo(NamedTuple):
+    """Identity of the control-plane coordinator as last exported by the
+    launcher (``HOROVOD_COORD_RANK`` / ``_EPOCH`` / ``_ELECTIONS``).  After
+    a failover the coordinator is no longer rank 0; ``epoch`` increments
+    on every re-election so responses from a dead epoch are discardable."""
+    rank: int
+    epoch: int
+    elections: int
+
+
+def coordinator() -> CoordinatorInfo:
+    """The current coordinator identity (rank, lease epoch, election
+    count).  Read fresh from the environment on every call — the launcher
+    re-exports the trio on each elastic restart attempt, so a worker
+    re-initialized after a failover sees the new epoch without any
+    collective.  Usable before ``hvd.init()``; defaults to the static
+    rank-0 coordinator of a never-failed job."""
+    return CoordinatorInfo(
+        rank=config.env_int("HOROVOD_COORD_RANK"),
+        epoch=config.env_int("HOROVOD_COORD_EPOCH"),
+        elections=config.env_int("HOROVOD_COORD_ELECTIONS"))
 
 
 def _topology_unchecked() -> Topology:

@@ -1109,6 +1109,74 @@ def run_serving_benchmark(out: Optional[str] = None, *,
     return result
 
 
+def run_coordsim_benchmark(out: Optional[str] = None, *,
+                           sizes=(8, 64, 256, 1024), ticks: int = 60,
+                           verbose: bool = True) -> dict:
+    """Control-plane message complexity: tree vs flat coordination
+    (docs/control_plane.md) measured on the deterministic protocol
+    simulator — no accelerator, no sockets, one process.
+
+    For each world size the same fault-free episode runs twice: flat
+    (every rank a direct child of the coordinator — the reference
+    O(world) shape) and tree (host blocks + k-ary leader tree).  Two
+    numbers per run: the worst per-tick fan-in any single node ingested
+    (the hot-spot the coordinator's accept loop serializes) and the
+    mean messages per tick across the whole fabric.  Tree must keep the
+    fan-in bounded by ``arity + slots - 1`` — effectively O(log N) in
+    depth — while flat grows linearly.
+
+    Prints one BENCH JSON line and (with ``out``) writes the same dict;
+    also publishes the ``hvd_coord_tick_messages`` gauge per (mode, n)
+    when telemetry is on."""
+    import json
+
+    from horovod_tpu import telemetry
+    from tools.coordsim.sim import Simulation
+
+    rows = []
+    for n in sizes:
+        row = {"n": n}
+        for mode, tree in (("flat", False), ("tree", True)):
+            sim = Simulation(n, tree=tree, seed=7)
+            stats = sim.run(ticks)
+            fan_in = (stats["observed_coord_fan_in"] if mode == "flat"
+                      else stats["observed_max_fan_in"])
+            per_tick = round(stats["net"]["sent"] / max(stats["ticks"], 1),
+                             1)
+            row[f"{mode}_max_fan_in"] = fan_in
+            row[f"{mode}_msgs_per_tick"] = per_tick
+            if mode == "tree":
+                row["tree_depth"] = stats["tree_depth"]
+            telemetry.gauge(
+                "hvd_coord_tick_messages",
+                "Worst per-tick control-message fan-in any node ingested "
+                "(coordsim benchmark lane)", mode=mode, n=str(n)
+            ).set(float(fan_in))
+        # Every round still takes one full sweep of announcements, so
+        # total traffic is O(N) in both modes; the win is the HOT SPOT —
+        # no node ever serializes more than the bounded tree fan-in.
+        row["fan_in_ratio"] = round(
+            row["flat_max_fan_in"] / max(row["tree_max_fan_in"], 1), 2)
+        rows.append(row)
+        if verbose:
+            print(f"coordsim n={n:5d}: flat fan-in "
+                  f"{row['flat_max_fan_in']:4d} -> tree "
+                  f"{row['tree_max_fan_in']:3d} "
+                  f"(depth {row['tree_depth']}, "
+                  f"ratio {row['fan_in_ratio']:.1f}x)", flush=True)
+    result = {
+        "metric": "coord_tree_vs_flat_fan_in",
+        "ticks": ticks,
+        "rows": rows,
+    }
+    print("BENCH " + json.dumps(result), flush=True)
+    if out:
+        with open(out, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+    return result
+
+
 def _main():
     import argparse
     parser = argparse.ArgumentParser(
@@ -1156,6 +1224,11 @@ def _main():
                              "tokens/s for the continuous-batching "
                              "router at max_batch 1 vs 8 (virtual-clock "
                              "rig, no accelerator needed)")
+    parser.add_argument("--coordsim", action="store_true",
+                        help="tree vs flat coordination message "
+                             "complexity at N in {8,64,256,1024} on the "
+                             "protocol simulator (no accelerator, no "
+                             "sockets)")
     parser.add_argument("--out", default=None, metavar="FILE",
                         help="also write the BENCH result dict to FILE "
                              "(e.g. BENCH_hier.json)")
@@ -1169,6 +1242,9 @@ def _main():
                   num_warmup_batches=args.num_warmup_batches,
                   num_batches_per_iter=args.num_batches_per_iter,
                   num_iters=args.num_iters)
+    if args.coordsim:
+        run_coordsim_benchmark(out=args.out, verbose=True)
+        return
     if args.serving:
         run_serving_benchmark(out=args.out, verbose=True)
         return

@@ -72,7 +72,8 @@ _var("HOROVOD_HOSTNAME", "str", "",
      native=True)
 _var("HOROVOD_TOPOLOGY", "str", "",
      "host:slots,... map exported per elastic attempt; drives "
-     "hvd.topology() and hierarchical routing")
+     "hvd.topology(), hierarchical routing and the native tree-"
+     "coordination host blocks", native=True)
 _var("HOROVOD_CONTROLLER", "str", "tcp",
      "Reference-compat marker exported by the launcher (always tcp here)")
 _var("HOROVOD_CPU_OPERATIONS", "str", "tcp",
@@ -248,6 +249,38 @@ _var("HOROVOD_HANG_DEADLINE", "float", 0.0,
      "Step-progress stall past this marks a rank hung; 0 disables")
 _var("HOROVOD_FLEET_JOB", "str", None,
      "Job name injected by the fleet controller (labels metric exports)")
+
+# ---------------------------------------------------------------------------
+# Coordination plane (horovod_tpu/coordination.py, docs/control_plane.md)
+# ---------------------------------------------------------------------------
+_var("HOROVOD_COORD_TREE", "bool", False,
+     "1 coordinates through the two-level host/leader tree instead of "
+     "the flat rank-0 star (O(log N) control fan-in)", native=True)
+_var("HOROVOD_COORD_EPOCH", "int", 0,
+     "Coordinator lease epoch, bumped by the launcher on each "
+     "re-election; stale-epoch control messages are discarded",
+     native=True)
+_var("HOROVOD_COORD_RANK", "int", 0,
+     "Global rank currently holding the coordinator lease (injected by "
+     "the launcher after failover)", native=True)
+_var("HOROVOD_COORD_ELECTIONS", "int", 0,
+     "Coordinator elections so far this job (launcher-injected; "
+     "surfaces in stall reports and hvd_coord_elections_total)",
+     native=True)
+_var("HOROVOD_COORD_LEASE_SECONDS", "float", 10.0,
+     "Coordinator lease term: heartbeats renew it, expiry triggers the "
+     "deterministic re-election of the lowest healthy leader host")
+_var("HOROVOD_COORD_MSG_RETRIES", "int", 4,
+     "Bounded retransmits per control message (jittered exponential "
+     "backoff between attempts)")
+_var("HOROVOD_COORD_MSG_DEADLINE", "float", 10.0,
+     "Total per-control-message deadline across all retransmits")
+_var("HOROVOD_PARTITION_GRACE_SECONDS", "float", 30.0,
+     "Launcher silence past this fences the rank (exit 75) as the "
+     "partitioned side rather than a re-election trigger")
+_var("HOROVOD_RPC_CONNECT_DEADLINE", "float", 60.0,
+     "Total cap across all connect_with_retry dials; per-dial retries "
+     "alone could otherwise stretch unbounded under chaos")
 
 # ---------------------------------------------------------------------------
 # Kernels / frameworks / misc knobs

@@ -69,7 +69,23 @@ Keys:
              ``request_storm[:N]`` (serving plane: flood the router
              with a burst of N synthetic requests — default 8 — per
              firing; the traffic-spike simulation the fleet autoscaler
-             must absorb by growing the serving job).
+             must absorb by growing the serving job),
+             ``msg_drop[:N]`` (control plane: suppress N coordination
+             messages — default 1 — the lost-control-message simulation
+             the bounded-retry wire must absorb),
+             ``msg_dup[:N]`` (control plane: deliver N coordination
+             messages twice — default 1 — the retransmit-replay
+             simulation the (epoch, seq) dedup must absorb),
+             ``msg_delay[:MS]`` (control plane: stall coordination
+             sends by MS milliseconds — the slow-wire simulation
+             per-message deadlines must bound),
+             ``partition[:S]`` (control plane: make the sender's host
+             unreachable for S seconds — default 5 — the split-brain
+             simulation: the majority side elects, the minority side
+             self-fences),
+             ``coord_crash`` (control plane: kill the current
+             coordinator — the failover simulation: lease expiry,
+             deterministic re-election, no whole-job abort).
 ``count``    maximum number of firings (default: unlimited for
              ``delay``/``error``/``nan``/``corrupt``/
              ``heartbeat_drop``/``spill_corrupt`` — chaos tests that
@@ -89,7 +105,11 @@ hooks — :func:`drop_heartbeat` in the heartbeat sender (site
 which the fleet controller polls once per scheduler tick (site
 ``fleet``); and the serving kinds (``replica_crash``/``request_storm``)
 fire only at :func:`crash_replica` (replica decode loop) and
-:func:`storm_requests` (router scheduler pass), both site ``serving``.
+:func:`storm_requests` (router scheduler pass), both site ``serving``;
+and the control kinds (``msg_drop``/``msg_dup``/``msg_delay``/
+``partition``/``coord_crash``) fire only at :func:`control_chaos`,
+polled per coordination-message send by the live control wire and
+armed per virtual send by ``tools/coordsim`` (site ``control``).
 ``attempt``  only fire when ``HOROVOD_RESTART_ATTEMPT`` equals this
              value — lets an elastic-restart test kill attempt 0 and
              let attempt 1 run clean.
@@ -114,7 +134,8 @@ ENV_VAR = "HOROVOD_FAULT_SPEC"
 
 _KINDS = ("crash", "exit", "hang", "delay", "error", "nan", "corrupt",
           "heartbeat_drop", "spill_corrupt", "preempt_storm", "host_flap",
-          "residual_drop", "replica_crash", "request_storm")
+          "residual_drop", "replica_crash", "request_storm",
+          "msg_drop", "msg_dup", "msg_delay", "partition", "coord_crash")
 
 # Kinds that mutate an op's *output value* instead of disrupting control
 # flow; they fire at corrupt_output(), never at inject().
@@ -135,10 +156,18 @@ FLEET_KINDS = ("preempt_storm", "host_flap")
 # request router — never at inject()/corrupt_output().
 SERVING_KINDS = ("replica_crash", "request_storm")
 
+# Kinds owned by the coordination control plane (site ``control``); they
+# fire at control_chaos(), polled per control-message send by the live
+# RPC path (runner.rpc.control_call / the launcher's coordination plane)
+# and armed per virtual send by the protocol simulator
+# (tools/coordsim.net.VirtualNetwork) — never at inject().
+CONTROL_KINDS = ("msg_drop", "msg_dup", "msg_delay", "partition",
+                 "coord_crash")
+
 SITES = (
     "allreduce", "allgather", "broadcast", "alltoall", "reducescatter",
     "barrier", "native_submit", "native_wait", "rpc", "spawn",
-    "heartbeat", "spill", "fleet", "compression", "serving",
+    "heartbeat", "spill", "fleet", "compression", "serving", "control",
 )
 
 
@@ -361,6 +390,24 @@ def parse_spec(spec: str) -> List[FaultRule]:
                             raise FaultSpecError(
                                 f"kind request_storm:{arg} must inject "
                                 f">= 1 request per firing")
+                    elif kind in ("msg_drop", "msg_dup"):
+                        arg = int(kind_arg) if kind_arg else None
+                        if arg is not None and arg < 1:
+                            raise FaultSpecError(
+                                f"kind {kind}:{arg} must act on "
+                                f">= 1 message")
+                    elif kind == "msg_delay":
+                        arg = float(kind_arg) if kind_arg else None
+                        if arg is not None and arg < 0:
+                            raise FaultSpecError(
+                                f"kind msg_delay:{arg} must delay by "
+                                f">= 0 ms")
+                    elif kind == "partition":
+                        arg = float(kind_arg) if kind_arg else None
+                        if arg is not None and arg <= 0:
+                            raise FaultSpecError(
+                                f"kind partition:{arg} must last "
+                                f"> 0 seconds")
                     elif kind_arg:
                         raise FaultSpecError(
                             f"kind {kind!r} takes no argument "
@@ -400,6 +447,14 @@ def parse_spec(spec: str) -> List[FaultRule]:
         if kind == "replica_crash" and count is None:
             count = arg if arg is not None else 1
         if kind == "request_storm" and count is None:
+            count = 1
+        # msg_drop:N / msg_dup:N are count shorthands (N messages);
+        # partition and coord_crash default to a single episode so the
+        # chaos settles and recovery is observable.  msg_delay keeps the
+        # unlimited default like the generic delay kind.
+        if kind in ("msg_drop", "msg_dup") and count is None:
+            count = arg if arg is not None else 1
+        if kind in ("partition", "coord_crash") and count is None:
             count = 1
         if site is not None and site not in SITES:
             raise FaultSpecError(
@@ -470,7 +525,8 @@ def inject(site: str, detail: Optional[str] = None,
     for rule in plan:
         if (rule.kind in VALUE_KINDS or rule.kind in PLANE_KINDS
                 or rule.kind in FLEET_KINDS
-                or rule.kind in SERVING_KINDS):
+                or rule.kind in SERVING_KINDS
+                or rule.kind in CONTROL_KINDS):
             continue
         if rule.arm(site, ctx_rank):
             rule.execute(site, detail, ctx_rank)
@@ -616,6 +672,36 @@ def storm_requests(rank: Optional[int] = None) -> int:
                            note=f" (storm of {size} requests)")
             burst += size
     return burst
+
+
+def control_chaos(rank: Optional[int] = None):
+    """Control-plane hook, polled once per coordination-message send
+    (site ``control``): returns ``(kind, arg)`` for every control rule
+    that armed on this send — ``msg_drop`` (suppress the send and let
+    the bounded-retry loop earn it back), ``msg_dup`` (send twice; the
+    receiver's (epoch, seq) dedup must make the copy a no-op),
+    ``msg_delay`` (arg = milliseconds to stall the send), ``partition``
+    (arg = seconds the sender must treat the wire as unreachable) and
+    ``coord_crash`` (the consumer kills the coordinator — the simulator
+    kills the coordinator's host; a live workload SIGKILLs rank 0).
+    The *caller* owns the semantics because only it knows its wire; the
+    simulator arms the same rules through its virtual network so a
+    chaos spec means the same thing simulated and live.  Same
+    zero-overhead contract as :func:`inject` when no spec is set."""
+    plan = _plan
+    if plan is _UNSET:
+        plan = load()
+    if plan is None:
+        return []
+    ctx_rank = _context_rank(rank)
+    fired = []
+    for rule in plan:
+        if rule.kind not in CONTROL_KINDS:
+            continue
+        if rule.arm("control", ctx_rank):
+            rule._announce("control", None, ctx_rank)
+            fired.append((rule.kind, rule.arg))
+    return fired
 
 
 def mangle_spill(path: str, rank: Optional[int] = None) -> bool:

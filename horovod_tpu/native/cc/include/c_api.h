@@ -53,6 +53,13 @@ int hvd_schedule_check_enabled();
 int64_t hvd_schedule_check_submissions();
 int64_t hvd_schedule_check_divergences();
 
+// 1 when tree coordination is active (HOROVOD_COORD_TREE=1 with a usable
+// multi-host HOROVOD_TOPOLOGY): members exchange with their host leader,
+// leaders with the master — per-cycle master fan-in O(hosts + local_size)
+// instead of O(world).  0 in flat mode (including schedule-check and
+// bad-topology fallbacks).
+int hvd_coord_tree();
+
 // 1 when the bootstrap agreement verified a hierarchical-capable topology
 // (homogeneous block mapping, >1 host) — the autotuner may then flip the
 // hier_* routing even if the env flags left it off.

@@ -88,6 +88,9 @@ class Controller {
   // fusing that list, keeping the fusion walk identical across ranks.
   void set_fusion_threshold(int64_t t) { fusion_threshold_ = t; }
   StallInspector& stall_inspector() { return stall_; }
+  // Tree coordination active (HOROVOD_COORD_TREE with a usable multi-host
+  // HOROVOD_TOPOLOGY; forced flat under HOROVOD_SCHEDULE_CHECK).
+  bool tree_mode() const { return tree_mode_; }
 
  private:
   std::map<int32_t, std::vector<int32_t>> process_sets_;
@@ -108,6 +111,34 @@ class Controller {
 
   Status MasterCycle(const RequestList& mine, ResponseList* out,
                      const TunedParams* tuned);
+
+  // ---- Tree coordination (HOROVOD_COORD_TREE) ----------------------------
+  // Two-level message pattern over the host topology: members exchange
+  // with their host's leader (slot-0 rank), leaders exchange with the
+  // master, so the master's per-cycle fan-in is O(hosts + local_size)
+  // instead of O(world).  The master keeps the global pending table —
+  // leaders AGGREGATE (requests carry their submitting rank) and relay
+  // the verdict bytes downward unchanged, so every rank still fuses the
+  // identical response stream.
+  // Decide tree eligibility from the (launcher-uniform) environment and
+  // carve the host blocks out of HOROVOD_TOPOLOGY.
+  void TreeSetup();
+  // Second rendezvous phase over the already-authenticated star: leaders
+  // open a member listener, the master brokers the leader port table,
+  // members re-home onto their leader.
+  Status TreeWire(const std::vector<PeerAddr>& peers, const std::string& key);
+  // Leader cycle: gather members, fold list-level state into the
+  // aggregated fields, exchange with the master, relay verdicts down.
+  Status LeaderCycle(RequestList& mine, ResponseList* out);
+
+  bool tree_mode_ = false;
+  int leader_rank_ = 0;              // my host's leader (== rank_ if leader)
+  std::vector<int> member_ranks_;    // leader: my host's members (excl. me)
+  std::vector<int> child_ranks_;     // master: host-0 members + other leaders
+  std::vector<int> tree_leaders_;    // master: the non-zero leaders
+  TcpSocket tree_listener_;          // leader: member rendezvous
+  std::vector<TcpSocket> member_conns_;   // leader: parallel to member_ranks_
+  TcpSocket parent_;                 // non-host-0 member: conn to my leader
   // Record one rank's announcements (reference IncrementTensorCount,
   // controller.cc:700-723); names becoming ready join ready_ in arrival
   // order (identical on all ranks because only the master defines it).

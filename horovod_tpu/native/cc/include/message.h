@@ -40,6 +40,19 @@ struct RequestList {
   bool shutdown = false;
   std::vector<uint64_t> cache_hits;   // response-cache bit vector
 
+  // Tree coordination (HOROVOD_COORD_TREE): a host leader forwards its
+  // members' announcements upstream in ONE aggregated list.  Requests
+  // already carry their submitting rank; these two fields carry the
+  // list-LEVEL state a flat exchange encodes implicitly by which socket
+  // it arrived on.  Both stay empty in flat mode (4 bytes each on the
+  // wire).
+  std::vector<int32_t> shutdown_ranks;   // ranks whose list had shutdown
+  struct MemberBits {
+    int32_t rank = 0;
+    std::vector<uint64_t> bits;          // that rank's cache-hit bits
+  };
+  std::vector<MemberBits> member_cache_hits;
+
   // Collective-schedule contract verifier (HOROVOD_SCHEDULE_CHECK=1):
   // this rank's submission records for the cycle, captured at announce
   // time — BEFORE cache bit-compression, so the true submissions

@@ -2,6 +2,7 @@
 
 #include <algorithm>
 #include <chrono>
+#include <cstdlib>
 #include <sstream>
 
 #include "auth.h"
@@ -97,6 +98,12 @@ Status Controller::Init(int rank, int size, const std::string& master_addr,
   sched_digest_seen_.assign(size, 0);
   sched_quiet_since_ = std::chrono::steady_clock::now();
   peers_out->assign(size, PeerAddr{});
+  TreeSetup();
+  // Lease epoch this job attempt runs under (0 for a never-failed job).
+  // A worker surviving from a dead epoch must not re-join the rendezvous
+  // of the elected successor: its in-flight state belongs to the old
+  // coordinator and is discarded here.
+  const int epoch = static_cast<int>(EnvInt("HOROVOD_COORD_EPOCH", 0));
 
   const std::string key = JobKey();
   if (rank == 0) {
@@ -130,7 +137,7 @@ Status Controller::Init(int rank, int size, const std::string& master_addr,
                      << s.reason << ")";
         continue;
       }
-      // hello frame: "rank data_port host".  The self-reported host (the
+      // hello frame: "rank data_port host epoch".  The self-reported host (the
       // worker's HOROVOD_HOSTNAME) is preferred over the observed peer
       // address: on multi-host jobs a worker co-located with rank 0 — or
       // one whose hostname resolves to loopback in /etc/hosts — is
@@ -143,10 +150,19 @@ Status Controller::Init(int rank, int size, const std::string& master_addr,
                      << s.reason << ")";
         continue;
       }
-      int r = -1, dport = 0;
+      int r = -1, dport = 0, wepoch = epoch;
       char hostbuf[256] = {0};
-      int n_parsed =
-          std::sscanf(hello.c_str(), "%d %d %255s", &r, &dport, hostbuf);
+      int n_parsed = std::sscanf(hello.c_str(), "%d %d %255s %d", &r, &dport,
+                                 hostbuf, &wepoch);
+      if (n_parsed >= 2 && wepoch != epoch) {
+        // A straggler from before the coordinator failover: its responses
+        // belong to the dead epoch.  Drop it and keep accepting — the
+        // launcher restarts the rank under the current epoch.
+        LOG(Warning) << "controller: dropped rank " << r
+                     << " announcing stale coordination epoch " << wepoch
+                     << " (current epoch " << epoch << ")";
+        continue;
+      }
       if (n_parsed < 2 || r <= 0 || r >= size || workers_[r].valid()) {
         // An AUTHENTICATED peer speaking garbage (or a duplicate rank) is
         // a real job misconfiguration, not scanner noise — fail loudly.
@@ -173,6 +189,7 @@ Status Controller::Init(int rank, int size, const std::string& master_addr,
       s = workers_[r].SendFrame(table.str());
       if (!s.ok()) return s;
     }
+    if (tree_mode_) return TreeWire(*peers_out, key);
     return Status::OK();
   }
 
@@ -182,7 +199,7 @@ Status Controller::Init(int rank, int size, const std::string& master_addr,
   if (!s.ok()) return s;
   std::ostringstream hello;
   hello << rank << " " << my_data_port << " "
-        << (my_data_host.empty() ? "-" : my_data_host);
+        << (my_data_host.empty() ? "-" : my_data_host) << " " << epoch;
   s = master_.SendFrame(hello.str());
   if (!s.ok()) return s;
   std::string table;
@@ -198,12 +215,198 @@ Status Controller::Init(int rank, int size, const std::string& master_addr,
       // address this worker successfully dialed is it.
       (*peers_out)[r].host = (r == 0) ? master_addr : "127.0.0.1";
   }
+  if (tree_mode_) return TreeWire(*peers_out, key);
+  return Status::OK();
+}
+
+void Controller::TreeSetup() {
+  // Flat default: the master's children are every other rank.
+  child_ranks_.clear();
+  for (int r = 1; r < size_; ++r) child_ranks_.push_back(r);
+  leader_rank_ = 0;
+  member_ranks_.clear();
+  tree_mode_ = EnvBool("HOROVOD_COORD_TREE", false) && size_ > 1;
+  if (!tree_mode_) return;
+  if (schedule_check_) {
+    // The schedule verifier attributes per-SOCKET submission streams; a
+    // leader's aggregated list would fold several streams into one.  The
+    // verifier is a debugging lane — prefer it, fall back flat.
+    if (rank_ == 0)
+      LOG(Warning) << "HOROVOD_COORD_TREE=1 is incompatible with "
+                      "HOROVOD_SCHEDULE_CHECK=1; using flat coordination "
+                      "so the schedule verifier can run";
+    tree_mode_ = false;
+    return;
+  }
+  // Host blocks from the launcher-exported rank-major topology string
+  // ("h1:4,h2:4").  Every input here is launcher-uniform env, so the
+  // enable decision is identical on every rank — a per-rank divergence
+  // would wedge the rendezvous.
+  const std::string spec = EnvStr("HOROVOD_TOPOLOGY", "");
+  std::vector<int> slots;
+  int total = 0;
+  size_t pos = 0;
+  while (pos <= spec.size() && !spec.empty()) {
+    const size_t comma = spec.find(',', pos);
+    const size_t end = comma == std::string::npos ? spec.size() : comma;
+    if (end > pos) {
+      const std::string part = spec.substr(pos, end - pos);
+      const size_t colon = part.rfind(':');
+      const int n = colon == std::string::npos
+          ? 1 : std::atoi(part.c_str() + colon + 1);
+      if (n <= 0) { total = -1; break; }
+      slots.push_back(n);
+      total += n;
+    }
+    if (comma == std::string::npos) break;
+    pos = comma + 1;
+  }
+  if (total != size_ || slots.size() < 2) {
+    if (rank_ == 0)
+      LOG(Warning) << "HOROVOD_COORD_TREE=1 but HOROVOD_TOPOLOGY (\"" << spec
+                   << "\") does not map this " << size_
+                   << "-rank job onto >= 2 hosts; using flat coordination";
+    tree_mode_ = false;
+    return;
+  }
+  child_ranks_.clear();
+  int base = 0;
+  for (size_t h = 0; h < slots.size(); ++h) {
+    const int leader = base;
+    if (base <= rank_ && rank_ < base + slots[h]) {
+      leader_rank_ = leader;
+      if (rank_ == leader)
+        for (int r = base + 1; r < base + slots[h]; ++r)
+          member_ranks_.push_back(r);
+    }
+    if (h == 0) {
+      // Host 0's members reach the master directly over the rendezvous
+      // star: the master IS their leader.
+      for (int r = 1; r < slots[0]; ++r) child_ranks_.push_back(r);
+    } else {
+      child_ranks_.push_back(leader);
+      tree_leaders_.push_back(leader);
+    }
+    base += slots[h];
+  }
+}
+
+Status Controller::TreeWire(const std::vector<PeerAddr>& peers,
+                            const std::string& key) {
+  // Second rendezvous phase, brokered over the authenticated star that
+  // already exists: leaders report an ephemeral member-listener port, the
+  // master broadcasts the leader port table, members re-home onto their
+  // leader.  Every worker participates in the frame exchange (even those
+  // that keep talking to the master) so the star stays frame-synchronous.
+  Status s;
+  if (rank_ == 0) {
+    std::map<int, int> ports;
+    for (int L : tree_leaders_) {
+      std::string msg;
+      s = workers_[L].RecvFrame(&msg);
+      if (!s.ok()) return s;
+      int port = 0;
+      if (std::sscanf(msg.c_str(), "coordport %d", &port) != 1)
+        return Status::Unknown("bad tree-coordination port report: " + msg);
+      ports[L] = port;
+    }
+    std::ostringstream table;
+    for (const auto& kv : ports)
+      table << kv.first << " " << kv.second << "\n";
+    for (int r = 1; r < size_; ++r) {
+      s = workers_[r].SendFrame(table.str());
+      if (!s.ok()) return s;
+    }
+    return Status::OK();
+  }
+
+  const bool leading = rank_ == leader_rank_ && !member_ranks_.empty();
+  if (rank_ == leader_rank_) {   // non-zero leader (memberless ones too)
+    int port = 0;
+    if (leading) {
+      s = tree_listener_.Listen("", 0);
+      if (!s.ok()) return s;
+      port = tree_listener_.bound_port();
+    }
+    s = master_.SendFrame("coordport " + std::to_string(port));
+    if (!s.ok()) return s;
+  }
+  std::string table;
+  s = master_.RecvFrame(&table);
+  if (!s.ok()) return s;
+
+  if (leading) {
+    // Accept my host's members, rogue-resilient like the main rendezvous.
+    member_conns_.resize(member_ranks_.size());
+    auto deadline =
+        std::chrono::steady_clock::now() + std::chrono::seconds(120);
+    for (size_t registered = 0; registered < member_ranks_.size();) {
+      auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+          deadline - std::chrono::steady_clock::now()).count();
+      if (left <= 0)
+        return Status::Unknown("tree-coordination rendezvous timed out "
+                               "waiting for host members");
+      TcpSocket conn;
+      s = tree_listener_.Accept(&conn, static_cast<int>(left));
+      if (!s.ok()) return s;
+      conn.SetRecvTimeout(10000);
+      s = AuthAccept(conn, key);
+      if (!s.ok()) {
+        LOG(Warning) << "tree coordination: dropped unauthenticated member "
+                        "connection (" << s.reason << ")";
+        continue;
+      }
+      std::string hello;
+      s = conn.RecvFrame(&hello);
+      if (!s.ok()) continue;
+      const int r = std::atoi(hello.c_str());
+      size_t idx = member_ranks_.size();
+      for (size_t i = 0; i < member_ranks_.size(); ++i)
+        if (member_ranks_[i] == r) { idx = i; break; }
+      if (idx == member_ranks_.size() || member_conns_[idx].valid()) {
+        if (key.empty()) {
+          LOG(Warning) << "tree coordination: dropped bad member hello: "
+                       << hello;
+          continue;
+        }
+        return Status::Unknown("bad tree-coordination member hello: " +
+                               hello);
+      }
+      conn.SetRecvTimeout(0);
+      member_conns_[idx] = std::move(conn);
+      ++registered;
+    }
+    return Status::OK();
+  }
+
+  if (leader_rank_ != 0) {
+    // Member of a remote host: re-home onto my leader.  The old master
+    // socket stays open but silent (the master never reads it in tree
+    // mode); both close at Shutdown.
+    int lport = 0;
+    std::istringstream in(table);
+    int lr, lp;
+    while (in >> lr >> lp)
+      if (lr == leader_rank_) lport = lp;
+    if (lport <= 0)
+      return Status::Unknown("tree coordination: no listener port for "
+                             "leader rank " + std::to_string(leader_rank_));
+    s = parent_.Connect(peers[leader_rank_].host, lport);
+    if (!s.ok()) return s;
+    s = AuthConnect(parent_, key);
+    if (!s.ok()) return s;
+    s = parent_.SendFrame(std::to_string(rank_));
+    if (!s.ok()) return s;
+  }
   return Status::OK();
 }
 
 void Controller::Shutdown() {
   master_.Close();
+  parent_.Close();
   for (auto& w : workers_) w.Close();
+  for (auto& m : member_conns_) m.Close();
+  tree_listener_.Close();
   listener_.Close();
 }
 
@@ -215,11 +418,62 @@ Status Controller::Cycle(RequestList& mine, ResponseList* out,
     return MasterCycle(RequestList{}, out, tuned);
   }
   if (rank_ == 0) return MasterCycle(mine, out, tuned);
+  if (tree_mode_ && rank_ == leader_rank_ && !member_ranks_.empty())
+    return LeaderCycle(mine, out);
+  // Member exchange: with my host's leader in tree mode (unless the
+  // master is my leader), the master otherwise.
+  TcpSocket& up = (tree_mode_ && leader_rank_ != 0 && rank_ != leader_rank_)
+                      ? parent_ : master_;
+  Status s = up.SendFrame(mine.Serialize());
+  if (!s.ok()) return s;
+  std::string buf;
+  s = up.RecvFrame(&buf);
+  if (!s.ok()) return s;
+  return ResponseList::Parse(buf, out);
+}
+
+Status Controller::LeaderCycle(RequestList& mine, ResponseList* out) {
+  // Fold my own list-level state into the explicit per-rank fields so the
+  // master attributes everything by rank, never by socket.
+  if (mine.shutdown) {
+    mine.shutdown_ranks.push_back(rank_);
+    mine.shutdown = false;
+  }
+  if (!mine.cache_hits.empty()) {
+    RequestList::MemberBits mb;
+    mb.rank = rank_;
+    mb.bits = std::move(mine.cache_hits);
+    mine.member_cache_hits.push_back(std::move(mb));
+    mine.cache_hits.clear();
+  }
+  for (size_t i = 0; i < member_conns_.size(); ++i) {
+    std::string buf;
+    Status s = member_conns_[i].RecvFrame(&buf);
+    if (!s.ok()) return s;
+    RequestList rl;
+    s = RequestList::Parse(buf, &rl);
+    if (!s.ok()) return s;
+    const int mr = member_ranks_[i];
+    if (rl.shutdown) mine.shutdown_ranks.push_back(mr);
+    if (!rl.cache_hits.empty()) {
+      RequestList::MemberBits mb;
+      mb.rank = mr;
+      mb.bits = std::move(rl.cache_hits);
+      mine.member_cache_hits.push_back(std::move(mb));
+    }
+    for (auto& r : rl.requests) mine.requests.push_back(std::move(r));
+  }
   Status s = master_.SendFrame(mine.Serialize());
   if (!s.ok()) return s;
   std::string buf;
   s = master_.RecvFrame(&buf);
   if (!s.ok()) return s;
+  // Relay the verdict BYTES unchanged down the tree: every rank parses
+  // and fuses the identical response stream locally.
+  for (auto& c : member_conns_) {
+    s = c.SendFrame(buf);
+    if (!s.ok()) return s;
+  }
   return ResponseList::Parse(buf, out);
 }
 
@@ -230,7 +484,10 @@ Status Controller::MasterCycle(const RequestList& mine, ResponseList* out,
   // exactly one list per cycle.
   if (schedule_check_) VerifySchedule(mine, 0);
   Ingest(mine, 0);
-  for (int r = 1; r < size_; ++r) {
+  // Direct children only: every rank in flat mode, host-0 members plus
+  // the other hosts' leaders in tree mode (leaders deliver their host's
+  // announcements aggregated — requests carry their submitting rank).
+  for (int r : child_ranks_) {
     std::string buf;
     RequestList rl;
     Status s = workers_[r].RecvFrame(&buf);
@@ -259,7 +516,7 @@ Status Controller::MasterCycle(const RequestList& mine, ResponseList* out,
       LOG(Error) << sched_abort_;
       if (size_ > 1) {
         std::string payload = out->Serialize();
-        for (int r = 1; r < size_; ++r) {
+        for (int r : child_ranks_) {
           Status s = workers_[r].SendFrame(payload);
           if (!s.ok()) return s;
         }
@@ -361,10 +618,11 @@ Status Controller::MasterCycle(const RequestList& mine, ResponseList* out,
   // Broadcast verdicts UNFUSED (reference SendFinalTensors / 2x MPI_Bcast,
   // mpi_controller.cc:152-161); every rank — this one included — fuses the
   // list locally with the same deterministic walk after updating its
-  // response cache from the per-name entries.
+  // response cache from the per-name entries.  In tree mode the leaders
+  // relay these bytes unchanged to their members.
   if (size_ > 1) {
     std::string payload = out->Serialize();
-    for (int r = 1; r < size_; ++r) {
+    for (int r : child_ranks_) {
       Status s = workers_[r].SendFrame(payload);
       if (!s.ok()) return s;
     }
@@ -396,17 +654,34 @@ bool Controller::IsReady(const PendingTensor& p, OpType op) const {
 
 void Controller::Ingest(const RequestList& list, int from_rank) {
   if (list.shutdown) shutdown_ranks_[from_rank] = true;
+  // Tree mode: a leader's aggregated list names its shutdown-signaling
+  // ranks explicitly (the single shutdown bit can't attribute them).
+  for (int32_t r : list.shutdown_ranks)
+    if (r >= 0 && r < size_) shutdown_ranks_[r] = true;
   std::vector<Request> expanded;
   if (cache_ != nullptr && !list.cache_hits.empty())
     // Bit-announced tensors: reconstruct full requests from the cache so
     // the normal validation/readiness pipeline sees them.
     expanded = cache_->Expand(list.cache_hits, from_rank);
+  if (cache_ != nullptr)
+    for (const auto& mb : list.member_cache_hits) {
+      if (mb.rank < 0 || mb.rank >= size_) continue;
+      std::vector<Request> ex = cache_->Expand(mb.bits, mb.rank);
+      expanded.insert(expanded.end(),
+                      std::make_move_iterator(ex.begin()),
+                      std::make_move_iterator(ex.end()));
+    }
   bool join_arrived = false;
   for (const std::vector<Request>* reqs :
        {&list.requests, const_cast<const std::vector<Request>*>(&expanded)})
    for (const auto& req : *reqs) {
-    if (req.op_type == OpType::kJoin && !joined_[from_rank]) {
-      joined_[from_rank] = true;
+    // Flat mode attributes by socket (a buggy rank stamp must not
+    // cross-credit a peer); an aggregated tree list carries several
+    // ranks' announcements, so trust each request's stamped rank there.
+    int src = from_rank;
+    if (tree_mode_ && req.rank >= 0 && req.rank < size_) src = req.rank;
+    if (req.op_type == OpType::kJoin && !joined_[src]) {
+      joined_[src] = true;
       join_arrived = true;
     }
     const std::string key = TableKey(req.set_id, req.name);
@@ -415,8 +690,8 @@ void Controller::Ingest(const RequestList& list, int from_rank) {
       p.submitted.assign(size_, false);
       p.first_seen = std::chrono::steady_clock::now();
     }
-    if (p.submitted[from_rank]) continue;  // duplicate guard
-    p.submitted[from_rank] = true;
+    if (p.submitted[src]) continue;  // duplicate guard
+    p.submitted[src] = true;
     p.requests.push_back(req);
     ++p.count;
     if (!p.queued && IsReady(p, req.op_type)) {

@@ -131,6 +131,12 @@ std::string RequestList::Serialize() const {
   PutPod<uint64_t>(&buf, sched_digest);
   PutPod<uint32_t>(&buf, static_cast<uint32_t>(sched.size()));
   for (const auto& r : sched) PutRequest(&buf, r);
+  PutVec(&buf, shutdown_ranks);
+  PutPod<uint32_t>(&buf, static_cast<uint32_t>(member_cache_hits.size()));
+  for (const auto& mb : member_cache_hits) {
+    PutPod<int32_t>(&buf, mb.rank);
+    PutVec(&buf, mb.bits);
+  }
   return buf;
 }
 
@@ -151,6 +157,12 @@ Status RequestList::Parse(const std::string& buf, RequestList* out) {
   out->sched.resize(n);
   for (auto& r : out->sched)
     if (!GetRequest(&rd, &r)) return Malformed("sched record");
+  if (!rd.GetVec(&out->shutdown_ranks)) return Malformed("shutdown_ranks");
+  if (!rd.GetPod(&n)) return Malformed("member bits count");
+  out->member_cache_hits.resize(n);
+  for (auto& mb : out->member_cache_hits)
+    if (!rd.GetPod(&mb.rank) || !rd.GetVec(&mb.bits))
+      return Malformed("member bits");
   return Status::OK();
 }
 

@@ -910,6 +910,10 @@ int64_t hvd_cache_hits() {
 int hvd_schedule_check_enabled() {
   return g && g->schedule_check.load() ? 1 : 0;
 }
+
+int hvd_coord_tree() {
+  return g && g->initialized.load() && g->controller.tree_mode() ? 1 : 0;
+}
 int64_t hvd_schedule_check_submissions() {
   return g ? static_cast<int64_t>(
                  g->sched_submissions.load(std::memory_order_relaxed))

@@ -22,12 +22,20 @@ bool StallInspector::Check(const std::string& name,
     for (size_t r = 0; r < submitted.size(); ++r)
       (submitted[r] ? ready : missing) << r << " ";
     const bool sched_check = EnvBool("HOROVOD_SCHEDULE_CHECK", false);
+    // Name the coordination plane: after a failover the coordinator is no
+    // longer rank 0, and a stall right after an election usually means
+    // some rank is still talking to the dead epoch.
+    const int64_t coord_rank = EnvInt("HOROVOD_COORD_RANK", 0);
+    const int64_t coord_epoch = EnvInt("HOROVOD_COORD_EPOCH", 0);
+    const int64_t elections = EnvInt("HOROVOD_COORD_ELECTIONS", 0);
     LOG(Warning) << "One or more tensors were submitted to be reduced, "
                  << "gathered or broadcasted by subset of ranks and are "
                  << "waiting for remainder of ranks for more than "
                  << warn_s_ << " seconds. Tensor: " << name
                  << " ready ranks: [" << ready.str() << "] missing ranks: ["
-                 << missing.str() << "]"
+                 << missing.str() << "] Coordinator: rank " << coord_rank
+                 << ", lease epoch " << coord_epoch << ", elections so far "
+                 << elections << "."
                  << (sched_check ? "" :
                      " Rerun with HOROVOD_SCHEDULE_CHECK=1 to catch the "
                      "first diverging submission (rank, call index, "

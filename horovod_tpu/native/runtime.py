@@ -214,6 +214,9 @@ class Runtime:
         if self._sched_div_fn is not None:
             self._sched_div_fn.restype = ctypes.c_longlong
         self._sched_published = {}  # sym -> last value already inc'd
+        # Tree coordination (HOROVOD_COORD_TREE): 1 when the two-level
+        # member/leader/master wiring is active on this rank.
+        self._coord_tree_fn = getattr(lib, "hvd_coord_tree", None)
         # Hierarchical-plane introspection (per-level byte/latency
         # counters + topology availability), all optional symbols.
         self._hier_avail_fn = getattr(
@@ -288,6 +291,15 @@ class Runtime:
         """True when the bootstrap agreement enabled the 2-level
         allgather (HOROVOD_HIERARCHICAL_ALLGATHER)."""
         return bool(self._hier_ag_fn and self._hier_ag_fn())
+
+    def coord_tree_enabled(self) -> bool:
+        """True when tree coordination is active (HOROVOD_COORD_TREE=1
+        with a usable multi-host HOROVOD_TOPOLOGY): members negotiate
+        through their host leader, leaders through the master — so the
+        coordinator's per-cycle fan-in is O(hosts + local_size) instead
+        of O(world).  False in flat mode, including the schedule-check
+        and bad-topology fallbacks."""
+        return bool(self._coord_tree_fn and self._coord_tree_fn())
 
     # -- adaptive-control-plane introspection ------------------------------
 
@@ -581,6 +593,14 @@ class Runtime:
                 "verifies every rank's submission stream and aborts at "
                 "the first divergence naming both ranks, the call index "
                 "and the mismatched field instead of stalling here.")
+        # Name the coordination plane: after a failover the coordinator is
+        # no longer rank 0, and a stall right after an election points at
+        # ranks still talking to the dead epoch.
+        coord_note = (
+            f" Coordination plane: coordinator rank "
+            f"{config.env_int('HOROVOD_COORD_RANK')}, lease epoch "
+            f"{config.env_int('HOROVOD_COORD_EPOCH')}, elections so far "
+            f"{config.env_int('HOROVOD_COORD_ELECTIONS')}.")
         return (
             f"Stalled eager op '{name}': submitted by rank {self.rank} "
             f"but not completed after {elapsed:.1f}s. One or more ranks "
@@ -589,8 +609,8 @@ class Runtime:
             f"coordinator's stall watchdog, HOROVOD_STALL_CHECK_TIME_"
             f"SECONDS, reports the authoritative list on rank 0). "
             f"Possible causes: a crashed or hung peer, a deadlocked "
-            f"submission order, or a network partition." + cfg_note
-            + sched_note)
+            f"submission order, or a network partition." + coord_note
+            + cfg_note + sched_note)
 
     def _watchdog(self) -> None:
         """Background stall reporter for the default (no hard timeout)

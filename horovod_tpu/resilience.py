@@ -936,19 +936,37 @@ def progress() -> Tuple[int, float]:
 
 class HeartbeatSender:
     """Daemon thread sending ``{"kind": "heartbeat", rank, step,
-    progress_ts}`` to the launcher's health plane every ``interval``
-    seconds over the authenticated RPC plane.  Single-shot dials with no
-    retries and a short timeout — a slow or dead launcher must never
-    stall training — and every failure is swallowed (counted, logged at
-    debug)."""
+    progress_ts, epoch, seq}`` to the launcher's health plane every
+    ``interval`` seconds over the authenticated RPC plane.  Single-shot
+    dials with no retries and a short timeout — a slow or dead launcher
+    must never stall training — and every failure is swallowed (counted,
+    logged at debug).
+
+    Two control-plane duties ride along (docs/control_plane.md):
+
+    * Rank 0's successful sends are the coordinator lease renewals —
+      counted as ``hvd_coord_lease_renewals_total`` and consumed by the
+      launcher's ``_CoordinationPlane``.
+    * The **partition fence**: a rank that cannot reach the launcher for
+      ``HOROVOD_PARTITION_GRACE_SECONDS`` is the cut-off side of a
+      partition (the launcher is a fixed point — its death kills local
+      ranks anyway).  It exits with rc 75 (reschedule) rather than
+      holding a stale gang hostage; 0 disables the fence.
+    """
 
     def __init__(self, addr: str, port: int, key: bytes, rank: int,
                  interval: float):
+        from horovod_tpu import config
         self.addr = addr
         self.port = int(port)
         self.key = key
         self.rank = int(rank)
         self.interval = max(0.05, float(interval))
+        self.epoch = config.env_int("HOROVOD_COORD_EPOCH")
+        self.partition_grace = config.env_float(
+            "HOROVOD_PARTITION_GRACE_SECONDS")
+        self._seq = 0
+        self._last_ok: Optional[float] = None   # monotonic, None = never
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="hvd-heartbeat", daemon=True)
@@ -959,7 +977,31 @@ class HeartbeatSender:
     def stop(self) -> None:
         self._stop.set()
 
+    def _fence_check(self, now: float) -> None:
+        """Self-fence (exit rc 75) after a full grace window with zero
+        launcher contact.  Only armed once a first heartbeat landed —
+        start-up misconfiguration belongs to the rendezvous timeout,
+        not the fence."""
+        if not self.partition_grace or self._last_ok is None:
+            return
+        if now - self._last_ok <= self.partition_grace:
+            return
+        msg = (f"rank {self.rank}: no launcher contact for "
+               f"{now - self._last_ok:.0f}s (> partition grace "
+               f"{self.partition_grace:g}s); self-fencing with rc "
+               f"{PREEMPTION_RC}")
+        log.error(msg)
+        print(f"horovod_tpu: {msg}", file=sys.stderr, flush=True)
+        if telemetry.enabled():
+            telemetry.counter(
+                "hvd_partition_fences_total",
+                "Ranks that self-fenced after losing launcher contact "
+                "past the partition grace").inc()
+            telemetry.flush()
+        os._exit(PREEMPTION_RC)
+
     def _run(self) -> None:
+        import time as _time
         from horovod_tpu.runner import rpc
         while not self._stop.wait(self.interval):
             if faults.drop_heartbeat(self.rank):
@@ -969,17 +1011,25 @@ class HeartbeatSender:
                         "heartbeats suppressed by fault injection").inc()
                 continue
             step, ts = progress()
+            self._seq += 1
             try:
                 resp = rpc.rpc_call(
                     self.addr, self.port,
                     {"kind": "heartbeat", "rank": self.rank,
-                     "step": step, "progress_ts": ts},
+                     "step": step, "progress_ts": ts,
+                     "epoch": self.epoch, "seq": self._seq},
                     self.key, timeout=max(1.0, self.interval),
                     retries=0)
+                self._last_ok = _time.monotonic()
                 if telemetry.enabled():
                     telemetry.counter(
                         "hvd_heartbeat_sent_total",
                         "heartbeats delivered to the launcher").inc()
+                    if self.rank == 0:
+                        telemetry.counter(
+                            "hvd_coord_lease_renewals_total",
+                            "Coordinator lease renewals (rank 0 "
+                            "heartbeats that reached the launcher)").inc()
                 if isinstance(resp, dict) and resp.get("preempt") and \
                         not _preempt_event.is_set():
                     # The launcher can't SIGTERM a remote rank (only its
@@ -1001,6 +1051,7 @@ class HeartbeatSender:
                         "restarting, or gone)").inc()
                 log.debug("heartbeat send failed: %s: %s",
                           type(e).__name__, e)
+                self._fence_check(_time.monotonic())
 
 
 _heartbeat_sender: Optional[HeartbeatSender] = None

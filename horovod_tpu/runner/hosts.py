@@ -115,6 +115,20 @@ def topology_string(infos: List[RankInfo]) -> str:
     return ",".join(f"{h.hostname}:{h.slots}" for h in hosts)
 
 
+def promote_host(host_list: List[HostSlots],
+                 hostname: str) -> List[HostSlots]:
+    """Reorder ``host_list`` so ``hostname`` leads.  Rank assignment is
+    host-major (:func:`allocate`), so the promoted host's first slot
+    becomes rank 0 — this is how the launcher pins the elected
+    coordinator host after a failover.  The relative order of the other
+    hosts is preserved; an unknown hostname returns the list unchanged.
+    """
+    head = [h for h in host_list if h.hostname == hostname]
+    if not head:
+        return list(host_list)
+    return head + [h for h in host_list if h.hostname != hostname]
+
+
 def free_slots(hosts: List[HostSlots],
                used: Dict[str, int]) -> List[HostSlots]:
     """Remaining per-host capacity after subtracting ``used`` (hostname →

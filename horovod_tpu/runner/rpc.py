@@ -24,7 +24,7 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
-from horovod_tpu import faults, telemetry
+from horovod_tpu import config, faults, telemetry
 
 
 class AuthError(RuntimeError):
@@ -117,7 +117,9 @@ def connect_with_retry(addr: str, port: int, timeout: float = 30.0,
                        retries: int = 4, base_delay: float = 0.2,
                        max_delay: float = 3.0,
                        sleep: Callable[[float], None] = time.sleep,
-                       rng: Callable[[], float] = random.random
+                       rng: Callable[[], float] = random.random,
+                       deadline: Optional[float] = None,
+                       clock: Callable[[], float] = time.monotonic
                        ) -> socket.socket:
     """``socket.create_connection`` with jittered exponential backoff.
 
@@ -126,28 +128,47 @@ def connect_with_retry(addr: str, port: int, timeout: float = 30.0,
     RPCs.  Backoff is ``min(max_delay, base_delay * 2**attempt)`` scaled
     by a uniform [0.5, 1.5) jitter, so a herd of ranks re-dialing a
     restarting driver doesn't re-arrive in lockstep (the failure mode
-    the reference's fixed-interval retry loops invite).  ``sleep``/
-    ``rng`` are injection hooks for tests."""
+    the reference's fixed-interval retry loops invite).
+
+    ``deadline`` caps the TOTAL elapsed time across every attempt
+    (default ``HOROVOD_RPC_CONNECT_DEADLINE``).  Per-attempt bounds
+    alone don't bound the call: each dial may burn its full ``timeout``
+    against a black-holed address, so 5 attempts at 30 s plus backoff
+    could hold a coordination step hostage for minutes.  ``sleep``/
+    ``rng``/``clock`` are injection hooks for tests."""
+    if deadline is None:
+        deadline = config.env_float("HOROVOD_RPC_CONNECT_DEADLINE")
+    started = clock()
     last_err: Optional[OSError] = None
+    attempts = 0
     for attempt in range(retries + 1):
+        budget = deadline - (clock() - started)
+        if budget <= 0:
+            last_err = last_err or OSError("connect deadline exhausted")
+            break
+        attempts += 1
         try:
-            return socket.create_connection((addr, port), timeout=timeout)
+            return socket.create_connection((addr, port),
+                                            timeout=min(timeout, budget))
         except OSError as e:
             last_err = e
             if attempt >= retries:
+                break
+            delay = (min(max_delay, base_delay * (2.0 ** attempt))
+                     * (0.5 + rng()))
+            if clock() - started + delay >= deadline:
                 break
             telemetry.counter(
                 "hvd_rpc_connect_retries_total",
                 "RPC dial attempts that failed and were retried with "
                 "backoff").inc()
-            delay = min(max_delay, base_delay * (2.0 ** attempt))
-            sleep(delay * (0.5 + rng()))
+            sleep(delay)
     telemetry.counter(
         "hvd_rpc_connect_failures_total",
         "RPC dials that exhausted every retry").inc()
     raise ConnectionError(
-        f"could not connect to {addr}:{port} after {retries + 1} "
-        f"attempts: {last_err}")
+        f"could not connect to {addr}:{port} after {attempts} attempts "
+        f"within {deadline:.1f}s: {last_err}")
 
 
 def rpc_call(addr: str, port: int, request: Any, key: bytes,
@@ -165,6 +186,69 @@ def rpc_call(addr: str, port: int, request: Any, key: bytes,
                             retries=retries) as sock:
         _send_msg(sock, pickle.dumps(request), key)
         return pickle.loads(_recv_msg(sock, key))
+
+
+def control_call(addr: str, port: int, request: dict, key: bytes,
+                 *, epoch: int = 0, seq: int = 0,
+                 retries: Optional[int] = None,
+                 deadline: Optional[float] = None,
+                 timeout: float = 5.0,
+                 sleep: Callable[[float], None] = time.sleep,
+                 rng: Callable[[], float] = random.random,
+                 clock: Callable[[], float] = time.monotonic) -> Any:
+    """Coordination-plane round trip: :func:`rpc_call` hardened per
+    docs/control_plane.md.
+
+    The request is stamped with ``(epoch, seq)`` so the receiver can
+    discard stale-epoch traffic and dedup retransmits — which is what
+    makes retrying the WHOLE round trip safe here, where plain
+    :func:`rpc_call` may only retry the dial.  Retransmits use jittered
+    exponential backoff, bounded by ``HOROVOD_COORD_MSG_RETRIES``
+    attempts and the ``HOROVOD_COORD_MSG_DEADLINE`` total budget.  The
+    ``faults.py`` site ``control`` injects here on the live wire with
+    the same kinds the simulator's virtual network honors."""
+    from horovod_tpu.coordination import RetryPolicy
+    if retries is None:
+        retries = config.env_int("HOROVOD_COORD_MSG_RETRIES")
+    if deadline is None:
+        deadline = config.env_float("HOROVOD_COORD_MSG_DEADLINE")
+    policy = RetryPolicy(retries=retries, deadline=deadline)
+    request = dict(request, epoch=int(epoch), seq=int(seq))
+    kind = str(request.get("kind"))
+    started = clock()
+    attempt = 0
+    last_err: Optional[Exception] = None
+    while not policy.give_up(attempt, clock() - started):
+        send_copies = 1
+        try:
+            for fault_kind, arg in faults.control_chaos():
+                if fault_kind == "msg_drop":
+                    raise ConnectionError("chaos: control message dropped")
+                if fault_kind == "msg_dup":
+                    send_copies = 2
+                elif fault_kind == "msg_delay":
+                    sleep(float(arg) / 1000.0 if arg is not None else 0.1)
+                elif fault_kind == "partition":
+                    raise ConnectionError("chaos: control partition")
+            resp = None
+            for _ in range(send_copies):
+                with connect_with_retry(addr, port, timeout=timeout,
+                                        retries=0, deadline=timeout,
+                                        clock=clock) as sock:
+                    _send_msg(sock, pickle.dumps(request), key)
+                    resp = pickle.loads(_recv_msg(sock, key))
+            return resp
+        except (OSError, AuthError, pickle.PickleError) as e:
+            last_err = e
+            attempt += 1
+            telemetry.counter(
+                "hvd_coord_msg_retries_total",
+                "Control-plane messages retransmitted after a failed "
+                "round trip", kind=kind).inc()
+            sleep(policy.backoff(attempt - 1, rng))
+    raise ConnectionError(
+        f"control message kind={kind} epoch={epoch} seq={seq} to "
+        f"{addr}:{port} failed after {attempt} attempts: {last_err}")
 
 
 def probe_reachable(host: str, port: int, timeout: float = 3.0) -> bool:

@@ -21,7 +21,7 @@ import socket
 import sys
 import tempfile
 import time
-from typing import List
+from typing import Callable, List, Optional
 
 import horovod_tpu
 from horovod_tpu import config, telemetry
@@ -268,6 +268,10 @@ def run_command(args) -> int:
                 config.env_str("HOROVOD_HANG_DEADLINE", "").strip() or 0.0)
         health = _HealthPlane(extra_env["HOROVOD_SECRET_KEY"],
                               hb_interval, deadline, hang)
+    coord = _CoordinationPlane(
+        config.env_float("HOROVOD_COORD_LEASE_SECONDS"))
+    if health is not None:
+        health.coord = coord
     # Warm-restart spill scratch dir: one per JOB, stable across elastic
     # restart attempts so a new attempt's ranks find the old attempt's
     # spills.  A user-provided HOROVOD_SPILL_DIR is respected (and never
@@ -336,7 +340,7 @@ def run_command(args) -> int:
                             print(f"hvdrun: host {host} is unreachable; "
                                   f"blacklisting", file=sys.stderr,
                                   flush=True)
-            usable = blacklist.filter(host_list)
+            usable = coord.ensure_coordinator(blacklist.filter(host_list))
             capacity = sum(h.slots for h in usable)
             cur_np = min(np_, capacity)
             if cur_np < min_np:
@@ -352,6 +356,7 @@ def run_command(args) -> int:
                       file=sys.stderr, flush=True)
             infos = hosts.allocate(usable, cur_np)
             extra_env["HOROVOD_RESTART_ATTEMPT"] = str(attempt)
+            extra_env.update(coord.env())
             if prev_np is not None and prev_np != cur_np:
                 # World size changed across the restart: workers use this
                 # to rescale the learning rate / accumulate so the global
@@ -428,16 +433,29 @@ class _HealthPlane:
         self._killed: set = set()
         self._preempt = False
         self._last_gauge = 0.0
+        self.coord: Optional["_CoordinationPlane"] = None
         self._server = rpc.RpcServer(rpc.job_key_bytes(secret),
                                      self._handle)
 
     def _handle(self, req):
         if isinstance(req, dict) and req.get("kind") == "heartbeat":
             try:
-                self.monitor.progress(int(req.get("rank", -1)),
-                                      int(req.get("step", -1)))
+                rank = int(req.get("rank", -1))
+                epoch = int(req.get("epoch", 0))
             except (TypeError, ValueError):
                 return {"ok": False}
+            if self.coord is not None and epoch < self.coord.epoch:
+                # A straggler from before the failover: its heartbeat
+                # must not resurrect the dead epoch's liveness state.
+                return {"ok": False, "stale_epoch": True}
+            try:
+                self.monitor.progress(rank, int(req.get("step", -1)))
+            except (TypeError, ValueError):
+                return {"ok": False}
+            if rank == 0 and self.coord is not None:
+                # Rank 0's heartbeat doubles as the coordinator lease
+                # renewal (docs/control_plane.md).
+                self.coord.renew()
             return {"ok": True, "preempt": self._preempt}
         return {"ok": False}
 
@@ -491,6 +509,75 @@ class _HealthPlane:
 
     def shutdown(self) -> None:
         self._server.shutdown()
+
+
+class _CoordinationPlane:
+    """Launcher half of coordinator failover (docs/control_plane.md).
+
+    The coordinator lease IS the heartbeat stream from rank 0: every
+    rank-0 heartbeat renews it, so the existing health-plane deadline
+    doubles as lease expiry.  When the coordinator's host drops out of
+    the usable set (watchdog kill, crash, unreachable), the next
+    attempt runs the deterministic election — the first healthy host in
+    host-major order (the "lowest healthy leader" of
+    :func:`horovod_tpu.coordination.elect`) is promoted to the front of
+    the list, its first slot becomes the new rank 0, and the epoch
+    bumps.  The rendezvous itself lives in the launcher process, so
+    re-pointing the gang is just the fresh attempt's allocation; ranks
+    learn the epoch from ``HOROVOD_COORD_EPOCH`` and discard any
+    in-flight control state from the dead epoch."""
+
+    def __init__(self, lease_term: float,
+                 clock: Callable[[], float] = time.monotonic):
+        from horovod_tpu import coordination
+        self._clock = clock
+        self.lease = coordination.LeaseState(lease_term, holder=0,
+                                             now=clock())
+        self.coordinator_host: Optional[str] = None
+        self.epoch = 0
+        self.elections = 0
+
+    def renew(self) -> None:
+        """A rank-0 heartbeat arrived: the coordinator host lives."""
+        self.lease.renew(self._clock(), holder=0, epoch=self.epoch)
+
+    def ensure_coordinator(self, usable):
+        """Pin the coordinator host for the coming attempt, electing a
+        replacement when the incumbent is gone.  Returns the (possibly
+        reordered) host list."""
+        names = [h.hostname for h in usable]
+        if not names:
+            return usable
+        if self.coordinator_host is None:
+            self.coordinator_host = names[0]
+        elif self.coordinator_host not in names:
+            dead = self.coordinator_host
+            self.epoch += 1
+            self.elections += 1
+            # Host-major order makes names[0] the lowest healthy
+            # leader — the same deterministic rule coordination.elect
+            # applies to leader ranks.
+            self.coordinator_host = names[0]
+            self.lease.renew(self._clock(), holder=0, epoch=self.epoch)
+            telemetry.counter(
+                "hvd_coord_elections_total",
+                "Coordinator re-elections after lease expiry").inc()
+            print(f"hvdrun: coordinator lease expired (host {dead} "
+                  f"gone); elected host {self.coordinator_host} as "
+                  f"coordinator epoch={self.epoch}",
+                  file=sys.stderr, flush=True)
+        telemetry.gauge(
+            "hvd_coord_epoch",
+            "Coordinator lease epoch (bumps on each re-election)"
+        ).set(float(self.epoch))
+        return hosts.promote_host(usable, self.coordinator_host)
+
+    def env(self) -> dict:
+        """Per-attempt env injection: ranks stamp control messages with
+        the epoch and surface it in stall reports."""
+        return {"HOROVOD_COORD_EPOCH": str(self.epoch),
+                "HOROVOD_COORD_RANK": "0",
+                "HOROVOD_COORD_ELECTIONS": str(self.elections)}
 
 
 class _MetricsCollector:

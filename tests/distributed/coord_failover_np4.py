@@ -1,0 +1,102 @@
+"""Coordinator-failover gate workload (run: hvdrun -np 4
+-H 127.0.1.1:2,localhost:2 --elastic-restarts 1 --min-np 2, fake ssh —
+see tests/test_chaos.py::test_chaos_coordinator_host_death_reelects).
+
+Attempt 0 (np=4, coordinator host = 127.0.1.1): guarded training
+commits + spills every step; both ranks on the COORDINATOR's host
+(ranks 0 and 1) SIGKILL themselves right after committing step
+``CRASH_AT - 1`` — the whole host is gone, taking the rendezvous
+master and the lease holder with it.
+
+The launcher must blame the host, demote it, notice the coordinator
+lease can no longer be renewed, and run the deterministic election:
+the first surviving host (localhost) is promoted to the front, its
+first slot becomes the new rank 0, and the epoch bumps to 1.
+
+Attempt 1 (np=2 on the survivor): every rank sees the new epoch via
+:func:`horovod_tpu.coordinator`, warm-restores from the surviving PEER
+SPILL at the last committed step (no disk checkpoint exists at all —
+only the spill can explain a resume), applies the 4 -> 2 elastic
+continuity policy, and trains to the exact final state an
+uninterrupted run produces.  No full-job abort anywhere.
+"""
+import os
+import signal
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import resilience, telemetry
+
+hvd.init()
+rank, size = hvd.rank(), hvd.size()
+attempt = os.environ.get("HOROVOD_RESTART_ATTEMPT", "0")
+TOTAL = 8
+CRASH_AT = 5     # the coordinator host dies after committing step 4
+
+coord = hvd.coordinator()
+if attempt == "0":
+    assert size == 4, f"expected full world of 4, got {size}"
+    assert (coord.rank, coord.epoch, coord.elections) == (0, 0, 0), coord
+else:
+    # The acceptance assertions: the lease expired, exactly one election
+    # ran, and the promoted host's first slot is the new rank 0.
+    assert size == 2, f"expected surviving world of 2, got {size}"
+    assert coord.epoch == 1, f"expected lease epoch 1, got {coord}"
+    assert coord.elections == 1, coord
+    assert coord.rank == 0, coord
+
+params = {"w": np.zeros(4, np.float32)}
+opt_state = {"m": np.zeros(4, np.float32)}
+guard = resilience.StepGuard(policy="rollback", nan_burst=1,
+                             snapshot_interval=1, sentinel_interval=0)
+
+params, opt_state, committed, source, extra = resilience.warm_restore(
+    params, opt_state)
+start = committed + 1
+
+if attempt == "0":
+    assert (source, start) == ("fresh", 0), (source, start)
+else:
+    # Peer-spill recovery on the new epoch: there is NO disk checkpoint
+    # in this workload, so a non-zero resume can only come from the
+    # surviving host's spill of the last committed step.
+    assert source == "spill", \
+        f"expected peer-spill recovery, got {source!r}"
+    assert committed == CRASH_AT - 1, \
+        f"expected committed step {CRASH_AT - 1}, got {committed}"
+    # World-size-change continuity: launcher injected PREV_SIZE=4.
+    prev, lr_scale, accum = hvd.elastic_transition(policy="lr_scale")
+    assert (prev, lr_scale, accum) == (4, 0.5, 1), (prev, lr_scale, accum)
+
+for step in range(start, TOTAL):
+    # Every rank contributes the same value, so the allreduce mean — and
+    # therefore the final w — is identical at np=4 and np=2.
+    g = np.full(4, float(step), np.float32)
+    params = {"w": params["w"] + np.asarray(
+        hvd.allreduce(g, name=f"coord.{step}"))}
+    params, opt_state, ev = guard.after_step(params, opt_state, step, 0.1)
+    assert ev.action == "ok", f"rank {rank} step {step}: {ev}"
+    if attempt == "0" and rank < 2 and step + 1 == CRASH_AT:
+        # Kill the WHOLE coordinator host (both its slots) after the
+        # commit+spill of step 4: the survivors' spill now holds the
+        # newest committed state, and nothing is left to renew the
+        # lease.  The brief sleep lets the survivors finish folding
+        # step 4's verdict before their control sockets die.
+        time.sleep(0.5)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+want = float(sum(range(TOTAL)))
+np.testing.assert_allclose(params["w"], np.full(4, want), rtol=1e-6)
+
+if telemetry.enabled() and attempt == "1":
+    snap = hvd.metrics_snapshot()
+    # The rank-side epoch gauge must agree with the launcher's story.
+    fam = snap.get("hvd_coord_epoch") or {}
+    vals = [e.get("value") for e in fam.get("values", [])]
+    assert vals == [1.0], fam
+
+print(f"COORD_OK attempt={attempt} rank={rank} size={size} "
+      f"epoch={coord.epoch} source={source} committed={committed}",
+      flush=True)

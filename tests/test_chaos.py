@@ -347,6 +347,98 @@ def test_chaos_warm_restart_recovers_from_peer_spill(tmp_path):
     assert "WARM_OK attempt=0" not in res.stdout, out
 
 
+def test_chaos_coordinator_host_death_reelects(tmp_path):
+    """The resilient-control-plane acceptance scenario (ISSUE 16): both
+    ranks on the COORDINATOR's host SIGKILL themselves after committing
+    step 4 — the rendezvous master and the lease holder die together.
+    The launcher must demote the host, expire the lease, run the
+    deterministic election (the surviving host is promoted, its first
+    slot becomes the new rank 0, epoch 0 -> 1), and warm-restart the
+    survivors from peer spill.  One election, no full-job abort, and the
+    merged metrics summary must count it."""
+    import json
+
+    metrics = tmp_path / "metrics.json"
+    workload = os.path.join(REPO, "tests", "distributed",
+                            "coord_failover_np4.py")
+    res = _hvdrun(
+        ["-np", "4", "-H", "127.0.1.1:2,localhost:2",
+         "--elastic-restarts", "1", "--min-np", "2",
+         "--metrics-file", str(metrics),
+         sys.executable, workload],
+        env={"HOROVOD_SSH_CMD": str(_fake_ssh(tmp_path))})
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out
+    # Launcher-side story: host blamed, lease expired, election ran.
+    assert "blacklisting host 127.0.1.1" in res.stderr, out
+    assert ("coordinator lease expired (host 127.0.1.1 gone); elected "
+            "host localhost as coordinator epoch=1") in res.stderr, out
+    assert "smaller world: 2/4" in res.stderr, out
+    # Workload-side story: the new epoch reached every rank and the
+    # peer spill carried the committed state across the failover.
+    assert ("COORD_OK attempt=1 rank=0 size=2 epoch=1 source=spill "
+            "committed=4") in res.stdout, out
+    assert "COORD_OK attempt=0" not in res.stdout, out
+    # Telemetry story: the election is visible in the merged summary.
+    doc = json.loads(metrics.read_text())
+    assert doc["schema"] == "horovod_tpu.metrics.summary.v1", doc
+    from horovod_tpu.telemetry import aggregate
+    assert aggregate.counter_total(
+        doc["merged"], "hvd_coord_elections_total") >= 1, doc["merged"]
+    assert aggregate.counter_total(
+        doc["launcher"]["metrics"], "hvd_coord_elections_total") == 1
+
+
+def test_chaos_tree_coordination_two_host_matrix(tmp_path):
+    """Tree coordination end to end (ISSUE 16 tentpole, native half):
+    an np=4 job across two (fake-ssh) hosts with HOROVOD_COORD_TREE=1
+    must wire members to their host leader and leaders to the master,
+    report tree mode active on every rank, and produce bit-identical
+    collective results — including cache-hit steady state and a
+    shutdown negotiated through the tree."""
+    script = tmp_path / "tree.py"
+    script.write_text(textwrap.dedent("""\
+        import numpy as np
+        import horovod_tpu as hvd
+
+        hvd.init()
+        rank, size = hvd.rank(), hvd.size()
+        assert size == 4, size
+        from horovod_tpu import basics
+        rt = basics.runtime()
+        assert rt is not None and rt.coord_tree_enabled(), \\
+            f"rank {rank}: tree coordination did not engage"
+        # Repeated named collectives: the second pass rides the
+        # response cache, whose bit-announcements now traverse the
+        # member -> leader -> master aggregation path.
+        for step in range(3):
+            out = np.asarray(hvd.allreduce(
+                np.full(8, float(rank + 1), np.float32),
+                average=False, name="tree.sum"))
+            np.testing.assert_allclose(out, np.full(8, 10.0))
+            gathered = np.asarray(hvd.allgather(
+                np.full((1, 2), float(rank), np.float32),
+                name="tree.gather"))
+            np.testing.assert_allclose(
+                gathered, np.repeat(np.arange(4.0, dtype=np.float32),
+                                    2).reshape(4, 2))
+        root = np.asarray(hvd.broadcast(
+            np.full(4, float(rank), np.float32), root_rank=2,
+            name="tree.bcast"))
+        np.testing.assert_allclose(root, np.full(4, 2.0))
+        print(f"TREE_OK rank={rank}", flush=True)
+    """))
+    res = _hvdrun(
+        ["-np", "4", "-H", "127.0.1.1:2,localhost:2",
+         sys.executable, str(script)],
+        env={"HOROVOD_SSH_CMD": str(_fake_ssh(tmp_path)),
+             "HOROVOD_COORD_TREE": "1"})
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out
+    for r in range(4):
+        assert f"TREE_OK rank={r}" in res.stdout, out
+
+
 def test_chaos_heartbeat_drop_triggers_proactive_restart(tmp_path):
     """The health plane's dead-worker path: rank 1's heartbeats are
     chaos-dropped after the first few, so nothing but the launcher-side

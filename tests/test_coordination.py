@@ -1,0 +1,285 @@
+"""Unit tests for the control-plane protocol primitives
+(horovod_tpu/coordination.py): tree plan shape, lease semantics,
+election safety, (epoch, seq) dedup, retry policy bounds and the
+partition detector's dead-vs-partitioned verdicts.  Protocol *episodes*
+(many nodes + chaos) live in tests/test_coordsim.py."""
+
+import math
+
+import pytest
+
+from horovod_tpu import coordination as co
+
+
+# -- TreePlan ----------------------------------------------------------------
+
+def test_tree_plan_leaders_and_membership():
+    plan = co.TreePlan([4, 4, 4])
+    assert plan.leaders == [0, 4, 8]
+    assert plan.leader_of(6) == 4
+    assert plan.members_of(4) == [5, 6, 7]
+    assert plan.is_leader(8) and not plan.is_leader(9)
+
+
+def test_tree_plan_parent_child_symmetry():
+    plan = co.TreePlan([2] * 11, arity=4)
+    for rank in range(plan.size):
+        p = plan.parent_of(rank)
+        if p is None:
+            assert rank == 0
+        else:
+            assert rank in plan.children_of(p)
+
+
+def test_tree_plan_fan_in_sublinear_vs_flat():
+    plan = co.TreePlan([8] * 32, arity=4)   # 256 ranks
+    assert co.TreePlan.flat_fan_in(plan.size) == 255
+    # arity child leaders + 7 host members bounds every node.
+    assert plan.max_fan_in() <= plan.arity + 8 - 1
+    assert plan.depth() <= 1 + math.ceil(math.log(32, 4)) + 1
+
+
+def test_tree_plan_from_topology_string():
+    plan = co.TreePlan.from_topology_string("h1:2,h2:2,h3:4")
+    assert plan.slot_sizes == (2, 2, 4)
+    assert plan.leaders == [0, 2, 4]
+
+
+def test_tree_plan_rejects_bad_shapes():
+    with pytest.raises(ValueError):
+        co.TreePlan([])
+    with pytest.raises(ValueError):
+        co.TreePlan([2, 0])
+    with pytest.raises(ValueError):
+        co.TreePlan([2], arity=1)
+
+
+# -- LeaseState --------------------------------------------------------------
+
+def test_lease_renewal_and_expiry():
+    lease = co.LeaseState(10.0, holder=0, now=0.0)
+    assert not lease.expired(9.9)
+    assert lease.expired(10.0)
+    assert lease.renew(8.0)
+    assert not lease.expired(17.9)
+    assert lease.renewals == 1
+
+
+def test_lease_discards_stale_epoch_adopts_newer():
+    lease = co.LeaseState(10.0, holder=0, epoch=2, now=0.0)
+    assert not lease.renew(5.0, holder=9, epoch=1)   # stale: discarded
+    assert lease.holder == 0 and lease.epoch == 2
+    assert lease.renew(5.0, holder=4, epoch=3)       # newer: adopted
+    assert lease.holder == 4 and lease.epoch == 3
+
+
+# -- election ----------------------------------------------------------------
+
+def test_elect_lowest_healthy_leader():
+    assert co.elect([8, 16, 24]) == 8
+    with pytest.raises(RuntimeError):
+        co.elect([])
+
+
+def test_election_single_vote_per_epoch():
+    e = co.Election(node=16, n_leaders=5)
+    assert e.consider_vote(1, candidate=8) == 8
+    # Re-grant to the same candidate is idempotent; any other candidate
+    # is refused — even a lower one, else two majorities could overlap.
+    assert e.consider_vote(1, candidate=8) == 8
+    assert e.consider_vote(1, candidate=0) is None
+    assert e.consider_vote(2, candidate=0) == 0     # fresh epoch: fresh vote
+
+
+def test_election_majority_quorum_fires_once():
+    e = co.Election(node=8, n_leaders=5)
+    assert e.quorum() == 3
+    assert not e.record_vote(1, voter=8)
+    assert not e.record_vote(1, voter=16)
+    assert e.record_vote(1, voter=24)        # third vote completes quorum
+    assert not e.record_vote(1, voter=32)    # later votes do not re-fire
+
+
+def test_no_two_disjoint_majorities():
+    # 5 leaders, each votes once in epoch 1: however the votes land, at
+    # most one candidate can reach quorum(3).
+    leaders = [0, 8, 16, 24, 32]
+    voters = {r: co.Election(r, 5) for r in leaders}
+    tally = {0: 0, 8: 0}
+    for r, vote_for in zip(leaders, [0, 8, 0, 8, 0]):
+        got = voters[r].consider_vote(1, vote_for)
+        if got is not None:
+            tally[got] += 1
+    assert sum(1 for v in tally.values() if v >= 3) <= 1
+
+
+# -- DedupFilter -------------------------------------------------------------
+
+def test_dedup_replay_and_stale_epoch():
+    d = co.DedupFilter()
+    assert d.accept(src=1, epoch=0, seq=1)
+    assert not d.accept(src=1, epoch=0, seq=1)       # replay
+    assert d.accept(src=1, epoch=0, seq=2)
+    d.advance_epoch(1)
+    assert not d.accept(src=1, epoch=0, seq=3)       # dead epoch
+    assert d.accept(src=1, epoch=1, seq=1)           # seqs restart per epoch
+    assert d.dropped_dup == 1 and d.dropped_stale == 1
+
+
+def test_dedup_newer_epoch_auto_advances():
+    d = co.DedupFilter()
+    assert d.accept(src=1, epoch=2, seq=1)
+    assert d.epoch == 2
+    assert not d.accept(src=1, epoch=1, seq=99)
+
+
+def test_dedup_window_is_bounded():
+    d = co.DedupFilter(window=8)
+    for seq in range(1, 100):
+        assert d.accept(src=1, epoch=0, seq=seq)
+    assert len(d._seen[1]) <= 8
+    assert not d.accept(src=1, epoch=0, seq=5)       # below the floor
+
+
+# -- RetryPolicy -------------------------------------------------------------
+
+def test_retry_backoff_is_jittered_exponential():
+    rp = co.RetryPolicy(retries=4, base_delay=0.2, max_delay=3.0,
+                        deadline=10.0)
+    lo = rp.backoff(0, rng=lambda: 0.0)
+    hi = rp.backoff(0, rng=lambda: 0.999)
+    assert 0.1 <= lo < hi < 0.3
+    # The cap binds for large attempts.
+    assert rp.backoff(10, rng=lambda: 0.999) <= 3.0 * 1.5
+
+
+def test_retry_give_up_on_attempts_or_deadline():
+    rp = co.RetryPolicy(retries=2, deadline=5.0)
+    assert not rp.give_up(2, 1.0)
+    assert rp.give_up(3, 1.0)        # attempts exhausted
+    assert rp.give_up(0, 5.0)        # total deadline reached
+
+
+# -- PartitionDetector -------------------------------------------------------
+
+def test_partition_verdicts():
+    d = co.PartitionDetector(grace=5.0, peers=[1, 2, 3, 4],
+                             coordinator=0, now=0.0)
+    assert d.verdict(1.0) == d.HEALTHY
+    # Coordinator silent, majority of peers alive: elect.
+    for p in (1, 2, 3):
+        d.observe(p, True, 6.0)
+    assert d.verdict(8.0) == d.COORDINATOR_DEAD
+    # Everyone silent: we are the partitioned side.
+    assert d.verdict(20.0) == d.PARTITIONED
+
+
+def test_partition_recent_contact_excludes_own_host():
+    d = co.PartitionDetector(grace=5.0, peers=[1, 8], coordinator=0,
+                             now=0.0)
+    d.observe(1, True, 10.0)
+    assert d.recent_contact(12.0)
+    # Rank 1 is on our own host: contact with it proves nothing about
+    # the network — the fence check must exclude it.
+    assert not d.recent_contact(12.0, exclude=[0, 1])
+    d.observe(8, True, 12.0)
+    assert d.recent_contact(13.0, exclude=[0, 1])
+
+
+# -- runner.rpc control wire -------------------------------------------------
+
+def test_connect_with_retry_total_deadline_caps_elapsed():
+    """Regression: per-dial retries alone never bounded the call — five
+    30 s dials against a black-holed address plus backoff could stall a
+    coordination step for minutes.  The total deadline must cut in."""
+    from horovod_tpu.runner import rpc
+
+    fake_now = [0.0]
+
+    def clock():
+        return fake_now[0]
+
+    def sleep(secs):
+        fake_now[0] += secs
+
+    dials = []
+
+    def failing_dial(addr_port, timeout=None):
+        dials.append(timeout)
+        fake_now[0] += timeout         # each dial burns its full timeout
+        raise OSError("black hole")
+
+    import socket as socket_mod
+    orig = socket_mod.create_connection
+    socket_mod.create_connection = failing_dial
+    try:
+        with pytest.raises(ConnectionError) as ei:
+            rpc.connect_with_retry("10.255.255.1", 1, timeout=30.0,
+                                   retries=100, deadline=45.0,
+                                   sleep=sleep, rng=lambda: 0.5,
+                                   clock=clock)
+    finally:
+        socket_mod.create_connection = orig
+    assert fake_now[0] <= 45.0 + 30.0        # bounded, not 100 * 30 s
+    assert len(dials) <= 3
+    # The last dial's socket timeout was clipped to the remaining budget.
+    assert dials[-1] <= 45.0
+    assert "within 45.0s" in str(ei.value)
+
+
+def test_connect_with_retry_deadline_default_registered():
+    from horovod_tpu import config
+    assert config.env_float("HOROVOD_RPC_CONNECT_DEADLINE") == 60.0
+
+
+def test_control_call_retries_and_counts(monkeypatch):
+    """control_call retransmits the whole (epoch, seq)-stamped request
+    with backoff and counts each retransmit."""
+    from horovod_tpu import telemetry
+    from horovod_tpu.runner import rpc
+
+    key = b"k"
+    seen = []
+
+    def handler(req):
+        seen.append((req["epoch"], req["seq"]))
+        return {"ok": True}
+
+    server = rpc.RpcServer(key, handler, bind="127.0.0.1")
+    try:
+        calls = {"n": 0}
+        orig_connect = rpc.connect_with_retry
+
+        def flaky_connect(*args, **kwargs):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("first attempt eaten")
+            return orig_connect(*args, **kwargs)
+
+        monkeypatch.setattr(rpc, "connect_with_retry", flaky_connect)
+        telemetry.configure(enabled_flag=True)
+        telemetry.registry().clear()
+        resp = rpc.control_call("127.0.0.1", server.port,
+                                {"kind": "renew"}, key,
+                                epoch=3, seq=7, sleep=lambda s: None)
+        assert resp == {"ok": True}
+        assert seen == [(3, 7)]
+        from horovod_tpu.telemetry import aggregate
+        snap = telemetry.metrics_snapshot()
+        assert aggregate.counter_total(
+            snap, "hvd_coord_msg_retries_total", {"kind": "renew"}) == 1
+    finally:
+        telemetry.configure(enabled_flag=False)
+        telemetry.registry().clear()
+        server.shutdown()
+
+
+def test_control_call_gives_up_within_deadline():
+    from horovod_tpu.runner import rpc
+    fake_now = [0.0]
+    with pytest.raises(ConnectionError, match="kind=renew"):
+        rpc.control_call(
+            "127.0.0.1", 9, {"kind": "renew"}, b"k",
+            retries=2, deadline=5.0, timeout=0.1,
+            sleep=lambda s: fake_now.__setitem__(0, fake_now[0] + s),
+            clock=lambda: fake_now[0])

@@ -1,0 +1,203 @@
+"""Protocol-level episodes over tools/coordsim: the fast CI lane that
+asserts the ISSUE-16 invariants before the protocol touches a real job.
+
+* **Safety** — at most one coordinator commits per epoch, under every
+  ``faults.py`` control chaos kind.
+* **Shape** — the busiest tree node's per-tick fan-in stays sub-linear
+  while the flat star's coordinator ingests O(N) (measured, not
+  asserted from the plan).
+* **Liveness** — agreement converges within a bounded number of virtual
+  ticks under 10% drop, dup storms, partitions and coordinator crash.
+
+Everything is deterministic: fixed seeds, virtual clock, no sleeps.
+"""
+
+import pytest
+
+from horovod_tpu.coordination import RetryPolicy
+from tools.coordsim.sim import Simulation, hosts_for
+
+
+def assert_safety(sim):
+    """The headline invariant: never two coordinators committing in
+    one epoch."""
+    per_epoch = sim.coordinators_per_epoch()
+    assert all(len(coords) == 1 for coords in per_epoch.values()), per_epoch
+    return per_epoch
+
+
+# -- layout helper -----------------------------------------------------------
+
+def test_hosts_for_layout():
+    assert hosts_for(64, 8) == [8] * 8
+    assert hosts_for(20, 8) == [8, 8, 4]
+    assert hosts_for(4, 8) == [4]
+
+
+# -- shape: tree fan-in sub-linear vs flat -----------------------------------
+
+@pytest.mark.parametrize("n", [8, 64, 256])
+def test_tree_converges_healthy(n):
+    sim = Simulation(n, tree=True, seed=1)
+    stats = sim.run(100)
+    assert_safety(sim)
+    assert stats["min_applied_round"] >= 10
+    assert stats["elections"] == 0 and not stats["fenced"]
+
+
+def test_tree_fan_in_sublinear_vs_flat_at_256():
+    tree = Simulation(256, tree=True, seed=2).run(100)
+    flat = Simulation(256, tree=False, seed=2).run(100)
+    # Measured, not planned: the flat coordinator ingests every rank's
+    # READY in one tick; the tree's busiest node stays near arity+slots.
+    assert flat["observed_coord_fan_in"] == 255
+    assert tree["observed_max_fan_in"] <= 24
+    assert tree["observed_max_fan_in"] * 8 < flat["observed_coord_fan_in"]
+    assert tree["min_applied_round"] >= 10   # sub-linear but still live
+
+
+# -- liveness under probabilistic chaos --------------------------------------
+
+def test_converges_under_10pct_drop():
+    sim = Simulation(64, tree=True, seed=3, drop_rate=0.10)
+    stats = sim.run(160)
+    assert_safety(sim)
+    # Bounded-tick convergence: the ISSUE asks for progress under 10%
+    # drop, not progress equal to the clean run.
+    assert stats["min_applied_round"] >= 12
+    assert not stats["fenced"]
+    assert stats["net"]["dropped"] > 100    # chaos actually happened
+
+
+def test_dup_storm_absorbed_by_dedup():
+    sim = Simulation(64, tree=True, seed=4, dup_rate=0.5)
+    stats = sim.run(120)
+    assert_safety(sim)
+    assert stats["min_applied_round"] >= 12
+    dups_dropped = sum(n.dedup.dropped_dup for n in sim.nodes.values())
+    assert dups_dropped > 1000              # the filter did the absorbing
+
+
+def test_reorder_delay_tolerated():
+    sim = Simulation(64, tree=True, seed=5, max_extra_delay=3.0)
+    stats = sim.run(140)
+    assert_safety(sim)
+    assert stats["min_applied_round"] >= 10
+
+
+# -- partitions --------------------------------------------------------------
+
+def test_short_partition_heals_without_fence():
+    sim = Simulation(64, tree=True, seed=6)
+    sim.net.partition_host(3, 20.0)
+    stats = sim.run(120)
+    assert_safety(sim)
+    assert stats["min_applied_round"] >= 10
+    assert not stats["fenced"]
+
+
+def test_long_partition_fences_exactly_the_cut_leader():
+    sim = Simulation(64, tree=True, seed=7)
+    sim.net.partition_host(3, 1e9)
+    for _ in range(60):
+        sim.step()
+    # The partitioned host's leader (rank 24) self-fences — the rc-75
+    # analog — and nobody else does: no cascade, no split-brain.
+    assert sorted(r for r, n in sim.nodes.items() if n.fenced) == [24]
+    # The launcher's follow-up (blacklist + world shrink) resumes the
+    # survivors.
+    sim.kill_host(3)
+    for _ in range(80):
+        sim.step()
+    stats = sim.stats()
+    assert_safety(sim)
+    assert stats["min_applied_round"] >= 10
+    assert stats["fenced"] == [24]
+
+
+# -- coordinator crash: lease expiry -> election -> new epoch ----------------
+
+def test_coord_crash_elects_new_epoch():
+    sim = Simulation(64, tree=True, seed=8,
+                     chaos_spec="site=control,kind=coord_crash,after=12")
+    stats = sim.run(200)
+    per_epoch = assert_safety(sim)
+    assert stats["elections"] >= 1
+    assert max(per_epoch) >= 1                       # a new epoch committed
+    post = {h for e, c in per_epoch.items() if e > 0 for h in c}
+    assert post and 0 not in post                    # by a new coordinator
+    assert per_epoch[max(per_epoch)] == {8}          # lowest healthy leader
+    assert stats["min_applied_round"] >= 10          # training resumed
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_coord_crash_plus_drop_safety_sweep(seed):
+    sim = Simulation(64, tree=True, seed=seed, drop_rate=0.05,
+                     chaos_spec="site=control,kind=coord_crash,after=15")
+    stats = sim.run(240)
+    per_epoch = assert_safety(sim)
+    assert stats["elections"] >= 1 and max(per_epoch) >= 1
+    assert 0 not in {h for e, c in per_epoch.items() if e > 0 for h in c}
+    assert stats["min_applied_round"] >= 10
+
+
+# -- faults.py control kinds on the virtual wire -----------------------------
+
+@pytest.mark.parametrize("spec,stat,min_rounds", [
+    ("site=control,kind=msg_drop:40,after=5", "dropped", 10),
+    ("site=control,kind=msg_dup:40,after=5", "duped", 10),
+    # No count on msg_delay = every message +2.5 ticks, forever: rounds
+    # stretch but agreement never stops.
+    ("site=control,kind=msg_delay:2500", "delayed", 4),
+])
+def test_chaos_spec_kinds_fire_and_stay_safe(spec, stat, min_rounds):
+    sim = Simulation(64, tree=True, seed=9, chaos_spec=spec)
+    stats = sim.run(160)
+    assert_safety(sim)
+    assert stats["min_applied_round"] >= min_rounds
+    assert stats["net"][stat] >= (1 if stat == "delayed" else 40)
+
+
+def test_chaos_spec_partition_kind():
+    sim = Simulation(64, tree=True, seed=10,
+                     chaos_spec="site=control,kind=partition:20,"
+                                "after=30,rank=24")
+    stats = sim.run(160)
+    assert_safety(sim)
+    assert stats["min_applied_round"] >= 10
+    assert stats["net"]["partition_blocked"] > 0
+
+
+# -- protocol details --------------------------------------------------------
+
+def test_flat_mode_is_the_reference_star():
+    sim = Simulation(16, tree=False, seed=11)
+    stats = sim.run(60)
+    assert len(sim.plan.leaders) == 1
+    assert stats["observed_coord_fan_in"] == 15
+    assert_safety(sim)
+
+
+def test_retry_exhaustion_is_not_fatal_while_coordinator_lives():
+    # A stuck round must not silence followers forever: RENEW carriers
+    # keep resetting the round's retransmit budget, so the coordinator
+    # never mistakes a slow round for a partition.
+    sim = Simulation(64, tree=True, seed=12,
+                     retry=RetryPolicy(retries=4, deadline=30.0))
+    sim.net.partition_host(7, 20.0)          # rounds stall until t=20
+    stats = sim.run(160)
+    assert_safety(sim)
+    # With retries=4 the stalled round would exhaust its budget in ~5
+    # ticks; the coordinator's RENEWs keep resetting it, so nobody
+    # fences during the 20-tick stall and agreement resumes after.
+    assert not stats["fenced"]
+    assert stats["min_applied_round"] >= 10  # resumed after the heal
+
+
+def test_stale_epoch_messages_are_discarded():
+    sim = Simulation(64, tree=True, seed=13,
+                     chaos_spec="site=control,kind=coord_crash,after=12")
+    sim.run(200)
+    stale = sum(n.dedup.dropped_stale for n in sim.nodes.values())
+    assert stale >= 1       # old-epoch traffic existed and died at dedup
+    assert_safety(sim)

@@ -1,0 +1,148 @@
+"""Virtual clock and chaos-injecting virtual network for coordsim.
+
+The network is a priority queue of (deliver_at, msg) pairs.  Chaos is
+applied at *send* time, in two composable layers:
+
+* a seeded probabilistic layer (``drop_rate`` / ``dup_rate`` /
+  ``max_extra_delay``) for statistical episodes like "converge under
+  10% drop" — deterministic for a fixed seed;
+* the ``faults.py`` rule layer (site ``control``): parsed
+  ``HOROVOD_FAULT_SPEC`` rules whose ``msg_drop`` / ``msg_dup`` /
+  ``msg_delay`` / ``partition`` / ``coord_crash`` kinds fire with the
+  exact hit-counting semantics the live RPC path uses, so a chaos spec
+  exercised in simulation means the same thing against a real job.
+
+``Date``-free and ``random``-module-free: all randomness flows through
+one ``random.Random(seed)`` instance owned by the caller.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import random
+from typing import Dict, List, Optional, Tuple
+
+from horovod_tpu import faults
+from horovod_tpu.coordination import Msg
+
+
+class VirtualClock:
+    """Monotone injected clock; one tick is the simulated cycle time."""
+
+    def __init__(self, tick_seconds: float = 1.0):
+        self.tick_seconds = tick_seconds
+        self.now = 0.0
+        self.ticks = 0
+
+    def advance(self) -> float:
+        self.ticks += 1
+        self.now = self.ticks * self.tick_seconds
+        return self.now
+
+
+class VirtualNetwork:
+    """In-memory message fabric between simulated ranks."""
+
+    def __init__(self, rng: random.Random, *,
+                 latency_ticks: float = 1.0,
+                 drop_rate: float = 0.0,
+                 dup_rate: float = 0.0,
+                 max_extra_delay: float = 0.0,
+                 control_rules: Optional[List[faults.FaultRule]] = None,
+                 host_of: Optional[Dict[int, int]] = None):
+        self.rng = rng
+        self.latency = latency_ticks
+        self.drop_rate = drop_rate
+        self.dup_rate = dup_rate
+        self.max_extra_delay = max_extra_delay
+        self.rules = control_rules or []
+        self.host_of = host_of or {}
+        self._q: List[Tuple[float, int, Msg]] = []
+        self._tiebreak = itertools.count()
+        self._partitioned_until: Dict[int, float] = {}   # host -> heal time
+        self.stats = {"sent": 0, "dropped": 0, "duped": 0, "delayed": 0,
+                      "partition_blocked": 0}
+
+    # -- chaos -------------------------------------------------------------
+
+    def _partitioned(self, rank: int, now: float) -> bool:
+        host = self.host_of.get(rank)
+        return (host is not None
+                and now < self._partitioned_until.get(host, -1.0))
+
+    def partition_host(self, host: int, until: float) -> None:
+        self._partitioned_until[host] = until
+
+    def _fire_rules(self, msg: Msg, now: float) -> Optional[str]:
+        """Arm control-kind rules against this send; returns a terminal
+        verdict ('drop') or None.  Non-terminal kinds mutate state."""
+        verdict = None
+        for rule in self.rules:
+            # coord_crash is node-fatal, polled per tick by Simulation —
+            # arming it here would burn its firing budget on a send.
+            if rule.kind not in faults.CONTROL_KINDS or \
+                    rule.kind == "coord_crash":
+                continue
+            if not rule.arm("control", msg.src):
+                continue
+            if rule.kind == "msg_drop":
+                self.stats["dropped"] += 1
+                verdict = "drop"
+            elif rule.kind == "msg_dup":
+                self.stats["duped"] += 1
+                self._enqueue(msg, now + self.latency
+                              + self.rng.random() * self.latency)
+            elif rule.kind == "msg_delay":
+                extra = (float(rule.arg) / 1000.0 if rule.arg is not None
+                         else self.latency)
+                self.stats["delayed"] += 1
+                self._enqueue(msg, now + self.latency + extra)
+                verdict = "drop"   # the delayed copy is the delivery
+            elif rule.kind == "partition":
+                host = self.host_of.get(msg.src, 0)
+                secs = float(rule.arg) if rule.arg is not None else 5.0
+                self.partition_host(host, now + secs)
+            # coord_crash is node-fatal, not a wire kind: the Simulation
+            # polls it once per tick (see sim.Simulation._poll_chaos).
+        return verdict
+
+    # -- send / deliver ----------------------------------------------------
+
+    def _enqueue(self, msg: Msg, at: float) -> None:
+        heapq.heappush(self._q, (at, next(self._tiebreak), msg))
+
+    def send(self, msg: Msg, now: float) -> None:
+        self.stats["sent"] += 1
+        if self._partitioned(msg.src, now) or self._partitioned(msg.dst, now):
+            self.stats["partition_blocked"] += 1
+            return
+        if self._fire_rules(msg, now) == "drop":
+            return
+        if self.drop_rate and self.rng.random() < self.drop_rate:
+            self.stats["dropped"] += 1
+            return
+        at = now + self.latency
+        if self.max_extra_delay and self.rng.random() < 0.25:
+            at += self.rng.random() * self.max_extra_delay
+            self.stats["delayed"] += 1
+        self._enqueue(msg, at)
+        if self.dup_rate and self.rng.random() < self.dup_rate:
+            self.stats["duped"] += 1
+            self._enqueue(msg, at + self.rng.random() * self.latency)
+
+    def deliveries(self, now: float) -> List[Msg]:
+        """Pop every message whose delivery time has arrived, respecting
+        partitions still active at delivery time."""
+        out: List[Msg] = []
+        while self._q and self._q[0][0] <= now:
+            _, _, msg = heapq.heappop(self._q)
+            if self._partitioned(msg.dst, now) or \
+                    self._partitioned(msg.src, now):
+                self.stats["partition_blocked"] += 1
+                continue
+            out.append(msg)
+        return out
+
+    def pending(self) -> int:
+        return len(self._q)

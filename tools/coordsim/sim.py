@@ -1,0 +1,176 @@
+"""The coordsim episode driver.
+
+One :class:`Simulation` holds N :class:`horovod_tpu.coordination.Node`
+instances wired through a :class:`tools.coordsim.net.VirtualNetwork`.
+Each virtual tick it (1) polls node-fatal chaos (``coord_crash``),
+(2) delivers due messages, (3) ticks every live node, and (4) records
+per-tick fan-in stats.  Everything is deterministic for a fixed seed.
+
+Flat mode (``tree=False``) is the reference baseline: one host with N
+slots, so every rank is a direct child of the coordinator and the
+coordinator's fan-in is N-1 — the O(world) shape ROADMAP item 3 calls
+the binding constraint.  Tree mode groups ranks host-major and stacks a
+k-ary leader tree on top, bounding any node's fan-in by
+``arity + slots - 1``.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Sequence, Set
+
+from horovod_tpu import faults
+from horovod_tpu.coordination import Commit, Node, RetryPolicy, TreePlan
+from tools.coordsim.net import VirtualClock, VirtualNetwork
+
+
+def hosts_for(n: int, slots: int = 8) -> List[int]:
+    """Host-major slot layout for N simulated ranks (last host ragged)."""
+    sizes = [slots] * (n // slots)
+    if n % slots:
+        sizes.append(n % slots)
+    return sizes or [0]
+
+
+class Simulation:
+    """One deterministic protocol episode."""
+
+    def __init__(self, n: int, *, tree: bool = True, slots: int = 8,
+                 arity: int = 4, lease_term: float = 8.0,
+                 seed: int = 0, drop_rate: float = 0.0,
+                 dup_rate: float = 0.0, max_extra_delay: float = 0.0,
+                 chaos_spec: str = "",
+                 retry: Optional[RetryPolicy] = None):
+        slot_sizes = hosts_for(n, slots) if tree else [n]
+        self.plan = TreePlan(slot_sizes, arity=arity)
+        self.clock = VirtualClock()
+        self.rng = random.Random(seed)
+        rules = faults.parse_spec(chaos_spec) if chaos_spec else []
+        host_of = {}
+        base = 0
+        for h, s in enumerate(slot_sizes):
+            for r in range(base, base + s):
+                host_of[r] = h
+            base += s
+        self.host_of = host_of
+        self.net = VirtualNetwork(
+            self.rng, drop_rate=drop_rate, dup_rate=dup_rate,
+            max_extra_delay=max_extra_delay, control_rules=rules,
+            host_of=host_of)
+        self.rules = rules
+        retry = retry or RetryPolicy(retries=64, deadline=1e9)
+        self.nodes: Dict[int, Node] = {
+            r: Node(r, self.plan, lease_term, retry=retry)
+            for r in range(self.plan.size)}
+        self.dead_hosts: Set[int] = set()
+        # Per-tick fan-in record: max messages any single node ingested.
+        self.fan_in_per_tick: List[int] = []
+        self.coord_fan_in_per_tick: List[int] = []
+
+    # -- chaos helpers -----------------------------------------------------
+
+    def current_coordinator(self) -> Optional[int]:
+        """The coordinator by live consensus: the holder most commonly
+        believed in by live, unfenced nodes."""
+        votes: Dict[int, int] = {}
+        for node in self.nodes.values():
+            if node.alive and not node.fenced:
+                votes[node.lease.holder] = votes.get(node.lease.holder,
+                                                    0) + 1
+        return max(votes, key=votes.get) if votes else None
+
+    def kill_host(self, host: int) -> None:
+        """SIGKILL analog for a whole host, plus the launcher's follow-up:
+        surviving nodes' expected world shrinks to the live gang."""
+        self.dead_hosts.add(host)
+        dead = {r for r, h in self.host_of.items() if h in self.dead_hosts}
+        live = {r for r in self.nodes if r not in dead}
+        for r in self.nodes:
+            if self.host_of[r] in self.dead_hosts:
+                self.nodes[r].alive = False
+        for r in live:
+            self.nodes[r].set_expected_world(live)
+
+    def _poll_chaos(self) -> None:
+        for rule in self.rules:
+            if rule.kind != "coord_crash":
+                continue
+            if rule.arm("control", None):
+                coord = self.current_coordinator()
+                if coord is not None:
+                    self.kill_host(self.host_of[coord])
+
+    # -- the loop ----------------------------------------------------------
+
+    def step(self) -> None:
+        now = self.clock.advance()
+        self._poll_chaos()
+        inbox: Dict[int, List] = {}
+        for msg in self.net.deliveries(now):
+            inbox.setdefault(msg.dst, []).append(msg)
+        fan_in = {r: len(msgs) for r, msgs in inbox.items()}
+        self.fan_in_per_tick.append(max(fan_in.values(), default=0))
+        coord = self.current_coordinator()
+        self.coord_fan_in_per_tick.append(
+            fan_in.get(coord, 0) if coord is not None else 0)
+        for dst, msgs in inbox.items():
+            node = self.nodes.get(dst)
+            if node is None or not node.alive:
+                continue
+            for msg in msgs:
+                for reply in node.on_message(msg, now):
+                    self.net.send(reply, now)
+        for node in self.nodes.values():
+            for msg in node.tick(now):
+                self.net.send(msg, now)
+
+    def run(self, ticks: int) -> dict:
+        for _ in range(ticks):
+            self.step()
+        return self.stats()
+
+    # -- results -----------------------------------------------------------
+
+    def all_commits(self) -> List[Commit]:
+        out: List[Commit] = []
+        for node in self.nodes.values():
+            out.extend(node.committed_as_coord)
+        return out
+
+    def coordinators_per_epoch(self) -> Dict[int, Set[int]]:
+        by_epoch: Dict[int, Set[int]] = {}
+        for c in self.all_commits():
+            by_epoch.setdefault(c.epoch, set()).add(c.coordinator)
+        return by_epoch
+
+    def min_applied_round(self) -> int:
+        """The furthest round every live, unfenced node has applied —
+        the convergence measure (rounds complete gang-wide)."""
+        rounds = [n.round for n in self.nodes.values()
+                  if n.alive and not n.fenced]
+        return min(rounds) if rounds else 0
+
+    def elections_total(self) -> int:
+        return sum(n.election.elections_started
+                   for n in self.nodes.values())
+
+    def stats(self) -> dict:
+        live = [n for n in self.nodes.values() if n.alive]
+        return {
+            "n": self.plan.size,
+            "ticks": self.clock.ticks,
+            "tree_depth": self.plan.depth(),
+            "planned_max_fan_in": self.plan.max_fan_in(),
+            "flat_fan_in": TreePlan.flat_fan_in(self.plan.size),
+            "observed_max_fan_in": max(self.fan_in_per_tick, default=0),
+            "observed_coord_fan_in": max(self.coord_fan_in_per_tick,
+                                         default=0),
+            "min_applied_round": self.min_applied_round(),
+            "commits": len(self.all_commits()),
+            "epochs": sorted(self.coordinators_per_epoch()),
+            "elections": self.elections_total(),
+            "fenced": sorted(r for r, n in self.nodes.items() if n.fenced),
+            "dead_hosts": sorted(self.dead_hosts),
+            "live_nodes": len(live),
+            "net": dict(self.net.stats),
+        }
