@@ -152,6 +152,53 @@ JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
 python tools/check_metrics.py "$METRICS_DIR/metrics.json" 2
 rm -rf "$METRICS_DIR"
 
+echo "--- distributed-tracing gate (2 ranks): merged skew-corrected
+--- Perfetto trace with cross-rank trace_id correlation, critical-path
+--- straggler report, and the disabled-path no-write negative
+--- (docs/timeline.md, 'Distributed tracing')"
+TRACE_DIR="$(mktemp -d)"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  python -m horovod_tpu.runner -np 2 --trace "$TRACE_DIR" \
+  python tests/distributed/trace_workload_np2.py
+PYTHONPATH="$PWD" python - "$TRACE_DIR" <<'EOF'
+import importlib, json, sys
+d = sys.argv[1]
+spans_mod = importlib.import_module("horovod_tpu.telemetry.spans")
+doc = json.load(open(f"{d}/trace.json"))          # merged trace loads
+by_tid = {}
+for ev in doc["traceEvents"]:
+    if ev.get("ph") != "X":
+        continue
+    tid = (ev.get("args") or {}).get("trace_id")
+    if tid:
+        by_tid.setdefault(tid, set()).add(ev["pid"])
+# every named collective correlates across BOTH ranks by trace_id
+for name in [f"trace.step{i}" for i in range(5)] + ["trace.gather"]:
+    tid = spans_mod.trace_id(name, 0)
+    assert by_tid.get(tid) == {0, 1}, \
+        f"{name}: ranks {by_tid.get(tid)} (want both)"
+cp = json.load(open(f"{d}/critical_path.json"))
+assert cp["ranks"] == [0, 1] and cp["steps"] >= 6, cp["steps"]
+assert cp["attribution"], "no straggler attribution rows"
+print(f"TRACE_GATE_OK correlated={len(by_tid)} steps={cp['steps']}")
+EOF
+# offline analyzer re-derives the report and names a rank and a phase
+PYTHONPATH="$PWD" python -m tools.hvdtrace "$TRACE_DIR" \
+  | tee "$TRACE_DIR/report.txt"
+grep -q "slowest rank:" "$TRACE_DIR/report.txt"
+grep -Eq "rank [0-9]+ / (submit|negotiate|fuse|local|cross|wait):" \
+  "$TRACE_DIR/report.txt"
+# negative: without --trace the recorder must stay off and no span
+# file may appear (the workload asserts the recorder is None itself)
+NEG_DIR="$(mktemp -d)"
+(cd "$NEG_DIR" && JAX_PLATFORMS=cpu PYTHONPATH="$OLDPWD" \
+  python -m horovod_tpu.runner -np 2 \
+  python "$OLDPWD/tests/distributed/trace_workload_np2.py")
+if ls "$NEG_DIR"/spans.rank*.json 2>/dev/null; then
+  echo "span files written without --trace"; exit 1
+fi
+rm -rf "$TRACE_DIR" "$NEG_DIR"
+
 echo "--- online-autotune gate (2 ranks): Bayesian explorer pins, the
 --- drift detector re-opens after a 128x payload shift, the cache hit
 --- ratio climbs, and the merged summary carries the hvd_autotune_*

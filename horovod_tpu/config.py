@@ -201,6 +201,20 @@ _var("HOROVOD_METRICS_RPC", "str", None,
      "hvdrun)")
 _var("HOROVOD_EAGER_TIMELINE", "str", None,
      "Chrome-tracing JSON path for the eager-plane timeline")
+_var("HOROVOD_TRACE", "bool", False,
+     "1 turns cross-rank distributed tracing on (set by hvdrun --trace)",
+     native=True)
+_var("HOROVOD_TRACE_DIR", "str", None,
+     "Directory for the per-rank span-log file fallback "
+     "(spans.rank<k>.json)")
+_var("HOROVOD_TRACE_RPC", "str", None,
+     "launcher host:port span documents are pushed to (set by hvdrun)")
+_var("HOROVOD_TRACE_SAMPLE", "int", 1,
+     "Trace 1-in-N collective occurrences (1 = every one); pure in the "
+     "occurrence index, so sampling stays rank-consistent", native=True)
+_var("HOROVOD_TRACE_BUFFER", "int", 65536,
+     "Per-rank span buffer capacity; overflow drops spans and counts "
+     "hvd_trace_spans_dropped_total", native=True)
 _var("HOROVOD_TIMELINE", "str", "",
      "Native coordinator timeline path (rank 0)", native=True)
 _var("HOROVOD_TIMELINE_MARK_CYCLES", "bool", False,

@@ -80,6 +80,29 @@ int64_t hvd_hier_ag_local_bytes();
 int64_t hvd_hier_ag_cross_bytes();
 int64_t hvd_hier_ag_ops();
 
+// Distributed tracing (HOROVOD_TRACE; trace.h).  Fixed-size span record
+// mirrored by ctypes in native/runtime.py — 72 bytes of char arrays then
+// four int64s, no padding.  (name, seq) is the cross-rank correlation
+// key: the schedule contract makes the per-name occurrence index
+// identical on every rank, so the Python exporter derives the same
+// trace_id everywhere with zero wire changes.
+typedef struct {
+  char name[56];
+  char phase[16];
+  int64_t seq;
+  int64_t start_us;   // steady_clock microseconds (CLOCK_MONOTONIC —
+  int64_t end_us;     // same domain as Python's time.monotonic())
+  int64_t bytes;
+} hvd_trace_span_t;
+
+// 1 while HOROVOD_TRACE span recording is latched on (set at init).
+int hvd_trace_enabled();
+// Copy up to `max` buffered spans into `dst` (FIFO); returns the count.
+// Drained by the Python watchdog thread and at shutdown.
+int32_t hvd_trace_drain(hvd_trace_span_t* dst, int32_t max);
+// Spans dropped at the HOROVOD_TRACE_BUFFER capacity bound (monotonic).
+int64_t hvd_trace_dropped();
+
 // Enqueue a collective.  `shape` has `ndim` dims (scalar: ndim=0).
 // `arg` = reduce-op code (allreduce/reducescatter) or root rank (broadcast).
 // `splits`/`nsplits`: alltoall only — dim-0 rows sent to each destination

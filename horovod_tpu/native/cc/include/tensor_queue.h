@@ -94,6 +94,12 @@ struct TensorTableEntry {
   int64_t output_count = 0;
   Status status;
   bool done = false;
+
+  // Distributed tracing (trace.h): the per-name occurrence index that
+  // halves the cross-rank correlation key, and the enqueue timestamp
+  // that starts the negotiate span.  -1 = tracing off / sampled out.
+  int64_t trace_seq = -1;
+  int64_t trace_enqueued_us = 0;
 };
 
 using EntryPtr = std::shared_ptr<TensorTableEntry>;

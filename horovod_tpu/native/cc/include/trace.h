@@ -1,0 +1,82 @@
+// Native half of the distributed span tracer (HOROVOD_TRACE).
+//
+// The Python recorder (telemetry/spans.py) correlates collectives across
+// ranks by (tensor name, per-name occurrence index) — a pair the schedule
+// contract makes identical on every rank without any wire change.  This
+// module gives the background thread the same stream: TensorQueue::Add
+// stamps each entry with NextSeq(name) + an enqueue timestamp, the
+// execution path records negotiate/fuse spans against that seq, and the
+// data plane attributes its per-level transport phases (local_rs /
+// cross_ring / local_ag) to the op the background thread is currently
+// executing (thread-local context — exactly one response executes at a
+// time, so one slot suffices).
+//
+// Records are fixed-size PODs in a bounded, mutex-guarded buffer; Python
+// drains them through hvd_trace_drain (c_api.h) from the watchdog thread
+// and at shutdown, converting steady_clock microseconds to the same
+// CLOCK_MONOTONIC domain time.monotonic() reads.  Disabled cost: one
+// relaxed atomic load per call site (Enabled()), nothing else.
+#ifndef HVD_TRACE_H
+#define HVD_TRACE_H
+
+#include <stdint.h>
+
+namespace hvd {
+namespace trace {
+
+// Mirrored by ctypes in native/runtime.py and by hvd_trace_span_t in
+// c_api.h — keep the three layouts in sync (no padding: 72 bytes of
+// char arrays, then four int64s).
+struct Span {
+  char name[56];    // tensor / batch name, NUL-terminated, truncated
+  char phase[16];   // negotiate | fuse | local_rs | cross_ring | ...
+  int64_t seq;      // per-name occurrence index (trace-id half)
+  int64_t start_us; // steady_clock since epoch, microseconds
+  int64_t end_us;
+  int64_t bytes;    // payload attributed to this span (0 = n/a)
+};
+
+// Latch HOROVOD_TRACE / HOROVOD_TRACE_SAMPLE / HOROVOD_TRACE_BUFFER and
+// reset the buffer + counters; called from the background thread's init
+// (re-init safe for elastic restarts).
+void Configure();
+
+// One relaxed atomic load — the guard every hook tests first.
+bool Enabled();
+
+// Record occurrence `seq`?  seq % HOROVOD_TRACE_SAMPLE == 0, the same
+// pure-of-the-index rule the Python recorder applies, so sampling never
+// desynchronizes ranks.
+bool Sampled(int64_t seq);
+
+// Allocate the next occurrence index for `name` (0-based; counts every
+// occurrence regardless of sampling, mirroring SpanRecorder.next_seq).
+int64_t NextSeq(const char* name);
+
+// steady_clock time since epoch in microseconds (CLOCK_MONOTONIC on
+// Linux — directly comparable with Python's time.monotonic()).
+int64_t NowUs();
+
+// Append a span (no-op when disabled, sampled out, or full — overflow
+// increments the dropped counter instead of blocking).
+void Record(const char* name, const char* phase, int64_t seq,
+            int64_t start_us, int64_t end_us, int64_t bytes);
+
+// Current-op context for the data plane's phase spans.  Only the
+// background thread sets/clears it (around data-plane calls in
+// ExecuteResponse); thread-local, so a future multi-executor refactor
+// stays correct per thread.
+void SetCurrentOp(const char* name, int64_t seq);
+void ClearCurrentOp();
+bool CurrentOp(const char** name, int64_t* seq);
+
+// Drain up to `max` spans into `dst`; returns the count (FIFO).
+int32_t Drain(Span* dst, int32_t max);
+
+// Spans dropped at the capacity bound since Configure().
+int64_t Dropped();
+
+}  // namespace trace
+}  // namespace hvd
+
+#endif  // HVD_TRACE_H

@@ -9,6 +9,7 @@
 #include <cstring>
 
 #include "auth.h"
+#include "trace.h"
 
 namespace hvd {
 
@@ -665,9 +666,21 @@ Status DataPlane::Allreduce(void* buf, int64_t count, DataType dtype,
         std::memory_order_relaxed);
     flat_allreduce_ops_.fetch_add(1, std::memory_order_relaxed);
   }
+  // Flat ring: one "cross" span for the whole wire exchange, attributed
+  // to the op the background thread is executing (trace.h current-op
+  // context, set around the data-plane call in ExecuteResponse).
+  const int64_t trace_t0 = trace::Enabled() ? trace::NowUs() : 0;
   Status st = RingReduceScatterPhase(group, buf, count, dtype, op);
   if (!st.ok()) return st;
-  return RingAllgatherPhase(group, buf, count, dtype);
+  st = RingAllgatherPhase(group, buf, count, dtype);
+  if (trace::Enabled()) {
+    const char* nm;
+    int64_t sq;
+    if (trace::CurrentOp(&nm, &sq))
+      trace::Record(nm, "cross", sq, trace_t0, trace::NowUs(),
+                    count * static_cast<int64_t>(DataTypeSize(dtype)));
+  }
+  return st;
 }
 
 // 2-level allreduce (reference NCCLHierarchicalAllreduce structure,
@@ -732,6 +745,25 @@ Status DataPlane::HierarchicalAllreduce(void* buf, int64_t count,
                            std::memory_order_relaxed);
   hier_cross_us_.fetch_add(us(t2 - t1), std::memory_order_relaxed);
   hier_allreduce_ops_.fetch_add(1, std::memory_order_relaxed);
+  // Per-level transport spans from the timestamps already taken above:
+  // the merged trace shows exactly which level a straggler lost time in.
+  if (trace::Enabled()) {
+    const char* nm;
+    int64_t sq;
+    if (trace::CurrentOp(&nm, &sq)) {
+      auto abs_us = [](clk::time_point t) {
+        return std::chrono::duration_cast<std::chrono::microseconds>(
+                   t.time_since_epoch())
+            .count();
+      };
+      trace::Record(nm, "local_rs", sq, abs_us(t0), abs_us(t1),
+                    count * esize);
+      trace::Record(nm, "cross_ring", sq, abs_us(t1), abs_us(t2),
+                    ccount > 0 ? ccount * esize : 0);
+      trace::Record(nm, "local_ag", sq, abs_us(t2), abs_us(t3),
+                    count * esize);
+    }
+  }
   return st;
 }
 

@@ -22,6 +22,7 @@
 #include "response_cache.h"
 #include "tensor_queue.h"
 #include "timeline.h"
+#include "trace.h"
 
 namespace hvd {
 namespace {
@@ -196,6 +197,18 @@ void ParticipateJoined(const Response& resp) {
 int64_t ExecuteResponse(const Response& resp) {
   auto entries = g->queue.TakeEntries(resp);
   for (auto& e : entries) g->timeline.NegotiateEnd(e->name);
+  // Distributed tracing: the negotiate span covers enqueue -> response
+  // arrival (coordination wait); transport phases are recorded deeper in
+  // the data plane under the current-op context set per branch below.
+  const bool tracing = trace::Enabled();
+  if (tracing) {
+    const int64_t neg_end = trace::NowUs();
+    for (auto& e : entries)
+      if (e->trace_seq >= 0)
+        trace::Record(e->name.c_str(), "negotiate", e->trace_seq,
+                      e->trace_enqueued_us, neg_end,
+                      e->count * static_cast<int64_t>(DataTypeSize(e->dtype)));
+  }
   // Seed large outputs from the warm-buffer pool before the per-op
   // resize_uninit: recycled pages skip the kernel zero-page fault that
   // dominates fresh multi-MB allocations (tensor_queue.h).  The size
@@ -292,6 +305,8 @@ int64_t ExecuteResponse(const Response& resp) {
         std::memcpy(e->output.data(), e->input, e->output.size());
         e->output_count = e->count;
         g->timeline.ActivityStart(e->name, "TCP_ALLREDUCE");
+        if (tracing && e->trace_seq >= 0)
+          trace::SetCurrentOp(e->name.c_str(), e->trace_seq);
         if (rop == ReduceOp::kAdasum)
           // Real Adasum (scaled-projection butterfly, data_plane.cc);
           // never fused — the projection is per-TENSOR, and Fuse()
@@ -301,6 +316,7 @@ int64_t ExecuteResponse(const Response& resp) {
         else
           st = g->data_plane.Allreduce(e->output.data(), e->count,
                                        resp.dtype, rop, *group);
+        trace::ClearCurrentOp();
         g->timeline.ActivityEnd(e->name);
         g->timeline.End(e->name);
       } else {
@@ -319,6 +335,14 @@ int64_t ExecuteResponse(const Response& resp) {
           total += static_cast<size_t>(d) * esz;
         if (g->fusion_buffer.size() < total) g->fusion_buffer.resize(total);
         char* buf = g->fusion_buffer.data();
+        // Fuse/transport spans for the whole bucket are booked under one
+        // sampled-in anchor entry: the batch shares a single wire
+        // exchange, so per-member spans would double-count it.
+        TensorTableEntry* anchor = nullptr;
+        if (tracing)
+          for (auto& e : entries)
+            if (e->trace_seq >= 0) { anchor = e.get(); break; }
+        const int64_t fuse_in_t0 = anchor ? trace::NowUs() : 0;
         size_t off = 0;
         for (size_t i = 0; i < resp.names.size(); ++i) {
           size_t nbytes = static_cast<size_t>(resp.first_dims[i]) * esz;
@@ -333,6 +357,12 @@ int64_t ExecuteResponse(const Response& resp) {
             std::memset(buf + off, 0, nbytes);
           }
           off += nbytes;
+        }
+        if (anchor) {
+          trace::Record(anchor->name.c_str(), "fuse", anchor->trace_seq,
+                        fuse_in_t0, trace::NowUs(),
+                        static_cast<int64_t>(total));
+          trace::SetCurrentOp(anchor->name.c_str(), anchor->trace_seq);
         }
         if (!entries.empty())
           g->timeline.ActivityStart(entries[0]->name, "TCP_ALLREDUCE");
@@ -349,7 +379,9 @@ int64_t ExecuteResponse(const Response& resp) {
               buf, static_cast<int64_t>(total / esz), resp.dtype, rop,
               *group);
         }
+        trace::ClearCurrentOp();
         if (!entries.empty()) g->timeline.ActivityEnd(entries[0]->name);
+        const int64_t fuse_out_t0 = anchor ? trace::NowUs() : 0;
         off = 0;
         for (size_t i = 0; i < resp.names.size(); ++i) {
           size_t nbytes = static_cast<size_t>(resp.first_dims[i]) * esz;
@@ -364,6 +396,10 @@ int64_t ExecuteResponse(const Response& resp) {
           }
           off += nbytes;
         }
+        if (anchor)
+          trace::Record(anchor->name.c_str(), "fuse", anchor->trace_seq,
+                        fuse_out_t0, trace::NowUs(),
+                        static_cast<int64_t>(total));
       }
       break;
     }
@@ -382,8 +418,15 @@ int64_t ExecuteResponse(const Response& resp) {
       e->output.resize_uninit(static_cast<size_t>(total_elems) * esz);
       e->output_count = total_elems;
       g->timeline.ActivityStart(e->name, "TCP_ALLGATHER");
-      st = g->data_plane.Allgather(e->input, e->output.data(), counts,
-                                   *group);
+      {
+        const int64_t tt0 = tracing ? trace::NowUs() : 0;
+        st = g->data_plane.Allgather(e->input, e->output.data(), counts,
+                                     *group);
+        if (tracing && e->trace_seq >= 0)
+          trace::Record(e->name.c_str(), "cross", e->trace_seq, tt0,
+                        trace::NowUs(),
+                        total_elems * static_cast<int64_t>(esz));
+      }
       g->timeline.ActivityEnd(e->name);
       g->timeline.End(e->name);
       break;
@@ -395,8 +438,15 @@ int64_t ExecuteResponse(const Response& resp) {
       std::memcpy(e->output.data(), e->input, e->output.size());
       e->output_count = e->count;
       g->timeline.ActivityStart(e->name, "TCP_BROADCAST");
-      st = g->data_plane.Broadcast(e->output.data(), e->count, resp.dtype,
-                                   resp.arg, *group);
+      {
+        const int64_t tt0 = tracing ? trace::NowUs() : 0;
+        st = g->data_plane.Broadcast(e->output.data(), e->count, resp.dtype,
+                                     resp.arg, *group);
+        if (tracing && e->trace_seq >= 0)
+          trace::Record(e->name.c_str(), "cross", e->trace_seq, tt0,
+                        trace::NowUs(),
+                        e->count * static_cast<int64_t>(esz));
+      }
       g->timeline.ActivityEnd(e->name);
       g->timeline.End(e->name);
       break;
@@ -431,8 +481,13 @@ int64_t ExecuteResponse(const Response& resp) {
         e->output.resize_uninit(static_cast<size_t>(out_elems) * esz);
         e->output_count = out_elems;
         g->timeline.ActivityStart(e->name, "TCP_ALLTOALLV");
+        const int64_t tt0 = tracing ? trace::NowUs() : 0;
         st = g->data_plane.Alltoallv(e->input, e->output.data(), send_b,
                                      recv_b, *group);
+        if (tracing && e->trace_seq >= 0)
+          trace::Record(e->name.c_str(), "cross", e->trace_seq, tt0,
+                        trace::NowUs(),
+                        out_elems * static_cast<int64_t>(esz));
       } else {
         e->output.resize_uninit(static_cast<size_t>(e->count) * esz);
         e->output_count = e->count;
@@ -442,8 +497,13 @@ int64_t ExecuteResponse(const Response& resp) {
             trailing > 0 ? e->count / trailing / group_size : 0;
         e->recv_splits.assign(group_size, rows);
         g->timeline.ActivityStart(e->name, "TCP_ALLTOALL");
+        const int64_t tt0 = tracing ? trace::NowUs() : 0;
         st = g->data_plane.Alltoall(e->input, e->output.data(), e->count,
                                     resp.dtype, *group);
+        if (tracing && e->trace_seq >= 0)
+          trace::Record(e->name.c_str(), "cross", e->trace_seq, tt0,
+                        trace::NowUs(),
+                        e->count * static_cast<int64_t>(esz));
       }
       g->timeline.ActivityEnd(e->name);
       g->timeline.End(e->name);
@@ -456,9 +516,16 @@ int64_t ExecuteResponse(const Response& resp) {
       e->output.resize_uninit(static_cast<size_t>(out_count) * esz);
       e->output_count = out_count;
       g->timeline.ActivityStart(e->name, "TCP_REDUCESCATTER");
-      st = g->data_plane.Reducescatter(e->input, e->output.data(), e->count,
-                                       resp.dtype,
-                                       static_cast<ReduceOp>(resp.arg));
+      {
+        const int64_t tt0 = tracing ? trace::NowUs() : 0;
+        st = g->data_plane.Reducescatter(e->input, e->output.data(),
+                                         e->count, resp.dtype,
+                                         static_cast<ReduceOp>(resp.arg));
+        if (tracing && e->trace_seq >= 0)
+          trace::Record(e->name.c_str(), "cross", e->trace_seq, tt0,
+                        trace::NowUs(),
+                        e->count * static_cast<int64_t>(esz));
+      }
       g->timeline.ActivityEnd(e->name);
       g->timeline.End(e->name);
       break;
@@ -653,6 +720,9 @@ void BackgroundThread() {
                                 g->hierarchical_available,
                                 g->data_plane.chunk_bytes());
 
+  // Latch span recording before callers can enqueue (TensorQueue::Add
+  // reads trace::Enabled() the moment hvd_init returns).
+  trace::Configure();
   if (s.ok()) g->initialized.store(true);  // before the init_cv handshake:
   // the caller may enqueue the moment hvd_init returns.
   {
@@ -670,6 +740,10 @@ void BackgroundThread() {
   g->schedule_check.store(sched_check);
 
   bool shutdown_seen = false;
+  // Coordination-cycle index for tracing.  Cycle() is a lock-step
+  // exchange, so the index is identical on every rank — a valid
+  // cross-rank correlation key for the "coord" spans.
+  int64_t trace_cycle = 0;
   while (!shutdown_seen) {
     auto cycle_start = std::chrono::steady_clock::now();
     g->timeline.MarkCycleStart();
@@ -719,8 +793,17 @@ void BackgroundThread() {
     ResponseList responses;
     TunedParams tuned;
     if (g->autotune && g->rank == 0) tuned = g->param_manager.Current();
+    const int64_t coord_t0 = trace::Enabled() ? trace::NowUs() : 0;
     s = g->controller.Cycle(mine, &responses,
                             tuned.present ? &tuned : nullptr);
+    // One span per cycle that delivered work: the coordinator exchange
+    // itself (announce + verdict round trip).  Idle cycles are skipped —
+    // at a 1 ms cycle time they would flood the buffer with noise.
+    if (trace::Enabled() && s.ok() && !responses.responses.empty() &&
+        trace::Sampled(trace_cycle))
+      trace::Record("coord/cycle", "coord", trace_cycle, coord_t0,
+                    trace::NowUs(), 0);
+    ++trace_cycle;
     if (!s.ok()) {
       LOG(Error) << "controller cycle failed: " << s.reason;
       SetLastError(s.reason);

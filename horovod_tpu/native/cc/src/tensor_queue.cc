@@ -2,6 +2,8 @@
 
 #include <algorithm>
 
+#include "trace.h"
+
 namespace hvd {
 
 Status TensorQueue::Add(const EntryPtr& entry) {
@@ -13,6 +15,16 @@ Status TensorQueue::Add(const EntryPtr& entry) {
   if (by_name_.count(entry->name))
     return Status::Precondition(
         DuplicateNameError(entry->op_type, entry->name));
+  if (trace::Enabled()) {
+    // The occurrence counter ticks for EVERY accepted entry (sampled or
+    // not) so it stays aligned with the other ranks' streams; the seq is
+    // kept only when this occurrence samples in.
+    const int64_t seq = trace::NextSeq(entry->name.c_str());
+    if (trace::Sampled(seq)) {
+      entry->trace_seq = seq;
+      entry->trace_enqueued_us = trace::NowUs();
+    }
+  }
   entry->handle = next_handle_++;
   by_name_[entry->name] = entry;
   by_handle_[entry->handle] = entry;

@@ -1,0 +1,145 @@
+// Bounded native span buffer for the distributed tracer — see trace.h.
+#include "trace.h"
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+
+#include "hvd_common.h"
+
+namespace hvd {
+namespace trace {
+namespace {
+
+// Hot-path guards live outside the mutex: every instrumentation site
+// tests Enabled() (one relaxed load) before touching anything else.
+std::atomic<bool> g_enabled{false};
+std::atomic<int64_t> g_sample{1};
+std::atomic<int64_t> g_dropped{0};
+
+struct State {
+  std::mutex mu;
+  std::deque<Span> buf;           // FIFO: Record pushes back, Drain pops front
+  std::unordered_map<std::string, int64_t> seq;
+  size_t cap = 65536;
+};
+
+State& S() {
+  static State* s = new State();  // leaked like GlobalState: a framework
+  return *s;                      // thread may race process teardown
+}
+
+// Exactly one response executes at a time on the background thread, so a
+// single thread-local slot carries the op identity into the data plane.
+thread_local char tl_op_name[sizeof(Span().name)] = {0};
+thread_local int64_t tl_op_seq = -1;
+
+void CopyStr(char* dst, size_t cap, const char* src) {
+  std::strncpy(dst, src ? src : "", cap - 1);
+  dst[cap - 1] = '\0';
+}
+
+}  // namespace
+
+void Configure() {
+  State& s = S();
+  std::lock_guard<std::mutex> lk(s.mu);
+  s.buf.clear();
+  s.seq.clear();
+  g_dropped.store(0, std::memory_order_relaxed);
+  g_sample.store(std::max<int64_t>(EnvInt("HOROVOD_TRACE_SAMPLE", 1), 1),
+                 std::memory_order_relaxed);
+  s.cap = static_cast<size_t>(
+      std::max<int64_t>(EnvInt("HOROVOD_TRACE_BUFFER", 65536), 1024));
+  // Last: hooks may only observe enabled==true with the rest latched.
+  g_enabled.store(EnvBool("HOROVOD_TRACE", false),
+                  std::memory_order_release);
+}
+
+bool Enabled() { return g_enabled.load(std::memory_order_relaxed); }
+
+bool Sampled(int64_t seq) {
+  const int64_t n = g_sample.load(std::memory_order_relaxed);
+  return n <= 1 || (seq % n) == 0;
+}
+
+int64_t NextSeq(const char* name) {
+  State& s = S();
+  std::lock_guard<std::mutex> lk(s.mu);
+  return s.seq[name ? name : ""]++;
+}
+
+int64_t NowUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+void Record(const char* name, const char* phase, int64_t seq,
+            int64_t start_us, int64_t end_us, int64_t bytes) {
+  if (!Enabled() || !Sampled(seq)) return;
+  State& s = S();
+  std::lock_guard<std::mutex> lk(s.mu);
+  if (s.buf.size() >= s.cap) {
+    g_dropped.fetch_add(1, std::memory_order_relaxed);
+    return;
+  }
+  s.buf.emplace_back();
+  Span& sp = s.buf.back();
+  CopyStr(sp.name, sizeof(sp.name), name);
+  CopyStr(sp.phase, sizeof(sp.phase), phase);
+  sp.seq = seq;
+  sp.start_us = start_us;
+  sp.end_us = end_us;
+  sp.bytes = bytes;
+}
+
+void SetCurrentOp(const char* name, int64_t seq) {
+  CopyStr(tl_op_name, sizeof(tl_op_name), name);
+  tl_op_seq = seq;
+}
+
+void ClearCurrentOp() { tl_op_seq = -1; }
+
+bool CurrentOp(const char** name, int64_t* seq) {
+  if (tl_op_seq < 0) return false;
+  *name = tl_op_name;
+  *seq = tl_op_seq;
+  return true;
+}
+
+int32_t Drain(Span* dst, int32_t max) {
+  if (dst == nullptr || max <= 0) return 0;
+  State& s = S();
+  std::lock_guard<std::mutex> lk(s.mu);
+  const int32_t n = static_cast<int32_t>(
+      std::min<size_t>(s.buf.size(), static_cast<size_t>(max)));
+  for (int32_t i = 0; i < n; ++i) {
+    dst[i] = s.buf.front();
+    s.buf.pop_front();
+  }
+  return n;
+}
+
+int64_t Dropped() { return g_dropped.load(std::memory_order_relaxed); }
+
+}  // namespace trace
+}  // namespace hvd
+
+// C API (declared in c_api.h; exported via hvd.lds's hvd_* glob).
+extern "C" {
+
+int hvd_trace_enabled() { return hvd::trace::Enabled() ? 1 : 0; }
+
+int32_t hvd_trace_drain(hvd::trace::Span* dst, int32_t max) {
+  return hvd::trace::Drain(dst, max);
+}
+
+int64_t hvd_trace_dropped() { return hvd::trace::Dropped(); }
+
+}  // extern "C"

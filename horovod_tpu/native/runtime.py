@@ -30,6 +30,17 @@ _OP_NAMES = {0: "allreduce", 1: "allgather", 2: "broadcast", 3: "alltoall",
              4: "reducescatter", 5: "barrier", 6: "join", 7: "process_set"}
 
 
+class _TraceSpan(ctypes.Structure):
+    """Mirror of ``hvd_trace_span_t`` (c_api.h): 72 bytes of char arrays
+    followed by four int64s, no padding."""
+    _fields_ = [("name", ctypes.c_char * 56),
+                ("phase", ctypes.c_char * 16),
+                ("seq", ctypes.c_longlong),
+                ("start_us", ctypes.c_longlong),
+                ("end_us", ctypes.c_longlong),
+                ("bytes", ctypes.c_longlong)]
+
+
 class EagerStallError(RuntimeError):
     """An eager op outlived HOROVOD_EAGER_OP_TIMEOUT — the Python-boundary
     mirror of the native stall watchdog (reference ``stall_inspector.cc``):
@@ -232,6 +243,22 @@ class Runtime:
                 fn.restype = ctypes.c_longlong
                 self._hier_counter_fns[sym] = fn
         self._hier_published = {}   # sym -> last value already inc'd
+        # Distributed tracing (HOROVOD_TRACE): the native plane buffers
+        # its spans in C++ and Python drains them here (watchdog + stop).
+        self._trace_enabled_fn = getattr(lib, "hvd_trace_enabled", None)
+        self._trace_drain_fn = getattr(lib, "hvd_trace_drain", None)
+        if self._trace_drain_fn is not None:
+            self._trace_drain_fn.argtypes = [ctypes.POINTER(_TraceSpan),
+                                             ctypes.c_int]
+            self._trace_drain_fn.restype = ctypes.c_int
+        self._trace_dropped_fn = getattr(lib, "hvd_trace_dropped", None)
+        if self._trace_dropped_fn is not None:
+            self._trace_dropped_fn.restype = ctypes.c_longlong
+        self._trace_dropped_seen = 0
+        # The telemetry at-exit export can run before basics.shutdown()
+        # (atexit LIFO) — give it a hook to pull the native buffer while
+        # this runtime is still alive.
+        telemetry.register_span_flush_hook(self._drain_native_spans)
         port = config.env_int("HOROVOD_RENDEZVOUS_PORT", 0)
         rc = lib.hvd_init(self.rank, self.size, self.local_rank,
                           self.local_size, addr.encode(), port)
@@ -264,6 +291,8 @@ class Runtime:
             # Final gauge snapshot BEFORE shutdown zeroes the native state,
             # so the metrics summary records the config the job ended on.
             self._publish_autotune_gauges()
+            self._drain_native_spans()
+            telemetry.unregister_span_flush_hook(self._drain_native_spans)
             from horovod_tpu.ops import fusion as _fusion
             _fusion.set_live_threshold_provider(None)
             self._lib.hvd_shutdown()
@@ -420,6 +449,33 @@ class Runtime:
         ).set(1.0 if cfg.get("hier_allgather") else 0.0)
         self._publish_hier_metrics()
 
+    def _drain_native_spans(self) -> None:
+        """Move buffered native spans (trace.cc) into the Python span
+        recorder.  steady_clock and time.monotonic() share Linux's
+        CLOCK_MONOTONIC, so the native microsecond timestamps convert to
+        recorder seconds with a plain divide — no per-plane offset."""
+        sp = telemetry.spans()
+        if (sp is None or self._lib is None
+                or self._trace_drain_fn is None
+                or not (self._trace_enabled_fn
+                        and self._trace_enabled_fn())):
+            return
+        batch = (_TraceSpan * 256)()
+        while True:
+            n = self._trace_drain_fn(batch, 256)
+            for i in range(n):
+                s = batch[i]
+                sp.record(s.name.decode("utf-8", "replace"),
+                          s.phase.decode("utf-8", "replace"), int(s.seq),
+                          s.start_us / 1e6, s.end_us / 1e6, int(s.bytes))
+            if n < 256:
+                break
+        if self._trace_dropped_fn is not None:
+            d = int(self._trace_dropped_fn())
+            if d > self._trace_dropped_seen:
+                sp.dropped += d - self._trace_dropped_seen
+                self._trace_dropped_seen = d
+
     def _publish_schedule_check_metrics(self) -> None:
         """``hvd_schedule_check_*`` series (docs/metrics.md): whether the
         collective-schedule contract verifier is armed, how many
@@ -549,10 +605,21 @@ class Runtime:
         if h < 0:
             raise RuntimeError(self._lib.hvd_last_error().decode())
         t_enqueued = time.monotonic()
+        # Distributed tracing: the Python occurrence counter ticks once
+        # per submit, mirroring the native counter in TensorQueue::Add —
+        # same names in the same per-name order on both sides, so the
+        # (name, seq) correlation key lines up without a native readback.
+        sp = telemetry.spans()
+        trace_seq = sp.next_seq(name) if sp is not None else -1
+        if sp is not None:
+            sp.record(name, "submit", trace_seq, t_submit, t_enqueued,
+                      int(arr.nbytes))
         with self._inflight_lock:
-            # [buffer, name, submit time, last warn time, op kind, nbytes]
+            # [buffer, name, submit time, last warn time, op kind,
+            #  nbytes, trace seq]
             self._inflight[h] = [arr, name, t_enqueued, 0.0,
-                                 _OP_NAMES.get(op, str(op)), arr.nbytes]
+                                 _OP_NAMES.get(op, str(op)), arr.nbytes,
+                                 trace_seq]
         tl = telemetry.timeline()
         if tl is not None:
             tl.span(name, f"SUBMIT_{_OP_NAMES.get(op, str(op)).upper()}",
@@ -624,6 +691,7 @@ class Runtime:
             # watchdog is the one periodic thread the runtime already has.
             try:
                 self._publish_autotune_gauges()
+                self._drain_native_spans()
             except Exception:   # never let telemetry kill the watchdog
                 pass
             now = time.monotonic()
@@ -715,6 +783,9 @@ class Runtime:
             raise RuntimeError(err)
         if entry is not None:
             name, t0, nbytes = entry[1], entry[2], entry[5]
+            sp = telemetry.spans()
+            if sp is not None and len(entry) > 6 and entry[6] >= 0:
+                sp.record(name, "wait", entry[6], t_wait, t_done, nbytes)
             telemetry.observe_op(op_kind, max(t_done - t0, 1e-9), nbytes)
             if telemetry.enabled():
                 telemetry.histogram(

@@ -57,7 +57,8 @@ log = get_logger(__name__)
 def _tstart() -> float:
     """Timestamp ops only when some telemetry consumer exists — the
     disabled path must not even read the clock."""
-    if telemetry.enabled() or telemetry.timeline() is not None:
+    if (telemetry.enabled() or telemetry.timeline() is not None
+            or telemetry.spans() is not None):
         return telemetry.clock()
     return 0.0
 
@@ -71,6 +72,12 @@ def _record_local(kind: str, name: str, arr, t0: float) -> None:
     tl = telemetry.timeline()
     if tl is not None:
         tl.record_op(name, kind, t0, t1, t1, nbytes)
+    sp = telemetry.spans()
+    if sp is not None:
+        # Single-process execution: the whole op is one in-process span.
+        # The occurrence counter still ticks per name so repeated steps
+        # of the same tensor stay distinguishable in the merged trace.
+        sp.record(name, "exec", sp.next_seq(name), t0, t1, nbytes)
 
 
 # ---------------------------------------------------------------------------

@@ -182,10 +182,62 @@ def rpc_call(addr: str, port: int, request: Any, key: bytes,
     telemetry.counter("hvd_rpc_calls_total",
                       "Authenticated RPC round trips issued",
                       kind=kind).inc()
+    # Request-scoped span: the RPC plane's round trips show up in the
+    # merged trace next to the collectives they interleave with.  The
+    # time-sync probe is excluded — it runs during span export, and a
+    # span recorded mid-probe would land in some documents but not
+    # others depending on push-vs-file timing.
+    sp = telemetry.spans() if kind != "time_sync" else None
+    t0 = time.monotonic() if sp is not None else 0.0
     with connect_with_retry(addr, port, timeout=timeout,
                             retries=retries) as sock:
         _send_msg(sock, pickle.dumps(request), key)
-        return pickle.loads(_recv_msg(sock, key))
+        reply = pickle.loads(_recv_msg(sock, key))
+    if sp is not None:
+        sp.event(f"rpc/{kind}", "rpc", t0, time.monotonic())
+    return reply
+
+
+def time_sync_reply() -> dict:
+    """The server half of the time-sync handshake: collectors answer a
+    ``{"kind": "time_sync"}`` request with their monotonic clock read as
+    close to the reply as possible."""
+    return {"ok": True, "server_time": time.monotonic()}
+
+
+def measure_clock_offset(addr: str, port: int, key: bytes,
+                         samples: int = 5,
+                         timeout: float = 5.0) -> Optional[tuple]:
+    """Estimate this process's monotonic-clock offset against the
+    server at ``addr:port`` (Cristian's algorithm): each probe reads the
+    local clock before (t0) and after (t1) a ``time_sync`` round trip
+    and assumes the server stamped its clock at the midpoint, so
+    ``offset = server_time - (t0 + t1) / 2``.  The estimate from the
+    minimum-RTT probe wins — queueing delay only ever inflates RTT, so
+    the fastest round trip bounds the error tightest (error <= rtt/2).
+
+    Returns ``(offset_seconds, rtt_seconds)`` with offset =
+    server_clock - local_clock, or None when the server is unreachable
+    or does not answer the handshake (a pre-tracing launcher).  On one
+    host all ranks share CLOCK_MONOTONIC, so the offset is ~0 and the
+    result doubles as a sanity check on the estimator itself.
+    """
+    best: Optional[tuple] = None
+    for _ in range(max(samples, 1)):
+        t0 = time.monotonic()
+        try:
+            reply = rpc_call(addr, port, {"kind": "time_sync"}, key,
+                             timeout=timeout, retries=0)
+        except Exception:
+            continue
+        t1 = time.monotonic()
+        if not isinstance(reply, dict) or "server_time" not in reply:
+            return None   # collector predates the handshake; stop probing
+        rtt = t1 - t0
+        offset = float(reply["server_time"]) - (t0 + t1) / 2.0
+        if best is None or rtt < best[1]:
+            best = (offset, rtt)
+    return best
 
 
 def control_call(addr: str, port: int, request: dict, key: bytes,

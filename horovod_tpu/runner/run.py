@@ -152,6 +152,18 @@ def build_parser() -> argparse.ArgumentParser:
                               "HOROVOD_METRICS_FILE when set "
                               "(docs/metrics.md).")
 
+    tracing = p.add_argument_group("tracing")
+    tracing.add_argument("--trace", dest="trace_dir", default=None,
+                         metavar="DIR",
+                         help="Distributed tracing: every rank records "
+                              "per-collective spans (HOROVOD_TRACE) and "
+                              "the launcher merges them into DIR/"
+                              "trace.json (skew-corrected Perfetto/Chrome "
+                              "trace) plus DIR/critical_path.json with a "
+                              "straggler report. Defaults to "
+                              "HOROVOD_TRACE_DIR when set; sampling via "
+                              "HOROVOD_TRACE_SAMPLE (docs/timeline.md).")
+
     stall = p.add_argument_group("stall detection")
     stall.add_argument("--stall-check-time-seconds", type=float, default=None)
     stall.add_argument("--stall-shutdown-time-seconds", type=float,
@@ -250,6 +262,18 @@ def run_command(args) -> int:
         os.environ.pop("HOROVOD_METRICS_FILE", None)
         telemetry.configure(enabled_flag=True)
         collector = _MetricsCollector(extra_env["HOROVOD_SECRET_KEY"])
+    trace_dir = (getattr(args, "trace_dir", None) or
+                 config.env_str("HOROVOD_TRACE_DIR", "").strip() or
+                 None)
+    tracer = None
+    if trace_dir:
+        # The launcher must not record spans itself (it runs no
+        # collectives) — the env vars are injected per rank in
+        # _launch_once.  Telemetry is enabled so the critical-path
+        # gauges land in the launcher snapshot of --metrics-file.
+        os.environ.pop("HOROVOD_TRACE_DIR", None)
+        telemetry.configure(enabled_flag=True)
+        tracer = _TraceCollector(extra_env["HOROVOD_SECRET_KEY"])
     # Heartbeat health plane (docs/fault_tolerance.md "Warm restart"):
     # active only when an interval is configured, so launch paths (and
     # tests) that stub _launch_once keep their historical signature.
@@ -373,6 +397,9 @@ def run_command(args) -> int:
                    if collector is not None else {})
             if health is not None:
                 mkw["health"] = health
+            if tracer is not None:
+                mkw["trace_dir"] = trace_dir
+                mkw["tracer"] = tracer
             rc = _launch_once(args, infos, addr, extra_env, report=report,
                               **mkw)
             if rc == 0:
@@ -398,6 +425,16 @@ def run_command(args) -> int:
             health.shutdown()
         if owned_spill_dir is not None:
             shutil.rmtree(owned_spill_dir, ignore_errors=True)
+        if tracer is not None:
+            # BEFORE the metrics summary: publish_gauges lands the
+            # hvd_critical_path_* series in the launcher registry the
+            # summary snapshots.
+            try:
+                _write_trace_outputs(trace_dir, tracer, np_)
+            except OSError as e:
+                print(f"hvdrun: could not write trace outputs to "
+                      f"{trace_dir}: {e}", file=sys.stderr, flush=True)
+            tracer.shutdown()
         if collector is not None:
             try:
                 _write_metrics_summary(metrics_file, collector, np_, rc)
@@ -603,6 +640,46 @@ class _MetricsCollector:
             if isinstance(report, dict):
                 self.reports[str(report.get("rank", "?"))] = report
                 return {"ok": True}
+        if isinstance(req, dict) and req.get("kind") == "time_sync":
+            # Clock-skew handshake (rpc.measure_clock_offset): answered
+            # here too — hvd_clock_skew_seconds rides the metrics plane
+            # even when --trace is off.
+            from horovod_tpu.runner import rpc
+            return rpc.time_sync_reply()
+        return {"ok": False}
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+
+
+class _TraceCollector:
+    """Launcher-side sink for the ranks' at-exit span logs
+    (``hvdrun --trace``) plus the time-sync responder of the clock-skew
+    handshake.  Same authenticated RPC plane and rank-keyed overwrite
+    semantics as :class:`_MetricsCollector`; ranks whose push never
+    arrives fall back to their ``spans.rank<k>.json`` files."""
+
+    def __init__(self, secret: str):
+        from horovod_tpu.runner import rpc
+        self._rpc = rpc
+        self.reports: dict = {}
+        self._server = rpc.RpcServer(rpc.job_key_bytes(secret),
+                                     self._handle)
+
+    def _handle(self, req):
+        if isinstance(req, dict):
+            kind = req.get("kind")
+            if kind == "time_sync":
+                return self._rpc.time_sync_reply()
+            if kind == "trace_report":
+                report = req.get("report")
+                if isinstance(report, dict):
+                    self.reports[int(report.get("rank", 0))] = report
+                    return {"ok": True}
         return {"ok": False}
 
     @property
@@ -657,6 +734,58 @@ def _write_metrics_summary(path: str, collector: "_MetricsCollector",
     print(f"hvdrun: metrics summary ({len(ranks)}/{world_size} ranks"
           + (f"; missing {missing}" if missing else "")
           + f") written to {path}", file=sys.stderr, flush=True)
+    # Headline latency distribution: the merged eager-op histogram's
+    # estimated percentiles (aggregate.estimate_percentiles).
+    for entry in doc["merged"].get(
+            "hvd_eager_op_seconds", {}).get("values", []):
+        pct = entry.get("percentiles")
+        if pct:
+            op = (entry.get("labels") or {}).get("op", "?")
+            print(f"hvdrun: {op} latency estimate: " + "  ".join(
+                f"{q}={v * 1e3:.2f}ms" for q, v in sorted(pct.items())),
+                file=sys.stderr, flush=True)
+    # Per-rank clock offsets measured by the time-sync handshake — the
+    # operator-visible skew bound for cross-rank timeline comparison.
+    skew = doc["merged"].get("hvd_clock_skew_seconds", {})
+    for entry in skew.get("values", []):
+        print(f"hvdrun: rank clock skew vs launcher: "
+              f"min {entry.get('min', 0.0) * 1e3:.3f}ms / "
+              f"max {entry.get('max', 0.0) * 1e3:.3f}ms",
+              file=sys.stderr, flush=True)
+
+
+def _write_trace_outputs(dir_path: str, tracer: "_TraceCollector",
+                         world_size: int) -> None:
+    """Merge the collected span logs into ``DIR/trace.json`` (skew-
+    corrected Chrome/Perfetto trace), write the critical-path analysis
+    to ``DIR/critical_path.json``, mirror it into the launcher's
+    ``hvd_critical_path_*`` gauges, and print the straggler report."""
+    from horovod_tpu.telemetry import critical_path, trace_merge
+    reports = dict(tracer.reports)
+    for rank, doc in trace_merge.load_rank_docs(dir_path).items():
+        reports.setdefault(rank, doc)   # RPC push wins over the file
+    if not reports:
+        print(f"hvdrun: trace requested but no rank delivered a span "
+              f"log (dir {dir_path})", file=sys.stderr, flush=True)
+        return
+    os.makedirs(dir_path, exist_ok=True)
+    events = trace_merge.merge_span_docs(
+        reports[r] for r in sorted(reports))
+    merged_path = trace_merge.write_chrome(
+        events, os.path.join(dir_path, "trace.json"))
+    result = critical_path.analyze(reports)
+    cp_path = os.path.join(dir_path, "critical_path.json")
+    tmp = f"{cp_path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, cp_path)
+    critical_path.publish_gauges(result)
+    print(f"hvdrun: merged trace ({len(events)} events, "
+          f"{len(reports)}/{world_size} ranks) written to {merged_path}",
+          file=sys.stderr, flush=True)
+    print(critical_path.format_report(result), file=sys.stderr,
+          flush=True)
 
 
 def _demote_failed_hosts(blacklist, host_list, failed, min_np) -> None:
@@ -692,7 +821,8 @@ def _demote_failed_hosts(blacklist, host_list, failed, min_np) -> None:
 
 
 def _launch_once(args, infos, addr, extra_env, report=None,
-                 metrics_file=None, collector=None, health=None) -> int:
+                 metrics_file=None, collector=None, health=None,
+                 trace_dir=None, tracer=None) -> int:
     port = args.rendezvous_port or launch.find_free_port()
     if getattr(args, "jax_distributed", False):
         # The jax.distributed coordinator runs INSIDE rank 0 (unlike the
@@ -720,6 +850,14 @@ def _launch_once(args, infos, addr, extra_env, report=None,
             env["HOROVOD_METRICS_FILE"] = _per_rank_metrics_path(
                 metrics_file, info.rank)
             env["HOROVOD_METRICS_RPC"] = f"{addr}:{collector.port}"
+    if trace_dir and tracer is not None:
+        # Tracing rides its own env triple: the flag arms the recorders
+        # (Python + native), the RPC endpoint is the push/time-sync
+        # target, and the dir is each rank's file fallback.
+        for env in env_per_rank:
+            env["HOROVOD_TRACE"] = "1"
+            env["HOROVOD_TRACE_DIR"] = trace_dir
+            env["HOROVOD_TRACE_RPC"] = f"{addr}:{tracer.port}"
     watchdog = None
     if health is not None:
         for env in env_per_rank:

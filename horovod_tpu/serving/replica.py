@@ -145,6 +145,10 @@ class ReplicaWorker:
                 "Wall time of one batched decode step",
                 bounds=DECODE_TIME_BUCKETS).observe(
                 telemetry.clock() - t0)
+        sp = telemetry.spans()
+        if sp is not None:
+            sp.event(f"serving/decode.{self.replica_id}", "decode", t0,
+                     telemetry.clock())
         return {"ok": True, "generation": gen,
                 "tokens": {rid: tok for (rid, _, _), tok
                            in zip(seqs, tokens)}}
@@ -195,6 +199,8 @@ def broadcast_weights(weights, generation: int, root_rank: int = 0,
     may sit in it while their RPC threads keep serving decode steps.
     """
     import horovod_tpu as hvd
+    sp = telemetry.spans()
+    t0 = telemetry.clock() if sp is not None else 0.0
     gen = np.asarray([int(generation)], np.int64)
     gen = np.asarray(hvd.broadcast(gen, root_rank=root_rank,
                                    name=f"{name}.gen"))
@@ -202,6 +208,11 @@ def broadcast_weights(weights, generation: int, root_rank: int = 0,
     out = np.asarray(hvd.broadcast(
         np.asarray(weights, np.float32), root_rank=root_rank,
         name=f"{name}.g{live_gen}"))
+    if sp is not None:
+        # Umbrella span over the hot-update protocol; the per-collective
+        # spans of the two broadcasts nest under it in the merged trace.
+        sp.event(f"serving/weights.g{live_gen}", "broadcast", t0,
+                 telemetry.clock(), int(out.nbytes))
     return out, live_gen
 
 

@@ -319,11 +319,19 @@ class Router:
                 seqs = [(rid, batch[rid].last_token, batch[rid].pos)
                         for rid in sorted(batch)]
                 t0 = self._clock()
+                # Request-scoped trace span: the router->replica leg of a
+                # decode step, on the real monotonic clock (the injectable
+                # self._clock may be synthetic in tests).
+                sp = telemetry.spans()
+                t0m = time.monotonic() if sp is not None else 0.0
                 try:
                     resp = self.replicas[idx].decode(seqs)
                 except Exception as e:                # noqa: BLE001
                     self._failover(idx, e)
                     continue
+                if sp is not None:
+                    sp.event(f"serving/route.replica{idx}", "route",
+                             t0m, time.monotonic())
                 dt = max(0.0, self._clock() - t0)
                 self._step_ewma = dt if self._step_ewma == 0.0 else \
                     0.8 * self._step_ewma + 0.2 * dt
@@ -370,6 +378,15 @@ class Router:
                     "hvd_serving_latency_seconds",
                     "Submit-to-completion latency",
                     bounds=LATENCY_BUCKETS, tenant=tenant).observe(latency)
+                sp = telemetry.spans()
+                if sp is not None:
+                    # End-to-end request span, unique by request id.  The
+                    # end sits on the monotonic clock; the start is backed
+                    # off by the measured latency (exact whenever
+                    # self._clock IS time.monotonic, the production case).
+                    now_m = time.monotonic()
+                    sp.event(f"request/{rid}", "route", now_m - latency,
+                             now_m)
                 seq.handle.done.set()
         return n
 

@@ -55,6 +55,9 @@ clock = time.monotonic   # one clock for every duration metric + timeline
 
 _ENV_VARS = ("HOROVOD_METRICS", "HOROVOD_METRICS_PORT",
              "HOROVOD_METRICS_FILE", "HOROVOD_METRICS_RPC")
+# Span tracing (HOROVOD_TRACE / _DIR / _RPC) is configured alongside but
+# independently of metrics, like the eager timeline: telemetry.spans()
+# returns None when every trace variable is unset.
 
 
 class _Noop:
@@ -82,6 +85,8 @@ NOOP = _Noop()
 _registry = MetricsRegistry()
 _enabled = False
 _timeline = None          # EagerTimelineWriter or None
+_spans = None             # spans.SpanRecorder or None
+_span_flush_hooks = []    # callables draining foreign span buffers
 _http_server = None
 _configured = False
 
@@ -94,7 +99,7 @@ def _configure_from_env() -> None:
     """Resolve enablement and export paths from the environment.  Runs
     once at first import (i.e. before any instrumented op can fire);
     :func:`reset_for_tests` re-runs it after monkeypatching."""
-    global _enabled, _timeline, _http_server, _configured
+    global _enabled, _timeline, _http_server, _configured, _spans
     _configured = True
     # HOROVOD_METRICS is a boolean toggle ("0"/"false" disable); the
     # export-path variables enable whenever non-empty — including
@@ -118,20 +123,58 @@ def _configure_from_env() -> None:
             per_rank_path(tl_path),
             rank=int(os.environ.get("HOROVOD_RANK", "0") or 0))
 
+    if _spans is None:
+        # importlib, not ``from ... import spans``: the :func:`spans`
+        # accessor below shadows the submodule as a package attribute,
+        # so an attribute-based import would grab the function.
+        import importlib
+        _spans = importlib.import_module(
+            "horovod_tpu.telemetry.spans").configured_recorder()
+
 
 def _at_exit() -> None:
     """Flush every export path.  File/RPC targets are re-read from the
     environment HERE (not at configure time) so the launcher's per-rank
     overrides and late ``os.environ`` edits are honored."""
-    global _timeline
+    global _timeline, _spans
     if _timeline is not None:
         _timeline.close()
         _timeline = None
+    if _spans is not None:
+        # Upstream planes (the native runtime's C++ buffer) flush into
+        # the recorder first: this atexit handler can run BEFORE
+        # basics.shutdown() (LIFO — basics registers its hook earlier,
+        # at import), so without the explicit flush the native spans
+        # would drain into an already-closed recorder and vanish.
+        for hook in list(_span_flush_hooks):
+            try:
+                hook()
+            except Exception:
+                pass
+        # Span export runs BEFORE the metrics push so the recorder's
+        # hvd_trace_* totals land in this rank's metrics snapshot.
+        # (importlib: the spans() accessor shadows the submodule.)
+        import importlib
+        spans_mod = importlib.import_module("horovod_tpu.telemetry.spans")
+        try:
+            spans_mod.export_at_exit(_spans)
+        except Exception:
+            pass  # exit path: tracing must never mask the job's rc
+        _spans = None
     if not _enabled:
         return
     from horovod_tpu.telemetry import exporter
     endpoint = os.environ.get("HOROVOD_METRICS_RPC", "").strip()
     if endpoint:
+        # Satellite of the trace plane that works even with tracing off:
+        # measure this rank's monotonic-clock offset against the
+        # launcher over the same collector the metrics push targets, so
+        # the merged summary can attribute cross-host skew.
+        skew = exporter.measure_launcher_offset(endpoint)
+        if skew is not None:
+            gauge("hvd_clock_skew_seconds",
+                  "Monotonic-clock offset vs the launcher (launcher "
+                  "minus rank, RTT-halving estimate)").set(skew[0])
         exporter.push_to_launcher(endpoint, _registry.snapshot)
     path = os.environ.get("HOROVOD_METRICS_FILE", "").strip()
     if path:
@@ -161,6 +204,29 @@ def timeline():
     Named ``timeline`` — not ``eager_timeline`` — because that attribute
     is the submodule holding the writer class."""
     return _timeline
+
+
+def spans():
+    """The distributed span recorder, or None when tracing is off (the
+    tracing plane's own no-op guard, independent of metrics — see
+    ``spans.py``)."""
+    return _spans
+
+
+def register_span_flush_hook(fn) -> None:
+    """Register a callable that moves buffered spans from another plane
+    (the native runtime's C++ buffer) into the recorder.  Hooks run
+    right before the at-exit span export, which can precede
+    ``basics.shutdown()`` in atexit order."""
+    if fn not in _span_flush_hooks:
+        _span_flush_hooks.append(fn)
+
+
+def unregister_span_flush_hook(fn) -> None:
+    try:
+        _span_flush_hooks.remove(fn)
+    except ValueError:
+        pass
 
 
 def counter(name: str, help_text: str = "", **labels: str):
@@ -241,10 +307,13 @@ def reset_for_tests() -> None:
     tears down the timeline writer (without terminator) and forgets a
     previously started HTTP server reference (daemon thread; freed at
     process exit)."""
-    global _timeline, _http_server, _enabled
+    global _timeline, _http_server, _enabled, _spans
     if _timeline is not None:
         _timeline.close()
         _timeline = None
+    if _spans is not None:
+        _spans.close()
+        _spans = None
     if _http_server is not None:
         _http_server.shutdown()
         _http_server = None

@@ -20,6 +20,46 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 
+def estimate_percentiles(buckets: Dict[str, int],
+                         qs=(0.5, 0.95, 0.99)) -> Dict[str, float]:
+    """Percentile estimates from a merged (non-cumulative) bucket map.
+
+    Linear interpolation within each fixed bucket — the standard
+    histogram-quantile estimate: observations are assumed uniform
+    between a bucket's lower and upper bound, so the q-th rank inside a
+    bucket lands a proportional fraction of the way through it.  The
+    ``+Inf`` bucket has no upper bound; ranks landing there report the
+    last finite bound (a deliberate under-estimate, matching Prometheus
+    ``histogram_quantile``).  Returns ``{"p50": ..., ...}`` keyed by the
+    requested quantiles; empty dict for an empty histogram.
+    """
+    finite = sorted((float(b), int(n)) for b, n in buckets.items()
+                    if b not in ("+Inf", "inf", "Inf"))
+    inf_n = sum(int(n) for b, n in buckets.items()
+                if b in ("+Inf", "inf", "Inf"))
+    total = sum(n for _, n in finite) + inf_n
+    if total <= 0:
+        return {}
+    out: Dict[str, float] = {}
+    last_finite = finite[-1][0] if finite else 0.0
+    for q in qs:
+        target = q * total
+        seen = 0.0
+        lo = 0.0
+        value = last_finite
+        for bound, n in finite:
+            if seen + n >= target and n > 0:
+                frac = (target - seen) / n
+                value = lo + (bound - lo) * frac
+                break
+            seen += n
+            lo = bound
+        else:
+            value = last_finite   # target fell in +Inf
+        out[f"p{q * 100:g}"] = value
+    return out
+
+
 def _merge_values(kind: str, entries: List[dict]) -> dict:
     """Merge same-labels children from several ranks into one entry."""
     out: dict = {"labels": entries[0]["labels"]}
@@ -31,6 +71,9 @@ def _merge_values(kind: str, entries: List[dict]) -> dict:
         out["sum"] = sum(e.get("sum", 0.0) for e in entries)
         out["count"] = sum(e.get("count", 0) for e in entries)
         out["buckets"] = buckets
+        pct = estimate_percentiles(buckets)
+        if pct:
+            out["percentiles"] = pct
     elif kind == "gauge":
         vals = [e.get("value", 0.0) for e in entries]
         out["min"] = min(vals)

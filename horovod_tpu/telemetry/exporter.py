@@ -75,6 +75,21 @@ def push_to_launcher(endpoint: str, snapshot_fn: Callable[[], dict],
         return False
 
 
+def measure_launcher_offset(endpoint: str):
+    """This rank's monotonic-clock offset against the launcher's
+    collector (``host:port``): ``(offset_seconds, rtt_seconds)`` from
+    the RTT-halving handshake in ``runner/rpc.py``, or None when the
+    collector is unreachable or predates the ``time_sync`` kind.  Runs
+    on the exit path, so every failure is swallowed."""
+    try:
+        from horovod_tpu.runner import rpc
+        host, port = endpoint.rsplit(":", 1)
+        key = rpc.job_key_bytes(os.environ.get("HOROVOD_SECRET_KEY"))
+        return rpc.measure_clock_offset(host, int(port), key)
+    except Exception:  # noqa: BLE001 — best-effort exit-path handshake
+        return None
+
+
 class _MetricsHandler(BaseHTTPRequestHandler):
     # Class attributes injected by start_http_server via type().
     render_prometheus: Callable[[], str]

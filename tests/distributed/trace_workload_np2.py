@@ -1,0 +1,52 @@
+"""Distributed-tracing gate workload (run: hvdrun -np 2 --trace DIR,
+see ci/run_tests.sh and docs/timeline.md "Distributed tracing").
+
+Drives named eager collectives so both ranks record spans for the same
+logical steps, then exits cleanly — the at-exit exporter syncs clocks
+with the launcher, pushes the span document over RPC, and leaves the
+``spans.rank<k>.json`` file fallback.  The launcher merges both into
+``DIR/trace.json`` + ``DIR/critical_path.json``, which the gate then
+validates (cross-rank trace_id correlation, straggler report).
+
+Run WITHOUT ``--trace`` the same workload asserts the negative: no span
+recorder is active and nothing gets written — the disabled path must
+stay a no-op.
+"""
+import os
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import telemetry
+
+hvd.init()
+rank, size = hvd.rank(), hvd.size()
+assert size == 2, f"this workload expects -np 2, got size={size}"
+
+traced = os.environ.get("HOROVOD_TRACE", "").strip() not in (
+    "", "0", "false")
+sp = telemetry.spans()
+if traced:
+    assert sp is not None, \
+        "hvdrun --trace must activate the span recorder on every rank"
+else:
+    assert sp is None, \
+        "span recorder active without HOROVOD_TRACE — disabled path broken"
+
+for step in range(5):
+    out = hvd.allreduce(np.full(16, float(rank + 1), np.float32),
+                        average=False, name=f"trace.step{step}")
+    want = float(sum(r + 1 for r in range(size)))
+    assert np.asarray(out).tolist() == [want] * 16, \
+        f"step {step}: expected {want}, got {np.asarray(out)[:4]}"
+
+gathered = hvd.allgather(np.full(4, float(rank), np.float32),
+                         name="trace.gather")
+assert np.asarray(gathered).shape == (4 * size,)
+
+n_spans = len(sp) if sp is not None else 0
+if traced:
+    assert n_spans > 0, f"rank {rank}: traced run recorded no spans"
+
+print(f"TRACE_WORKLOAD_OK rank={rank} traced={int(traced)} "
+      f"spans={n_spans}", flush=True)

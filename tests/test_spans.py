@@ -1,0 +1,366 @@
+"""Distributed-tracing plane tests: the span recorder and its
+correlation-id scheme, the disabled no-op contract (including the
+allocation-free assertion the ISSUE acceptance demands), the trace
+merger, and the critical-path straggler analysis.
+"""
+
+import gc
+import importlib
+import json
+import os
+import sys
+
+import pytest
+
+from horovod_tpu import telemetry
+from horovod_tpu.telemetry import critical_path, trace_merge
+
+# The telemetry package's spans() accessor shadows the submodule as an
+# attribute — import the module itself explicitly.
+spans = importlib.import_module("horovod_tpu.telemetry.spans")
+
+
+@pytest.fixture()
+def recorder(monkeypatch):
+    """A live span recorder installed as the telemetry front door's."""
+    rec = spans.SpanRecorder(rank=0)
+    monkeypatch.setattr(telemetry, "_spans", rec)
+    yield rec
+
+
+@pytest.fixture()
+def enabled_telemetry():
+    telemetry.registry().clear()
+    telemetry.configure(enabled_flag=True)
+    yield telemetry
+    telemetry.configure(enabled_flag=False)
+    telemetry.registry().clear()
+
+
+# ---------------------------------------------------------------------------
+# correlation ids
+# ---------------------------------------------------------------------------
+
+def test_trace_id_is_deterministic_across_ranks():
+    # Two ranks compute the id independently; same (name, seq) -> same id.
+    assert spans.trace_id("grad/dense0", 17) == \
+        spans.trace_id("grad/dense0", 17)
+    assert len(spans.trace_id("x", 0)) == 16
+    int(spans.trace_id("x", 0), 16)  # hex64
+
+
+def test_trace_id_distinguishes_name_and_occurrence():
+    ids = {spans.trace_id(n, s)
+           for n in ("grad.0", "grad.1", "grad.2")
+           for s in range(100)}
+    assert len(ids) == 300  # no collisions across a realistic stream
+
+
+# ---------------------------------------------------------------------------
+# recorder semantics
+# ---------------------------------------------------------------------------
+
+def test_next_seq_counts_per_name():
+    rec = spans.SpanRecorder()
+    assert [rec.next_seq("a") for _ in range(3)] == [0, 1, 2]
+    assert rec.next_seq("b") == 0  # independent stream per tensor name
+
+
+def test_sampling_is_pure_in_the_occurrence_index():
+    rec = spans.SpanRecorder(sample=4)
+    assert [rec.sampled(s) for s in range(8)] == \
+        [True, False, False, False, True, False, False, False]
+    # sampled-out occurrences are silently not recorded...
+    rec.record("t", "submit", 1, 0.0, 0.1)
+    assert len(rec) == 0
+    # ...but the sequence counter still ticked for them upstream, so a
+    # sampled-in occurrence lands with its true index.
+    rec.record("t", "submit", 4, 0.0, 0.1)
+    assert len(rec) == 1
+
+
+def test_capacity_bound_drops_and_counts():
+    rec = spans.SpanRecorder(capacity=2)
+    for i in range(5):
+        rec.record("t", "wait", 0, float(i), float(i) + 0.1)
+    assert len(rec) == 2
+    assert rec.dropped == 3
+    assert rec.document()["dropped"] == 3
+
+
+def test_document_shape_and_ordering():
+    rec = spans.SpanRecorder(rank=3)
+    rec.record("b", "wait", 0, 2.0, 2.5, 64)
+    rec.record("a", "submit", 1, 1.0, 1.1, 32)
+    rec.event("request/r1", "route", 0.5, 0.9)
+    doc = rec.document()
+    assert doc["schema"] == spans.SCHEMA
+    assert doc["rank"] == 3 and doc["clock"] == "monotonic"
+    names = [s["name"] for s in doc["spans"]]
+    assert names == ["request/r1", "a", "b"]  # sorted by t0
+    a = doc["spans"][1]
+    assert a["trace_id"] == spans.trace_id("a", 1)
+    assert a["bytes"] == 32 and a["seq"] == 1
+    req = doc["spans"][0]
+    assert req["seq"] == spans.REQUEST_SEQ and req["phase"] == "route"
+    # span ids are unique within the document
+    assert len({s["span_id"] for s in doc["spans"]}) == 3
+
+
+def test_closed_recorder_stops_recording():
+    rec = spans.SpanRecorder()
+    rec.record("t", "wait", 0, 0.0, 0.1)
+    rec.close()
+    rec.record("t", "wait", 1, 0.2, 0.3)
+    assert len(rec) == 1
+
+
+# ---------------------------------------------------------------------------
+# the disabled no-op contract
+# ---------------------------------------------------------------------------
+
+def test_spans_off_by_default(monkeypatch):
+    for var in ("HOROVOD_TRACE", "HOROVOD_TRACE_DIR", "HOROVOD_TRACE_RPC"):
+        monkeypatch.delenv(var, raising=False)
+    assert spans.configured_recorder() is None
+
+
+def test_configured_recorder_reads_env(monkeypatch):
+    monkeypatch.setenv("HOROVOD_TRACE", "1")
+    monkeypatch.setenv("HOROVOD_TRACE_SAMPLE", "8")
+    monkeypatch.setenv("HOROVOD_TRACE_BUFFER", "1024")
+    monkeypatch.setenv("HOROVOD_RANK", "5")
+    rec = spans.configured_recorder()
+    assert rec is not None
+    assert (rec.rank, rec.sample, rec.capacity) == (5, 8, 1024)
+    monkeypatch.setenv("HOROVOD_TRACE", "0")
+    monkeypatch.delenv("HOROVOD_TRACE_SAMPLE", raising=False)
+    assert spans.configured_recorder() is None
+    # an export path alone turns the recorder on (file-only tracing)
+    monkeypatch.setenv("HOROVOD_TRACE_DIR", "/tmp/t")
+    assert spans.configured_recorder() is not None
+
+
+def test_disabled_path_is_allocation_free(monkeypatch):
+    """ISSUE acceptance: with tracing off, the instrumentation pattern
+    ``sp = telemetry.spans(); if sp is not None: ...`` must allocate
+    nothing — the recorder accessor returns the module-global None."""
+    monkeypatch.setattr(telemetry, "_spans", None)
+
+    def probe():
+        sp = telemetry.spans()
+        if sp is not None:
+            sp.record("x", "wait", 0, 0.0, 0.1, 64)
+
+    for _ in range(64):  # warm up allocator caches / bytecode
+        probe()
+    gc.collect()
+    gc.disable()
+    try:
+        before = sys.getallocatedblocks()
+        for _ in range(512):
+            probe()
+        after = sys.getallocatedblocks()
+    finally:
+        gc.enable()
+    assert after - before <= 2, \
+        f"disabled trace path allocated {after - before} blocks"
+
+
+# ---------------------------------------------------------------------------
+# export: file fallback + at-exit counters
+# ---------------------------------------------------------------------------
+
+def test_rank_log_roundtrip(tmp_path):
+    rec = spans.SpanRecorder(rank=1)
+    rec.record("g", "cross", 2, 1.0, 1.5, 128)
+    path = spans.write_rank_log(rec, str(tmp_path))
+    assert os.path.basename(path) == "spans.rank1.json"
+    docs = trace_merge.load_rank_docs(str(tmp_path))
+    assert set(docs) == {1}
+    assert docs[1]["spans"][0]["trace_id"] == spans.trace_id("g", 2)
+
+
+def test_load_rank_docs_skips_garbage(tmp_path):
+    (tmp_path / "spans.rank0.json").write_text("{not json")
+    (tmp_path / "spans.rank1.json").write_text(
+        json.dumps({"schema": "something.else", "rank": 1}))
+    rec = spans.SpanRecorder(rank=2)
+    spans.write_rank_log(rec, str(tmp_path))
+    assert set(trace_merge.load_rank_docs(str(tmp_path))) == {2}
+
+
+def test_export_at_exit_writes_fallback_and_counters(
+        tmp_path, monkeypatch, enabled_telemetry):
+    monkeypatch.setenv("HOROVOD_TRACE_DIR", str(tmp_path))
+    monkeypatch.delenv("HOROVOD_TRACE_RPC", raising=False)
+    rec = spans.SpanRecorder(rank=0, capacity=1)
+    rec.record("t", "wait", 0, 0.0, 0.1)
+    rec.record("t", "wait", 1, 0.2, 0.3)  # over capacity -> dropped
+    spans.export_at_exit(rec)
+    assert (tmp_path / "spans.rank0.json").exists()
+    snap = telemetry.metrics_snapshot()
+    assert snap["hvd_trace_spans_total"]["values"][0]["value"] == 1.0
+    assert snap["hvd_trace_spans_dropped_total"]["values"][0]["value"] == 1.0
+    # the recorder is closed after export (late spans are discarded)
+    rec.record("t", "wait", 2, 0.4, 0.5)
+    assert len(rec) == 1
+
+
+# ---------------------------------------------------------------------------
+# merger
+# ---------------------------------------------------------------------------
+
+def _doc(rank, span_list, offset=None):
+    return {
+        "schema": spans.SCHEMA, "rank": rank, "host": f"h{rank}",
+        "clock_offset": offset,
+        "spans": [
+            {"name": n, "phase": ph, "seq": sq,
+             "trace_id": spans.trace_id(n, sq), "span_id": i,
+             "t0": t0, "t1": t1, "bytes": b}
+            for i, (n, ph, sq, t0, t1, b) in enumerate(span_list)
+        ],
+    }
+
+
+def test_spans_doc_to_events_applies_clock_offset():
+    doc = _doc(1, [("g", "cross", 0, 1.0, 1.1, 64)], offset=0.5)
+    events = trace_merge.spans_doc_to_events(doc)
+    ev = next(e for e in events if e["ph"] == "X")
+    assert ev["pid"] == 1
+    assert ev["ts"] == int(1.5e6) and ev["dur"] == int(0.1e6)
+    assert ev["args"]["trace_id"] == spans.trace_id("g", 0)
+    # metadata announces the process and the per-tensor row
+    assert any(e["name"] == "process_name" and "h1" in e["args"]["name"]
+               for e in events)
+    assert any(e["name"] == "thread_name" and e["args"]["name"] == "g"
+               for e in events)
+
+
+def test_merge_span_docs_sorts_on_corrected_clock():
+    # rank 1's clock runs 2s behind the launcher: offset +2.0 puts its
+    # span (raw t0=0.5) AFTER rank 0's (raw t0=1.0).
+    d0 = _doc(0, [("g", "cross", 0, 1.0, 1.2, 0)], offset=0.0)
+    d1 = _doc(1, [("g", "cross", 0, 0.5, 0.7, 0)], offset=2.0)
+    events = trace_merge.merge_span_docs([d0, d1])
+    body = [e for e in events if e["ph"] == "X"]
+    assert [e["pid"] for e in body] == [0, 1]
+    assert body[1]["ts"] == int(2.5e6)
+    # metadata leads the file, as trace viewers expect
+    assert events[0]["ph"] == "M"
+
+
+def test_tolerant_load_survives_truncation(tmp_path):
+    p = tmp_path / "tl.json"
+    p.write_text('[\n{"name": "A", "ph": "X", "pid": 0, "ts": 1},\n'
+                 '{"name": "B", "ph": "X", "pid": 0, "ts": 2},\n'
+                 '{"name": "C", "ph"')  # crashed writer: cut mid-object
+    events = trace_merge.tolerant_load_events(str(p))
+    assert [e["name"] for e in events] == ["A", "B"]
+
+
+def test_merge_chrome_traces_shifts_by_rank_offset(tmp_path):
+    p0 = tmp_path / "r0.json"
+    p1 = tmp_path / "r1.json"
+    p0.write_text(json.dumps([
+        {"name": "t", "ph": "M", "pid": 0, "args": {"name": "x"}},
+        {"name": "op", "ph": "X", "pid": 0, "ts": 1000, "dur": 10}]))
+    p1.write_text(json.dumps([
+        {"name": "op", "ph": "X", "pid": 1, "ts": 1000, "dur": 10}]))
+    merged = trace_merge.merge_chrome_traces(
+        [str(p0), str(p1)], offsets={1: 0.25})
+    body = [e for e in merged if e["ph"] == "X"]
+    assert {e["pid"]: e["ts"] for e in body} == {0: 1000, 1: 251000}
+
+
+def test_write_chrome_emits_loadable_wrapper(tmp_path):
+    path = trace_merge.write_chrome(
+        [{"name": "op", "ph": "X", "pid": 0, "ts": 1, "dur": 1}],
+        str(tmp_path / "trace.json"))
+    doc = json.loads(open(path).read())
+    assert doc["traceEvents"][0]["name"] == "op"
+    assert doc["displayTimeUnit"] == "ms"
+
+
+# ---------------------------------------------------------------------------
+# critical path
+# ---------------------------------------------------------------------------
+
+def _two_rank_reports():
+    """Rank 1 is the straggler: its cross phase runs 80ms longer."""
+    d0 = _doc(0, [
+        ("grad", "submit", 0, 0.00, 0.01, 64),
+        ("grad", "cross", 0, 0.01, 0.02, 64),
+        ("grad", "wait", 0, 0.02, 0.03, 64),
+    ], offset=0.0)
+    d1 = _doc(1, [
+        ("grad", "submit", 0, 0.00, 0.01, 64),
+        ("grad", "cross", 0, 0.01, 0.10, 64),
+        ("grad", "wait", 0, 0.10, 0.11, 64),
+    ], offset=0.0)
+    return {0: d0, 1: d1}
+
+
+def test_critical_path_finds_straggler_and_phase():
+    result = critical_path.analyze(_two_rank_reports())
+    assert result["steps"] == 1 and result["ranks"] == [0, 1]
+    assert result["slowest_counts"] == {"0": 0, "1": 1}
+    step = result["slowest_steps"][0]
+    assert step["slowest_rank"] == 1
+    assert step["dominant_phase"] == "cross"
+    assert step["wall_seconds"] == pytest.approx(0.11)
+    assert step["delay_seconds"] == pytest.approx(0.08)
+    assert result["slack_seconds"]["0"] == pytest.approx(0.08)
+    top = result["attribution"][0]
+    assert (top["rank"], top["phase"]) == (1, "cross")
+    assert top["seconds"] == pytest.approx(0.08)
+    assert "p95" in result["step_wall_percentiles"]
+
+
+def test_critical_path_applies_clock_offset():
+    # Same spans, but rank 1's raw clock runs 5s behind and its measured
+    # offset corrects it — the analysis must be invariant.
+    reports = _two_rank_reports()
+    d1 = reports[1]
+    d1["clock_offset"] = 5.0
+    for s in d1["spans"]:
+        s["t0"] -= 5.0
+        s["t1"] -= 5.0
+    result = critical_path.analyze(reports)
+    assert result["slowest_steps"][0]["slowest_rank"] == 1
+    assert result["slowest_steps"][0]["delay_seconds"] == \
+        pytest.approx(0.08)
+
+
+def test_critical_path_excludes_request_scoped_spans():
+    reports = _two_rank_reports()
+    reports[0]["spans"].append(
+        {"name": "rpc/metrics_report", "phase": "rpc", "seq": 0,
+         "trace_id": spans.trace_id("rpc/metrics_report", 0),
+         "span_id": 99, "t0": 0.0, "t1": 9.0, "bytes": 0})
+    result = critical_path.analyze(reports)
+    assert result["steps"] == 1  # the 9s rpc span created no fake step
+
+
+def test_format_report_names_rank_and_phase():
+    text = critical_path.format_report(
+        critical_path.analyze(_two_rank_reports()))
+    assert "slowest rank: 1" in text
+    assert "rank 1 / cross" in text
+    assert "grad#0" in text
+
+
+def test_publish_gauges_lands_in_registry(enabled_telemetry):
+    critical_path.publish_gauges(critical_path.analyze(_two_rank_reports()))
+    snap = telemetry.metrics_snapshot()
+    assert snap["hvd_critical_path_steps"]["values"][0]["value"] == 1.0
+    slowest = {v["labels"]["rank"]: v["value"]
+               for v in snap["hvd_critical_path_slowest_steps"]["values"]}
+    assert slowest == {"0": 0.0, "1": 1.0}
+    phases = {v["labels"]["phase"]: v["value"]
+              for v in snap["hvd_critical_path_phase_seconds"]["values"]}
+    assert phases["cross"] == pytest.approx(0.08)
+    qs = {v["labels"]["q"]
+          for v in snap["hvd_trace_step_seconds"]["values"]}
+    assert {"p50", "p95", "p99"} <= qs

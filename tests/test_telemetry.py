@@ -266,6 +266,31 @@ def test_eager_timeline_truncated_file_still_parses(tmp_path):
     w.close()
 
 
+def test_two_rank_timeline_merge_is_skew_corrected(tmp_path):
+    """Two ranks' eager timelines merge onto the launcher clock: rank
+    1's events shift by its measured offset, and a truncated file (the
+    rank crashed before ``close()``) still contributes its events."""
+    from horovod_tpu.telemetry import trace_merge
+    p0 = str(tmp_path / "tl.rank0.json")
+    p1 = str(tmp_path / "tl.rank1.json")
+    w0 = EagerTimelineWriter(p0, rank=0)
+    w0.record_op("g", "allreduce", w0._epoch + 1.0, w0._epoch + 1.1,
+                 w0._epoch + 1.3, nbytes=64)
+    w0.close()
+    w1 = EagerTimelineWriter(p1, rank=1)
+    w1.record_op("g", "allreduce", w1._epoch + 1.0, w1._epoch + 1.1,
+                 w1._epoch + 1.3, nbytes=64)
+    w1._file.flush()  # no close(): truncated tail, tolerant loader path
+    merged = trace_merge.merge_chrome_traces(
+        [p0, p1], offsets={1: 0.25})
+    subs = [e for e in merged if e["name"] == "SUBMIT_ALLREDUCE"]
+    assert {e["pid"] for e in subs} == {0, 1}  # pid stays the rank
+    ts = {e["pid"]: e["ts"] for e in subs}
+    assert ts[1] - ts[0] == 250000  # rank 1 moved onto the launcher clock
+    body = [e for e in merged if e.get("ph") != "M"]
+    assert body == sorted(body, key=lambda e: e["ts"])
+
+
 def test_per_rank_path(monkeypatch):
     monkeypatch.setenv("HOROVOD_SIZE", "4")
     monkeypatch.setenv("HOROVOD_RANK", "2")

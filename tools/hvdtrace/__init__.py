@@ -1,0 +1,12 @@
+"""Offline trace analyzer for ``hvdrun --trace`` artifacts.
+
+``python -m tools.hvdtrace <trace-dir>`` re-runs the critical-path
+analysis over a collected trace directory (the per-rank
+``spans.rank<k>.json`` logs and/or the merged ``trace.json``) and
+prints the straggler report — the same analysis ``hvdrun --trace``
+runs at job exit, usable after the fact on archived artifacts.
+
+The analysis itself lives in ``horovod_tpu/telemetry/critical_path.py``
+(inside the package so the metrics-drift lint covers its gauges); this
+package is the thin CLI around it.
+"""

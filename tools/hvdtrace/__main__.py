@@ -1,0 +1,68 @@
+"""CLI: ``python -m tools.hvdtrace <trace-dir> [options]``.
+
+Reads the per-rank span logs of an ``hvdrun --trace`` directory,
+(re)builds the merged skew-corrected Chrome trace, and prints the
+critical-path straggler report.  Exits 0 on success, 1 when the
+directory holds no usable span logs, 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from horovod_tpu.telemetry import critical_path, trace_merge
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.hvdtrace",
+        description="Critical-path straggler analysis over an "
+                    "hvdrun --trace directory (docs/timeline.md).")
+    parser.add_argument(
+        "trace_dir",
+        help="directory holding spans.rank<k>.json logs (as written by "
+             "hvdrun --trace DIR or the per-rank file fallback)")
+    parser.add_argument(
+        "--top", type=int, default=5, metavar="K",
+        help="attribution rows to print (default 5)")
+    parser.add_argument(
+        "--json", dest="json_out", default=None, metavar="PATH",
+        help="also write the analysis as JSON (- for stdout)")
+    parser.add_argument(
+        "--merge", dest="merge_out", default=None, metavar="PATH",
+        help="also (re)write the merged Chrome trace to PATH")
+    args = parser.parse_args(argv)
+
+    if not os.path.isdir(args.trace_dir):
+        parser.error(f"{args.trace_dir} is not a directory")
+    docs = trace_merge.load_rank_docs(args.trace_dir)
+    if not docs:
+        print(f"hvdtrace: no spans.rank*.json logs under "
+              f"{args.trace_dir}", file=sys.stderr)
+        return 1
+
+    result = critical_path.analyze(docs, top_k=args.top)
+    print(critical_path.format_report(result, top_k=args.top))
+
+    if args.merge_out:
+        events = trace_merge.merge_span_docs(
+            docs[r] for r in sorted(docs))
+        path = trace_merge.write_chrome(events, args.merge_out)
+        print(f"hvdtrace: merged trace ({len(events)} events, "
+              f"{len(docs)} ranks) written to {path}")
+    if args.json_out:
+        text = json.dumps(result, indent=1, sort_keys=True)
+        if args.json_out == "-":
+            print(text)
+        else:
+            with open(args.json_out, "w") as f:
+                f.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
