@@ -70,12 +70,16 @@ restore() {
 }
 trap restore EXIT
 
-echo "--- $VARIANT: np=2 distributed native-op suite (preload $PRELOAD)"
+echo "--- $VARIANT: np=2 distributed native-op suite (preload $PRELOAD;
+--- HOROVOD_TRANSPORT=shm forces the lock-free intra-host ring under the
+--- sanitizer — the acquire/release slot protocol is exactly the code a
+--- race would hide in)"
 SAN_KEY="${SAN_ENV%%=*}"
 SAN_VAL="${SAN_ENV#*=}"
 set +e
 env LD_PRELOAD="$PRELOAD" "$SAN_KEY=$SAN_VAL" \
   JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  HOROVOD_TRANSPORT=shm \
   python -m horovod_tpu.runner -np 2 \
   python -m pytest tests/distributed/test_native_ops.py -x -q
 SUITE_RC=$?

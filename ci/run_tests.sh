@@ -121,6 +121,60 @@ print(f"HIER_NP4_OK cross_bytes={cross:.0f} flat_bytes={flat:.0f} "
 EOF
 rm -rf "$HIER_DIR"
 
+echo "--- transport gate (2 ranks intra-host): the shm ring must engage
+--- (shm bytes > 0, data-plane socket bytes == 0), forced striping must
+--- negotiate the requested stripe count, and all three backends must
+--- produce BITWISE identical allreduce outputs; the shm run's merged
+--- telemetry must show hvd_transport_bytes_total{backend=shm} > 0
+--- (docs/performance.md, 'Transport backends')"
+TRANSPORT_DIR="$(mktemp -d)"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  TRANSPORT_GATE_DIR="$TRANSPORT_DIR" \
+  TRANSPORT_GATE_EXPECT=socket HOROVOD_TRANSPORT=socket \
+  python -m horovod_tpu.runner -np 2 \
+  python tests/distributed/transport_np2.py
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  TRANSPORT_GATE_DIR="$TRANSPORT_DIR" \
+  HOROVOD_METRICS_FILE="$TRANSPORT_DIR/shm.json" \
+  TRANSPORT_GATE_EXPECT=shm HOROVOD_TRANSPORT=shm \
+  python -m horovod_tpu.runner -np 2 \
+  python tests/distributed/transport_np2.py
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  TRANSPORT_GATE_DIR="$TRANSPORT_DIR" \
+  TRANSPORT_GATE_EXPECT=striped HOROVOD_TRANSPORT=striped \
+  HOROVOD_TRANSPORT_STRIPES=2 \
+  python -m horovod_tpu.runner -np 2 \
+  python tests/distributed/transport_np2.py
+python tools/check_metrics.py "$TRANSPORT_DIR/shm.json" 2
+PYTHONPATH="$PWD" python - "$TRANSPORT_DIR" <<'EOF'
+import json, pathlib, sys
+import numpy as np
+from horovod_tpu.telemetry import aggregate
+
+d = pathlib.Path(sys.argv[1])
+# The transport layer must never change the math: byte-for-byte parity
+# across socket / shm / striped on every rank.
+for r in range(2):
+    ref = np.load(d / f"out_socket_r{r}.npy")
+    for backend in ("shm", "striped"):
+        got = np.load(d / f"out_{backend}_r{r}.npy")
+        assert got.dtype == ref.dtype and got.shape == ref.shape, \
+            (backend, r)
+        assert (got.view(np.uint8) == ref.view(np.uint8)).all(), \
+            f"{backend} vs socket allreduce differ bitwise (rank {r})"
+
+doc = json.load(open(d / "shm.json"))
+shm_bytes = aggregate.counter_total(
+    doc["merged"], "hvd_transport_bytes_total", {"backend": "shm"})
+assert shm_bytes > 0, "merged telemetry shows no shm transport bytes"
+sock_bytes = aggregate.counter_total(
+    doc["merged"], "hvd_transport_bytes_total", {"backend": "socket"})
+assert sock_bytes == 0, \
+    f"intra-host shm run leaked {sock_bytes} bytes onto sockets"
+print(f"TRANSPORT_GATE_SUMMARY_OK shm_bytes={shm_bytes:.0f}")
+EOF
+rm -rf "$TRANSPORT_DIR"
+
 echo "--- TF1-session async collectives (2 ranks, pruned-sync reaping)"
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" HOROVOD_TF1_ASYNC=1 \
   python -m horovod_tpu.runner -np 2 \
@@ -186,7 +240,7 @@ EOF
 PYTHONPATH="$PWD" python -m tools.hvdtrace "$TRACE_DIR" \
   | tee "$TRACE_DIR/report.txt"
 grep -q "slowest rank:" "$TRACE_DIR/report.txt"
-grep -Eq "rank [0-9]+ / (submit|negotiate|fuse|local|cross|wait):" \
+grep -Eq "rank [0-9]+ / (submit|negotiate|fuse|local|cross|transport|wait):" \
   "$TRACE_DIR/report.txt"
 # negative: without --trace the recorder must stay off and no span
 # file may appear (the workload asserts the recorder is None itself)
@@ -503,6 +557,24 @@ echo "--- hierarchical allreduce A/B (BENCH json; two hvdrun -np 4
 --- telemetry gate's exact 1/local_size byte ratio)"
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
   python -m horovod_tpu.benchmark --hierarchical --out BENCH_hier.json
+
+echo "--- transport backend A/B (BENCH json; five hvdrun -np 2 loopback
+--- runs: single socket vs shm ring vs striped x1/x2/x4 — every worker
+--- asserts the forced backend carried the bytes, headline ratios come
+--- from the thread-CPU link counters so a single-core runner measures
+--- the transport, not the scheduler)"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  python -m horovod_tpu.benchmark --transport --out BENCH_transport.json
+python - <<'EOF'
+import json
+doc = json.load(open("BENCH_transport.json"))
+assert doc["backend_engagement_asserted"]
+assert doc["shm_vs_socket_64mb"] > 1.0, doc["shm_vs_socket_64mb"]
+assert doc["striped4_vs_striped1_64mb"] > 1.0, \
+    doc["striped4_vs_striped1_64mb"]
+print("TRANSPORT_BENCH_OK shm=%.2fx striped4=%.2fx" %
+      (doc["shm_vs_socket_64mb"], doc["striped4_vs_striped1_64mb"]))
+EOF
 
 echo "--- coordination message complexity (BENCH json; tree vs flat
 --- per-tick fan-in at N in {8,64,256,1024} on the protocol simulator —

@@ -162,6 +162,32 @@ _var("HOROVOD_HIERARCHICAL_ALLREDUCE_THRESHOLD", "int", 262144,
      "ring", native=True)
 
 # ---------------------------------------------------------------------------
+# Transport backends (native/cc/src/{shm,striped}_transport.cc,
+# docs/performance.md "Transport backends")
+# ---------------------------------------------------------------------------
+_var("HOROVOD_TRANSPORT", "str", "auto",
+     "Data-plane backend selection: auto (shm intra-host, striped "
+     "cross-host when stripes>1, else socket) | shm | striped | socket",
+     native=True)
+_var("HOROVOD_TRANSPORT_STRIPES", "int", 0,
+     "Parallel TCP connections per cross-host peer link (0/1 = single "
+     "socket; capped at 16; autotune may lower the active count)",
+     native=True)
+_var("HOROVOD_SHM_DIR", "str", "",
+     "Per-job shared-memory namespace for intra-host rings (provisioned "
+     "and swept by hvdrun; empty disables the shm backend)", native=True)
+_var("HOROVOD_SHM_SLOTS", "int", 16,
+     "Slots per shm ring direction (min 2)", native=True)
+_var("HOROVOD_SHM_SLOT_BYTES", "int", 1024 * 1024,
+     "Payload bytes per shm ring slot (min 4096)", native=True)
+_var("HOROVOD_SHM_GRANULE_BYTES", "int", 0,
+     "Shm push granule; 0 = whole-slot pushes (autotune may override)",
+     native=True)
+_var("HOROVOD_TRANSPORT_CODECS", "str", "",
+     "Per-link-level codec overrides, e.g. 'cross:fp16,local:none' — "
+     "cross-host traffic may compress harder than intra-host shm")
+
+# ---------------------------------------------------------------------------
 # Autotuner
 # ---------------------------------------------------------------------------
 _var("HOROVOD_AUTOTUNE", "bool", False,

@@ -98,6 +98,12 @@ struct TunedParams {
   // response-stream position everywhere so routing never diverges.
   bool hier_allreduce = false;
   bool hier_allgather = false;
+  // Transport-layer knobs (transport.h): active stripe count for striped
+  // cross-host links and shm push granule for intra-host rings.  0 = knob
+  // not in play (no such links, or autotune off) — the executor leaves
+  // the transport's own defaults untouched.
+  int32_t transport_stripes = 0;
+  int64_t shm_granule_bytes = 0;
 };
 
 // Coordinator-side tuner: warmup -> samples of bytes/usec -> median score
@@ -123,10 +129,15 @@ class ParameterManager {
   // their bootstrap state, like cache with capacity 0).  chunk_bytes:
   // the configured eager sub-chunk size; 0 = chunking disabled AND not
   // explored (the dimension only exists when the feature is on).
+  // transport_stripes: the negotiated per-peer stripe count (>1 adds a
+  // stripe-count dimension over 1..that max); shm_links: intra-host shm
+  // rings exist, adding a push-granule dimension (64 KB .. slot size).
+  // Like chunking, a transport dimension exists only when its links do.
   void Initialize(int rank, double cycle_ms, int64_t fusion_bytes,
                   bool cache_enabled, bool hier_allreduce = false,
                   bool hier_allgather = false, bool hier_available = false,
-                  int64_t chunk_bytes = 0);
+                  int64_t chunk_bytes = 0, int transport_stripes = 0,
+                  bool shm_links = false);
 
   bool active() const { return active_; }
   bool monitoring() const { return monitoring_; }
@@ -160,6 +171,12 @@ class ParameterManager {
   bool hier_ar_ = false;
   bool hier_ag_ = false;
   bool hier_available_ = false;  // false: topology can't go 2-level
+  // Transport dimensions (exist only when the matching links do).
+  int max_stripes_ = 0;          // negotiated per-peer stripe count
+  int stripes_ = 0;              // current active-stripe proposal
+  bool shm_available_ = false;   // intra-host shm rings exist
+  int64_t shm_granule_ = 0;      // current push-granule proposal (bytes)
+  double granule_max_kb_ = 1024.0;  // slot size bound, read at Initialize
 
   // Sampling state.
   int warmup_remaining_ = 3;

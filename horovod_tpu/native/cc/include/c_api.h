@@ -80,6 +80,25 @@ int64_t hvd_hier_ag_local_bytes();
 int64_t hvd_hier_ag_cross_bytes();
 int64_t hvd_hier_ag_ops();
 
+// Transport-backend introspection (transport.h).  Counter matrix indexed
+// by backend (0 socket, 1 shm, 2 striped), hierarchical level (0 flat,
+// 1 local, 2 cross) and kind (0 bytes moved, 1 busy microseconds, 2 push
+// /pump operations); all monotonic since process start, -1 when an index
+// is out of range.  Feeds the hvd_transport_* telemetry series.
+int64_t hvd_transport_counter(int backend, int level, int kind);
+// 1 when the data-plane mesh holds at least one link of that backend.
+int hvd_transport_shm_links();
+int hvd_transport_striped_links();
+// Negotiated per-peer stripe count (0 = no striped links).
+int hvd_transport_stripes();
+// Live autotuned transport knobs (0 = transport defaults untouched):
+// active stripes actually used per exchange, and the shm push granule.
+int hvd_tuned_transport_stripes();
+int64_t hvd_tuned_shm_granule();
+// Per-link state lines for stall reports ("peer N shm: tx ..B left");
+// writes up to cap-1 bytes + NUL into dst, returns the length written.
+int32_t hvd_transport_describe(char* dst, int32_t cap);
+
 // Distributed tracing (HOROVOD_TRACE; trace.h).  Fixed-size span record
 // mirrored by ctypes in native/runtime.py — 72 bytes of char arrays then
 // four int64s, no padding.  (name, seq) is the cross-rank correlation

@@ -21,6 +21,7 @@
 
 #include "hvd_common.h"
 #include "socket.h"
+#include "transport.h"
 
 namespace hvd {
 
@@ -37,8 +38,19 @@ class DataPlane {
   int port() const { return listener_.bound_port(); }
 
   // Establish the full mesh: connect to lower ranks, accept from higher
-  // ranks (deadlock-free order).
+  // ranks (deadlock-free order).  Then upgrade each pair to its best
+  // transport (transport.h): pairwise negotiation over the mesh socket,
+  // shm ring handshakes for same-host pairs, dedicated stripe
+  // connections for striped pairs.  Any upgrade failure falls back to
+  // the single-socket link on both sides.
   Status Connect(int rank, int size, const std::vector<PeerAddr>& peers);
+
+  // Transport availability, latched by Connect (autotuner search-space
+  // conditioning: stripes/granule dims only open when the backend that
+  // reads them is live).
+  bool has_shm_links() const { return has_shm_links_; }
+  bool has_striped_links() const { return has_striped_links_; }
+  int configured_stripes() const { return stripes_; }
 
   // Every collective takes an optional ``group``: a sorted list of GLOBAL
   // ranks forming a sub-communicator (later-Horovod process sets;
@@ -215,8 +227,17 @@ class DataPlane {
   std::atomic<int64_t> hier_ag_ops_{0};
   TcpSocket listener_;
   std::vector<std::unique_ptr<TcpSocket>> peers_;  // [size], self = null
+  // One transport link per peer (transport.h), self = null.  Socket
+  // links borrow peers_[r]; shm/striped links own their resources.
+  std::vector<std::unique_ptr<transport::Link>> links_;
+  bool has_shm_links_ = false;
+  bool has_striped_links_ = false;
+  int stripes_ = 0;
   std::unique_ptr<char[]> scratch_;
   size_t scratch_cap_ = 0;
+
+  // Per-pair transport upgrade (Connect phase 2).
+  Status UpgradeLinks(const std::vector<PeerAddr>& peers);
 };
 
 // Typed reduction: acc[i] op= val[i].  Exposed for the fusion layer.

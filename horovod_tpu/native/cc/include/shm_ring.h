@@ -1,0 +1,179 @@
+// Lock-free SPSC shared-memory ring for the intra-host transport.
+//
+// Reference analogue: the reference's Gloo backend moves intra-host
+// payloads through /dev/shm pair rings (gloo/transport/..., SURVEY L1);
+// here one mmap'd file per ordered rank pair carries chunk-sized slots
+// between exactly one producer and one consumer process.
+//
+// Protocol (single producer, single consumer, fixed-size slots):
+//
+//   producer                            consumer
+//     wait head - tail < slots            wait head > tail        (acquire)
+//     slot.seq_begin = head+1 (relaxed)   check seq_end == tail+1 (acquire)
+//     memcpy payload, set len             check seq_begin == seq_end
+//     slot.seq_end = head+1   (release)     (mismatch => torn write)
+//     head = head+1           (release)   copy out
+//                                         tail = tail+1           (release)
+//
+// The per-slot begin/end sequence pair detects torn writes: a producer
+// that died (or scribbled) mid-slot leaves seq_begin != seq_end for the
+// slot the head counter claims is complete, and the consumer surfaces a
+// Status error instead of consuming garbage.  head/tail live on separate
+// cache lines so the two sides never false-share.
+//
+// The ring is geometry-checked at attach (magic + slot count/size), and
+// everything is in-process testable: Init() works on any suitably sized
+// buffer, no mmap required (tests/test_shm_ring.cc).
+#ifndef HVD_SHM_RING_H
+#define HVD_SHM_RING_H
+
+#include <atomic>
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+#include "hvd_common.h"
+
+namespace hvd {
+namespace shm {
+
+constexpr uint32_t kRingMagic = 0x68766452;  // "hvdR"
+
+struct alignas(64) RingHeader {
+  std::atomic<uint64_t> head;   // slots produced (producer-owned)
+  char pad0[64 - sizeof(std::atomic<uint64_t>)];
+  std::atomic<uint64_t> tail;   // slots consumed (consumer-owned)
+  char pad1[64 - sizeof(std::atomic<uint64_t>)];
+  uint32_t magic;
+  uint32_t slot_count;
+  uint32_t slot_bytes;
+  uint32_t reserved;
+};
+
+struct SlotHeader {
+  std::atomic<uint64_t> seq_begin;
+  std::atomic<uint64_t> seq_end;
+  uint32_t len;
+  uint32_t reserved;
+};
+
+// One producer-or-consumer view over a mapped ring region.  The region
+// layout is RingHeader followed by slot_count slots of
+// (SlotHeader + slot_bytes), each slot 64-byte aligned.
+class Ring {
+ public:
+  static size_t SlotStride(uint32_t slot_bytes) {
+    size_t raw = sizeof(SlotHeader) + slot_bytes;
+    return (raw + 63) & ~size_t(63);
+  }
+  static size_t RegionBytes(uint32_t slot_count, uint32_t slot_bytes) {
+    return sizeof(RingHeader) + size_t(slot_count) * SlotStride(slot_bytes);
+  }
+
+  // Producer-side initialization of a fresh region (zeroes the header
+  // and slot sequence counters; payload bytes are left untouched).
+  static void Init(void* region, uint32_t slot_count, uint32_t slot_bytes) {
+    auto* h = new (region) RingHeader();
+    h->head.store(0, std::memory_order_relaxed);
+    h->tail.store(0, std::memory_order_relaxed);
+    h->slot_count = slot_count;
+    h->slot_bytes = slot_bytes;
+    h->reserved = 0;
+    char* base = static_cast<char*>(region) + sizeof(RingHeader);
+    for (uint32_t i = 0; i < slot_count; ++i) {
+      auto* s = new (base + i * SlotStride(slot_bytes)) SlotHeader();
+      s->seq_begin.store(0, std::memory_order_relaxed);
+      s->seq_end.store(0, std::memory_order_relaxed);
+      s->len = 0;
+      s->reserved = 0;
+    }
+    // Publish the geometry last: an attacher spins on magic.
+    std::atomic_thread_fence(std::memory_order_release);
+    h->magic = kRingMagic;
+  }
+
+  // Attach to an existing region; verifies the geometry stamp.
+  Status Attach(void* region, size_t region_bytes) {
+    auto* h = static_cast<RingHeader*>(region);
+    if (region_bytes < sizeof(RingHeader) || h->magic != kRingMagic)
+      return Status::Precondition("shm ring: bad magic (not a ring?)");
+    if (h->slot_count == 0 || h->slot_bytes == 0 ||
+        RegionBytes(h->slot_count, h->slot_bytes) > region_bytes)
+      return Status::Precondition("shm ring: geometry exceeds mapping");
+    hdr_ = h;
+    slots_ = static_cast<char*>(region) + sizeof(RingHeader);
+    stride_ = SlotStride(h->slot_bytes);
+    return Status::OK();
+  }
+
+  bool attached() const { return hdr_ != nullptr; }
+  uint32_t slot_count() const { return hdr_->slot_count; }
+  uint32_t slot_bytes() const { return hdr_->slot_bytes; }
+
+  size_t FreeSlots() const {
+    uint64_t head = hdr_->head.load(std::memory_order_relaxed);
+    uint64_t tail = hdr_->tail.load(std::memory_order_acquire);
+    return hdr_->slot_count - (head - tail);
+  }
+
+  // Producer: push one payload of n <= slot_bytes.  Returns false when
+  // the ring is full (backpressure; caller retries after Progress).
+  bool TryPush(const void* p, uint32_t n) {
+    uint64_t head = hdr_->head.load(std::memory_order_relaxed);
+    uint64_t tail = hdr_->tail.load(std::memory_order_acquire);
+    if (head - tail >= hdr_->slot_count) return false;
+    SlotHeader* s = Slot(head % hdr_->slot_count);
+    s->seq_begin.store(head + 1, std::memory_order_relaxed);
+    std::memcpy(Payload(s), p, n);
+    s->len = n;
+    s->seq_end.store(head + 1, std::memory_order_release);
+    hdr_->head.store(head + 1, std::memory_order_release);
+    return true;
+  }
+
+  // Consumer: pop one payload into out (capacity cap).  Returns the
+  // payload length, 0 when the ring is empty, or -1 with *st set on a
+  // torn-sequence / geometry violation.
+  int64_t TryPop(void* out, size_t cap, Status* st) {
+    uint64_t tail = hdr_->tail.load(std::memory_order_relaxed);
+    uint64_t head = hdr_->head.load(std::memory_order_acquire);
+    if (head == tail) return 0;
+    SlotHeader* s = Slot(tail % hdr_->slot_count);
+    uint64_t end = s->seq_end.load(std::memory_order_acquire);
+    uint64_t begin = s->seq_begin.load(std::memory_order_relaxed);
+    if (end != tail + 1 || begin != end) {
+      *st = Status::Aborted(
+          "shm ring: torn slot sequence (producer died or scribbled "
+          "mid-write): expected " + std::to_string(tail + 1) +
+          " got begin=" + std::to_string(begin) +
+          " end=" + std::to_string(end));
+      return -1;
+    }
+    uint32_t n = s->len;
+    if (n > hdr_->slot_bytes || n > cap) {
+      *st = Status::Aborted("shm ring: slot length " + std::to_string(n) +
+                            " exceeds slot/destination capacity");
+      return -1;
+    }
+    std::memcpy(out, Payload(s), n);
+    hdr_->tail.store(tail + 1, std::memory_order_release);
+    return n;
+  }
+
+ private:
+  SlotHeader* Slot(uint64_t i) const {
+    return reinterpret_cast<SlotHeader*>(slots_ + i * stride_);
+  }
+  static char* Payload(SlotHeader* s) {
+    return reinterpret_cast<char*>(s) + sizeof(SlotHeader);
+  }
+
+  RingHeader* hdr_ = nullptr;
+  char* slots_ = nullptr;
+  size_t stride_ = 0;
+};
+
+}  // namespace shm
+}  // namespace hvd
+
+#endif  // HVD_SHM_RING_H

@@ -1,0 +1,95 @@
+// Chunk striping plan + out-of-order reassembly for the multi-socket
+// cross-host transport (striped_transport.cc).
+//
+// The sender splits a message into fixed granules and deals them
+// round-robin over its active stripes; every frame is self-describing
+// ({seq, len, offset}), so the receiver needs no knowledge of the
+// sender's stripe count or granule — it just merges byte intervals and
+// tracks the contiguous prefix that feeds the pipelined reduce hook.
+// Both halves are pure and in-process testable
+// (tests/test_stripe_plan.cc).
+#ifndef HVD_STRIPE_PLAN_H
+#define HVD_STRIPE_PLAN_H
+
+#include <cstddef>
+#include <cstdint>
+#include <map>
+#include <vector>
+
+namespace hvd {
+namespace stripe {
+
+struct Chunk {
+  uint64_t offset;
+  uint32_t len;
+  uint32_t stripe;
+};
+
+// Deal [0, n) into granule-sized chunks, chunk c on stripe c % stripes.
+// granule == 0 or a single stripe degrades to one chunk per stripe
+// round — callers normalize beforehand; this clamps defensively.
+inline std::vector<Chunk> Plan(uint64_t n, uint64_t granule,
+                               uint32_t stripes) {
+  std::vector<Chunk> out;
+  if (n == 0) return out;
+  if (granule == 0 || granule > n) granule = n;
+  if (stripes == 0) stripes = 1;
+  out.reserve(static_cast<size_t>((n + granule - 1) / granule));
+  uint64_t off = 0;
+  uint32_t c = 0;
+  while (off < n) {
+    uint64_t len = n - off < granule ? n - off : granule;
+    out.push_back(Chunk{off, static_cast<uint32_t>(len), c % stripes});
+    off += len;
+    ++c;
+  }
+  return out;
+}
+
+// Byte-interval reassembly: Add() frames in any order; contiguous()
+// grows only while the prefix [0, contiguous()) is fully present, so a
+// stalled stripe caps the pipelined-reduce watermark without blocking
+// delivery of the out-of-order remainder (total() still completes the
+// message).
+class Reassembly {
+ public:
+  void Reset(uint64_t expected) {
+    expected_ = expected;
+    contig_ = 0;
+    total_ = 0;
+    pending_.clear();
+  }
+
+  void Add(uint64_t offset, uint64_t len) {
+    if (len == 0) return;
+    total_ += len;
+    if (offset == contig_) {
+      contig_ += len;
+      // Absorb any previously out-of-order intervals now adjacent.
+      auto it = pending_.begin();
+      while (it != pending_.end() && it->first <= contig_) {
+        uint64_t end = it->first + it->second;
+        if (end > contig_) contig_ = end;
+        it = pending_.erase(it);
+      }
+    } else {
+      pending_[offset] = len;
+    }
+  }
+
+  uint64_t contiguous() const { return contig_; }
+  uint64_t total() const { return total_; }
+  uint64_t expected() const { return expected_; }
+  bool complete() const { return total_ >= expected_; }
+
+ private:
+  uint64_t expected_ = 0;
+  uint64_t contig_ = 0;
+  uint64_t total_ = 0;
+  std::map<uint64_t, uint64_t> pending_;
+};
+
+}  // namespace stripe
+}  // namespace hvd
+
+#endif  // HVD_STRIPE_PLAN_H

@@ -1,0 +1,218 @@
+// Pluggable point-to-point transport backends for the native data plane.
+//
+// Reference equivalent: the ops/collective_operations.h backend registry —
+// AllreduceOp::Enabled()/Execute() dispatching per tensor over
+// MPI/NCCL/Gloo.  Our registry selects per LINK instead of per tensor:
+// each peer pair gets the best transport its placement allows —
+//
+//   shm      lock-free shared-memory ring, intra-host only
+//            (zero protocol bytes on-node; shm_transport.cc)
+//   striped  HOROVOD_TRANSPORT_STRIPES parallel TCP connections with
+//            chunk round-robin + per-stripe reassembly (cross-host;
+//            striped_transport.cc)
+//   socket   the original single TCP stream (always available)
+//
+// selected by Enabled(mode, same_host, stripes) mirroring the
+// reference's Enabled() shape, with fallback shm -> striped -> socket
+// (docs/performance.md, "Transport backends").
+//
+// A Link is full-duplex to one peer and deliberately asymmetric-free:
+// the ring exchange arms a send on one link and a recv on another and
+// pumps both, so every backend exposes the same non-blocking state
+// machine (StartSend/StartRecv/Progress) plus blocking helpers for the
+// broadcast fan-out.
+#ifndef HVD_TRANSPORT_H
+#define HVD_TRANSPORT_H
+
+#include <atomic>
+#include <cstddef>
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "hvd_common.h"
+
+namespace hvd {
+
+class TcpSocket;
+
+namespace transport {
+
+// --------------------------------------------------------------------------
+// Selection (reference AllreduceOp::Enabled analogue).
+// --------------------------------------------------------------------------
+
+enum class Mode : int { kAuto = 0, kShm = 1, kStriped = 2, kSocket = 3 };
+
+Mode ParseMode(const std::string& s);   // HOROVOD_TRANSPORT value
+const char* ModeName(Mode m);
+
+enum class Backend : int { kSocket = 0, kShm = 1, kStriped = 2 };
+constexpr int kNumBackends = 3;
+const char* BackendName(Backend b);
+
+// Which backend should serve a link, given the selection mode, peer
+// placement and the configured stripe count.  Never fails: the socket
+// backend is the universal fallback (a failed shm/striped setup also
+// degrades here at link-construction time).
+Backend Enabled(Mode mode, bool same_host, int stripes);
+
+// --------------------------------------------------------------------------
+// Per-(backend, level) accounting, mirrored to Python as
+// hvd_transport_{bytes,seconds,ops}_total{backend,level}
+// (docs/metrics.md).  Level is thread-local context set by the
+// hierarchical phases so the series can split intra-host from
+// cross-host traffic.
+// --------------------------------------------------------------------------
+
+enum class Level : int { kFlat = 0, kLocal = 1, kCross = 2 };
+constexpr int kNumLevels = 3;
+const char* LevelName(Level l);
+
+enum class Counter : int { kBytes = 0, kMicros = 1, kOps = 2 };
+constexpr int kNumCounters = 3;
+
+void SetLevel(Level l);         // thread-local; kFlat by default
+Level CurrentLevel();
+
+class ScopedLevel {
+ public:
+  explicit ScopedLevel(Level l) : prev_(CurrentLevel()) { SetLevel(l); }
+  ~ScopedLevel() { SetLevel(prev_); }
+  ScopedLevel(const ScopedLevel&) = delete;
+  ScopedLevel& operator=(const ScopedLevel&) = delete;
+
+ private:
+  Level prev_;
+};
+
+void Account(Backend b, int64_t bytes, int64_t micros);
+// Explicit-level variant for worker threads that account on behalf of a
+// data-plane exchange (the thread-local level lives on the arming thread).
+void AccountAt(Backend b, Level l, int64_t bytes, int64_t micros);
+int64_t CounterValue(int backend, int level, int counter);
+
+// Per-thread CPU clock for the micros argument above.  Pump loops time
+// themselves with THREAD CPU time, not wall time: on an oversubscribed
+// host a wall interval mostly measures the scheduler (every runnable
+// pump thread inflates every other's), while CPU micros per byte is a
+// stable efficiency figure — and one that sums meaningfully across
+// concurrent stripes (total CPU spent moving bytes, regardless of how
+// the cores were shared).  bytes/seconds from these counters therefore
+// reads as "bandwidth per dedicated core", the number a stripe delivers
+// when it gets its own core/NIC queue.
+int64_t PumpClockUs();
+
+// --------------------------------------------------------------------------
+// Link: one full-duplex transport to one peer.
+// --------------------------------------------------------------------------
+
+class Link {
+ public:
+  virtual ~Link() = default;
+  virtual Backend backend() const = 0;
+  virtual int peer() const = 0;
+
+  // Arm one outgoing / incoming message.  At most one of each may be in
+  // flight; callers (the data plane) serialize exchanges per link.
+  virtual void StartSend(const void* buf, size_t n) = 0;
+  virtual void StartRecv(void* buf, size_t n) = 0;
+
+  // Pump both directions without blocking.  Returns a non-OK status on
+  // a dead peer / protocol violation; the in-flight exchange is then
+  // unrecoverable.
+  virtual Status Progress() = 0;
+
+  virtual bool SendDone() const = 0;
+  virtual bool RecvDone() const = 0;
+  // Contiguous prefix of the armed recv already landed in the
+  // destination buffer — the pipelined-reduce watermark.
+  virtual size_t RecvBytes() const = 0;
+
+  // Pollable backends return their fd and the poll events that would
+  // unblock pending work; non-pollable (shm, striped) return -1 and the
+  // data-plane pump falls back to a yielding spin.
+  virtual int PollFd(short* events) const {
+    (void)events;
+    return -1;
+  }
+
+  // Blocking helpers for the broadcast fan-out (and any future
+  // one-directional path); default implementations pump Progress().
+  virtual Status Send(const void* buf, size_t n);
+  virtual Status Recv(void* buf, size_t n);
+
+  // One-line state summary for stall reports ("stripe 2: tx 4/16 ...").
+  virtual std::string Describe() const = 0;
+
+  virtual void Shutdown() {}
+};
+
+// The original single-TCP-stream path, wrapped in the non-blocking link
+// state machine.  Non-owning: the socket belongs to DataPlane's mesh.
+class SocketLink : public Link {
+ public:
+  SocketLink(int peer, TcpSocket* sock) : peer_(peer), sock_(sock) {}
+
+  Backend backend() const override { return Backend::kSocket; }
+  int peer() const override { return peer_; }
+  void StartSend(const void* buf, size_t n) override;
+  void StartRecv(void* buf, size_t n) override;
+  Status Progress() override;
+  bool SendDone() const override { return send_left_ == 0; }
+  bool RecvDone() const override { return recv_left_ == 0; }
+  size_t RecvBytes() const override { return recv_total_ - recv_left_; }
+  int PollFd(short* events) const override;
+  std::string Describe() const override;
+
+ private:
+  int peer_;
+  TcpSocket* sock_;
+  const char* send_ptr_ = nullptr;
+  size_t send_left_ = 0;
+  char* recv_ptr_ = nullptr;
+  size_t recv_left_ = 0;
+  size_t recv_total_ = 0;
+};
+
+// Factories (defined in shm_transport.cc / striped_transport.cc).
+// Both return nullptr with a logged warning on setup failure — the
+// caller falls back to SocketLink.
+
+// Shared-memory link.  `creator` (the lower rank) creates + initializes
+// both ring files under `dir` and early-unlinks them once the peer
+// acknowledges the mapping over `handshake` (the existing mesh socket),
+// so a SIGKILL mid-exchange leaves nothing behind.
+std::unique_ptr<Link> MakeShmLink(int self, int peer, bool creator,
+                                  const std::string& dir,
+                                  TcpSocket* handshake);
+
+// Striped link over `socks` dedicated TCP connections (stripe index ==
+// vector index).
+std::unique_ptr<Link> MakeStripedLink(int self, int peer,
+                                      std::vector<TcpSocket> socks);
+
+// Live-tunable knobs (autotuner-driven, rank-agreed via TunedParams;
+// both are sender-local for correctness — slots and frames are
+// self-describing — so applying them between steps is always safe).
+void SetShmGranule(int64_t bytes);       // 0 = full slot
+int64_t ShmGranule();
+void SetActiveStripes(int64_t stripes);  // 0 = all configured stripes
+int64_t ActiveStripes();
+
+// --------------------------------------------------------------------------
+// Global link registry for stall reports: the data plane registers its
+// links at connect; DescribeAll() renders the active backends and
+// per-stripe states (stall_inspector.cc and the Python EagerStallError
+// path both surface it).
+// --------------------------------------------------------------------------
+
+void RegisterLinks(const std::vector<Link*>& links);
+void ClearLinks();
+std::string DescribeAll();
+
+}  // namespace transport
+}  // namespace hvd
+
+#endif  // HVD_TRANSPORT_H

@@ -2,7 +2,9 @@
 
 #include <fcntl.h>
 #include <poll.h>
+#include <sched.h>
 #include <sys/socket.h>
+#include <time.h>
 
 #include <algorithm>
 #include <chrono>
@@ -284,17 +286,213 @@ Status DataPlane::Connect(int rank, int size,
     peers_[who] = std::unique_ptr<TcpSocket>(new TcpSocket(std::move(conn)));
     ++registered;
   }
+  return UpgradeLinks(peers);
+}
+
+namespace {
+
+// Pairwise transport negotiation frame, exchanged over the established
+// mesh socket before any collective traffic.
+struct NegFrame {
+  uint32_t magic;       // kNegMagic
+  uint8_t want_shm;     // this side can do shared memory with the peer
+  uint8_t want_striped; // this side wants striping with the peer
+  uint16_t stripes;     // this side's configured stripe count
+};
+constexpr uint32_t kNegMagic = 0x48564454;  // "HVDT"
+
+// Hello on a dedicated stripe connection (after auth): which rank and
+// which stripe slot it serves.
+struct StripeHello {
+  int32_t rank;
+  int32_t stripe;
+};
+
+}  // namespace
+
+// Connect phase 2: upgrade every pair to the best transport both sides
+// agree on.  Three sub-phases, each deadlock-free by construction:
+//   2a  negotiate + shm handshakes, pairs in ascending peer order (the
+//       global (min,max) order every rank's subsequence respects — the
+//       smallest unfinished pair is always first on both endpoints)
+//   2b  stripe dials to HIGHER ranks (ascending), then stripe accepts
+//       from lower ranks: the highest rank dials nobody, so by reverse
+//       induction on rank every dial finds its accepter
+//   2c  wrap remaining pairs in SocketLink
+Status DataPlane::UpgradeLinks(const std::vector<PeerAddr>& peers) {
+  using transport::Backend;
+  links_.clear();
+  links_.resize(size_);
+  has_shm_links_ = false;
+  has_striped_links_ = false;
+
+  transport::Mode mode =
+      transport::ParseMode(EnvStr("HOROVOD_TRANSPORT", "auto"));
+  stripes_ = static_cast<int>(EnvInt("HOROVOD_TRANSPORT_STRIPES", 0));
+  if (stripes_ < 0) stripes_ = 0;
+  if (stripes_ > 16) stripes_ = 16;
+  // The shm namespace is launcher-provisioned (runner/run.py): without
+  // it there is no sweeper guarding the create-to-unlink window, so
+  // hand-launched jobs simply stay on sockets.
+  const std::string shm_dir = EnvStr("HOROVOD_SHM_DIR", "");
+  const std::string& my_host =
+      static_cast<size_t>(rank_) < peers.size() ? peers[rank_].host
+                                                : peers[0].host;
+
+  std::vector<Backend> agreed(size_, Backend::kSocket);
+  std::vector<int> pair_stripes(size_, 0);
+
+  // 2a. Negotiate (+ shm handshake immediately, keeping the per-pair
+  // mesh-socket stream strictly ordered), ascending peer order.
+  for (int r = 0; r < size_; ++r) {
+    if (r == rank_) continue;
+    bool same_host = static_cast<size_t>(r) < peers.size() &&
+                     !my_host.empty() && peers[r].host == my_host;
+    Backend want =
+        transport::Enabled(mode, same_host && !shm_dir.empty(), stripes_);
+    NegFrame mine{kNegMagic,
+                  static_cast<uint8_t>(want == Backend::kShm ? 1 : 0),
+                  static_cast<uint8_t>(want == Backend::kStriped ? 1 : 0),
+                  static_cast<uint16_t>(stripes_)};
+    NegFrame theirs{};
+    // 8-byte frames fit any socket buffer: symmetric send-then-recv
+    // cannot block.
+    Status st = peers_[r]->SendAll(&mine, sizeof(mine));
+    if (st.ok()) st = peers_[r]->RecvAll(&theirs, sizeof(theirs));
+    if (!st.ok())
+      return Status::Unknown("transport negotiation with rank " +
+                             std::to_string(r) + " failed: " + st.reason);
+    if (theirs.magic != kNegMagic)
+      return Status::Unknown("transport negotiation with rank " +
+                             std::to_string(r) + ": bad magic");
+    if (mine.want_shm && theirs.want_shm) {
+      auto link = transport::MakeShmLink(rank_, r, rank_ < r, shm_dir,
+                                         peers_[r].get());
+      if (link) {
+        links_[r] = std::move(link);
+        agreed[r] = Backend::kShm;
+        continue;
+      }
+      // Both sides observe the same handshake outcome, so the fallback
+      // below is symmetric.
+    }
+    if (mine.want_striped && theirs.want_striped) {
+      int s = std::min<int>(mine.stripes, theirs.stripes);
+      if (s > 1) {
+        agreed[r] = Backend::kStriped;
+        pair_stripes[r] = s;
+      }
+    }
+  }
+
+  // 2b. Dedicated stripe connections: dial to higher ranks first, then
+  // accept from lower ranks (arrival order arbitrary; the hello frame
+  // routes each connection to its slot).
+  const std::string key = JobKey();
+  std::vector<std::vector<TcpSocket>> stripe_socks(size_);
+  int expected_accepts = 0;
+  for (int r = 0; r < size_; ++r)
+    if (agreed[r] == Backend::kStriped && r < rank_)
+      expected_accepts += pair_stripes[r];
+  for (int r = rank_ + 1; r < size_; ++r) {
+    if (agreed[r] != Backend::kStriped) continue;
+    for (int s = 0; s < pair_stripes[r]; ++s) {
+      TcpSocket sock;
+      Status st = sock.Connect(peers[r].host, peers[r].port);
+      if (st.ok()) st = AuthConnect(sock, key);
+      StripeHello hello{rank_, s};
+      if (st.ok()) st = sock.SendAll(&hello, sizeof(hello));
+      if (!st.ok())
+        return Status::Unknown("stripe " + std::to_string(s) +
+                               " dial to rank " + std::to_string(r) +
+                               " failed: " + st.reason);
+      stripe_socks[r].push_back(std::move(sock));
+    }
+  }
+  auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::seconds(120);
+  for (int got = 0; got < expected_accepts;) {
+    auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                    deadline - std::chrono::steady_clock::now())
+                    .count();
+    if (left <= 0)
+      return Status::Unknown("timed out waiting for stripe connections (" +
+                             std::to_string(expected_accepts - got) +
+                             " of " + std::to_string(expected_accepts) +
+                             " missing)");
+    TcpSocket conn;
+    Status st = listener_.Accept(&conn, static_cast<int>(left));
+    if (!st.ok()) return st;
+    conn.SetRecvTimeout(10000);
+    st = AuthAccept(conn, key);
+    if (!st.ok()) {
+      LOG(Warning) << "data plane: dropped unauthenticated stripe "
+                   << "connection (" << st.reason << ")";
+      continue;
+    }
+    StripeHello hello{-1, -1};
+    st = conn.RecvAll(&hello, sizeof(hello));
+    if (!st.ok() || hello.rank < 0 || hello.rank >= rank_ ||
+        agreed[hello.rank] != Backend::kStriped || hello.stripe < 0 ||
+        hello.stripe >= pair_stripes[hello.rank]) {
+      LOG(Warning) << "data plane: dropped bad stripe hello from rank "
+                   << hello.rank;
+      continue;
+    }
+    conn.SetRecvTimeout(0);
+    auto& socks = stripe_socks[hello.rank];
+    if (socks.size() != static_cast<size_t>(pair_stripes[hello.rank]))
+      socks.resize(pair_stripes[hello.rank]);
+    socks[hello.stripe] = std::move(conn);
+    ++got;
+  }
+  for (int r = 0; r < size_; ++r) {
+    if (agreed[r] != Backend::kStriped) continue;
+    auto link =
+        transport::MakeStripedLink(rank_, r, std::move(stripe_socks[r]));
+    if (!link)
+      return Status::Unknown("striped link to rank " + std::to_string(r) +
+                             " failed after connection setup");
+    links_[r] = std::move(link);
+  }
+
+  // 2c. Everything else rides the original mesh socket.
+  for (int r = 0; r < size_; ++r) {
+    if (r == rank_) continue;
+    if (!links_[r])
+      links_[r] = std::make_unique<transport::SocketLink>(r, peers_[r].get());
+    if (links_[r]->backend() == Backend::kShm) has_shm_links_ = true;
+    if (links_[r]->backend() == Backend::kStriped) has_striped_links_ = true;
+  }
+  std::vector<transport::Link*> raw;
+  for (auto& l : links_)
+    if (l) raw.push_back(l.get());
+  transport::RegisterLinks(raw);
+  if (rank_ == 0 && size_ > 1) {
+    LOG(Debug) << "data plane transports (mode "
+               << transport::ModeName(mode) << "): shm="
+               << (has_shm_links_ ? "yes" : "no")
+               << " striped=" << (has_striped_links_ ? "yes" : "no")
+               << " stripes=" << stripes_;
+  }
   return Status::OK();
 }
 
 void DataPlane::Shutdown() {
+  transport::ClearLinks();
+  for (auto& l : links_)
+    if (l) l->Shutdown();
+  links_.clear();
   for (auto& p : peers_) p.reset();
   listener_.Close();
 }
 
-// Full-duplex exchange: non-blocking send+recv driven by poll so neither
-// side can deadlock on TCP buffers (the role cuda streams + NCCL play in
-// reference nccl_operations.cc — here it's just careful socket plumbing).
+// Full-duplex exchange over the per-peer transport links: both links are
+// pumped from one loop so neither side can deadlock on transport buffers
+// (the role cuda streams + NCCL play in reference nccl_operations.cc).
+// Pollable links (socket backend) block in poll() when idle; shm and
+// striped links spin-then-yield (their progress is produced by the peer
+// process / the stripe workers, not by an fd becoming ready).
 Status DataPlane::SendRecv(int send_peer, const void* sbuf, size_t sbytes,
                            int recv_peer, void* rbuf, size_t rbytes,
                            const std::function<void(size_t)>& on_recv) {
@@ -304,70 +502,89 @@ Status DataPlane::SendRecv(int send_peer, const void* sbuf, size_t sbytes,
     if (on_recv) on_recv(rbytes);
     return Status::OK();
   }
-  TcpSocket* ssock = send_peer == rank_ ? nullptr : peers_[send_peer].get();
-  TcpSocket* rsock = recv_peer == rank_ ? nullptr : peers_[recv_peer].get();
+  const int64_t trace_t0 = trace::Enabled() ? trace::NowUs() : 0;
+  transport::Link* sl =
+      send_peer == rank_ ? nullptr : links_[send_peer].get();
+  transport::Link* rl =
+      recv_peer == rank_ ? nullptr : links_[recv_peer].get();
   if (send_peer == rank_ && sbytes > 0) std::memcpy(rbuf, sbuf, sbytes);
 
-  const char* sp = static_cast<const char*>(sbuf);
-  char* rp = static_cast<char*>(rbuf);
-  size_t sleft = ssock ? sbytes : 0;
-  size_t rleft = rsock ? rbytes : 0;
-  while (sleft > 0 || rleft > 0) {
+  if (sl) sl->StartSend(sbuf, sbytes);
+  if (rl) rl->StartRecv(rbuf, rbytes);
+
+  size_t last_watermark = 0;
+  size_t last_recv = 0;
+  bool last_send_done = sl == nullptr;
+  auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::seconds(60);
+  int idle = 0;
+  while (true) {
+    Status st = sl ? sl->Progress() : Status::OK();
+    if (st.ok() && rl && rl != sl) st = rl->Progress();
+    if (!st.ok()) return st;
+
+    bool progressed = false;
+    if (rl) {
+      size_t wm = rl->RecvBytes();
+      if (wm > last_watermark) {
+        last_watermark = wm;
+        progressed = true;
+        // Progress hook AFTER each drain advance (not per syscall): the
+        // pipelined ring reduces completed sub-chunks here while the
+        // transport keeps both directions moving.
+        if (on_recv) on_recv(wm);
+      }
+      if (wm > last_recv) last_recv = wm;
+    }
+    bool send_done = sl == nullptr || sl->SendDone();
+    if (send_done != last_send_done) {
+      last_send_done = send_done;
+      progressed = true;
+    }
+    if (send_done && (rl == nullptr || rl->RecvDone())) break;
+
+    if (progressed) {
+      idle = 0;
+      deadline = std::chrono::steady_clock::now() + std::chrono::seconds(60);
+      continue;
+    }
+    if (std::chrono::steady_clock::now() > deadline)
+      return Status::Unknown("data-plane exchange timed out");
+    ++idle;
+    if (idle < 64) continue;
+    // Idle: block in poll when every pending link is pollable, otherwise
+    // yield (shm/striped progress comes from another process or thread,
+    // not an fd).  PollFd covers both directions of a link at once.
     pollfd fds[2];
     int nf = 0;
-    int si = -1, ri = -1;
-    if (sleft > 0) {
-      si = nf;
-      fds[nf++] = {ssock->fd(), POLLOUT, 0};
+    short ev;
+    bool pollable = true;
+    transport::Link* uniq[2] = {sl, rl == sl ? nullptr : rl};
+    for (transport::Link* l : uniq) {
+      if (l == nullptr || (l->SendDone() && l->RecvDone())) continue;
+      int fd = l->PollFd(&ev);
+      if (fd >= 0)
+        fds[nf++] = {fd, ev, 0};
+      else
+        pollable = false;
     }
-    if (rleft > 0) {
-      ri = nf;
-      fds[nf++] = {rsock->fd(), POLLIN, 0};
+    if (pollable && nf > 0) {
+      int rc = ::poll(fds, nf, 1000);
+      if (rc < 0 && errno != EINTR)
+        return Status::Unknown(std::string("poll: ") + std::strerror(errno));
+    } else if (idle < 1024) {
+      sched_yield();
+    } else {
+      struct timespec ts {0, 100 * 1000};
+      nanosleep(&ts, nullptr);
     }
-    int rc = ::poll(fds, nf, 60000);
-    if (rc == 0) return Status::Unknown("data-plane exchange timed out");
-    if (rc < 0) {
-      if (errno == EINTR) continue;
-      return Status::Unknown(std::string("poll: ") + std::strerror(errno));
-    }
-    // Drain each direction until EAGAIN, not one syscall per poll wakeup —
-    // with 8 MB kernel buffers a single wakeup can move megabytes, and the
-    // poll/send ping-pong otherwise caps throughput well under the wire.
-    if (si >= 0 && (fds[si].revents & (POLLOUT | POLLERR | POLLHUP))) {
-      while (sleft > 0) {
-        ssize_t w =
-            ::send(ssock->fd(), sp, sleft, MSG_NOSIGNAL | MSG_DONTWAIT);
-        if (w > 0) {
-          sp += w;
-          sleft -= static_cast<size_t>(w);
-          continue;
-        }
-        if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
-        if (w < 0 && errno == EINTR) continue;
-        if (w < 0)
-          return Status::Unknown(std::string("send: ") +
-                                 std::strerror(errno));
-      }
-    }
-    if (ri >= 0 && (fds[ri].revents & (POLLIN | POLLERR | POLLHUP))) {
-      size_t before = rleft;
-      while (rleft > 0) {
-        ssize_t r = ::recv(rsock->fd(), rp, rleft, MSG_DONTWAIT);
-        if (r > 0) {
-          rp += r;
-          rleft -= static_cast<size_t>(r);
-          continue;
-        }
-        if (r == 0) return Status::Aborted("peer closed during exchange");
-        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
-        if (errno == EINTR) continue;
-        return Status::Unknown(std::string("recv: ") + std::strerror(errno));
-      }
-      // Progress hook AFTER the drain (not per recv syscall): the
-      // pipelined ring reduces completed sub-chunks here while the
-      // kernel buffers keep both directions moving.
-      if (on_recv && rleft < before) on_recv(rbytes - rleft);
-    }
+  }
+  if (trace::Enabled()) {
+    const char* nm;
+    int64_t sq;
+    if (trace::CurrentOp(&nm, &sq))
+      trace::Record(nm, "transport", sq, trace_t0, trace::NowUs(),
+                    static_cast<int64_t>(sbytes + rbytes));
   }
   return Status::OK();
 }
@@ -707,7 +924,13 @@ Status DataPlane::HierarchicalAllreduce(void* buf, int64_t count,
 
   using clk = std::chrono::steady_clock;
   const auto t0 = clk::now();
-  Status st = RingReduceScatterPhase(local_group, buf, count, dtype, op);
+  Status st;
+  {
+    // Thread-local level context: the transport accounting below this
+    // phase books against the "local" series (hvd_transport_*).
+    transport::ScopedLevel lvl(transport::Level::kLocal);
+    st = RingReduceScatterPhase(local_group, buf, count, dtype, op);
+  }
   if (!st.ok()) return st;
   const auto t1 = clk::now();
 
@@ -720,13 +943,17 @@ Status DataPlane::HierarchicalAllreduce(void* buf, int64_t count,
                  static_cast<size_t>(off[done_c]) * DataTypeSize(dtype);
     // Same chunk index on every host (same count) — a flat ring among
     // the same-local-position ranks.
+    transport::ScopedLevel lvl(transport::Level::kCross);
     st = RingReduceScatterPhase(cross_group, cptr, ccount, dtype, op);
     if (!st.ok()) return st;
     st = RingAllgatherPhase(cross_group, cptr, ccount, dtype);
     if (!st.ok()) return st;
   }
   const auto t2 = clk::now();
-  st = RingAllgatherPhase(local_group, buf, count, dtype);
+  {
+    transport::ScopedLevel lvl(transport::Level::kLocal);
+    st = RingAllgatherPhase(local_group, buf, count, dtype);
+  }
   const auto t3 = clk::now();
 
   // Payload accounting (see the header comment on hier_local_bytes()):
@@ -868,21 +1095,25 @@ Status DataPlane::HierarchicalAllgather(
                 static_cast<size_t>(counts[rank_]));
 
   // A. cross exchange among {(h, local_rank_) for every host h}.
-  for (int k = 1; k < nhosts; ++k) {
-    const int to = ((host + k) % nhosts) * local_size_ + local_rank_;
-    const int from =
-        ((host - k + nhosts) % nhosts) * local_size_ + local_rank_;
-    Status st = SendRecv(to, in, static_cast<size_t>(counts[rank_]),
-                         from, o + displ[from],
-                         static_cast<size_t>(counts[from]));
-    if (!st.ok()) return st;
-    hier_ag_cross_bytes_.fetch_add(counts[rank_],
-                                   std::memory_order_relaxed);
+  {
+    transport::ScopedLevel lvl(transport::Level::kCross);
+    for (int k = 1; k < nhosts; ++k) {
+      const int to = ((host + k) % nhosts) * local_size_ + local_rank_;
+      const int from =
+          ((host - k + nhosts) % nhosts) * local_size_ + local_rank_;
+      Status st = SendRecv(to, in, static_cast<size_t>(counts[rank_]),
+                           from, o + displ[from],
+                           static_cast<size_t>(counts[from]));
+      if (!st.ok()) return st;
+      hier_ag_cross_bytes_.fetch_add(counts[rank_],
+                                     std::memory_order_relaxed);
+    }
   }
 
   // B. local fan-out: with peer at local position me±k, exchange my
   //    column (blocks (h, local_rank_) for all h, which phase A
   //    completed) against theirs, block by block.
+  transport::ScopedLevel lvl(transport::Level::kLocal);
   for (int k = 1; k < local_size_; ++k) {
     const int to_j = (local_rank_ + k) % local_size_;
     const int from_j = (local_rank_ - k + local_size_) % local_size_;
@@ -918,10 +1149,10 @@ Status DataPlane::Broadcast(void* buf, int64_t count, DataType dtype,
   if (rank_ == root) {
     // Oversized fan-out interleaves chunk-sized slices ACROSS peers:
     // while the root writes peer p+1's slice, peer p's slice is already
-    // draining out of its kernel socket buffer, instead of every later
-    // peer idling until the full monolithic send to its predecessors
+    // draining out of its transport buffer, instead of every later peer
+    // idling until the full monolithic send to its predecessors
     // completes.  The per-peer byte stream is unchanged (in-order
-    // slices), so receivers stay a single RecvAll.
+    // slices), so receivers stay a single blocking Recv.
     const int64_t chunk = chunk_bytes_.load(std::memory_order_relaxed);
     const size_t step = chunk > 0 && static_cast<size_t>(chunk) < nbytes
                             ? static_cast<size_t>(chunk)
@@ -932,13 +1163,13 @@ Status DataPlane::Broadcast(void* buf, int64_t count, DataType dtype,
       for (int p = 0; p < v.size; ++p) {
         int r = v.global_of(p);
         if (r == rank_) continue;
-        Status st = peers_[r]->SendAll(base + off, n);
+        Status st = links_[r]->Send(base + off, n);
         if (!st.ok()) return st;
       }
     }
     return Status::OK();
   }
-  return peers_[root]->RecvAll(buf, nbytes);
+  return links_[root]->Recv(buf, nbytes);
 }
 
 Status DataPlane::Alltoall(const void* in, void* out, int64_t count,

@@ -192,6 +192,8 @@ std::string ResponseList::Serialize() const {
     PutPod<uint8_t>(&buf, params.cache_enabled ? 1 : 0);
     PutPod<uint8_t>(&buf, params.hier_allreduce ? 1 : 0);
     PutPod<uint8_t>(&buf, params.hier_allgather ? 1 : 0);
+    PutPod<int32_t>(&buf, params.transport_stripes);
+    PutPod<int64_t>(&buf, params.shm_granule_bytes);
   }
   PutStr(&buf, abort_message);
   return buf;
@@ -232,7 +234,9 @@ Status ResponseList::Parse(const std::string& buf, ResponseList* out) {
     if (!rd.GetPod(&tuning) || !rd.GetPod(&out->params.cycle_time_ms) ||
         !rd.GetPod(&out->params.fusion_threshold) ||
         !rd.GetPod(&out->params.chunk_bytes) || !rd.GetPod(&cache) ||
-        !rd.GetPod(&har) || !rd.GetPod(&hag))
+        !rd.GetPod(&har) || !rd.GetPod(&hag) ||
+        !rd.GetPod(&out->params.transport_stripes) ||
+        !rd.GetPod(&out->params.shm_granule_bytes))
       return Malformed("params body");
     out->params.tuning = tuning != 0;
     out->params.cache_enabled = cache != 0;

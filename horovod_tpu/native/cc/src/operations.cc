@@ -23,6 +23,7 @@
 #include "tensor_queue.h"
 #include "timeline.h"
 #include "trace.h"
+#include "transport.h"
 
 namespace hvd {
 namespace {
@@ -82,6 +83,8 @@ struct GlobalState {
   std::atomic<double> tuned_cycle_ms{1.0};
   std::atomic<int64_t> tuned_fusion_bytes{64 * 1024 * 1024};
   std::atomic<int64_t> tuned_chunk_bytes{0};
+  std::atomic<int> tuned_stripes{0};        // 0 = transport default (all)
+  std::atomic<int64_t> tuned_shm_granule{0};  // 0 = whole-slot pushes
   std::atomic<bool> autotune_exploring{false};
   std::atomic<uint64_t> cache_lookups{0};
   std::atomic<uint64_t> cache_hit_count{0};
@@ -706,6 +709,14 @@ void BackgroundThread() {
   const int64_t chunk_bytes =
       EnvInt("HOROVOD_EAGER_CHUNK_BYTES", 1024 * 1024);
   g->data_plane.SetChunkBytes(chunk_bytes);
+  // Shm push granule: 0 keeps whole-slot pushes (the measured default);
+  // the autotuner may move it when shm links exist.
+  const int64_t shm_granule = EnvInt("HOROVOD_SHM_GRANULE_BYTES", 0);
+  if (shm_granule > 0) {
+    transport::SetShmGranule(shm_granule);
+    g->tuned_shm_granule.store(shm_granule);
+  }
+  g->tuned_stripes.store(g->data_plane.configured_stripes());
   g->tuned_cycle_ms.store(g->cycle_time_ms);
   g->tuned_fusion_bytes.store(g->controller.fusion_threshold());
   g->tuned_chunk_bytes.store(g->data_plane.chunk_bytes());
@@ -718,7 +729,9 @@ void BackgroundThread() {
                                 g->hierarchical_enabled,
                                 g->hierarchical_allgather_enabled,
                                 g->hierarchical_available,
-                                g->data_plane.chunk_bytes());
+                                g->data_plane.chunk_bytes(),
+                                g->data_plane.configured_stripes(),
+                                g->data_plane.has_shm_links());
 
   // Latch span recording before callers can enqueue (TensorQueue::Add
   // reads trace::Enabled() the moment hvd_init returns).
@@ -837,6 +850,18 @@ void BackgroundThread() {
             responses.params.hier_allgather);
         g->hierarchical_enabled = responses.params.hier_allreduce;
         g->hierarchical_allgather_enabled = responses.params.hier_allgather;
+      }
+      // Transport knobs are sender-local (slots and stripe frames are
+      // self-describing), but applying at the agreed response-stream
+      // position anyway keeps the A/B attribution of each trial's score
+      // clean — every rank switches between the same two lists.
+      if (responses.params.transport_stripes > 0) {
+        transport::SetActiveStripes(responses.params.transport_stripes);
+        g->tuned_stripes.store(responses.params.transport_stripes);
+      }
+      if (responses.params.shm_granule_bytes > 0) {
+        transport::SetShmGranule(responses.params.shm_granule_bytes);
+        g->tuned_shm_granule.store(responses.params.shm_granule_bytes);
       }
       // Mirror for the C introspection API (stall reports, telemetry).
       g->tuned_cycle_ms.store(responses.params.cycle_time_ms);
@@ -1040,6 +1065,37 @@ int64_t hvd_hier_ag_cross_bytes() {
 }
 int64_t hvd_hier_ag_ops() {
   return g ? g->data_plane.hier_ag_ops() : 0;
+}
+
+// Transport-layer introspection (transport.h).  The counter matrix is
+// process-global (links account into it directly), so it answers even
+// between init epochs; the link-topology flags need a live runtime.
+int64_t hvd_transport_counter(int backend, int level, int kind) {
+  return transport::CounterValue(backend, level, kind);
+}
+int hvd_transport_shm_links() {
+  return g && g->data_plane.has_shm_links() ? 1 : 0;
+}
+int hvd_transport_striped_links() {
+  return g && g->data_plane.has_striped_links() ? 1 : 0;
+}
+int hvd_transport_stripes() {
+  return g ? g->data_plane.configured_stripes() : 0;
+}
+int hvd_tuned_transport_stripes() {
+  return g ? g->tuned_stripes.load() : 0;
+}
+int64_t hvd_tuned_shm_granule() {
+  return g ? g->tuned_shm_granule.load() : 0;
+}
+int32_t hvd_transport_describe(char* dst, int32_t cap) {
+  if (dst == nullptr || cap <= 0) return 0;
+  std::string s = transport::DescribeAll();
+  int32_t n = static_cast<int32_t>(s.size());
+  if (n >= cap) n = cap - 1;
+  std::memcpy(dst, s.data(), static_cast<size_t>(n));
+  dst[n] = '\0';
+  return n;
 }
 
 int64_t hvd_enqueue(int op_type, const char* name, const void* data,

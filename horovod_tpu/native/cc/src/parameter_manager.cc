@@ -22,16 +22,21 @@ constexpr double kFusionMinMb = 1.0, kFusionMaxMb = 64.0;
 // to keep the reduce working set cache-warm, large enough to amortize the
 // per-chunk poll round trip.
 constexpr double kChunkMinKb = 256.0, kChunkMaxKb = 32768.0;
+// Shm push-granule floor (the ceiling is the configured slot size, read
+// at Initialize): below 64 KB the per-slot handshake overhead dominates.
+constexpr double kGranuleMinKb = 64.0;
 }  // namespace
 
 int ParameterManager::Dims() const {
-  return 3 + (chunk_available_ ? 1 : 0) + (hier_available_ ? 2 : 0);
+  return 3 + (chunk_available_ ? 1 : 0) + (hier_available_ ? 2 : 0) +
+         (max_stripes_ > 1 ? 1 : 0) + (shm_available_ ? 1 : 0);
 }
 
 void ParameterManager::Initialize(int rank, double cycle_ms,
                                   int64_t fusion_bytes, bool cache_enabled,
                                   bool hier_allreduce, bool hier_allgather,
-                                  bool hier_available, int64_t chunk_bytes) {
+                                  bool hier_available, int64_t chunk_bytes,
+                                  int transport_stripes, bool shm_links) {
   rank_ = rank;
   cycle_time_ms_ = cycle_ms;
   fusion_threshold_ = fusion_bytes;
@@ -42,6 +47,20 @@ void ParameterManager::Initialize(int rank, double cycle_ms,
   hier_ar_ = hier_allreduce;
   hier_ag_ = hier_allgather;
   hier_available_ = hier_available;
+  // Transport dimensions: stripe count is explorable only when striped
+  // links negotiated more than one connection per peer; shm granule only
+  // when intra-host rings exist.  Bounds come from the same env knobs the
+  // transport itself reads, so proposals never exceed what a link can do.
+  max_stripes_ = transport_stripes;
+  stripes_ = transport_stripes;
+  shm_available_ = shm_links;
+  if (shm_available_) {
+    const int64_t slot = EnvInt("HOROVOD_SHM_SLOT_BYTES", 1 << 20);
+    granule_max_kb_ = std::max(kGranuleMinKb,
+                               static_cast<double>(slot) / 1024.0);
+    const int64_t g0 = EnvInt("HOROVOD_SHM_GRANULE_BYTES", 0);
+    shm_granule_ = g0 > 0 ? g0 : slot;  // default: whole-slot pushes
+  }
   active_ = EnvBool("HOROVOD_AUTOTUNE", false);
   if (!active_) return;
   // Size the search space to the knobs that can actually move: on a
@@ -69,7 +88,8 @@ void ParameterManager::Initialize(int rank, double cycle_ms,
       log_.open(path, std::ios::trunc);
       log_ << "trial,cycle_time_ms,fusion_threshold_mb,cache_enabled,"
               "hier_allreduce,hier_allgather,"
-              "score_bytes_per_usec,best_score,pinned,chunk_kb,phase\n";
+              "score_bytes_per_usec,best_score,pinned,chunk_kb,"
+              "transport_stripes,shm_granule_kb,phase\n";
       log_.flush();
     }
     LOG(Info) << "Autotuner: enabled (warmup " << warmup_remaining_
@@ -102,6 +122,23 @@ std::vector<double> ParameterManager::CurrentPoint() const {
     x.push_back(hier_ar_ ? 1.0 : 0.0);
     x.push_back(hier_ag_ ? 1.0 : 0.0);
   }
+  if (max_stripes_ > 1) {
+    // Log-scale over 1..max (stripe counts trade off like parallelism
+    // degrees, not linearly).
+    double xs = std::log(static_cast<double>(std::max(stripes_, 1))) /
+                std::log(static_cast<double>(max_stripes_));
+    x.push_back(std::min(std::max(xs, 0.0), 1.0));
+  }
+  if (shm_available_) {
+    double kb = static_cast<double>(shm_granule_) / 1024.0;
+    kb = std::min(std::max(kb, kGranuleMinKb), granule_max_kb_);
+    double xg = granule_max_kb_ > kGranuleMinKb
+                    ? (std::log(kb) - std::log(kGranuleMinKb)) /
+                          (std::log(granule_max_kb_) -
+                           std::log(kGranuleMinKb))
+                    : 1.0;
+    x.push_back(std::min(std::max(xg, 0.0), 1.0));
+  }
   return x;
 }
 
@@ -126,6 +163,20 @@ void ParameterManager::ApplyPoint(const std::vector<double>& x) {
   if (hier_available_ && x.size() > i + 1) {
     hier_ar_ = x[i] >= 0.5;
     hier_ag_ = x[i + 1] >= 0.5;
+    i += 2;
+  }
+  if (max_stripes_ > 1 && x.size() > i) {
+    stripes_ = static_cast<int>(std::lround(
+        std::exp(x[i] * std::log(static_cast<double>(max_stripes_)))));
+    stripes_ = std::min(std::max(stripes_, 1), max_stripes_);
+    ++i;
+  }
+  if (shm_available_ && x.size() > i) {
+    double kb = std::exp(std::log(kGranuleMinKb) +
+                         x[i] * (std::log(granule_max_kb_) -
+                                 std::log(kGranuleMinKb)));
+    shm_granule_ = static_cast<int64_t>(kb * 1024.0);
+    ++i;
   }
 }
 
@@ -198,6 +249,8 @@ bool ParameterManager::Tune(double median_score) {
               << " cache=" << (cache_enabled_ ? 1 : 0)
               << " hier_allreduce=" << (hier_ar_ ? 1 : 0)
               << " hier_allgather=" << (hier_ag_ ? 1 : 0)
+              << " transport_stripes=" << (max_stripes_ > 1 ? stripes_ : 0)
+              << " shm_granule=" << (shm_available_ ? shm_granule_ : 0)
               << " (best " << optimizer_.best_score()
               << " bytes/usec); monitoring for drift";
     if (log_.is_open()) log_.flush();
@@ -261,6 +314,9 @@ void ParameterManager::LogTrial(double score, bool pinned,
        << (hier_ag_ ? 1 : 0) << "," << score << ","
        << optimizer_.best_score() << "," << (pinned ? 1 : 0) << ","
        << (static_cast<double>(chunk_bytes_) / 1024.0) << ","
+       << (max_stripes_ > 1 ? stripes_ : 0) << ","
+       << (shm_available_ ? static_cast<double>(shm_granule_) / 1024.0
+                          : 0.0) << ","
        << phase << "\n";
   log_.flush();
 }
@@ -275,6 +331,10 @@ TunedParams ParameterManager::Current() const {
   p.cache_enabled = cache_enabled_;
   p.hier_allreduce = hier_ar_;
   p.hier_allgather = hier_ag_;
+  // 0 when the dimension does not exist: the executor then leaves the
+  // transport's own configuration alone.
+  p.transport_stripes = max_stripes_ > 1 ? stripes_ : 0;
+  p.shm_granule_bytes = shm_available_ ? shm_granule_ : 0;
   return p;
 }
 

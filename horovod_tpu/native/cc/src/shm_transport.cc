@@ -1,0 +1,282 @@
+// Shared-memory intra-host transport: one pair of lock-free SPSC rings
+// (shm_ring.h) per ordered rank pair, mmap'd from files in the
+// launcher-provisioned HOROVOD_SHM_DIR namespace.
+//
+// Lifecycle is orphan-free by construction: the lower rank creates and
+// initializes both ring files, hands the paths to its peer over the
+// existing mesh socket, and unlinks them the moment the peer
+// acknowledges the mapping — after that only the two mappings keep the
+// memory alive, so a SIGKILL at ANY later point leaves nothing named on
+// disk (the launcher's startup sweep covers the narrow create-to-ack
+// window of a crashed prior attempt; see runner/run.py).
+//
+// Any setup failure degrades to the socket backend on BOTH sides: the
+// creator reports failure in the handshake frame (or learns of the
+// peer's failure from the ack), so the pair always agrees on the
+// fallback.
+#include <errno.h>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "shm_ring.h"
+#include "socket.h"
+#include "trace.h"
+#include "transport.h"
+
+namespace hvd {
+namespace transport {
+
+namespace {
+
+std::atomic<int64_t> g_shm_granule{0};
+
+struct Mapping {
+  void* base = nullptr;
+  size_t bytes = 0;
+
+  ~Mapping() {
+    if (base != nullptr) ::munmap(base, bytes);
+  }
+  Mapping() = default;
+  Mapping(const Mapping&) = delete;
+  Mapping& operator=(const Mapping&) = delete;
+  Mapping(Mapping&& o) noexcept : base(o.base), bytes(o.bytes) {
+    o.base = nullptr;
+    o.bytes = 0;
+  }
+
+  Status CreateAndMap(const std::string& path, size_t n) {
+    int fd = ::open(path.c_str(), O_RDWR | O_CREAT | O_TRUNC, 0600);
+    if (fd < 0)
+      return Status::Unknown("shm: create " + path + " failed: " +
+                             std::string(strerror(errno)));
+    if (::ftruncate(fd, static_cast<off_t>(n)) != 0) {
+      ::close(fd);
+      ::unlink(path.c_str());
+      return Status::Unknown("shm: ftruncate " + path + " failed: " +
+                             std::string(strerror(errno)));
+    }
+    return Map(fd, path, n);
+  }
+
+  Status OpenAndMap(const std::string& path, size_t n) {
+    int fd = ::open(path.c_str(), O_RDWR, 0600);
+    if (fd < 0)
+      return Status::Unknown("shm: open " + path + " failed: " +
+                             std::string(strerror(errno)));
+    return Map(fd, path, n);
+  }
+
+ private:
+  Status Map(int fd, const std::string& path, size_t n) {
+    void* p = ::mmap(nullptr, n, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    ::close(fd);  // The mapping keeps the file data alive.
+    if (p == MAP_FAILED)
+      return Status::Unknown("shm: mmap " + path + " failed: " +
+                             std::string(strerror(errno)));
+    base = p;
+    bytes = n;
+    return Status::OK();
+  }
+};
+
+class ShmLink : public Link {
+ public:
+  ShmLink(int peer, Mapping tx_map, Mapping rx_map)
+      : peer_(peer), tx_map_(std::move(tx_map)), rx_map_(std::move(rx_map)) {}
+
+  Status AttachRings() {
+    Status st = tx_.Attach(tx_map_.base, tx_map_.bytes);
+    if (!st.ok()) return st;
+    return rx_.Attach(rx_map_.base, rx_map_.bytes);
+  }
+
+  Backend backend() const override { return Backend::kShm; }
+  int peer() const override { return peer_; }
+
+  void StartSend(const void* buf, size_t n) override {
+    send_ptr_ = static_cast<const char*>(buf);
+    send_left_ = n;
+  }
+
+  void StartRecv(void* buf, size_t n) override {
+    recv_ptr_ = static_cast<char*>(buf);
+    recv_left_ = n;
+    recv_total_ = n;
+  }
+
+  Status Progress() override {
+    int64_t moved = 0;
+    int64_t t0 = 0;
+    size_t chunk_cap = ChunkCap();
+    while (send_left_ > 0) {
+      if (t0 == 0) t0 = PumpClockUs();
+      uint32_t n = static_cast<uint32_t>(
+          send_left_ < chunk_cap ? send_left_ : chunk_cap);
+      if (!tx_.TryPush(send_ptr_, n)) break;  // ring full: backpressure
+      send_ptr_ += n;
+      send_left_ -= n;
+      moved += n;
+    }
+    while (recv_left_ > 0) {
+      if (t0 == 0) t0 = PumpClockUs();
+      Status st = Status::OK();
+      int64_t n = rx_.TryPop(recv_ptr_, recv_left_, &st);
+      if (n < 0) return st;
+      if (n == 0) break;
+      recv_ptr_ += n;
+      recv_left_ -= static_cast<size_t>(n);
+      moved += n;
+    }
+    if (moved > 0) Account(Backend::kShm, moved, PumpClockUs() - t0);
+    return Status::OK();
+  }
+
+  bool SendDone() const override { return send_left_ == 0; }
+  bool RecvDone() const override { return recv_left_ == 0; }
+  size_t RecvBytes() const override { return recv_total_ - recv_left_; }
+
+  std::string Describe() const override {
+    char buf[160];
+    std::snprintf(buf, sizeof(buf),
+                  "peer %d shm: tx %zuB left (%zu free slots), rx %zuB left",
+                  peer_, send_left_, tx_.FreeSlots(), recv_left_);
+    return buf;
+  }
+
+ private:
+  // Granule per push: autotuned, clamped to the ring's slot capacity.
+  size_t ChunkCap() const {
+    size_t cap = tx_.slot_bytes();
+    int64_t g = g_shm_granule.load(std::memory_order_relaxed);
+    if (g > 0 && static_cast<size_t>(g) < cap) cap = static_cast<size_t>(g);
+    return cap;
+  }
+
+  int peer_;
+  Mapping tx_map_;
+  Mapping rx_map_;
+  shm::Ring tx_;
+  shm::Ring rx_;
+  const char* send_ptr_ = nullptr;
+  size_t send_left_ = 0;
+  char* recv_ptr_ = nullptr;
+  size_t recv_left_ = 0;
+  size_t recv_total_ = 0;
+};
+
+}  // namespace
+
+void SetShmGranule(int64_t bytes) {
+  g_shm_granule.store(bytes, std::memory_order_relaxed);
+}
+
+int64_t ShmGranule() { return g_shm_granule.load(std::memory_order_relaxed); }
+
+std::unique_ptr<Link> MakeShmLink(int self, int peer, bool creator,
+                                  const std::string& dir,
+                                  TcpSocket* handshake) {
+  int lo = self < peer ? self : peer;
+  int hi = self < peer ? peer : self;
+  // Directional ring files: `ab` carries lo -> hi payloads.
+  std::string path_ab =
+      dir + "/pair-" + std::to_string(lo) + "-" + std::to_string(hi) + "-ab";
+  std::string path_ba =
+      dir + "/pair-" + std::to_string(lo) + "-" + std::to_string(hi) + "-ba";
+
+  auto fail = [&](const std::string& why) -> std::unique_ptr<Link> {
+    LOG(Warning) << "shm link rank " << self << "<->" << peer
+                 << " unavailable (" << why << "); falling back to socket";
+    return nullptr;
+  };
+
+  if (creator) {
+    uint32_t slots = static_cast<uint32_t>(EnvInt("HOROVOD_SHM_SLOTS", 16));
+    uint32_t slot_bytes =
+        static_cast<uint32_t>(EnvInt("HOROVOD_SHM_SLOT_BYTES", 1 << 20));
+    if (slots < 2) slots = 2;
+    if (slot_bytes < 4096) slot_bytes = 4096;
+    size_t region = shm::Ring::RegionBytes(slots, slot_bytes);
+
+    Mapping map_ab, map_ba;
+    Status st = dir.empty()
+                    ? Status::Precondition("HOROVOD_SHM_DIR unset")
+                    : map_ab.CreateAndMap(path_ab, region);
+    if (st.ok()) st = map_ba.CreateAndMap(path_ba, region);
+    if (st.ok()) {
+      shm::Ring::Init(map_ab.base, slots, slot_bytes);
+      shm::Ring::Init(map_ba.base, slots, slot_bytes);
+      std::string offer = std::to_string(region) + "\n" + path_ab + "\n" +
+                          path_ba;
+      st = handshake->SendFrame(offer);
+      std::string ack;
+      if (st.ok()) st = handshake->RecvFrame(&ack);
+      if (st.ok() && ack != "ok")
+        st = Status::Unknown("peer rejected shm mapping: " + ack);
+      // Early unlink: from here on only the two mappings hold the
+      // memory — SIGKILL leaves no named segment behind.
+      ::unlink(path_ab.c_str());
+      ::unlink(path_ba.c_str());
+      if (st.ok()) {
+        Mapping tx = lo == self ? std::move(map_ab) : std::move(map_ba);
+        Mapping rx = lo == self ? std::move(map_ba) : std::move(map_ab);
+        auto link = std::make_unique<ShmLink>(peer, std::move(tx),
+                                              std::move(rx));
+        st = link->AttachRings();
+        if (st.ok()) return link;
+      }
+    } else {
+      ::unlink(path_ab.c_str());
+      ::unlink(path_ba.c_str());
+      // Keep the handshake stream in lockstep: report failure, drain ack.
+      handshake->SendFrame(std::string("fail: ") + st.reason);
+      std::string ack;
+      handshake->RecvFrame(&ack);
+    }
+    return fail(st.reason);
+  }
+
+  // Joiner: receive the offer, map, acknowledge.
+  std::string offer;
+  Status st = handshake->RecvFrame(&offer);
+  if (!st.ok()) return fail(st.reason);
+  if (offer.rfind("fail", 0) == 0) {
+    handshake->SendFrame(std::string("fail"));
+    return fail("creator reported: " + offer);
+  }
+  size_t nl1 = offer.find('\n');
+  size_t nl2 = nl1 == std::string::npos ? nl1 : offer.find('\n', nl1 + 1);
+  if (nl2 == std::string::npos) {
+    handshake->SendFrame(std::string("fail: malformed offer"));
+    return fail("malformed shm offer");
+  }
+  size_t region = static_cast<size_t>(std::stoll(offer.substr(0, nl1)));
+  std::string got_ab = offer.substr(nl1 + 1, nl2 - nl1 - 1);
+  std::string got_ba = offer.substr(nl2 + 1);
+
+  Mapping map_ab, map_ba;
+  st = map_ab.OpenAndMap(got_ab, region);
+  if (st.ok()) st = map_ba.OpenAndMap(got_ba, region);
+  std::unique_ptr<ShmLink> link;
+  if (st.ok()) {
+    Mapping tx = lo == self ? std::move(map_ab) : std::move(map_ba);
+    Mapping rx = lo == self ? std::move(map_ba) : std::move(map_ab);
+    link = std::make_unique<ShmLink>(peer, std::move(tx), std::move(rx));
+    st = link->AttachRings();
+  }
+  Status ackst =
+      handshake->SendFrame(st.ok() ? std::string("ok")
+                                   : std::string("fail: ") + st.reason);
+  if (!st.ok()) return fail(st.reason);
+  if (!ackst.ok()) return fail(ackst.reason);
+  return link;
+}
+
+}  // namespace transport
+}  // namespace hvd

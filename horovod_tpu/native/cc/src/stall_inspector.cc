@@ -2,6 +2,8 @@
 
 #include <sstream>
 
+#include "transport.h"
+
 namespace hvd {
 
 StallInspector::StallInspector()
@@ -28,6 +30,10 @@ bool StallInspector::Check(const std::string& name,
     const int64_t coord_rank = EnvInt("HOROVOD_COORD_RANK", 0);
     const int64_t coord_epoch = EnvInt("HOROVOD_COORD_EPOCH", 0);
     const int64_t elections = EnvInt("HOROVOD_COORD_ELECTIONS", 0);
+    // Per-link transport state (backend + bytes still in flight each
+    // way): a stall with one link mid-exchange names the wedged peer
+    // and backend directly, instead of leaving it to rank arithmetic.
+    const std::string links = transport::DescribeAll();
     LOG(Warning) << "One or more tensors were submitted to be reduced, "
                  << "gathered or broadcasted by subset of ranks and are "
                  << "waiting for remainder of ranks for more than "
@@ -39,7 +45,8 @@ bool StallInspector::Check(const std::string& name,
                  << (sched_check ? "" :
                      " Rerun with HOROVOD_SCHEDULE_CHECK=1 to catch the "
                      "first diverging submission (rank, call index, "
-                     "mismatched field) instead of waiting out the stall.");
+                     "mismatched field) instead of waiting out the stall.")
+                 << (links.empty() ? "" : "\n" + links);
   }
   return shutdown_s_ > 0 && age >= shutdown_s_;
 }

@@ -1,0 +1,446 @@
+// Striped multi-socket cross-host transport: HOROVOD_TRANSPORT_STRIPES
+// dedicated TCP connections per peer, each pumped full-duplex by its own
+// worker thread so one slow stream (or one saturated core) no longer
+// caps the link.
+//
+// The sender deals granule-sized chunks round-robin over its ACTIVE
+// stripes (live-tunable, <= configured stripes); every frame is
+// self-describing ({u32 seq, u32 len, u64 offset}, host order like the
+// rest of the wire protocol), so the receiver never needs to know the
+// sender's stripe count or granule — stripe_plan.h's Reassembly merges
+// whatever arrives and exposes the contiguous prefix as the pipelined
+// on_recv watermark.
+//
+// Seq gating keeps serialized exchanges safe without extra round trips:
+// each side numbers its sends and recvs 1, 2, 3...; a stripe that has
+// parsed a frame header for a seq the receiver has not armed yet simply
+// parks (the payload stays in the kernel buffer) until StartRecv
+// advances the armed seq.  Per-stripe TCP ordering guarantees a parsed
+// seq is never behind the armed one.
+#include <errno.h>
+#include <fcntl.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <thread>
+
+#include "socket.h"
+#include "stripe_plan.h"
+#include "trace.h"
+#include "transport.h"
+
+namespace hvd {
+namespace transport {
+
+namespace {
+
+std::atomic<int64_t> g_active_stripes{0};
+
+struct FrameHeader {
+  uint32_t seq;
+  uint32_t len;
+  uint64_t offset;
+};
+static_assert(sizeof(FrameHeader) == 16, "frame header layout");
+
+// Chunks dealt per exchange per stripe: enough rounds that active
+// stripes stay balanced even when TCP throughput varies between them.
+constexpr uint64_t kRoundsPerStripe = 2;
+constexpr uint64_t kMinGranule = 64 * 1024;
+
+class StripedLink : public Link {
+ public:
+  StripedLink(int peer, std::vector<TcpSocket> socks)
+      : peer_(peer), socks_(std::move(socks)) {
+    for (size_t s = 0; s < socks_.size(); ++s) {
+      int fl = ::fcntl(socks_[s].fd(), F_GETFL, 0);
+      ::fcntl(socks_[s].fd(), F_SETFL, fl | O_NONBLOCK);
+      stripes_.emplace_back(new Stripe());
+    }
+    for (size_t s = 0; s < socks_.size(); ++s)
+      stripes_[s]->thread =
+          std::thread([this, s]() { WorkerLoop(static_cast<int>(s)); });
+  }
+
+  ~StripedLink() override { Shutdown(); }
+
+  void Shutdown() override {
+    bool was = stop_.exchange(true, std::memory_order_acq_rel);
+    if (was) return;
+    for (auto& st : stripes_)
+      if (st->thread.joinable()) st->thread.join();
+  }
+
+  Backend backend() const override { return Backend::kStriped; }
+  int peer() const override { return peer_; }
+
+  void StartSend(const void* buf, size_t n) override {
+    if (n == 0) {
+      zero_send_ = true;
+      return;
+    }
+    zero_send_ = false;
+    link_level_.store(static_cast<int>(CurrentLevel()),
+                      std::memory_order_relaxed);
+    send_buf_ = static_cast<const char*>(buf);
+    uint64_t seq = armed_send_seq_.load(std::memory_order_relaxed) + 1;
+    int active = ActiveCount();
+    uint64_t granule = n / (static_cast<uint64_t>(active) * kRoundsPerStripe);
+    if (granule < kMinGranule) granule = kMinGranule;
+    auto plan = stripe::Plan(n, granule, static_cast<uint32_t>(active));
+    for (auto& st : stripes_) st->tx_chunks.clear();
+    for (const auto& c : plan)
+      stripes_[c.stripe]->tx_chunks.push_back(c);
+    // Publish: workers acquire this and see the chunk lists + buffer.
+    armed_send_seq_.store(seq, std::memory_order_release);
+  }
+
+  void StartRecv(void* buf, size_t n) override {
+    if (n == 0) {
+      zero_recv_ = true;
+      return;
+    }
+    zero_recv_ = false;
+    link_level_.store(static_cast<int>(CurrentLevel()),
+                      std::memory_order_relaxed);
+    recv_buf_ = static_cast<char*>(buf);
+    recv_expected_ = n;
+    {
+      std::lock_guard<std::mutex> lk(reasm_mu_);
+      reasm_.Reset(n);
+    }
+    rx_total_.store(0, std::memory_order_relaxed);
+    rx_contig_.store(0, std::memory_order_relaxed);
+    armed_recv_seq_.fetch_add(1, std::memory_order_release);
+  }
+
+  Status Progress() override {
+    // Workers do the I/O; the pump only surfaces their failures.
+    if (failed_.load(std::memory_order_acquire)) {
+      std::lock_guard<std::mutex> lk(err_mu_);
+      return err_;
+    }
+    return Status::OK();
+  }
+
+  bool SendDone() const override {
+    if (zero_send_) return true;
+    uint64_t seq = armed_send_seq_.load(std::memory_order_relaxed);
+    for (const auto& st : stripes_)
+      if (st->tx_done.load(std::memory_order_acquire) < seq) return false;
+    return true;
+  }
+
+  bool RecvDone() const override {
+    if (zero_recv_) return true;
+    return rx_total_.load(std::memory_order_acquire) >= recv_expected_;
+  }
+
+  size_t RecvBytes() const override {
+    if (zero_recv_) return 0;
+    return static_cast<size_t>(rx_contig_.load(std::memory_order_acquire));
+  }
+
+  std::string Describe() const override {
+    uint64_t sseq = armed_send_seq_.load(std::memory_order_relaxed);
+    uint64_t rseq = armed_recv_seq_.load(std::memory_order_relaxed);
+    char head[96];
+    std::snprintf(head, sizeof(head),
+                  "peer %d striped x%zu (send seq %llu, recv seq %llu):",
+                  peer_, stripes_.size(),
+                  static_cast<unsigned long long>(sseq),
+                  static_cast<unsigned long long>(rseq));
+    std::string out = head;
+    for (size_t s = 0; s < stripes_.size(); ++s) {
+      const Stripe& st = *stripes_[s];
+      char buf[128];
+      std::snprintf(buf, sizeof(buf),
+                    " [s%zu tx %zu/%zu chunks%s%s]", s,
+                    st.tx_chunk_idx.load(std::memory_order_relaxed),
+                    st.tx_chunks.size(),
+                    st.rx_gated.load(std::memory_order_relaxed) ? " rx-gated"
+                                                                : "",
+                    st.tx_done.load(std::memory_order_relaxed) <
+                            armed_send_seq_.load(std::memory_order_relaxed)
+                        ? " tx-pending"
+                        : "");
+      out += buf;
+    }
+    return out;
+  }
+
+ private:
+  struct Stripe {
+    std::thread thread;
+    std::vector<stripe::Chunk> tx_chunks;
+    std::atomic<uint64_t> tx_done{0};
+    std::atomic<size_t> tx_chunk_idx{0};
+    std::atomic<bool> rx_gated{false};
+  };
+
+  int ActiveCount() const {
+    int64_t a = g_active_stripes.load(std::memory_order_relaxed);
+    int n = static_cast<int>(stripes_.size());
+    if (a <= 0 || a > n) return n;
+    return static_cast<int>(a);
+  }
+
+  void Fail(const Status& st) {
+    {
+      std::lock_guard<std::mutex> lk(err_mu_);
+      if (err_.ok()) err_ = st;
+    }
+    failed_.store(true, std::memory_order_release);
+  }
+
+  struct TxCursor {
+    uint64_t seq = 0;       // exchange currently being written (0 = idle)
+    size_t chunk = 0;       // index into tx_chunks
+    size_t hdr_off = 0;     // header bytes already written
+    size_t pay_off = 0;     // payload bytes already written
+    FrameHeader hdr{};
+  };
+  struct RxCursor {
+    size_t hdr_off = 0;     // header bytes already read
+    size_t pay_off = 0;     // payload bytes already read
+    FrameHeader hdr{};
+  };
+
+  // One full-duplex pump round for stripe s.  Returns bytes moved, or
+  // -1 after Fail().
+  int64_t PumpOnce(int s, TxCursor& tx, RxCursor& rx);
+
+  void WorkerLoop(int s);
+
+  int peer_;
+  std::vector<TcpSocket> socks_;
+  std::vector<std::unique_ptr<Stripe>> stripes_;
+
+  const char* send_buf_ = nullptr;
+  std::atomic<uint64_t> armed_send_seq_{0};
+  bool zero_send_ = false;
+
+  char* recv_buf_ = nullptr;
+  size_t recv_expected_ = 0;
+  std::atomic<uint64_t> armed_recv_seq_{0};
+  bool zero_recv_ = false;
+  std::mutex reasm_mu_;
+  stripe::Reassembly reasm_;
+  std::atomic<uint64_t> rx_total_{0};
+  std::atomic<uint64_t> rx_contig_{0};
+
+  // Level of the exchange currently armed, captured from the arming
+  // thread's TLS so workers account against the right series.
+  std::atomic<int> link_level_{0};
+
+  std::atomic<bool> stop_{false};
+  std::atomic<bool> failed_{false};
+  std::mutex err_mu_;
+  Status err_;
+};
+
+int64_t StripedLink::PumpOnce(int s, TxCursor& tx, RxCursor& rx) {
+  Stripe& st = *stripes_[s];
+  int fd = socks_[s].fd();
+  int64_t moved = 0;
+
+  // ---- TX ----
+  uint64_t want = armed_send_seq_.load(std::memory_order_acquire);
+  if (tx.seq != want &&
+      st.tx_done.load(std::memory_order_relaxed) < want) {
+    tx.seq = want;
+    tx.chunk = 0;
+    tx.hdr_off = 0;
+    tx.pay_off = 0;
+    st.tx_chunk_idx.store(0, std::memory_order_relaxed);
+  }
+  while (tx.seq == want &&
+         st.tx_done.load(std::memory_order_relaxed) < want) {
+    if (tx.chunk >= st.tx_chunks.size()) {
+      st.tx_done.store(want, std::memory_order_release);
+      tx.seq = 0;
+      break;
+    }
+    const stripe::Chunk& c = st.tx_chunks[tx.chunk];
+    if (tx.hdr_off < sizeof(FrameHeader)) {
+      if (tx.hdr_off == 0)
+        tx.hdr = FrameHeader{static_cast<uint32_t>(want), c.len, c.offset};
+      const char* p = reinterpret_cast<const char*>(&tx.hdr) + tx.hdr_off;
+      ssize_t n = ::send(fd, p, sizeof(FrameHeader) - tx.hdr_off,
+                         MSG_DONTWAIT | MSG_NOSIGNAL);
+      if (n < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        if (errno == EINTR) continue;
+        Fail(Status::Unknown("striped send header to rank " +
+                             std::to_string(peer_) + " stripe " +
+                             std::to_string(s) + ": " + strerror(errno)));
+        return -1;
+      }
+      tx.hdr_off += static_cast<size_t>(n);
+      moved += n;
+      if (tx.hdr_off < sizeof(FrameHeader)) break;
+    }
+    {
+      const char* p = send_buf_ + c.offset + tx.pay_off;
+      ssize_t n = ::send(fd, p, c.len - tx.pay_off,
+                         MSG_DONTWAIT | MSG_NOSIGNAL);
+      if (n < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        if (errno == EINTR) continue;
+        Fail(Status::Unknown("striped send payload to rank " +
+                             std::to_string(peer_) + " stripe " +
+                             std::to_string(s) + ": " + strerror(errno)));
+        return -1;
+      }
+      tx.pay_off += static_cast<size_t>(n);
+      moved += n;
+      if (tx.pay_off < c.len) break;
+      ++tx.chunk;
+      st.tx_chunk_idx.store(tx.chunk, std::memory_order_relaxed);
+      tx.hdr_off = 0;
+      tx.pay_off = 0;
+    }
+  }
+
+  // ---- RX ----
+  while (true) {
+    if (rx.hdr_off < sizeof(FrameHeader)) {
+      char* p = reinterpret_cast<char*>(&rx.hdr) + rx.hdr_off;
+      ssize_t n = ::recv(fd, p, sizeof(FrameHeader) - rx.hdr_off,
+                         MSG_DONTWAIT);
+      if (n == 0) {
+        Fail(Status::Unknown("striped: rank " + std::to_string(peer_) +
+                             " closed stripe " + std::to_string(s)));
+        return -1;
+      }
+      if (n < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        if (errno == EINTR) continue;
+        Fail(Status::Unknown("striped recv header from rank " +
+                             std::to_string(peer_) + " stripe " +
+                             std::to_string(s) + ": " + strerror(errno)));
+        return -1;
+      }
+      rx.hdr_off += static_cast<size_t>(n);
+      moved += n;
+      if (rx.hdr_off < sizeof(FrameHeader)) break;
+    }
+    uint64_t armed = armed_recv_seq_.load(std::memory_order_acquire);
+    if (rx.hdr.seq > armed) {
+      // Frame for an exchange the receiver has not armed yet: park.
+      // Per-stripe TCP ordering means everything for the armed seq on
+      // this stripe already arrived, so parking cannot deadlock it.
+      st.rx_gated.store(true, std::memory_order_relaxed);
+      break;
+    }
+    st.rx_gated.store(false, std::memory_order_relaxed);
+    if (rx.hdr.seq < armed ||
+        rx.hdr.offset + rx.hdr.len > recv_expected_) {
+      Fail(Status::Unknown(
+          "striped: protocol violation from rank " + std::to_string(peer_) +
+          " stripe " + std::to_string(s) + ": frame seq " +
+          std::to_string(rx.hdr.seq) + " armed " + std::to_string(armed) +
+          " offset " + std::to_string(rx.hdr.offset) + "+" +
+          std::to_string(rx.hdr.len) + " expected " +
+          std::to_string(recv_expected_)));
+      return -1;
+    }
+    {
+      char* p = recv_buf_ + rx.hdr.offset + rx.pay_off;
+      ssize_t n = ::recv(fd, p, rx.hdr.len - rx.pay_off, MSG_DONTWAIT);
+      if (n == 0) {
+        Fail(Status::Unknown("striped: rank " + std::to_string(peer_) +
+                             " closed stripe " + std::to_string(s)));
+        return -1;
+      }
+      if (n < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        if (errno == EINTR) continue;
+        Fail(Status::Unknown("striped recv payload from rank " +
+                             std::to_string(peer_) + " stripe " +
+                             std::to_string(s) + ": " + strerror(errno)));
+        return -1;
+      }
+      rx.pay_off += static_cast<size_t>(n);
+      moved += n;
+      if (rx.pay_off < rx.hdr.len) break;
+      {
+        std::lock_guard<std::mutex> lk(reasm_mu_);
+        reasm_.Add(rx.hdr.offset, rx.hdr.len);
+        rx_contig_.store(reasm_.contiguous(), std::memory_order_release);
+      }
+      rx_total_.fetch_add(rx.hdr.len, std::memory_order_release);
+      rx.hdr_off = 0;
+      rx.pay_off = 0;
+    }
+  }
+
+  return moved;
+}
+
+void StripedLink::WorkerLoop(int s) {
+  Stripe& st = *stripes_[s];
+  TxCursor tx;
+  RxCursor rx;
+  int idle_rounds = 0;
+  while (!stop_.load(std::memory_order_acquire)) {
+    if (failed_.load(std::memory_order_acquire)) return;
+    int64_t t0 = PumpClockUs();
+    int64_t moved = PumpOnce(s, tx, rx);
+    if (moved < 0) return;
+    if (moved > 0) {
+      AccountAt(Backend::kStriped,
+                static_cast<Level>(link_level_.load(std::memory_order_relaxed)),
+                moved, PumpClockUs() - t0);
+      idle_rounds = 0;
+      continue;
+    }
+    ++idle_rounds;
+    if (idle_rounds < 256) continue;  // brisk spin keeps arming latency low
+    bool tx_pending =
+        st.tx_done.load(std::memory_order_relaxed) <
+        armed_send_seq_.load(std::memory_order_relaxed);
+    bool gated = st.rx_gated.load(std::memory_order_relaxed);
+    if (gated && !tx_pending) {
+      // Data is readable but parked behind the seq gate: polling POLLIN
+      // would spin hot, so sleep instead.
+      struct timespec ts {0, 100 * 1000};
+      nanosleep(&ts, nullptr);
+      continue;
+    }
+    struct pollfd pfd;
+    pfd.fd = socks_[s].fd();
+    pfd.events = static_cast<short>(POLLIN | (tx_pending ? POLLOUT : 0));
+    pfd.revents = 0;
+    ::poll(&pfd, 1, 1);  // 1ms cap on arming-notice latency
+  }
+}
+
+}  // namespace
+
+void SetActiveStripes(int64_t stripes) {
+  g_active_stripes.store(stripes, std::memory_order_relaxed);
+}
+
+int64_t ActiveStripes() {
+  return g_active_stripes.load(std::memory_order_relaxed);
+}
+
+std::unique_ptr<Link> MakeStripedLink(int self, int peer,
+                                      std::vector<TcpSocket> socks) {
+  if (socks.empty()) {
+    LOG(Warning) << "striped link rank " << self << "<->" << peer
+                 << " has no stripe sockets; falling back to single socket";
+    return nullptr;
+  }
+  (void)self;
+  return std::make_unique<StripedLink>(peer, std::move(socks));
+}
+
+}  // namespace transport
+}  // namespace hvd

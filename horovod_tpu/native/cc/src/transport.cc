@@ -1,0 +1,265 @@
+// Transport registry core: backend selection, per-(backend, level)
+// accounting, the single-TCP-stream SocketLink, and the global link
+// registry behind stall-report describes.  See transport.h.
+#include "transport.h"
+
+#include <errno.h>
+#include <poll.h>
+#include <sched.h>
+#include <sys/socket.h>
+#include <time.h>
+
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+
+#include "socket.h"
+#include "trace.h"
+
+namespace hvd {
+namespace transport {
+
+// --------------------------------------------------------------------------
+// Selection.
+// --------------------------------------------------------------------------
+
+Mode ParseMode(const std::string& s) {
+  if (s == "shm") return Mode::kShm;
+  if (s == "striped") return Mode::kStriped;
+  if (s == "socket") return Mode::kSocket;
+  if (!s.empty() && s != "auto") {
+    LOG(Warning) << "HOROVOD_TRANSPORT=" << s
+                 << " not recognized (auto|shm|striped|socket); using auto";
+  }
+  return Mode::kAuto;
+}
+
+const char* ModeName(Mode m) {
+  switch (m) {
+    case Mode::kShm: return "shm";
+    case Mode::kStriped: return "striped";
+    case Mode::kSocket: return "socket";
+    default: return "auto";
+  }
+}
+
+const char* BackendName(Backend b) {
+  switch (b) {
+    case Backend::kShm: return "shm";
+    case Backend::kStriped: return "striped";
+    default: return "socket";
+  }
+}
+
+const char* LevelName(Level l) {
+  switch (l) {
+    case Level::kLocal: return "local";
+    case Level::kCross: return "cross";
+    default: return "flat";
+  }
+}
+
+Backend Enabled(Mode mode, bool same_host, int stripes) {
+  switch (mode) {
+    case Mode::kSocket:
+      return Backend::kSocket;
+    case Mode::kShm:
+      // Forced shm: cross-host peers cannot share memory, fall through
+      // to the socket stream for them.
+      return same_host ? Backend::kShm : Backend::kSocket;
+    case Mode::kStriped:
+      // Forced striping applies to ALL peers (host placement ignored) so
+      // a loopback np=2 rig can A/B stripe counts without fake hosts.
+      return stripes > 1 ? Backend::kStriped : Backend::kSocket;
+    case Mode::kAuto:
+    default:
+      if (same_host) return Backend::kShm;
+      if (stripes > 1) return Backend::kStriped;
+      return Backend::kSocket;
+  }
+}
+
+// --------------------------------------------------------------------------
+// Accounting.
+// --------------------------------------------------------------------------
+
+namespace {
+std::atomic<int64_t> g_counters[kNumBackends][kNumLevels][kNumCounters];
+thread_local Level t_level = Level::kFlat;
+}  // namespace
+
+void SetLevel(Level l) { t_level = l; }
+Level CurrentLevel() { return t_level; }
+
+void Account(Backend b, int64_t bytes, int64_t micros) {
+  AccountAt(b, t_level, bytes, micros);
+}
+
+void AccountAt(Backend b, Level l, int64_t bytes, int64_t micros) {
+  auto* row = g_counters[static_cast<int>(b)][static_cast<int>(l)];
+  row[static_cast<int>(Counter::kBytes)].fetch_add(
+      bytes, std::memory_order_relaxed);
+  row[static_cast<int>(Counter::kMicros)].fetch_add(
+      micros, std::memory_order_relaxed);
+  row[static_cast<int>(Counter::kOps)].fetch_add(1, std::memory_order_relaxed);
+}
+
+int64_t CounterValue(int backend, int level, int counter) {
+  if (backend < 0 || backend >= kNumBackends || level < 0 ||
+      level >= kNumLevels || counter < 0 || counter >= kNumCounters)
+    return -1;
+  return g_counters[backend][level][counter].load(std::memory_order_relaxed);
+}
+
+int64_t PumpClockUs() {
+  struct timespec ts;
+  if (clock_gettime(CLOCK_THREAD_CPUTIME_ID, &ts) != 0) return 0;
+  return static_cast<int64_t>(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
+}
+
+// --------------------------------------------------------------------------
+// Blocking helpers shared by every backend.
+// --------------------------------------------------------------------------
+
+namespace {
+// Progressively back off while a pump makes no progress: spin, then
+// yield, then sleep 100us so a long-stalled peer doesn't burn a core.
+inline void PumpBackoff(int idle_rounds) {
+  if (idle_rounds < 64) return;
+  if (idle_rounds < 1024) {
+    sched_yield();
+    return;
+  }
+  struct timespec ts {0, 100 * 1000};
+  nanosleep(&ts, nullptr);
+}
+}  // namespace
+
+Status Link::Send(const void* buf, size_t n) {
+  StartSend(buf, n);
+  int idle = 0;
+  while (!SendDone()) {
+    Status st = Progress();
+    if (!st.ok()) return st;
+    PumpBackoff(idle++);
+  }
+  return Status::OK();
+}
+
+Status Link::Recv(void* buf, size_t n) {
+  StartRecv(buf, n);
+  int idle = 0;
+  while (!RecvDone()) {
+    Status st = Progress();
+    if (!st.ok()) return st;
+    PumpBackoff(idle++);
+  }
+  return Status::OK();
+}
+
+// --------------------------------------------------------------------------
+// SocketLink.
+// --------------------------------------------------------------------------
+
+void SocketLink::StartSend(const void* buf, size_t n) {
+  send_ptr_ = static_cast<const char*>(buf);
+  send_left_ = n;
+}
+
+void SocketLink::StartRecv(void* buf, size_t n) {
+  recv_ptr_ = static_cast<char*>(buf);
+  recv_left_ = n;
+  recv_total_ = n;
+}
+
+Status SocketLink::Progress() {
+  int64_t moved = 0;
+  int64_t t0 = 0;
+  while (send_left_ > 0) {
+    if (t0 == 0) t0 = PumpClockUs();
+    ssize_t n = ::send(sock_->fd(), send_ptr_, send_left_,
+                       MSG_DONTWAIT | MSG_NOSIGNAL);
+    if (n > 0) {
+      send_ptr_ += n;
+      send_left_ -= static_cast<size_t>(n);
+      moved += n;
+      continue;
+    }
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+    if (n < 0 && errno == EINTR) continue;
+    return Status::Unknown("transport socket send to rank " +
+                           std::to_string(peer_) + " failed: " +
+                           std::string(strerror(errno)));
+  }
+  while (recv_left_ > 0) {
+    if (t0 == 0) t0 = PumpClockUs();
+    ssize_t n = ::recv(sock_->fd(), recv_ptr_, recv_left_, MSG_DONTWAIT);
+    if (n > 0) {
+      recv_ptr_ += n;
+      recv_left_ -= static_cast<size_t>(n);
+      moved += n;
+      continue;
+    }
+    if (n == 0)
+      return Status::Unknown("transport socket: rank " +
+                             std::to_string(peer_) + " closed connection");
+    if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+    if (errno == EINTR) continue;
+    return Status::Unknown("transport socket recv from rank " +
+                           std::to_string(peer_) + " failed: " +
+                           std::string(strerror(errno)));
+  }
+  if (moved > 0) Account(Backend::kSocket, moved, PumpClockUs() - t0);
+  return Status::OK();
+}
+
+int SocketLink::PollFd(short* events) const {
+  short ev = 0;
+  if (send_left_ > 0) ev |= POLLOUT;
+  if (recv_left_ > 0) ev |= POLLIN;
+  if (ev == 0) return -1;
+  *events = ev;
+  return sock_->fd();
+}
+
+std::string SocketLink::Describe() const {
+  char buf[128];
+  std::snprintf(buf, sizeof(buf), "peer %d socket: tx %zuB left, rx %zuB left",
+                peer_, send_left_, recv_left_);
+  return buf;
+}
+
+// --------------------------------------------------------------------------
+// Link registry (stall reports).
+// --------------------------------------------------------------------------
+
+namespace {
+std::mutex g_links_mu;
+std::vector<Link*> g_links;
+}  // namespace
+
+void RegisterLinks(const std::vector<Link*>& links) {
+  std::lock_guard<std::mutex> lk(g_links_mu);
+  g_links = links;
+}
+
+void ClearLinks() {
+  std::lock_guard<std::mutex> lk(g_links_mu);
+  g_links.clear();
+}
+
+std::string DescribeAll() {
+  std::lock_guard<std::mutex> lk(g_links_mu);
+  if (g_links.empty()) return "";
+  std::string out = "transport links:";
+  for (Link* l : g_links) {
+    out += "\n  [";
+    out += BackendName(l->backend());
+    out += "] ";
+    out += l->Describe();
+  }
+  return out;
+}
+
+}  // namespace transport
+}  // namespace hvd

@@ -1,0 +1,118 @@
+// Stripe plan + reassembly oracle: round-robin dealing, out-of-order
+// chunk arrival, and the one-stripe-stall watermark property the
+// pipelined reduce depends on (stripe_plan.h).
+#include <algorithm>
+#include <cassert>
+#include <cstdio>
+#include <random>
+#include <vector>
+
+#include "stripe_plan.h"
+
+using hvd::stripe::Chunk;
+using hvd::stripe::Plan;
+using hvd::stripe::Reassembly;
+
+namespace {
+
+void TestPlanCoversExactly() {
+  // Every byte of [0, n) appears in exactly one chunk, chunks rotate
+  // stripes round-robin, and no chunk exceeds the granule.
+  const uint64_t n = 10 * 1024 * 1024 + 137;  // deliberately ragged
+  const uint64_t granule = 256 * 1024;
+  auto plan = Plan(n, granule, 4);
+  uint64_t off = 0;
+  for (size_t i = 0; i < plan.size(); ++i) {
+    assert(plan[i].offset == off);
+    assert(plan[i].len <= granule);
+    assert(plan[i].stripe == i % 4);
+    off += plan[i].len;
+  }
+  assert(off == n);
+  // Degenerate shapes.
+  assert(Plan(0, granule, 4).empty());
+  auto one = Plan(100, 0, 0);  // clamped: one chunk, one stripe
+  assert(one.size() == 1 && one[0].len == 100 && one[0].stripe == 0);
+  std::printf("plan: exact cover, round-robin, clamps OK\n");
+}
+
+void TestOutOfOrderArrival() {
+  // Deliver a 4-stripe plan in a shuffled order: total() completes and
+  // contiguous() reaches expected regardless of arrival order.
+  const uint64_t n = 1 << 20;
+  auto plan = Plan(n, 64 * 1024, 4);
+  std::mt19937 rng(42);
+  std::shuffle(plan.begin(), plan.end(), rng);
+  Reassembly r;
+  r.Reset(n);
+  for (const auto& c : plan) {
+    r.Add(c.offset, c.len);
+    assert(r.contiguous() <= r.total());
+    assert(r.total() <= n);
+  }
+  assert(r.complete());
+  assert(r.contiguous() == n);
+  assert(r.total() == n);
+  std::printf("out-of-order: shuffled arrival reassembles OK\n");
+}
+
+void TestOneStripeStall() {
+  // Stripe 0 stalls: its chunks never arrive.  The contiguous watermark
+  // must cap at the first missing byte (the pipelined reduce stops
+  // there) while total() keeps counting the other stripes' bytes —
+  // then releasing the stalled stripe completes everything.
+  const uint64_t n = 1 << 20;
+  auto plan = Plan(n, 64 * 1024, 4);
+  Reassembly r;
+  r.Reset(n);
+  uint64_t first_stalled = n;
+  for (const auto& c : plan)
+    if (c.stripe == 0) first_stalled = std::min(first_stalled, c.offset);
+  uint64_t delivered = 0;
+  for (const auto& c : plan) {
+    if (c.stripe == 0) continue;
+    r.Add(c.offset, c.len);
+    delivered += c.len;
+  }
+  assert(!r.complete());
+  assert(r.total() == delivered);
+  assert(r.contiguous() == first_stalled);
+  for (const auto& c : plan)
+    if (c.stripe == 0) r.Add(c.offset, c.len);
+  assert(r.complete());
+  assert(r.contiguous() == n);
+  std::printf("one-stripe-stall: watermark caps at stall, recovers OK\n");
+}
+
+void TestWatermarkMonotone() {
+  // Random interval arrival: contiguous() is monotone and never claims
+  // bytes that have not arrived.
+  const uint64_t n = 1 << 18;
+  auto plan = Plan(n, 4096, 7);
+  std::mt19937 rng(7);
+  std::shuffle(plan.begin(), plan.end(), rng);
+  Reassembly r;
+  r.Reset(n);
+  std::vector<bool> seen(n, false);
+  uint64_t last = 0;
+  for (const auto& c : plan) {
+    r.Add(c.offset, c.len);
+    for (uint64_t b = c.offset; b < c.offset + c.len; ++b) seen[b] = true;
+    assert(r.contiguous() >= last);
+    last = r.contiguous();
+    for (uint64_t b = 0; b < last; ++b) assert(seen[b]);
+  }
+  assert(last == n);
+  std::printf("watermark: monotone and never over-claims OK\n");
+}
+
+}  // namespace
+
+int main() {
+  TestPlanCoversExactly();
+  TestOutOfOrderArrival();
+  TestOneStripeStall();
+  TestWatermarkMonotone();
+  std::printf("test_stripe_plan: all OK\n");
+  return 0;
+}

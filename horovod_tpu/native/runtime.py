@@ -29,6 +29,10 @@ log = get_logger(__name__)
 _OP_NAMES = {0: "allreduce", 1: "allgather", 2: "broadcast", 3: "alltoall",
              4: "reducescatter", 5: "barrier", 6: "join", 7: "process_set"}
 
+# hvd_transport_counter index labels (transport.h Backend/Level enums).
+_TRANSPORT_BACKENDS = ("socket", "shm", "striped")
+_TRANSPORT_LEVELS = ("flat", "local", "cross")
+
 
 class _TraceSpan(ctypes.Structure):
     """Mirror of ``hvd_trace_span_t`` (c_api.h): 72 bytes of char arrays
@@ -243,6 +247,35 @@ class Runtime:
                 fn.restype = ctypes.c_longlong
                 self._hier_counter_fns[sym] = fn
         self._hier_published = {}   # sym -> last value already inc'd
+        # Transport-backend introspection (transport.h): the counter
+        # matrix indexed by (backend, level, kind), link-topology flags
+        # and the per-link describe lines for stall reports.
+        self._transport_counter_fn = getattr(
+            lib, "hvd_transport_counter", None)
+        if self._transport_counter_fn is not None:
+            self._transport_counter_fn.argtypes = [ctypes.c_int,
+                                                   ctypes.c_int,
+                                                   ctypes.c_int]
+            self._transport_counter_fn.restype = ctypes.c_longlong
+        self._transport_shm_fn = getattr(
+            lib, "hvd_transport_shm_links", None)
+        self._transport_striped_fn = getattr(
+            lib, "hvd_transport_striped_links", None)
+        self._transport_stripes_fn = getattr(
+            lib, "hvd_transport_stripes", None)
+        self._tuned_stripes_fn = getattr(
+            lib, "hvd_tuned_transport_stripes", None)
+        self._tuned_shm_granule_fn = getattr(
+            lib, "hvd_tuned_shm_granule", None)
+        if self._tuned_shm_granule_fn is not None:
+            self._tuned_shm_granule_fn.restype = ctypes.c_longlong
+        self._transport_describe_fn = getattr(
+            lib, "hvd_transport_describe", None)
+        if self._transport_describe_fn is not None:
+            self._transport_describe_fn.argtypes = [ctypes.c_char_p,
+                                                    ctypes.c_int]
+            self._transport_describe_fn.restype = ctypes.c_int
+        self._transport_published = {}  # (b, l, kind) -> last value
         # Distributed tracing (HOROVOD_TRACE): the native plane buffers
         # its spans in C++ and Python drains them here (watchdog + stop).
         self._trace_enabled_fn = getattr(lib, "hvd_trace_enabled", None)
@@ -274,6 +307,11 @@ class Runtime:
         # below the ops layer.)
         from horovod_tpu.ops import fusion as _fusion
         _fusion.set_live_threshold_provider(self._live_fusion_threshold)
+        # The telemetry at-exit export can run before basics.shutdown()
+        # (atexit LIFO); the hook guarantees the final gauge/counter
+        # deltas reach the snapshot even for jobs shorter than the
+        # watchdog's first publish tick.
+        telemetry.register_metrics_flush_hook(self._publish_autotune_gauges)
         if self._op_warn:
             self._watchdog_stop = threading.Event()
             self._watchdog_thread = threading.Thread(
@@ -292,6 +330,8 @@ class Runtime:
             # so the metrics summary records the config the job ended on.
             self._publish_autotune_gauges()
             self._drain_native_spans()
+            telemetry.unregister_metrics_flush_hook(
+                self._publish_autotune_gauges)
             telemetry.unregister_span_flush_hook(self._drain_native_spans)
             from horovod_tpu.ops import fusion as _fusion
             _fusion.set_live_threshold_provider(None)
@@ -330,6 +370,39 @@ class Runtime:
         and bad-topology fallbacks."""
         return bool(self._coord_tree_fn and self._coord_tree_fn())
 
+    # -- transport-backend introspection -----------------------------------
+
+    def transport_counters(self) -> dict:
+        """The native transport counter matrix as
+        ``{(backend, level): {"bytes", "seconds", "ops"}}``, omitting
+        all-zero cells.  Backends: socket/shm/striped; levels mirror the
+        hierarchical routing (flat/local/cross).  Counters are monotonic
+        since process start; the np=2 CI gate asserts engagement from
+        them (shm bytes > 0, socket bytes == 0 intra-host)."""
+        fn = self._transport_counter_fn
+        if fn is None or self._lib is None:
+            return {}
+        out = {}
+        for b, backend in enumerate(_TRANSPORT_BACKENDS):
+            for lv, level in enumerate(_TRANSPORT_LEVELS):
+                by = int(fn(b, lv, 0))
+                us = int(fn(b, lv, 1))
+                ops = int(fn(b, lv, 2))
+                if by or us or ops:
+                    out[(backend, level)] = {
+                        "bytes": by, "seconds": us / 1e6, "ops": ops}
+        return out
+
+    def transport_describe(self) -> str:
+        """Per-link state lines from the native transport registry
+        ("peer N shm: tx ..B left"); empty without links or on an old
+        library.  Feeds stall reports."""
+        if self._transport_describe_fn is None or self._lib is None:
+            return ""
+        buf = ctypes.create_string_buffer(8192)
+        n = self._transport_describe_fn(buf, len(buf))
+        return buf.raw[:max(n, 0)].decode("utf-8", "replace")
+
     # -- adaptive-control-plane introspection ------------------------------
 
     def tuned_config(self) -> dict:
@@ -365,6 +438,16 @@ class Runtime:
             "hier_allgather": self.hierarchical_allgather_enabled(),
             "hier_available": bool(self._hier_avail_fn
                                    and self._hier_avail_fn()),
+            # Transport backends as the data plane negotiated them, plus
+            # the live (possibly autotuned) knobs.  0 = knob untouched.
+            "transport_shm": bool(self._transport_shm_fn
+                                  and self._transport_shm_fn()),
+            "transport_striped": bool(self._transport_striped_fn
+                                      and self._transport_striped_fn()),
+            "transport_stripes": int(self._tuned_stripes_fn())
+            if self._tuned_stripes_fn is not None else 0,
+            "shm_granule_bytes": int(self._tuned_shm_granule_fn())
+            if self._tuned_shm_granule_fn is not None else 0,
         }
 
     def sync_tuned_config(self) -> dict:
@@ -393,7 +476,9 @@ class Runtime:
         local = np.array([cfg["fusion_threshold_bytes"],
                           cfg["chunk_bytes"],
                           1 if cfg.get("hier_allreduce") else 0,
-                          1 if cfg.get("hier_allgather") else 0],
+                          1 if cfg.get("hier_allgather") else 0,
+                          cfg.get("transport_stripes", 0),
+                          cfg.get("shm_granule_bytes", 0)],
                          dtype=np.int64)
         self._sync_seq = getattr(self, "_sync_seq", 0) + 1
         # 3 = ReduceOp Min (ops/collective.py; hvd_common.h kMin) — any
@@ -412,6 +497,9 @@ class Runtime:
         if agreed.size >= 4:   # old peers may still send 2-wide payloads
             out["hier_allreduce"] = bool(agreed[2])
             out["hier_allgather"] = bool(agreed[3])
+        if agreed.size >= 6:   # transport knobs ride positions 4 and 5
+            out["transport_stripes"] = int(agreed[4])
+            out["shm_granule_bytes"] = int(agreed[5])
         return out
 
     def _publish_autotune_gauges(self) -> None:
@@ -447,7 +535,17 @@ class Runtime:
             "hvd_autotune_hier_allgather",
             "1 while the 2-level eager allgather routing is active",
         ).set(1.0 if cfg.get("hier_allgather") else 0.0)
+        telemetry.gauge(
+            "hvd_autotune_transport_stripes",
+            "Active stripes per striped cross-host link (0 = no striped "
+            "links)",
+        ).set(float(cfg.get("transport_stripes", 0)))
+        telemetry.gauge(
+            "hvd_autotune_shm_granule_bytes",
+            "Active shm push granule (0 = whole-slot pushes)",
+        ).set(float(cfg.get("shm_granule_bytes", 0)))
         self._publish_hier_metrics()
+        self._publish_transport_metrics()
 
     def _drain_native_spans(self) -> None:
         """Move buffered native spans (trace.cc) into the Python span
@@ -582,6 +680,40 @@ class Runtime:
         bump("hvd_collective_bytes_total", wire_help, cross_ag,
              plane="eager", kind="allgather", codec="none", level="cross")
 
+    def _publish_transport_metrics(self) -> None:
+        """``hvd_transport_*`` series (docs/metrics.md): bytes,
+        thread-CPU pump seconds and pump rounds per (backend, level)
+        from the native counter matrix.  Like the hier counters, the
+        native values are monotonic and telemetry counters only inc(),
+        so each publish adds the delta since the previous one."""
+        if not telemetry.enabled() or self._transport_counter_fn is None \
+                or self._lib is None:
+            return
+        fn = self._transport_counter_fn
+
+        def bump(name, help_text, kind, scale, b, lv, backend, level):
+            now = int(fn(b, lv, kind))
+            key = (b, lv, kind)
+            d = now - self._transport_published.get(key, 0)
+            if d > 0:
+                self._transport_published[key] = now
+                telemetry.counter(name, help_text, backend=backend,
+                                  level=level).inc(d * scale)
+
+        for b, backend in enumerate(_TRANSPORT_BACKENDS):
+            for lv, level in enumerate(_TRANSPORT_LEVELS):
+                bump("hvd_transport_bytes_total",
+                     "Payload bytes moved per transport backend and "
+                     "hierarchical level", 0, 1.0, b, lv, backend, level)
+                bump("hvd_transport_seconds_total",
+                     "Thread-CPU seconds the transport pumps spent "
+                     "moving bytes per backend and level",
+                     1, 1e-6, b, lv, backend, level)
+                bump("hvd_transport_ops_total",
+                     "Transport pump rounds that moved bytes (socket "
+                     "drains, shm slot pushes, stripe pumps)",
+                     2, 1.0, b, lv, backend, level)
+
     # -- collectives -------------------------------------------------------
 
     def _submit(self, op: int, name: str, arr: np.ndarray, arg: int = 0,
@@ -652,6 +784,19 @@ class Runtime:
                 f"{cfg['chunk_bytes']}"
                 + (", autotuner exploring" if cfg["exploring"] else "")
                 + ".")
+        # Name the active transport backends and per-link/stripe state: a
+        # stall with a parked stripe or a backpressured shm ring points
+        # at the transport, and the report should show it directly.
+        transport_note = ""
+        desc = self.transport_describe()
+        if desc:
+            backends = [b for b, flag in (
+                ("shm", cfg.get("transport_shm")),
+                ("striped", cfg.get("transport_striped"))) if flag]
+            transport_note = (
+                " Active transport backends: "
+                + (", ".join(backends) if backends else "socket")
+                + ". " + desc.replace("\n", "; ").strip())
         sched_note = ""
         if not (self._sched_check_fn is not None and self._sched_check_fn()):
             sched_note = (
@@ -677,7 +822,7 @@ class Runtime:
             f"SECONDS, reports the authoritative list on rank 0). "
             f"Possible causes: a crashed or hung peer, a deadlocked "
             f"submission order, or a network partition." + coord_note
-            + cfg_note + sched_note)
+            + cfg_note + transport_note + sched_note)
 
     def _watchdog(self) -> None:
         """Background stall reporter for the default (no hard timeout)

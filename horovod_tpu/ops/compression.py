@@ -656,6 +656,56 @@ def resolve_codec(compression=None) -> BucketCodec:
     return c
 
 
+_LINK_LEVELS = ("flat", "local", "cross")
+_warned_bad_link_env = False
+
+
+def link_codec(level: str, compression=None) -> BucketCodec:
+    """The codec for one transport link level (``flat``, ``local`` or
+    ``cross``), consulting ``HOROVOD_TRANSPORT_CODECS``.
+
+    The transport plane moves intra-host traffic over shm rings and
+    cross-host traffic over (striped) sockets; their bandwidths differ by
+    orders of magnitude, so one global codec is the wrong trade on one of
+    the two.  ``HOROVOD_TRANSPORT_CODECS="cross:fp16,local:none"``
+    overrides per level; levels it does not name (and any parse error)
+    fall back to :func:`resolve_codec`'s answer for ``compression`` —
+    i.e. the global ``HOROVOD_COMPRESSION`` path.  Every rank sees the
+    same environment under hvdrun, so per-level selection stays
+    rank-agreed the same way the global codec does."""
+    global _warned_bad_link_env
+    base = resolve_codec(compression)
+    if level not in _LINK_LEVELS:
+        raise ValueError(
+            f"unknown link level {level!r}: expected one of {_LINK_LEVELS}")
+    spec = os.environ.get("HOROVOD_TRANSPORT_CODECS", "").strip()
+    if not spec:
+        return base
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        lvl, sep, codec_spec = part.partition(":")
+        if not sep or lvl.strip() not in _LINK_LEVELS:
+            if not _warned_bad_link_env:
+                _warned_bad_link_env = True
+                log.warning(
+                    "HOROVOD_TRANSPORT_CODECS=%r ignored entry %r: "
+                    "expected level:codec with level in %s",
+                    spec, part, _LINK_LEVELS)
+            continue
+        if lvl.strip() == level:
+            try:
+                return parse_codec(codec_spec)
+            except ValueError as e:
+                if not _warned_bad_link_env:
+                    _warned_bad_link_env = True
+                    log.warning("HOROVOD_TRANSPORT_CODECS=%r ignored: %s",
+                                spec, e)
+                return base
+    return base
+
+
 def as_legacy(codec: BucketCodec):
     """The legacy per-tensor :class:`Compressor` equivalent of a stateless
     codec (for the eager / replicated-allreduce paths), or ``None`` when

@@ -209,6 +209,84 @@ def _torch_mark() -> str:
         return "[ ]"
 
 
+def shm_base_dir() -> str:
+    """Base directory for per-job shm transport namespaces: tmpfs when
+    the host has one (ring files there are true shared memory), else the
+    regular temp dir (still mmap-shareable, just page-cache backed)."""
+    return "/dev/shm" if os.path.isdir("/dev/shm") else \
+        tempfile.gettempdir()
+
+
+def provision_shm_dir(base: Optional[str] = None) -> str:
+    """Create this job's shm namespace (``hvd-shm-<pid>-*``) and stamp
+    it with an ``owner.pid`` marker so :func:`sweep_orphan_shm_dirs`
+    can prove the owning launcher is gone before reclaiming it."""
+    base = base or shm_base_dir()
+    path = tempfile.mkdtemp(prefix=f"hvd-shm-{os.getpid()}-", dir=base)
+    with open(os.path.join(path, "owner.pid"), "w") as f:
+        f.write(f"{os.getpid()}\n")
+    return path
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True   # exists, just not ours to signal
+    except OSError:
+        return False
+    return True
+
+
+def sweep_orphan_shm_dirs(base: Optional[str] = None) -> int:
+    """Reclaim ``hvd-shm-*`` namespaces whose owning launcher is dead
+    (SIGKILL leaves no chance to run the ``finally`` cleanup — the NEXT
+    launch on the host sweeps instead).  A dir whose ``owner.pid`` names
+    a live process is left alone; one with a missing or unreadable
+    marker is treated as orphaned.  Returns the number removed."""
+    base = base or shm_base_dir()
+    swept = 0
+    try:
+        entries = os.listdir(base)
+    except OSError:
+        return 0
+    for name in entries:
+        if not name.startswith("hvd-shm-"):
+            continue
+        path = os.path.join(base, name)
+        if not os.path.isdir(path):
+            continue
+        try:
+            with open(os.path.join(path, "owner.pid")) as f:
+                pid = int(f.read().strip())
+        except (OSError, ValueError):
+            pid = None
+        if pid is not None and _pid_alive(pid):
+            continue
+        shutil.rmtree(path, ignore_errors=True)
+        swept += 1
+    return swept
+
+
+def wipe_shm_dir(path: str) -> None:
+    """Drop every ring file in the namespace but keep the dir and its
+    ``owner.pid`` marker — used between elastic restart attempts so the
+    fresh attempt's shm handshake never attaches to a dead ring."""
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return
+    for name in names:
+        if name == "owner.pid":
+            continue
+        try:
+            os.unlink(os.path.join(path, name))
+        except OSError:
+            pass
+
+
 def run_command(args) -> int:
     """Resolved-args entry, shared with tests."""
     if args.hostfile:
@@ -300,6 +378,21 @@ def run_command(args) -> int:
     # restart attempts so a new attempt's ranks find the old attempt's
     # spills.  A user-provided HOROVOD_SPILL_DIR is respected (and never
     # deleted); otherwise the launcher owns a temp dir for the job.
+    # Shared-memory transport namespace (docs/performance.md "Transport
+    # backends"): sweep orphans left by SIGKILLed launchers first, then
+    # provision one per-job dir with an owner.pid marker so the NEXT
+    # launcher can tell a live job's namespace from a dead one's.  A
+    # user-provided HOROVOD_SHM_DIR is respected (and never deleted).
+    swept = sweep_orphan_shm_dirs()
+    if swept:
+        print(f"hvdrun: swept {swept} orphaned shm transport "
+              f"namespace(s) from dead jobs", file=sys.stderr, flush=True)
+    owned_shm_dir = None
+    shm_dir = config.env_str("HOROVOD_SHM_DIR", "").strip()
+    if not shm_dir:
+        owned_shm_dir = provision_shm_dir()
+        shm_dir = owned_shm_dir
+    extra_env["HOROVOD_SHM_DIR"] = shm_dir
     owned_spill_dir = None
     spill_scratch = config.env_str("HOROVOD_SPILL_DIR", "").strip()
     if restarts > 0 and not spill_scratch:
@@ -321,6 +414,10 @@ def run_command(args) -> int:
                 telemetry.counter(
                     "hvd_elastic_restarts_total",
                     "Whole-job elastic restart attempts").inc()
+                if owned_shm_dir is not None:
+                    # Stale ring files from the dead attempt must not
+                    # collide with the fresh attempt's shm handshake.
+                    wipe_shm_dir(owned_shm_dir)
                 if rc == PREEMPTION_RC:
                     # Preemption: the ranks checkpointed and asked to be
                     # rescheduled — no backoff (the host is healthy, the
@@ -425,6 +522,10 @@ def run_command(args) -> int:
             health.shutdown()
         if owned_spill_dir is not None:
             shutil.rmtree(owned_spill_dir, ignore_errors=True)
+        if owned_shm_dir is not None:
+            # Covers every exit path, including the rc-75 preemption
+            # return: the shm namespace dies with the job.
+            shutil.rmtree(owned_shm_dir, ignore_errors=True)
         if tracer is not None:
             # BEFORE the metrics summary: publish_gauges lands the
             # hvd_critical_path_* series in the launcher registry the

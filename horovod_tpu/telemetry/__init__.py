@@ -87,6 +87,7 @@ _enabled = False
 _timeline = None          # EagerTimelineWriter or None
 _spans = None             # spans.SpanRecorder or None
 _span_flush_hooks = []    # callables draining foreign span buffers
+_metrics_flush_hooks = []  # callables mirroring foreign counters in
 _http_server = None
 _configured = False
 
@@ -163,6 +164,15 @@ def _at_exit() -> None:
         _spans = None
     if not _enabled:
         return
+    # Foreign metric planes (the native runtime's counter matrices)
+    # mirror into the registry NOW: this handler can run before
+    # basics.shutdown() (LIFO), so without the explicit flush a short
+    # job's final deltas would miss the snapshot below.
+    for hook in list(_metrics_flush_hooks):
+        try:
+            hook()
+        except Exception:
+            pass
     from horovod_tpu.telemetry import exporter
     endpoint = os.environ.get("HOROVOD_METRICS_RPC", "").strip()
     if endpoint:
@@ -225,6 +235,23 @@ def register_span_flush_hook(fn) -> None:
 def unregister_span_flush_hook(fn) -> None:
     try:
         _span_flush_hooks.remove(fn)
+    except ValueError:
+        pass
+
+
+def register_metrics_flush_hook(fn) -> None:
+    """Register a callable that mirrors another plane's counters (the
+    native runtime's transport/hier matrices) into the registry.  Hooks
+    run at exit right before the metrics push/dump, which can precede
+    ``basics.shutdown()`` in atexit order — without them a short job's
+    final deltas would never land in the snapshot."""
+    if fn not in _metrics_flush_hooks:
+        _metrics_flush_hooks.append(fn)
+
+
+def unregister_metrics_flush_hook(fn) -> None:
+    try:
+        _metrics_flush_hooks.remove(fn)
     except ValueError:
         pass
 

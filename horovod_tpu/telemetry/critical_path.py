@@ -52,6 +52,7 @@ PHASE_BUCKET = {
     "cross_ring": "cross",
     "cross": "cross",
     "exec": "cross",
+    "transport": "transport",
     "wait": "wait",
 }
 

@@ -1,0 +1,98 @@
+"""Transport-backend engagement gate (run: hvdrun -np 2, see
+ci/run_tests.sh "transport gate").
+
+One run per backend, selected by ``TRANSPORT_GATE_EXPECT`` in
+{``socket``, ``shm``, ``striped``} with the matching
+``HOROVOD_TRANSPORT`` forced by the CI lane.  Every run drives the same
+deterministic eager allreduces and dumps each rank's output to
+``$TRANSPORT_GATE_DIR/out_<expect>_r<rank>.npy``; the lane then
+byte-compares the dumps across backends (the transport must never
+change the math).
+
+The engagement assertions are the point of the gate:
+
+* ``shm``:   shm bytes > 0 AND data-plane socket bytes == 0 — the
+  intra-host exchange must move over the ring, not fall back silently;
+* ``striped``: striped bytes > 0 and the negotiated stripe count
+  matches ``HOROVOD_TRANSPORT_STRIPES``;
+* ``socket``: socket bytes > 0 with both accelerated backends at zero.
+
+Counters come from ``Runtime.transport_counters()`` (the
+``hvd_transport_counter`` C ABI), i.e. the same source feeding the
+``hvd_transport_bytes_total`` telemetry the lane checks in the merged
+metrics summary.
+"""
+import os
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import basics
+
+hvd.init()
+rank, size = hvd.rank(), hvd.size()
+assert size == 2, f"this workload expects -np 2, got size={size}"
+
+expect = os.environ["TRANSPORT_GATE_EXPECT"]
+assert expect in ("socket", "shm", "striped"), expect
+out_dir = os.environ["TRANSPORT_GATE_DIR"]
+os.makedirs(out_dir, exist_ok=True)
+
+# Non-integer float32 payloads make the bitwise cross-backend comparison
+# meaningful (any reassembly slip shows up in the low mantissa bits);
+# 1 MiB+ tensors force ring wraparound, multi-chunk striping and
+# fusion-path coverage.  One deliberately odd length breaks any
+# power-of-two alignment assumption.
+rng = np.random.RandomState(1234 + rank)
+outputs = []
+for step, n in enumerate([1 << 18, 1 << 20, 1000003]):
+    x = rng.standard_normal(n).astype(np.float32)
+    out = hvd.allreduce(x, average=False,
+                        name=f"transport.step{step}")
+    outputs.append(np.asarray(out))
+# A small fused batch rides along so the sub-granule path is covered.
+small = [hvd.allreduce(np.full(64, float(rank + s + 1), np.float32),
+                       average=False, name=f"transport.small{s}")
+         for s in range(4)]
+outputs.extend(np.asarray(o) for o in small)
+
+blob = np.concatenate(outputs)
+np.save(os.path.join(out_dir, f"out_{expect}_r{rank}.npy"), blob)
+
+rt = basics.runtime()
+counters = rt.transport_counters()
+by_backend = {b: 0 for b in ("socket", "shm", "striped")}
+for (backend, _level), kinds in counters.items():
+    by_backend[backend] += kinds["bytes"]
+cfg = rt.tuned_config()
+
+if expect == "shm":
+    assert cfg.get("transport_shm"), \
+        f"rank {rank}: no shm links negotiated: {cfg}"
+    assert by_backend["shm"] > 0, \
+        f"rank {rank}: shm backend moved no bytes: {counters}"
+    assert by_backend["socket"] == 0, \
+        f"rank {rank}: intra-host traffic leaked onto sockets: {counters}"
+elif expect == "striped":
+    want = int(os.environ.get("HOROVOD_TRANSPORT_STRIPES", "0"))
+    assert cfg.get("transport_striped"), \
+        f"rank {rank}: no striped links negotiated: {cfg}"
+    assert cfg.get("transport_stripes") == want, \
+        f"rank {rank}: negotiated {cfg.get('transport_stripes')} " \
+        f"stripes, wanted {want}"
+    assert by_backend["striped"] > 0, \
+        f"rank {rank}: striped backend moved no bytes: {counters}"
+    assert by_backend["shm"] == 0, counters
+else:
+    assert by_backend["socket"] > 0, \
+        f"rank {rank}: socket backend moved no bytes: {counters}"
+    assert by_backend["shm"] == 0 and by_backend["striped"] == 0, \
+        f"rank {rank}: forced-socket run engaged an accelerated " \
+        f"backend: {counters}"
+
+desc = rt.transport_describe()
+assert desc, "transport_describe() returned nothing"
+
+print(f"TRANSPORT_GATE_OK rank={rank} expect={expect} "
+      f"shm={by_backend['shm']} striped={by_backend['striped']} "
+      f"socket={by_backend['socket']}", flush=True)

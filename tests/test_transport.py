@@ -1,0 +1,265 @@
+"""Transport-plane tests: the launcher's shm namespace lifecycle
+(provision / orphan sweep / elastic wipe / SIGKILL chaos) and the
+per-link-level codec selection of ``HOROVOD_TRANSPORT_CODECS``.
+
+The shm ring exchange itself is covered natively (``make unittest``:
+tests/test_shm_ring.cc) and end-to-end by the np=2 distributed gate
+(tests/distributed/transport_np2.py); here we prove the *lifecycle*
+contract: a SIGKILLed job's namespace is reclaimable by the next
+launch, and no path leaks a ``hvd-shm-*`` dir past its owner.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import jax.numpy as jnp
+import pytest
+
+import horovod_tpu
+from horovod_tpu.ops import compression
+from horovod_tpu.runner import run as run_mod
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.abspath(horovod_tpu.__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Namespace lifecycle primitives.
+# ---------------------------------------------------------------------------
+
+def test_provision_stamps_owner_pid(tmp_path):
+    path = run_mod.provision_shm_dir(base=str(tmp_path))
+    assert os.path.basename(path).startswith(f"hvd-shm-{os.getpid()}-")
+    with open(os.path.join(path, "owner.pid")) as f:
+        assert int(f.read().strip()) == os.getpid()
+
+
+def test_sweep_reclaims_only_dead_owners(tmp_path):
+    # Live owner: this very process.
+    live = run_mod.provision_shm_dir(base=str(tmp_path))
+    # Dead owner: a subprocess that has already exited.
+    dead = tmp_path / "hvd-shm-dead-job"
+    dead.mkdir()
+    (dead / "owner.pid").write_text("%d\n" % _dead_pid())
+    (dead / "ring.0.1").write_bytes(b"x" * 64)
+    # Unreadable marker: treated as orphaned.
+    marker_less = tmp_path / "hvd-shm-no-marker"
+    marker_less.mkdir()
+    # Unrelated names and plain files are never touched.
+    (tmp_path / "hvd-spill-xyz").mkdir()
+    (tmp_path / "hvd-shm-a-file").write_text("not a dir")
+
+    assert run_mod.sweep_orphan_shm_dirs(base=str(tmp_path)) == 2
+    assert os.path.isdir(live)
+    assert not dead.exists()
+    assert not marker_less.exists()
+    assert (tmp_path / "hvd-spill-xyz").is_dir()
+    assert (tmp_path / "hvd-shm-a-file").is_file()
+
+
+def _dead_pid() -> int:
+    """PID of a process that provably no longer exists."""
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    return proc.pid
+
+
+def test_wipe_keeps_namespace_and_marker(tmp_path):
+    path = run_mod.provision_shm_dir(base=str(tmp_path))
+    ring = os.path.join(path, "ring.0.1")
+    with open(ring, "wb") as f:
+        f.write(b"y" * 128)
+    run_mod.wipe_shm_dir(path)
+    assert not os.path.exists(ring)
+    assert os.path.isdir(path)
+    assert os.path.exists(os.path.join(path, "owner.pid"))
+
+
+# ---------------------------------------------------------------------------
+# run_command integration: provision -> inject -> clean.
+# ---------------------------------------------------------------------------
+
+def _ns(**kw):
+    import argparse
+    base = dict(hostfile=None, hosts=None, np=None, elastic_restarts=0,
+                min_np=None, blacklist_cooldown=None)
+    base.update(kw)
+    return argparse.Namespace(**base)
+
+
+def test_run_command_provisions_injects_and_cleans(monkeypatch, tmp_path):
+    monkeypatch.setattr(run_mod, "shm_base_dir", lambda: str(tmp_path))
+    monkeypatch.delenv("HOROVOD_SHM_DIR", raising=False)
+    seen = {}
+
+    def fake_launch(args, infos, addr, extra_env, report=None):
+        seen["dir"] = extra_env["HOROVOD_SHM_DIR"]
+        assert os.path.isdir(seen["dir"])
+        assert os.path.exists(os.path.join(seen["dir"], "owner.pid"))
+        return 0
+
+    monkeypatch.setattr(run_mod, "_launch_once", fake_launch)
+    assert run_mod.run_command(_ns(np=2)) == 0
+    assert seen["dir"].startswith(str(tmp_path))
+    assert not os.path.exists(seen["dir"]), \
+        "launcher must reclaim its own shm namespace on exit"
+
+
+def test_run_command_respects_user_shm_dir(monkeypatch, tmp_path):
+    monkeypatch.setattr(run_mod, "shm_base_dir", lambda: str(tmp_path))
+    user_dir = tmp_path / "mine"
+    user_dir.mkdir()
+    monkeypatch.setenv("HOROVOD_SHM_DIR", str(user_dir))
+    seen = {}
+
+    def fake_launch(args, infos, addr, extra_env, report=None):
+        seen["dir"] = extra_env["HOROVOD_SHM_DIR"]
+        return 0
+
+    monkeypatch.setattr(run_mod, "_launch_once", fake_launch)
+    assert run_mod.run_command(_ns(np=2)) == 0
+    assert seen["dir"] == str(user_dir)
+    assert user_dir.is_dir(), "a user-provided dir is never deleted"
+
+
+def test_elastic_restart_wipes_stale_rings(monkeypatch, tmp_path):
+    monkeypatch.setattr(run_mod, "shm_base_dir", lambda: str(tmp_path))
+    monkeypatch.delenv("HOROVOD_SHM_DIR", raising=False)
+    monkeypatch.setattr(run_mod.time, "sleep", lambda s: None)
+    attempts = []
+
+    def fake_launch(args, infos, addr, extra_env, report=None):
+        d = extra_env["HOROVOD_SHM_DIR"]
+        rings = sorted(n for n in os.listdir(d) if n != "owner.pid")
+        attempts.append(rings)
+        if len(attempts) == 1:
+            # Simulate a crash mid-exchange: ring files left behind.
+            with open(os.path.join(d, "ring.0.1"), "wb") as f:
+                f.write(b"z" * 64)
+            report["failed"] = []
+            report["signalled"] = False
+            return 1
+        report["failed"] = []
+        report["signalled"] = False
+        return 0
+
+    monkeypatch.setattr(run_mod, "_launch_once", fake_launch)
+    assert run_mod.run_command(_ns(np=2, elastic_restarts=1)) == 0
+    assert attempts == [[], []], \
+        "attempt 2 must not see attempt 1's dead rings"
+
+
+# ---------------------------------------------------------------------------
+# Chaos: SIGKILL mid-exchange leaves no unreclaimable orphan.
+# ---------------------------------------------------------------------------
+
+def test_sigkill_orphan_swept_by_next_launch(tmp_path):
+    """A launcher SIGKILLed while its ranks hold open shm rings gets no
+    chance to run its ``finally`` cleanup; the namespace it leaves MUST
+    be reclaimed by the next launch's startup sweep."""
+    script = textwrap.dedent(f"""
+        import os, sys, time
+        sys.path.insert(0, {str(REPO_ROOT)!r})
+        from horovod_tpu.runner import run as run_mod
+        path = run_mod.provision_shm_dir(base={str(tmp_path)!r})
+        with open(os.path.join(path, "hvdring.0-1"), "wb") as f:
+            f.write(b"r" * 4096)   # a ring mid-exchange
+        print(path, flush=True)
+        time.sleep(300)            # until SIGKILL
+    """)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    child = subprocess.Popen([sys.executable, "-c", script],
+                             stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        orphan = child.stdout.readline().strip()
+        assert orphan, "child never provisioned its namespace"
+        os.kill(child.pid, signal.SIGKILL)
+        child.wait(timeout=60)
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait()
+    # The kill left the namespace behind -- that is the failure mode the
+    # sweep exists for.
+    assert os.path.isdir(orphan)
+    # What the next hvdrun does first thing at startup:
+    assert run_mod.sweep_orphan_shm_dirs(base=str(tmp_path)) == 1
+    assert not os.path.exists(orphan)
+    assert run_mod.sweep_orphan_shm_dirs(base=str(tmp_path)) == 0
+
+
+# ---------------------------------------------------------------------------
+# Per-link-level codec selection (HOROVOD_TRANSPORT_CODECS).
+# ---------------------------------------------------------------------------
+
+def test_link_codec_defaults_to_global(monkeypatch):
+    monkeypatch.delenv("HOROVOD_TRANSPORT_CODECS", raising=False)
+    monkeypatch.delenv("HOROVOD_COMPRESSION", raising=False)
+    for level in ("flat", "local", "cross"):
+        assert isinstance(compression.link_codec(level),
+                          compression.NoneCodec)
+
+
+def test_link_codec_per_level_override(monkeypatch):
+    monkeypatch.setenv("HOROVOD_TRANSPORT_CODECS", "cross:fp16,local:none")
+    monkeypatch.delenv("HOROVOD_COMPRESSION", raising=False)
+    cross = compression.link_codec("cross")
+    assert isinstance(cross, compression.CastCodec)
+    assert cross.wire_dtype == jnp.float16
+    assert isinstance(compression.link_codec("local"),
+                      compression.NoneCodec)
+    # Unnamed level falls back to the global resolution.
+    assert isinstance(compression.link_codec("flat"),
+                      compression.NoneCodec)
+
+
+def test_link_codec_layers_over_global_env(monkeypatch):
+    monkeypatch.setenv("HOROVOD_COMPRESSION", "bf16")
+    monkeypatch.setenv("HOROVOD_TRANSPORT_CODECS", "cross:fp16")
+    cross = compression.link_codec("cross")
+    assert cross.wire_dtype == jnp.float16
+    flat = compression.link_codec("flat")
+    assert isinstance(flat, compression.CastCodec)
+    assert flat.wire_dtype == jnp.bfloat16
+
+
+def test_link_codec_rejects_unknown_level():
+    with pytest.raises(ValueError, match="unknown link level"):
+        compression.link_codec("intergalactic")
+
+
+def test_link_codec_malformed_entry_falls_back(monkeypatch):
+    monkeypatch.setenv("HOROVOD_TRANSPORT_CODECS", "bogus,cross:fp16")
+    cross = compression.link_codec("cross")
+    assert cross.wire_dtype == jnp.float16       # good entry still applies
+    assert isinstance(compression.link_codec("local"),
+                      compression.NoneCodec)     # bad entry is skipped
+
+
+def test_link_codec_bad_codec_spec_falls_back(monkeypatch):
+    monkeypatch.setenv("HOROVOD_TRANSPORT_CODECS", "cross:quantum9")
+    monkeypatch.delenv("HOROVOD_COMPRESSION", raising=False)
+    assert isinstance(compression.link_codec("cross"),
+                      compression.NoneCodec)
+
+
+# ---------------------------------------------------------------------------
+# Config registry: the transport knobs exist with native defaults.
+# ---------------------------------------------------------------------------
+
+def test_transport_knobs_registered(monkeypatch):
+    from horovod_tpu import config
+    for var in ("HOROVOD_TRANSPORT", "HOROVOD_TRANSPORT_STRIPES",
+                "HOROVOD_SHM_DIR", "HOROVOD_SHM_SLOTS",
+                "HOROVOD_SHM_SLOT_BYTES", "HOROVOD_SHM_GRANULE_BYTES",
+                "HOROVOD_TRANSPORT_CODECS"):
+        monkeypatch.delenv(var, raising=False)
+    assert config.env_str("HOROVOD_TRANSPORT") == "auto"
+    assert config.env_int("HOROVOD_TRANSPORT_STRIPES") == 0
+    assert config.env_str("HOROVOD_SHM_DIR") == ""
+    assert config.env_int("HOROVOD_SHM_SLOTS") == 16
+    assert config.env_int("HOROVOD_SHM_SLOT_BYTES") == 1024 * 1024
+    assert config.env_int("HOROVOD_SHM_GRANULE_BYTES") == 0
