@@ -89,6 +89,27 @@ if [ "$SUITE_RC" -ne 0 ]; then
   exit "$SUITE_RC"
 fi
 
+echo "--- $VARIANT: np=2 striped transport under chaos (stripe_kill +
+--- frame_corrupt armed — the failover path re-enqueues chunks across
+--- worker threads and the NAK/retransmit queues are shared state:
+--- exactly the code a race would hide in)"
+CHAOS_DIR="$(mktemp -d)"
+set +e
+env LD_PRELOAD="$PRELOAD" "$SAN_KEY=$SAN_VAL" \
+  JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  HOROVOD_TRANSPORT=striped HOROVOD_TRANSPORT_STRIPES=2 \
+  TRANSPORT_GATE_DIR="$CHAOS_DIR" TRANSPORT_CHAOS_MODE=chaos \
+  HOROVOD_FAULT_SPEC="rank=0,site=transport,after=3,kind=stripe_kill:1;rank=1,site=transport,kind=frame_corrupt:2" \
+  python -m horovod_tpu.runner -np 2 \
+  python tests/distributed/transport_chaos_np2.py
+CHAOS_RC=$?
+set -e
+rm -rf "$CHAOS_DIR"
+if [ "$CHAOS_RC" -ne 0 ]; then
+  echo "$VARIANT: striped chaos workload failed (rc=$CHAOS_RC)" >&2
+  exit "$CHAOS_RC"
+fi
+
 # --- triage: suppressed noise vs frames that fail the lane -------------
 shopt -s nullglob
 LOGS=("$LOG_BASE".*)

@@ -175,6 +175,41 @@ print(f"TRANSPORT_GATE_SUMMARY_OK shm_bytes={shm_bytes:.0f}")
 EOF
 rm -rf "$TRANSPORT_DIR"
 
+echo "--- transport chaos gate (2 ranks, striped x2): a stripe_kill
+--- mid-allreduce plus corrupted frames must be absorbed IN-PROCESS —
+--- no elastic restart, merged failovers >= 1, retransmits >= 1 — and
+--- the chaos run's outputs must be BITWISE identical to the clean run
+--- (docs/fault_tolerance.md, 'Transport self-healing')"
+CHAOS_DIR="$(mktemp -d)"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  TRANSPORT_GATE_DIR="$CHAOS_DIR" TRANSPORT_CHAOS_MODE=clean \
+  HOROVOD_TRANSPORT=striped HOROVOD_TRANSPORT_STRIPES=2 \
+  python -m horovod_tpu.runner -np 2 \
+  python tests/distributed/transport_chaos_np2.py
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  TRANSPORT_GATE_DIR="$CHAOS_DIR" TRANSPORT_CHAOS_MODE=chaos \
+  HOROVOD_TRANSPORT=striped HOROVOD_TRANSPORT_STRIPES=2 \
+  HOROVOD_FAULT_SPEC="rank=0,site=transport,after=3,kind=stripe_kill:1;rank=1,site=transport,kind=frame_corrupt:2" \
+  python -m horovod_tpu.runner -np 2 \
+  python tests/distributed/transport_chaos_np2.py
+python - "$CHAOS_DIR" <<'EOF'
+import pathlib, sys
+import numpy as np
+
+d = pathlib.Path(sys.argv[1])
+# Self-healing must never change the math: the run that lost a stripe
+# and retransmitted corrupted frames ends bit-identical to the clean
+# run on every rank.
+for r in range(2):
+    ref = np.load(d / f"chaos_clean_r{r}.npy")
+    got = np.load(d / f"chaos_r{r}.npy")
+    assert got.dtype == ref.dtype and got.shape == ref.shape, r
+    assert (got.view(np.uint8) == ref.view(np.uint8)).all(), \
+        f"chaos vs clean allreduce differ bitwise (rank {r})"
+print("TRANSPORT_CHAOS_SUMMARY_OK")
+EOF
+rm -rf "$CHAOS_DIR"
+
 echo "--- TF1-session async collectives (2 ranks, pruned-sync reaping)"
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" HOROVOD_TF1_ASYNC=1 \
   python -m horovod_tpu.runner -np 2 \
@@ -558,11 +593,12 @@ echo "--- hierarchical allreduce A/B (BENCH json; two hvdrun -np 4
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
   python -m horovod_tpu.benchmark --hierarchical --out BENCH_hier.json
 
-echo "--- transport backend A/B (BENCH json; five hvdrun -np 2 loopback
---- runs: single socket vs shm ring vs striped x1/x2/x4 — every worker
---- asserts the forced backend carried the bytes, headline ratios come
---- from the thread-CPU link counters so a single-core runner measures
---- the transport, not the scheduler)"
+echo "--- transport backend A/B (BENCH json; six hvdrun -np 2 loopback
+--- runs: single socket (CRC-framed + unframed) vs shm ring vs striped
+--- x1/x2/x4 — every worker asserts the forced backend carried the
+--- bytes, headline ratios come from the thread-CPU link counters so a
+--- single-core runner measures the transport, not the scheduler; the
+--- checksum A/B bounds the wire-integrity overhead at 64 MB)"
 JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
   python -m horovod_tpu.benchmark --transport --out BENCH_transport.json
 python - <<'EOF'
@@ -572,8 +608,12 @@ assert doc["backend_engagement_asserted"]
 assert doc["shm_vs_socket_64mb"] > 1.0, doc["shm_vs_socket_64mb"]
 assert doc["striped4_vs_striped1_64mb"] > 1.0, \
     doc["striped4_vs_striped1_64mb"]
-print("TRANSPORT_BENCH_OK shm=%.2fx striped4=%.2fx" %
-      (doc["shm_vs_socket_64mb"], doc["striped4_vs_striped1_64mb"]))
+assert doc["checksum_overhead_64mb"] < 0.05, \
+    f"CRC32C framing cost {doc['checksum_overhead_64mb']:.1%} of link " \
+    f"bandwidth at 64 MB (target < 5%)"
+print("TRANSPORT_BENCH_OK shm=%.2fx striped4=%.2fx crc_overhead=%.1f%%" %
+      (doc["shm_vs_socket_64mb"], doc["striped4_vs_striped1_64mb"],
+       doc["checksum_overhead_64mb"] * 100))
 EOF
 
 echo "--- coordination message complexity (BENCH json; tree vs flat

@@ -56,7 +56,8 @@ def _var(name: str, type_: str, default: Any, doc: str,
 # run/gloo_run.py:211-254)
 # ---------------------------------------------------------------------------
 _var("HOROVOD_RANK", "int", None,
-     "This process's global rank; unset falls back to jax.process_index()")
+     "This process's global rank; unset falls back to jax.process_index()",
+     native=True)
 _var("HOROVOD_SIZE", "int", None,
      "World size; unset falls back to jax.process_count()")
 _var("HOROVOD_LOCAL_RANK", "int", None,
@@ -186,6 +187,19 @@ _var("HOROVOD_SHM_GRANULE_BYTES", "int", 0,
 _var("HOROVOD_TRANSPORT_CODECS", "str", "",
      "Per-link-level codec overrides, e.g. 'cross:fp16,local:none' — "
      "cross-host traffic may compress harder than intra-host shm")
+_var("HOROVOD_TRANSPORT_CHECKSUM", "str", "auto",
+     "CRC32C wire integrity on data-plane frames and shm slots: "
+     "auto (on) | on | off (off restores the unframed fast path)",
+     native=True)
+_var("HOROVOD_LINK_RETRIES", "int", 4,
+     "Bounded retransmits per corrupted frame offset before the link "
+     "fails hard instead of looping", native=True)
+_var("HOROVOD_SHM_STALL_MS", "int", 5000,
+     "Shm ring progress silence past this degrades the link to the "
+     "socket backend mid-job", native=True)
+_var("HOROVOD_LINK_PROBE_SECONDS", "float", 30.0,
+     "Seconds a degraded link waits before probing a rebuild of its "
+     "preferred backend", native=True)
 
 # ---------------------------------------------------------------------------
 # Autotuner
@@ -256,7 +270,8 @@ _var("HOROVOD_LOG_HIDE_TIME", "bool", False,
 # ---------------------------------------------------------------------------
 _var("HOROVOD_FAULT_SPEC", "str", None,
      "Deterministic chaos injection spec "
-     "(rank=,site=,after=,kind=[,attempt=])")
+     "(rank=,site=,after=,kind=[,attempt=]); site=transport kinds are "
+     "consumed natively by the data plane", native=True)
 _var("HOROVOD_STEP_GUARD", "str", "off",
      "In-graph NaN/Inf step-guard policy: off|skip|rollback|abort")
 _var("HOROVOD_GUARD_NAN_BURST", "int", 1,
@@ -276,7 +291,7 @@ _var("HOROVOD_ELASTIC_PREV_SIZE", "int", None,
      "Previous world size injected by the launcher across an elastic "
      "restart")
 _var("HOROVOD_RESTART_ATTEMPT", "int", 0,
-     "Elastic attempt counter injected by the launcher")
+     "Elastic attempt counter injected by the launcher", native=True)
 _var("HOROVOD_TERMINATE_GRACE_SECONDS", "float", 30.0,
      "Grace between SIGTERM and SIGKILL when tearing ranks down")
 _var("HOROVOD_HEALTH_RPC", "str", None,

@@ -85,7 +85,22 @@ Keys:
              self-fences),
              ``coord_crash`` (control plane: kill the current
              coordinator — the failover simulation: lease expiry,
-             deterministic re-election, no whole-job abort).
+             deterministic re-election, no whole-job abort),
+             ``frame_corrupt[:N]`` (data plane: corrupt the CRC of N
+             outgoing wire frames — default 1 — the bit-rot simulation
+             the checksum/NAK/retransmit ladder must absorb with
+             bitwise-identical results),
+             ``stripe_kill[:N]`` (data plane: hard-kill N striped-
+             transport stripe sockets mid-exchange — default 1 — the
+             NIC-death simulation: in-flight chunks re-enqueue on the
+             survivors and the stripe count renegotiates down),
+             ``shm_stall[:MS]`` (data plane: freeze the shared-memory
+             ring for MS milliseconds — default 2x
+             ``HOROVOD_SHM_STALL_MS`` — the wedged-peer simulation
+             driving mid-job fallback to the socket backend),
+             ``link_reset[:N]`` (data plane: force N immediate backend
+             degrades — default 1 — exercising the epoch-stamped
+             degrade handshake without waiting for a stall deadline).
 ``count``    maximum number of firings (default: unlimited for
              ``delay``/``error``/``nan``/``corrupt``/
              ``heartbeat_drop``/``spill_corrupt`` — chaos tests that
@@ -110,6 +125,12 @@ and the control kinds (``msg_drop``/``msg_dup``/``msg_delay``/
 ``partition``/``coord_crash``) fire only at :func:`control_chaos`,
 polled per coordination-message send by the live control wire and
 armed per virtual send by ``tools/coordsim`` (site ``control``).
+The transport kinds (``frame_corrupt``/``stripe_kill``/``shm_stall``/
+``link_reset``, site ``transport``) are consumed *natively*: the data
+plane parses the same env-passed spec inside ``libhorovod_tpu.so``
+(``src/link_heal.cc``) and arms them per wire frame / per exchange,
+emitting the same ``horovod_tpu.faults: firing`` announce line — this
+module only validates their grammar and never fires them from Python.
 ``attempt``  only fire when ``HOROVOD_RESTART_ATTEMPT`` equals this
              value — lets an elastic-restart test kill attempt 0 and
              let attempt 1 run clean.
@@ -135,7 +156,8 @@ ENV_VAR = "HOROVOD_FAULT_SPEC"
 _KINDS = ("crash", "exit", "hang", "delay", "error", "nan", "corrupt",
           "heartbeat_drop", "spill_corrupt", "preempt_storm", "host_flap",
           "residual_drop", "replica_crash", "request_storm",
-          "msg_drop", "msg_dup", "msg_delay", "partition", "coord_crash")
+          "msg_drop", "msg_dup", "msg_delay", "partition", "coord_crash",
+          "frame_corrupt", "stripe_kill", "shm_stall", "link_reset")
 
 # Kinds that mutate an op's *output value* instead of disrupting control
 # flow; they fire at corrupt_output(), never at inject().
@@ -164,10 +186,18 @@ SERVING_KINDS = ("replica_crash", "request_storm")
 CONTROL_KINDS = ("msg_drop", "msg_dup", "msg_delay", "partition",
                  "coord_crash")
 
+# Kinds owned by the native data plane (site ``transport``); the spec is
+# re-parsed inside libhorovod_tpu.so (src/link_heal.cc chaos::Arm) and
+# armed per wire frame / per exchange there — Python only validates the
+# grammar and never fires these from any of its own hooks.
+TRANSPORT_KINDS = ("frame_corrupt", "stripe_kill", "shm_stall",
+                   "link_reset")
+
 SITES = (
     "allreduce", "allgather", "broadcast", "alltoall", "reducescatter",
     "barrier", "native_submit", "native_wait", "rpc", "spawn",
     "heartbeat", "spill", "fleet", "compression", "serving", "control",
+    "transport",
 )
 
 
@@ -408,6 +438,19 @@ def parse_spec(spec: str) -> List[FaultRule]:
                             raise FaultSpecError(
                                 f"kind partition:{arg} must last "
                                 f"> 0 seconds")
+                    elif kind == "shm_stall":
+                        arg = float(kind_arg) if kind_arg else None
+                        if arg is not None and arg <= 0:
+                            raise FaultSpecError(
+                                f"kind shm_stall:{arg} must stall "
+                                f"> 0 milliseconds")
+                    elif kind in ("frame_corrupt", "stripe_kill",
+                                  "link_reset"):
+                        arg = int(kind_arg) if kind_arg else None
+                        if arg is not None and arg < 1:
+                            raise FaultSpecError(
+                                f"kind {kind}:{arg} must fire "
+                                f">= 1 time")
                     elif kind_arg:
                         raise FaultSpecError(
                             f"kind {kind!r} takes no argument "
@@ -455,6 +498,16 @@ def parse_spec(spec: str) -> List[FaultRule]:
         if kind in ("msg_drop", "msg_dup") and count is None:
             count = arg if arg is not None else 1
         if kind in ("partition", "coord_crash") and count is None:
+            count = 1
+        # frame_corrupt:N / stripe_kill:N / link_reset:N are count
+        # shorthands (N firings); shm_stall:MS instead sizes the stall
+        # (count says how many stalls).  All default to one firing so
+        # the chaos episode settles and recovery stays observable —
+        # mirrored by the native parser in src/link_heal.cc.
+        if kind in ("frame_corrupt", "stripe_kill", "link_reset") \
+                and count is None:
+            count = arg if arg is not None else 1
+        if kind == "shm_stall" and count is None:
             count = 1
         if site is not None and site not in SITES:
             raise FaultSpecError(
@@ -526,7 +579,8 @@ def inject(site: str, detail: Optional[str] = None,
         if (rule.kind in VALUE_KINDS or rule.kind in PLANE_KINDS
                 or rule.kind in FLEET_KINDS
                 or rule.kind in SERVING_KINDS
-                or rule.kind in CONTROL_KINDS):
+                or rule.kind in CONTROL_KINDS
+                or rule.kind in TRANSPORT_KINDS):
             continue
         if rule.arm(site, ctx_rank):
             rule.execute(site, detail, ctx_rank)

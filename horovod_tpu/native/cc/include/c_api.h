@@ -83,8 +83,10 @@ int64_t hvd_hier_ag_ops();
 // Transport-backend introspection (transport.h).  Counter matrix indexed
 // by backend (0 socket, 1 shm, 2 striped), hierarchical level (0 flat,
 // 1 local, 2 cross) and kind (0 bytes moved, 1 busy microseconds, 2 push
-// /pump operations); all monotonic since process start, -1 when an index
-// is out of range.  Feeds the hvd_transport_* telemetry series.
+// /pump operations, 3 frame retransmits, 4 CRC errors, 5 link failovers,
+// 6 links currently degraded); all monotonic since process start except
+// kind 6 (a gauge), -1 when an index is out of range.  Feeds the
+// hvd_transport_* telemetry series.
 int64_t hvd_transport_counter(int backend, int level, int kind);
 // 1 when the data-plane mesh holds at least one link of that backend.
 int hvd_transport_shm_links();

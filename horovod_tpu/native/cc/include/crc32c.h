@@ -1,0 +1,104 @@
+// CRC32C (Castagnoli) for transport wire integrity (transport.h,
+// HOROVOD_TRANSPORT_CHECKSUM).
+//
+// The polynomial choice is deliberate: iSCSI/ext4's Castagnoli
+// polynomial has hardware support on every x86-64 core shipped since
+// Nehalem (SSE4.2 crc32 instruction, ~15 GB/s/core), so a checksummed
+// granule costs a small fraction of the memcpy that moves it — the
+// property the <5% overhead budget in docs/performance.md rests on.
+// Hosts without SSE4.2 fall back to a slice-by-8-free table kernel
+// (~1 GB/s, still far above any single TCP stream this plane drives).
+//
+// In-process testable: pure functions, no transport dependencies
+// (tests/test_link_failover.cc checks the reference vectors).
+#ifndef HVD_CRC32C_H
+#define HVD_CRC32C_H
+
+#include <cstddef>
+#include <cstdint>
+
+#if defined(__x86_64__) || defined(__i386__)
+#include <cpuid.h>
+#endif
+
+namespace hvd {
+namespace crc32c {
+
+namespace detail {
+
+// Reflected CRC32C table, generated once per process (256 * 4 bytes;
+// lazy so library load stays allocation-free).
+inline const uint32_t* Table() {
+  static uint32_t table[256];
+  static bool ready = [] {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? (0x82F63B78u ^ (c >> 1)) : (c >> 1);
+      table[i] = c;
+    }
+    return true;
+  }();
+  (void)ready;
+  return table;
+}
+
+inline uint32_t Soft(uint32_t crc, const void* data, size_t n) {
+  const uint32_t* t = Table();
+  const auto* p = static_cast<const uint8_t*>(data);
+  while (n--) crc = t[(crc ^ *p++) & 0xFF] ^ (crc >> 8);
+  return crc;
+}
+
+#if defined(__x86_64__)
+__attribute__((target("sse4.2")))
+inline uint32_t Hw(uint32_t crc, const void* data, size_t n) {
+  const auto* p = static_cast<const uint8_t*>(data);
+  uint64_t c = crc;
+  while (n >= 8) {
+    uint64_t v;
+    __builtin_memcpy(&v, p, 8);
+    c = __builtin_ia32_crc32di(c, v);
+    p += 8;
+    n -= 8;
+  }
+  crc = static_cast<uint32_t>(c);
+  while (n--) crc = __builtin_ia32_crc32qi(crc, *p++);
+  return crc;
+}
+
+inline bool HaveHw() {
+  static const bool have = [] {
+    unsigned eax = 0, ebx = 0, ecx = 0, edx = 0;
+    if (!__get_cpuid(1, &eax, &ebx, &ecx, &edx)) return false;
+    return (ecx & (1u << 20)) != 0;  // SSE4.2
+  }();
+  return have;
+}
+#endif
+
+}  // namespace detail
+
+// Streaming update: crc of (prior bytes + [data, data+n)).  Start from
+// Init(), finish with Finish() — split so incremental receive paths can
+// checksum granules as the bytes land instead of re-touching them.
+inline uint32_t Init() { return 0xFFFFFFFFu; }
+
+inline uint32_t Update(uint32_t state, const void* data, size_t n) {
+#if defined(__x86_64__)
+  if (detail::HaveHw()) return detail::Hw(state, data, n);
+#endif
+  return detail::Soft(state, data, n);
+}
+
+inline uint32_t Finish(uint32_t state) { return state ^ 0xFFFFFFFFu; }
+
+// One-shot convenience.
+inline uint32_t Value(const void* data, size_t n) {
+  return Finish(Update(Init(), data, n));
+}
+
+}  // namespace crc32c
+}  // namespace hvd
+
+#endif  // HVD_CRC32C_H

@@ -238,6 +238,13 @@ class DataPlane {
 
   // Per-pair transport upgrade (Connect phase 2).
   Status UpgradeLinks(const std::vector<PeerAddr>& peers);
+
+  // Probe-time re-setup of a degraded striped pair (link_heal.h rebuild
+  // callback): re-dials / re-accepts the dedicated stripe connections
+  // and confirms success over the mesh socket so both ends promote (or
+  // stay degraded) together.  Returns nullptr on any failure.
+  std::unique_ptr<transport::Link> RebuildStripedLink(
+      int r, int ns, const PeerAddr& addr, const std::string& key);
 };
 
 // Typed reduction: acc[i] op= val[i].  Exposed for the fusion layer.

@@ -1,0 +1,99 @@
+// Self-healing wrapper around the transport backends (transport.h): wire
+// integrity, live link failover and degraded-mode operation.
+//
+// A HealingLink pairs an optional preferred inner link (shm ring or
+// striped multi-socket) with a CRC32C-framed engine speaking over the
+// existing mesh TCP socket.  The engine plays three roles:
+//
+//   1. control channel while the inner link is healthy (degrade /
+//      probe handshakes ride it, so backend agreement never depends on
+//      the backend being agreed about),
+//   2. the degraded-mode data path after the inner link dies — a dead
+//      shm peer or a fully-dead striped link falls back to the mesh
+//      socket MID-JOB, restarting the in-flight exchange without
+//      losing the collective,
+//   3. the checksummed socket backend itself (inner == nullptr) when
+//      HOROVOD_TRANSPORT_CHECKSUM is on: framed granules, corrupt-frame
+//      NAK -> bounded retransmit (HOROVOD_LINK_RETRIES) instead of
+//      silently reducing garbage into gradients.
+//
+// Split-brain safety: all engine frames share one TCP stream with the
+// data they describe, so a kDegrade frame sent before re-armed data is
+// PROCESSED before that data on the peer — FIFO ordering is the
+// agreement mechanism, and the epoch stamp carried by the handshake
+// frames makes stale/duplicate proposals detectable and idempotent.
+// Recovery runs the other way after HOROVOD_LINK_PROBE_SECONDS: the
+// lower rank schedules a rebuild rendezvous two exchange-settles ahead
+// via a kProbe frame, both sides reach that settle count at the same
+// stream position, and the data-plane rebuild callback re-runs the
+// original backend handshake (failure leaves both sides degraded).
+//
+// docs/fault_tolerance.md, "Transport self-healing".
+#ifndef HVD_LINK_HEAL_H
+#define HVD_LINK_HEAL_H
+
+#include <functional>
+#include <memory>
+
+#include "transport.h"
+
+namespace hvd {
+
+class TcpSocket;
+
+namespace transport {
+
+// ----------------------------------------------------------------------
+// Native consumer of the HOROVOD_FAULT_SPEC chaos grammar (faults.py),
+// site `transport`.  Same rule contract as the Python hooks: per-rule
+// hit counting, `after=` passages let through, `count` firings, and the
+// stderr announce line the chaos suites grep for.  Passage definitions:
+//   frame_corrupt[:N]  per outgoing data frame (corrupts the frame CRC
+//                      so the receiver's checksum path must catch it)
+//   stripe_kill[:N]    per outgoing striped data frame (kills the
+//                      stripe socket it would have used)
+//   shm_stall[:MS]     per armed exchange on an shm-preferred link
+//                      (suppresses the ring pump for MS milliseconds;
+//                      default 2x HOROVOD_SHM_STALL_MS, i.e. past the
+//                      stall deadline)
+//   link_reset[:N]     per armed exchange (hard-fails the inner link,
+//                      forcing an immediate backend degrade)
+// ----------------------------------------------------------------------
+
+namespace chaos {
+
+enum class Kind : int {
+  kFrameCorrupt = 0,
+  kStripeKill = 1,
+  kShmStall = 2,
+  kLinkReset = 3,
+};
+
+// Count one passage through the transport chaos site.  Returns the
+// firing rule's argument (>= 0; kind-specific, e.g. stall milliseconds)
+// when a fault fires on this passage, -1 otherwise.  Thread-safe —
+// stripe workers arm concurrently.
+double Arm(Kind k);
+
+// Drop the parsed spec so the next Arm() re-reads HOROVOD_FAULT_SPEC
+// (tests mutate the environment between cases).
+void ReloadForTest();
+
+}  // namespace chaos
+
+// ----------------------------------------------------------------------
+// Factory.  `inner` may be nullptr (engine-only checksummed socket
+// link).  `mesh` is the borrowed mesh socket (DataPlane::peers_).
+// `rebuild` (may be empty) re-runs the preferred backend's setup
+// handshake at the probe rendezvous; returning nullptr keeps the link
+// degraded and re-arms the probe timer.
+// ----------------------------------------------------------------------
+
+std::unique_ptr<Link> MakeHealingLink(
+    int self, int peer, Backend preferred, std::unique_ptr<Link> inner,
+    TcpSocket* mesh, std::function<std::unique_ptr<Link>()> rebuild);
+
+}  // namespace transport
+}  // namespace hvd
+
+#endif  // HVD_LINK_HEAL_H

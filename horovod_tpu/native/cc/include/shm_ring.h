@@ -30,8 +30,10 @@
 #include <atomic>
 #include <cstddef>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 
+#include "crc32c.h"
 #include "hvd_common.h"
 
 namespace hvd {
@@ -54,7 +56,7 @@ struct SlotHeader {
   std::atomic<uint64_t> seq_begin;
   std::atomic<uint64_t> seq_end;
   uint32_t len;
-  uint32_t reserved;
+  uint32_t crc;  // CRC32C of the payload when checksumming is enabled, else 0
 };
 
 // One producer-or-consumer view over a mapped ring region.  The region
@@ -85,7 +87,7 @@ class Ring {
       s->seq_begin.store(0, std::memory_order_relaxed);
       s->seq_end.store(0, std::memory_order_relaxed);
       s->len = 0;
-      s->reserved = 0;
+      s->crc = 0;
     }
     // Publish the geometry last: an attacher spins on magic.
     std::atomic_thread_fence(std::memory_order_release);
@@ -110,6 +112,13 @@ class Ring {
   uint32_t slot_count() const { return hdr_->slot_count; }
   uint32_t slot_bytes() const { return hdr_->slot_bytes; }
 
+  // Wire integrity: when on, TryPush stamps each slot with the
+  // payload's CRC32C and TryPop verifies it before advancing tail.
+  // Both sides of a ring must agree (the transport derives it from the
+  // same process-wide HOROVOD_TRANSPORT_CHECKSUM setting).
+  void set_checksum(bool on) { checksum_ = on; }
+  bool checksum() const { return checksum_; }
+
   size_t FreeSlots() const {
     uint64_t head = hdr_->head.load(std::memory_order_relaxed);
     uint64_t tail = hdr_->tail.load(std::memory_order_acquire);
@@ -126,6 +135,7 @@ class Ring {
     s->seq_begin.store(head + 1, std::memory_order_relaxed);
     std::memcpy(Payload(s), p, n);
     s->len = n;
+    s->crc = checksum_ ? crc32c::Value(p, n) : 0;
     s->seq_end.store(head + 1, std::memory_order_release);
     hdr_->head.store(head + 1, std::memory_order_release);
     return true;
@@ -156,6 +166,20 @@ class Ring {
       return -1;
     }
     std::memcpy(out, Payload(s), n);
+    if (checksum_) {
+      // Verify the copied-out bytes (not the slot in place): a producer
+      // scribble between our memcpy and a re-read would otherwise slip
+      // through verified.
+      uint32_t got = crc32c::Value(out, n);
+      if (got != s->crc) {
+        char note[96];
+        std::snprintf(note, sizeof(note),
+                      "slot CRC mismatch at seq %llu (want %08x got %08x)",
+                      static_cast<unsigned long long>(tail + 1), s->crc, got);
+        *st = Status::Aborted(std::string("shm ring: ") + note);
+        return -1;
+      }
+    }
     hdr_->tail.store(tail + 1, std::memory_order_release);
     return n;
   }
@@ -171,6 +195,7 @@ class Ring {
   RingHeader* hdr_ = nullptr;
   char* slots_ = nullptr;
   size_t stride_ = 0;
+  bool checksum_ = false;
 };
 
 }  // namespace shm

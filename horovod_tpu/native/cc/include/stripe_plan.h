@@ -77,6 +77,15 @@ class Reassembly {
     }
   }
 
+  // True when a frame starting at `offset` was already merged.  Add()
+  // is not idempotent, so retransmit paths (link_heal.cc,
+  // striped_transport.cc) dedup duplicate deliveries with this before
+  // merging; retransmits reuse the original chunk boundaries, so an
+  // exact-offset test is sufficient.
+  bool Covered(uint64_t offset) const {
+    return offset < contig_ || pending_.count(offset) > 0;
+  }
+
   uint64_t contiguous() const { return contig_; }
   uint64_t total() const { return total_; }
   uint64_t expected() const { return expected_; }

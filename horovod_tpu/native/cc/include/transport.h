@@ -70,8 +70,20 @@ enum class Level : int { kFlat = 0, kLocal = 1, kCross = 2 };
 constexpr int kNumLevels = 3;
 const char* LevelName(Level l);
 
-enum class Counter : int { kBytes = 0, kMicros = 1, kOps = 2 };
-constexpr int kNumCounters = 3;
+// Kinds 0-2 are the traffic triple accounted by AccountAt(); kinds 3-6
+// are the resilience series bumped by the self-healing machinery
+// (hvd_transport_{retransmits,crc_errors,failovers,degraded_links}_total
+// in docs/metrics.md).  All monotonic.
+enum class Counter : int {
+  kBytes = 0,
+  kMicros = 1,
+  kOps = 2,
+  kRetransmits = 3,   // granules/chunks re-sent after a NAK or stripe death
+  kCrcErrors = 4,     // corrupt frames/slots detected by CRC32C
+  kFailovers = 5,     // stripe deaths + backend degrades survived
+  kDegraded = 6,      // times a link entered degraded (fallback) mode
+};
+constexpr int kNumCounters = 7;
 
 void SetLevel(Level l);         // thread-local; kFlat by default
 Level CurrentLevel();
@@ -91,7 +103,18 @@ void Account(Backend b, int64_t bytes, int64_t micros);
 // Explicit-level variant for worker threads that account on behalf of a
 // data-plane exchange (the thread-local level lives on the arming thread).
 void AccountAt(Backend b, Level l, int64_t bytes, int64_t micros);
+// Resilience-counter bump (kinds 3-6); does not touch the traffic triple.
+void Bump(Backend b, Level l, Counter c, int64_t n = 1);
 int64_t CounterValue(int backend, int level, int counter);
+
+// --------------------------------------------------------------------------
+// Wire integrity (HOROVOD_TRANSPORT_CHECKSUM=auto|on|off).  auto means
+// on: CRC32C is hardware-accelerated on every deployment target, so the
+// safe default costs <5% at 64 MB (docs/performance.md); off removes
+// the per-granule checksum entirely for benchmarking the raw path.
+// --------------------------------------------------------------------------
+
+bool ChecksumEnabled();  // parsed once from the env, process-wide
 
 // Per-thread CPU clock for the micros argument above.  Pump loops time
 // themselves with THREAD CPU time, not wall time: on an oversubscribed
@@ -107,6 +130,13 @@ int64_t PumpClockUs();
 // --------------------------------------------------------------------------
 // Link: one full-duplex transport to one peer.
 // --------------------------------------------------------------------------
+
+// Per-link health reported into stall dumps (DescribeAll) and
+// EagerStallError: kOk = preferred backend live, kDegraded = running on
+// a fallback (fewer stripes / socket instead of shm), kFailed = no
+// usable path left (the exchange error is about to surface).
+enum class LinkHealth : int { kOk = 0, kDegraded = 1, kFailed = 2 };
+const char* HealthName(LinkHealth h);
 
 class Link {
  public:
@@ -145,6 +175,9 @@ class Link {
 
   // One-line state summary for stall reports ("stripe 2: tx 4/16 ...").
   virtual std::string Describe() const = 0;
+
+  // Health for stall diagnosis; backends with self-healing override.
+  virtual LinkHealth Health() const { return LinkHealth::kOk; }
 
   virtual void Shutdown() {}
 };

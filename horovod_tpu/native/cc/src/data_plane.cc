@@ -11,6 +11,7 @@
 #include <cstring>
 
 #include "auth.h"
+#include "link_heal.h"
 #include "trace.h"
 
 namespace hvd {
@@ -369,7 +370,17 @@ Status DataPlane::UpgradeLinks(const std::vector<PeerAddr>& peers) {
       auto link = transport::MakeShmLink(rank_, r, rank_ < r, shm_dir,
                                          peers_[r].get());
       if (link) {
-        links_[r] = std::move(link);
+        // Self-healing wrapper: a stalled/dead shm peer degrades this
+        // pair to the mesh socket mid-job; after the probe interval the
+        // pair re-runs the same shm handshake at an agreed rendezvous.
+        auto rebuild = [this, r, shm_dir]() -> std::unique_ptr<transport::Link> {
+          return transport::MakeShmLink(rank_, r, rank_ < r, shm_dir,
+                                        peers_[r].get());
+        };
+        links_[r] = transport::MakeHealingLink(rank_, r, Backend::kShm,
+                                               std::move(link),
+                                               peers_[r].get(),
+                                               std::move(rebuild));
         agreed[r] = Backend::kShm;
         continue;
       }
@@ -453,14 +464,34 @@ Status DataPlane::UpgradeLinks(const std::vector<PeerAddr>& peers) {
     if (!link)
       return Status::Unknown("striped link to rank " + std::to_string(r) +
                              " failed after connection setup");
-    links_[r] = std::move(link);
+    // Self-healing wrapper: individual stripe deaths are absorbed
+    // inside StripedLink (chunk re-enqueue + renegotiated stripe
+    // count); total death degrades the pair to the mesh socket, and
+    // the probe rendezvous re-runs the dial/accept setup below.
+    auto rebuild = [this, r, ns = pair_stripes[r], addr = peers[r],
+                    key]() -> std::unique_ptr<transport::Link> {
+      return RebuildStripedLink(r, ns, addr, key);
+    };
+    links_[r] = transport::MakeHealingLink(rank_, r, Backend::kStriped,
+                                           std::move(link), peers_[r].get(),
+                                           std::move(rebuild));
   }
 
-  // 2c. Everything else rides the original mesh socket.
+  // 2c. Everything else rides the original mesh socket — framed through
+  // the healing engine when checksumming is on (corrupt-frame NAK +
+  // retransmit), raw SocketLink when explicitly off (the documented
+  // fast path; docs/performance.md).
   for (int r = 0; r < size_; ++r) {
     if (r == rank_) continue;
-    if (!links_[r])
-      links_[r] = std::make_unique<transport::SocketLink>(r, peers_[r].get());
+    if (!links_[r]) {
+      if (transport::ChecksumEnabled())
+        links_[r] = transport::MakeHealingLink(rank_, r, Backend::kSocket,
+                                               nullptr, peers_[r].get(),
+                                               nullptr);
+      else
+        links_[r] =
+            std::make_unique<transport::SocketLink>(r, peers_[r].get());
+    }
     if (links_[r]->backend() == Backend::kShm) has_shm_links_ = true;
     if (links_[r]->backend() == Backend::kStriped) has_striped_links_ = true;
   }
@@ -476,6 +507,76 @@ Status DataPlane::UpgradeLinks(const std::vector<PeerAddr>& peers) {
                << " stripes=" << stripes_;
   }
   return Status::OK();
+}
+
+// Probe-rendezvous striped re-setup.  Both ends run this at the same
+// per-pair stream position (link_heal.h), with the frame engine
+// quiescent, so raw use of the listener and the mesh socket is safe.
+// The original dial/accept roles are reused (dial to higher ranks,
+// accept from lower), and a final ok/fail frame pair over the mesh
+// keeps promotion symmetric — a one-sided success never splits the
+// pair across backends.
+std::unique_ptr<transport::Link> DataPlane::RebuildStripedLink(
+    int r, int ns, const PeerAddr& addr, const std::string& key) {
+  std::vector<TcpSocket> socks;
+  Status st = Status::OK();
+  if (r > rank_) {
+    for (int s = 0; s < ns && st.ok(); ++s) {
+      TcpSocket sock;
+      st = sock.Connect(addr.host, addr.port);
+      if (st.ok()) st = AuthConnect(sock, key);
+      StripeHello hello{rank_, s};
+      if (st.ok()) st = sock.SendAll(&hello, sizeof(hello));
+      if (st.ok()) socks.push_back(std::move(sock));
+    }
+  } else {
+    socks.resize(ns);
+    int got = 0;
+    auto deadline =
+        std::chrono::steady_clock::now() + std::chrono::seconds(10);
+    while (got < ns) {
+      auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                      deadline - std::chrono::steady_clock::now())
+                      .count();
+      if (left <= 0) {
+        st = Status::Unknown("timed out re-accepting stripe connections");
+        break;
+      }
+      TcpSocket conn;
+      st = listener_.Accept(&conn, static_cast<int>(left));
+      if (!st.ok()) break;
+      conn.SetRecvTimeout(10000);
+      Status ast = AuthAccept(conn, key);
+      if (!ast.ok()) {
+        LOG(Warning) << "stripe rebuild: dropped unauthenticated connection ("
+                     << ast.reason << ")";
+        continue;
+      }
+      StripeHello hello{-1, -1};
+      ast = conn.RecvAll(&hello, sizeof(hello));
+      if (!ast.ok() || hello.rank != r || hello.stripe < 0 ||
+          hello.stripe >= ns) {
+        LOG(Warning) << "stripe rebuild: dropped bad hello from rank "
+                     << hello.rank;
+        continue;
+      }
+      conn.SetRecvTimeout(0);
+      socks[hello.stripe] = std::move(conn);
+      ++got;
+    }
+  }
+  bool mine_ok = st.ok() && socks.size() == static_cast<size_t>(ns);
+  Status cst = peers_[r]->SendFrame(mine_ok ? "ok" : "fail");
+  std::string theirs;
+  if (cst.ok()) cst = peers_[r]->RecvFrame(&theirs);
+  if (!cst.ok() || !mine_ok || theirs != "ok") {
+    LOG(Warning) << "stripe rebuild with rank " << r << " failed ("
+                 << (st.ok() ? (cst.ok() ? "peer: " + theirs : cst.reason)
+                             : st.reason)
+                 << "); staying on socket";
+    return nullptr;
+  }
+  return transport::MakeStripedLink(rank_, r, std::move(socks));
 }
 
 void DataPlane::Shutdown() {

@@ -1,0 +1,981 @@
+// Self-healing transport wrapper: CRC32C frame engine over the mesh
+// socket, mid-job backend failover, probe-based recovery, and the
+// native consumer of the `transport` chaos site.  See link_heal.h for
+// the protocol overview and docs/fault_tolerance.md for the failure
+// ladder.
+#include "link_heal.h"
+
+#include <errno.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "crc32c.h"
+#include "socket.h"
+#include "stripe_plan.h"
+#include "trace.h"
+
+namespace hvd {
+namespace transport {
+
+namespace {
+
+int64_t MonoUs() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
+}
+
+}  // namespace
+
+// ==========================================================================
+// Chaos: native HOROVOD_FAULT_SPEC rules for site `transport`.
+// ==========================================================================
+
+namespace chaos {
+
+namespace {
+
+const char* KindName(Kind k) {
+  switch (k) {
+    case Kind::kFrameCorrupt: return "frame_corrupt";
+    case Kind::kStripeKill: return "stripe_kill";
+    case Kind::kShmStall: return "shm_stall";
+    default: return "link_reset";
+  }
+}
+
+struct Rule {
+  int rank = -1;       // -1 = any ('*')
+  Kind kind;
+  double arg = -1.0;   // kind-specific; <0 = kind default
+  int after = 0;
+  int count = 1;
+  int attempt = -1;    // -1 = any
+  int hits = 0;
+  int fired = 0;
+};
+
+struct Spec {
+  std::vector<Rule> rules;
+  bool loaded = false;
+};
+
+std::mutex g_mu;
+Spec g_spec;
+
+// Mirror of faults.FaultRule semantics for the subset the native layer
+// consumes: site must be `transport` or `*`, kind must be a transport
+// kind (Python skips those kinds at its own hooks), and the count
+// shorthand `kind:N` means N firings for frame_corrupt / stripe_kill /
+// link_reset and a milliseconds argument for shm_stall.  Unknown keys
+// or non-transport kinds are simply ignored here — faults.load() is the
+// grammar authority and raises on real typos.
+void ParseLocked() {
+  if (g_spec.loaded) return;
+  g_spec.loaded = true;
+  std::string spec = EnvStr("HOROVOD_FAULT_SPEC", "");
+  if (spec.empty()) return;
+  size_t pos = 0;
+  while (pos <= spec.size()) {
+    size_t semi = spec.find(';', pos);
+    std::string rule_s = spec.substr(
+        pos, semi == std::string::npos ? std::string::npos : semi - pos);
+    pos = semi == std::string::npos ? spec.size() + 1 : semi + 1;
+    if (rule_s.empty()) continue;
+
+    Rule r;
+    bool site_ok = false, kind_ok = false, bad = false;
+    size_t fp = 0;
+    while (fp <= rule_s.size()) {
+      size_t comma = rule_s.find(',', fp);
+      std::string field = rule_s.substr(
+          fp, comma == std::string::npos ? std::string::npos : comma - fp);
+      fp = comma == std::string::npos ? rule_s.size() + 1 : comma + 1;
+      size_t eq = field.find('=');
+      if (eq == std::string::npos) continue;
+      std::string key = field.substr(0, eq);
+      std::string val = field.substr(eq + 1);
+      if (key == "site") {
+        site_ok = (val == "transport" || val == "*");
+      } else if (key == "rank") {
+        r.rank = (val == "*") ? -1 : std::atoi(val.c_str());
+      } else if (key == "after") {
+        r.after = std::atoi(val.c_str());
+      } else if (key == "count") {
+        r.count = std::atoi(val.c_str());
+      } else if (key == "attempt") {
+        r.attempt = std::atoi(val.c_str());
+      } else if (key == "kind") {
+        std::string name = val;
+        std::string arg;
+        size_t colon = val.find(':');
+        if (colon != std::string::npos) {
+          name = val.substr(0, colon);
+          arg = val.substr(colon + 1);
+        }
+        if (name == "frame_corrupt") r.kind = Kind::kFrameCorrupt;
+        else if (name == "stripe_kill") r.kind = Kind::kStripeKill;
+        else if (name == "shm_stall") r.kind = Kind::kShmStall;
+        else if (name == "link_reset") r.kind = Kind::kLinkReset;
+        else { bad = true; continue; }
+        kind_ok = true;
+        if (!arg.empty()) {
+          if (r.kind == Kind::kShmStall)
+            r.arg = std::atof(arg.c_str());  // milliseconds
+          else
+            r.count = std::atoi(arg.c_str());  // count shorthand
+        }
+      }
+    }
+    if (site_ok && kind_ok && !bad) g_spec.rules.push_back(r);
+  }
+}
+
+}  // namespace
+
+double Arm(Kind k) {
+  // Fast path mirrors faults.inject(): no spec, no cost beyond the
+  // first parse.
+  std::lock_guard<std::mutex> lk(g_mu);
+  ParseLocked();
+  if (g_spec.rules.empty()) return -1.0;
+  int rank = static_cast<int>(EnvInt("HOROVOD_RANK", -1));
+  int attempt = static_cast<int>(EnvInt("HOROVOD_RESTART_ATTEMPT", 0));
+  for (auto& r : g_spec.rules) {
+    if (r.kind != k) continue;
+    if (r.rank >= 0 && r.rank != rank) continue;
+    if (r.attempt >= 0 && r.attempt != attempt) continue;
+    ++r.hits;
+    if (r.hits <= r.after) continue;
+    if (r.count > 0 && r.fired >= r.count) continue;
+    ++r.fired;
+    // Same announce line as faults.FaultRule._announce — the chaos
+    // suites grep for it to prove the fault actually fired.
+    std::fprintf(stderr,
+                 "horovod_tpu.faults: firing kind=%s at site=transport "
+                 "[rank %d, hit %d]\n",
+                 KindName(k), rank, r.hits);
+    std::fflush(stderr);
+    return r.arg >= 0 ? r.arg : 0.0;
+  }
+  return -1.0;
+}
+
+void ReloadForTest() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_spec = Spec{};
+}
+
+}  // namespace chaos
+
+// ==========================================================================
+// Frame engine: checksummed framed protocol over one TCP stream.
+// ==========================================================================
+
+namespace {
+
+constexpr uint32_t kFrameMagic = 0x4856444C;  // "HVDL"
+
+enum FrameKind : uint32_t {
+  kFData = 1,        // one payload granule of the armed exchange
+  kFNak = 2,         // receiver: granule at `offset` failed its CRC
+  kFAck = 3,         // receiver: exchange `seq` fully verified
+  kFDegrade = 4,     // fall back to the engine; `seq` = proposed epoch
+  kFDegradeAck = 5,  // degrade confirmation; `seq` = committed epoch
+  kFProbe = 6,       // rebuild rendezvous at settle count `offset`
+};
+
+struct WireFrame {
+  uint32_t magic;
+  uint32_t kind;
+  uint64_t seq;     // data/nak/ack: per-direction exchange seq; ctrl: epoch
+  uint64_t offset;  // data/nak: granule offset; probe: target settle count
+  uint32_t len;     // data/nak: granule length
+  uint32_t crc;     // data: CRC32C of the payload granule (0 when off)
+};
+static_assert(sizeof(WireFrame) == 32, "wire frame layout");
+
+constexpr size_t kEngineGranule = 1 << 20;
+
+// Jittered exponential backoff between retransmits of the same granule
+// (the control_call discipline: base * 2^attempt, multiplicative jitter
+// in [0.5, 1.0], capped).
+int64_t RetryBackoffUs(int attempt, unsigned* seed) {
+  int64_t base = 200;  // us
+  int64_t d = base << (attempt > 8 ? 8 : attempt);
+  if (d > 50000) d = 50000;
+  double jitter = 0.5 + 0.5 * (rand_r(seed) / (RAND_MAX + 1.0));
+  return static_cast<int64_t>(d * jitter);
+}
+
+// One direction's worth of framed-exchange state plus the shared socket
+// pump.  Single-threaded: everything runs on the data-plane thread.
+class FrameEngine {
+ public:
+  FrameEngine(int self, int peer, TcpSocket* sock)
+      : peer_(peer), sock_(sock),
+        seed_(static_cast<unsigned>(0x9E3779B9u ^ (self << 16) ^ peer)),
+        checksum_(ChecksumEnabled()),
+        max_retries_(static_cast<int>(EnvInt("HOROVOD_LINK_RETRIES", 4))) {}
+
+  // Ctrl frames (kDegrade / kDegradeAck / kProbe) are surfaced to the
+  // owner; data/nak/ack are handled internally.
+  void SetCtrlHandler(std::function<void(const WireFrame&)> h) {
+    on_ctrl_ = std::move(h);
+  }
+
+  void StartSend(const void* buf, size_t n) {
+    sbuf_ = static_cast<const char*>(buf);
+    sn_ = n;
+    snext_ = 0;
+    acked_ = (n == 0);
+    retx_.clear();
+    retry_counts_.clear();
+    if (n > 0) ++sseq_;
+  }
+
+  void StartRecv(void* buf, size_t n) {
+    rbuf_ = static_cast<char*>(buf);
+    rn_ = n;
+    floor_ = 0;
+    reasm_.Reset(n);
+    rdone_ = (n == 0);
+    if (n > 0) ++rseq_;
+  }
+
+  // Watermark floor carried over from a failed inner link: the prefix
+  // the pipelined reduce already consumed must never regress even
+  // though the engine re-receives from offset 0 (the re-received bytes
+  // are identical, so the overwrite is harmless).
+  void SetFloor(size_t f) {
+    if (f > floor_) floor_ = f;
+  }
+
+  void QueueCtrl(uint32_t kind, uint64_t seq, uint64_t offset) {
+    ctrl_q_.push_back(WireFrame{kFrameMagic, kind, seq, offset, 0, 0});
+  }
+
+  bool SendDone() const {
+    return sn_ == 0 ||
+           (snext_ >= sn_ && retx_.empty() && !writing_retx_ && acked_);
+  }
+  bool RecvDone() const { return rdone_; }
+  size_t RecvBytes() const {
+    size_t c = static_cast<size_t>(reasm_.contiguous());
+    return c > floor_ ? c : floor_;
+  }
+  bool Idle() const { return SendDone() && RecvDone() && ctrl_q_.empty() &&
+                             !wactive_; }
+
+  int PollFd(short* events) const {
+    short ev = POLLIN;
+    if (TxPending()) ev |= POLLOUT;
+    *events = ev;
+    return sock_->fd();
+  }
+
+  // Pump both directions without blocking.
+  Status Pump() {
+    int64_t t0 = 0;
+    int64_t moved = 0;
+    Status st = PumpRx(&moved, &t0);
+    if (st.ok()) st = PumpTx(&moved, &t0);
+    if (moved > 0) Account(Backend::kSocket, moved, PumpClockUs() - t0);
+    return st;
+  }
+
+  int64_t retransmits() const { return retx_total_; }
+  int64_t crc_errors() const { return crc_err_total_; }
+  std::string last_crc_error() const { return last_crc_err_; }
+
+  std::string Describe() const {
+    char buf[192];
+    std::snprintf(buf, sizeof(buf),
+                  "engine tx %zu/%zuB seq=%llu%s%s, rx %zu/%zuB seq=%llu, "
+                  "retx=%lld, crc_errs=%lld",
+                  snext_, sn_, static_cast<unsigned long long>(sseq_),
+                  acked_ ? "" : " unacked",
+                  retx_.empty() ? "" : " retx-pending", RecvBytes(), rn_,
+                  static_cast<unsigned long long>(rseq_),
+                  static_cast<long long>(retx_total_),
+                  static_cast<long long>(crc_err_total_));
+    std::string out = buf;
+    if (!last_crc_err_.empty()) out += ", last crc err: " + last_crc_err_;
+    return out;
+  }
+
+ private:
+  bool TxPending() const {
+    if (wactive_ || !ctrl_q_.empty()) return true;
+    if (sn_ > 0 && snext_ < sn_) return true;
+    if (!retx_.empty()) return true;
+    return false;
+  }
+
+  Status Violation(const std::string& why) {
+    return Status::Unknown("transport engine peer " + std::to_string(peer_) +
+                           ": " + why);
+  }
+
+  // ---- RX ----------------------------------------------------------------
+
+  Status PumpRx(int64_t* moved, int64_t* t0) {
+    while (true) {
+      if (parked_) {
+        // A parked frame blocks further reads (TCP backpressure) until
+        // StartRecv arms its seq.
+        if (rn_ == 0 || park_hdr_.seq != rseq_) return Status::OK();
+        WireFrame hdr = park_hdr_;
+        parked_ = false;
+        Status st = FinishData(hdr, park_buf_.data());
+        if (!st.ok()) return st;
+        continue;
+      }
+      if (rhdr_off_ < sizeof(WireFrame)) {
+        char* p = reinterpret_cast<char*>(&rhdr_) + rhdr_off_;
+        ssize_t n = ::recv(sock_->fd(), p, sizeof(WireFrame) - rhdr_off_,
+                           MSG_DONTWAIT);
+        if (*t0 == 0) *t0 = PumpClockUs();
+        if (n == 0)
+          return Violation("peer closed connection");
+        if (n < 0) {
+          if (errno == EAGAIN || errno == EWOULDBLOCK) return Status::OK();
+          if (errno == EINTR) continue;
+          return Violation(std::string("recv failed: ") + strerror(errno));
+        }
+        rhdr_off_ += static_cast<size_t>(n);
+        *moved += n;
+        if (rhdr_off_ < sizeof(WireFrame)) return Status::OK();
+        if (rhdr_.magic != kFrameMagic)
+          return Violation("bad frame magic (stream desync)");
+        if (rhdr_.kind != kFData) {
+          rhdr_off_ = 0;
+          Status st = HandleCtrl(rhdr_);
+          if (!st.ok()) return st;
+          continue;
+        }
+        // Data frame: route its payload.
+        if (rhdr_.len > kEngineGranule)
+          return Violation("oversized granule");
+        if (rn_ > 0 && rhdr_.seq == rseq_) {
+          if (rhdr_.offset + rhdr_.len > rn_)
+            return Violation("granule exceeds armed recv");
+          rpay_dst_ = rbuf_ + rhdr_.offset;
+        } else if (rn_ == 0 || rhdr_.seq > rseq_) {
+          // Future exchange: park (copy); everything still needed for
+          // the armed seq is ahead of this frame in the stream.
+          if (park_buf_.size() < rhdr_.len) park_buf_.resize(rhdr_.len);
+          rpay_dst_ = park_buf_.data();
+          parking_ = true;
+        } else {
+          // Stale retransmit for an already-completed exchange: drain
+          // and re-ack.
+          if (scratch_.size() < rhdr_.len) scratch_.resize(rhdr_.len);
+          rpay_dst_ = scratch_.data();
+          stale_ = true;
+        }
+        rpay_off_ = 0;
+        rcrc_ = crc32c::Init();
+      }
+      while (rpay_off_ < rhdr_.len) {
+        ssize_t n = ::recv(sock_->fd(), rpay_dst_ + rpay_off_,
+                           rhdr_.len - rpay_off_, MSG_DONTWAIT);
+        if (*t0 == 0) *t0 = PumpClockUs();
+        if (n == 0)
+          return Violation("peer closed connection mid-frame");
+        if (n < 0) {
+          if (errno == EAGAIN || errno == EWOULDBLOCK) return Status::OK();
+          if (errno == EINTR) continue;
+          return Violation(std::string("recv failed: ") + strerror(errno));
+        }
+        if (checksum_) rcrc_ = crc32c::Update(rcrc_, rpay_dst_ + rpay_off_, n);
+        rpay_off_ += static_cast<size_t>(n);
+        *moved += n;
+      }
+      WireFrame hdr = rhdr_;
+      rhdr_off_ = 0;
+      if (parking_) {
+        parking_ = false;
+        parked_ = true;
+        park_hdr_ = hdr;
+        park_crc_ = crc32c::Finish(rcrc_);
+        continue;  // loop re-checks the parked gate and stops reading
+      }
+      if (stale_) {
+        stale_ = false;
+        QueueCtrl(kFAck, hdr.seq, 0);
+        continue;
+      }
+      Status st = FinishData(hdr, rbuf_ + hdr.offset);
+      if (!st.ok()) return st;
+    }
+  }
+
+  // Verify + merge one fully-received data granule already sitting at
+  // its destination (`data`; for unparked frames the park buffer).
+  Status FinishData(const WireFrame& hdr, const char* data) {
+    uint32_t got;
+    if (data == park_buf_.data()) {
+      got = park_crc_;
+      // Parked payload was copied outside the armed buffer; move it in.
+      if (hdr.offset + hdr.len > rn_)
+        return Violation("parked granule exceeds armed recv");
+      std::memcpy(rbuf_ + hdr.offset, data, hdr.len);
+    } else {
+      got = crc32c::Finish(rcrc_);
+    }
+    if (checksum_ && got != hdr.crc) {
+      ++crc_err_total_;
+      Bump(Backend::kSocket, CurrentLevel(), Counter::kCrcErrors);
+      char note[96];
+      std::snprintf(note, sizeof(note),
+                    "granule %llu+%u of seq %llu (want %08x got %08x)",
+                    static_cast<unsigned long long>(hdr.offset), hdr.len,
+                    static_cast<unsigned long long>(hdr.seq), hdr.crc, got);
+      last_crc_err_ = note;
+      LOG(Warning) << "transport engine peer " << peer_
+                   << ": CRC mismatch on " << note << "; requesting retransmit";
+      QueueCtrl(kFNak, hdr.seq, hdr.offset);
+      ctrl_q_.back().len = hdr.len;
+      return Status::OK();
+    }
+    if (!reasm_.Covered(hdr.offset)) reasm_.Add(hdr.offset, hdr.len);
+    if (reasm_.complete() && !rdone_) {
+      rdone_ = true;
+      QueueCtrl(kFAck, rseq_, 0);
+    }
+    return Status::OK();
+  }
+
+  Status HandleCtrl(const WireFrame& f) {
+    switch (f.kind) {
+      case kFAck:
+        if (f.seq == sseq_) acked_ = true;
+        return Status::OK();
+      case kFNak: {
+        if (f.seq != sseq_ || sn_ == 0) return Status::OK();  // stale
+        if (f.offset + f.len > sn_)
+          return Violation("NAK for granule outside armed send");
+        int tries = ++retry_counts_[f.offset];
+        if (tries > max_retries_)
+          return Violation("granule at offset " + std::to_string(f.offset) +
+                           " exceeded HOROVOD_LINK_RETRIES=" +
+                           std::to_string(max_retries_));
+        retx_.push_back(
+            Retx{f.offset, f.len, MonoUs() + RetryBackoffUs(tries - 1, &seed_)});
+        return Status::OK();
+      }
+      case kFDegrade:
+      case kFDegradeAck:
+      case kFProbe:
+        if (on_ctrl_) on_ctrl_(f);
+        return Status::OK();
+      default:
+        return Violation("unknown frame kind " + std::to_string(f.kind));
+    }
+  }
+
+  // ---- TX ----------------------------------------------------------------
+
+  Status PumpTx(int64_t* moved, int64_t* t0) {
+    while (true) {
+      if (!wactive_) {
+        if (!NextFrame()) return Status::OK();
+      }
+      while (whdr_off_ < sizeof(WireFrame)) {
+        const char* p = reinterpret_cast<const char*>(&whdr_) + whdr_off_;
+        ssize_t n = ::send(sock_->fd(), p, sizeof(WireFrame) - whdr_off_,
+                           MSG_DONTWAIT | MSG_NOSIGNAL);
+        if (*t0 == 0) *t0 = PumpClockUs();
+        if (n < 0) {
+          if (errno == EAGAIN || errno == EWOULDBLOCK) return Status::OK();
+          if (errno == EINTR) continue;
+          return Violation(std::string("send failed: ") + strerror(errno));
+        }
+        whdr_off_ += static_cast<size_t>(n);
+        *moved += n;
+      }
+      while (wpay_off_ < wpay_len_) {
+        ssize_t n = ::send(sock_->fd(), wpay_ + wpay_off_,
+                           wpay_len_ - wpay_off_, MSG_DONTWAIT | MSG_NOSIGNAL);
+        if (*t0 == 0) *t0 = PumpClockUs();
+        if (n < 0) {
+          if (errno == EAGAIN || errno == EWOULDBLOCK) return Status::OK();
+          if (errno == EINTR) continue;
+          return Violation(std::string("send failed: ") + strerror(errno));
+        }
+        wpay_off_ += static_cast<size_t>(n);
+        *moved += n;
+      }
+      if (writing_retx_) {
+        writing_retx_ = false;
+        ++retx_total_;
+        Bump(Backend::kSocket, CurrentLevel(), Counter::kRetransmits);
+      }
+      wactive_ = false;
+    }
+  }
+
+  // Select the next frame to write: ctrl first, then due retransmits,
+  // then fresh granules.  Returns false when nothing is ready.
+  bool NextFrame() {
+    whdr_off_ = 0;
+    wpay_off_ = 0;
+    wpay_ = nullptr;
+    // Ctrl frames are header-only; their `len` field is metadata (e.g. a
+    // NAK's retransmit length), never a payload length.
+    wpay_len_ = 0;
+    if (!ctrl_q_.empty()) {
+      whdr_ = ctrl_q_.front();
+      ctrl_q_.pop_front();
+      wactive_ = true;
+      return true;
+    }
+    if (!retx_.empty() && MonoUs() >= retx_.front().not_before) {
+      Retx r = retx_.front();
+      retx_.pop_front();
+      BuildData(r.offset, r.len);
+      writing_retx_ = true;
+      wactive_ = true;
+      return true;
+    }
+    if (sn_ > 0 && snext_ < sn_) {
+      size_t len = sn_ - snext_;
+      if (len > kEngineGranule) len = kEngineGranule;
+      BuildData(snext_, static_cast<uint32_t>(len));
+      snext_ += len;
+      wactive_ = true;
+      return true;
+    }
+    return false;
+  }
+
+  void BuildData(uint64_t offset, uint32_t len) {
+    uint32_t crc = 0;
+    if (checksum_) {
+      crc = crc32c::Value(sbuf_ + offset, len);
+      // Chaos: corrupt the advertised CRC (not the payload), so the
+      // receiver's verify path must catch it and the retransmitted
+      // granule stays bitwise identical to the original.
+      if (chaos::Arm(chaos::Kind::kFrameCorrupt) >= 0) crc ^= 0x5A5A5A5Au;
+    }
+    whdr_ = WireFrame{kFrameMagic, kFData, sseq_, offset, len, crc};
+    wpay_ = sbuf_ + offset;
+    wpay_len_ = len;
+  }
+
+  int peer_;
+  TcpSocket* sock_;
+  unsigned seed_;
+  const bool checksum_;
+  const int max_retries_;
+  std::function<void(const WireFrame&)> on_ctrl_;
+
+  // TX state.
+  const char* sbuf_ = nullptr;
+  size_t sn_ = 0;
+  size_t snext_ = 0;
+  uint64_t sseq_ = 0;
+  bool acked_ = true;
+  struct Retx {
+    uint64_t offset;
+    uint32_t len;
+    int64_t not_before;
+  };
+  std::deque<Retx> retx_;
+  std::map<uint64_t, int> retry_counts_;
+  std::deque<WireFrame> ctrl_q_;
+  bool wactive_ = false;
+  bool writing_retx_ = false;
+  WireFrame whdr_{};
+  size_t whdr_off_ = 0;
+  const char* wpay_ = nullptr;
+  size_t wpay_off_ = 0;
+  uint32_t wpay_len_ = 0;
+
+  // RX state.
+  char* rbuf_ = nullptr;
+  size_t rn_ = 0;
+  uint64_t rseq_ = 0;
+  bool rdone_ = true;
+  size_t floor_ = 0;
+  stripe::Reassembly reasm_;
+  WireFrame rhdr_{};
+  size_t rhdr_off_ = 0;
+  char* rpay_dst_ = nullptr;
+  size_t rpay_off_ = 0;
+  uint32_t rcrc_ = 0;
+  bool parking_ = false;
+  bool parked_ = false;
+  bool stale_ = false;
+  WireFrame park_hdr_{};
+  uint32_t park_crc_ = 0;
+  std::vector<char> park_buf_;
+  std::vector<char> scratch_;
+
+  // Stats (Describe / owner).
+  int64_t retx_total_ = 0;
+  int64_t crc_err_total_ = 0;
+  std::string last_crc_err_;
+};
+
+// ==========================================================================
+// HealingLink.
+// ==========================================================================
+
+class HealingLink : public Link {
+ public:
+  HealingLink(int self, int peer, Backend preferred,
+              std::unique_ptr<Link> inner, TcpSocket* mesh,
+              std::function<std::unique_ptr<Link>()> rebuild)
+      : self_(self), peer_(peer), preferred_(preferred),
+        inner_(std::move(inner)), eng_(self, peer, mesh),
+        rebuild_(std::move(rebuild)),
+        stall_ms_(EnvInt("HOROVOD_SHM_STALL_MS", 5000)),
+        probe_us_(static_cast<int64_t>(
+            EnvDouble("HOROVOD_LINK_PROBE_SECONDS", 30.0) * 1e6)) {
+    eng_.SetCtrlHandler([this](const WireFrame& f) { OnCtrl(f); });
+  }
+
+  ~HealingLink() override { Shutdown(); }
+
+  Backend backend() const override { return preferred_; }
+  int peer() const override { return peer_; }
+
+  void StartSend(const void* buf, size_t n) override {
+    OnArm(/*is_send=*/true);
+    send_armed_ = true;
+    sbuf_ = buf;
+    sn_ = n;
+    if (inner_) {
+      ArmChaos();
+      if (inner_) {
+        inner_->StartSend(buf, n);
+        TouchInner();
+        return;
+      }
+    }
+    eng_.StartSend(buf, n);
+  }
+
+  void StartRecv(void* buf, size_t n) override {
+    OnArm(/*is_send=*/false);
+    recv_armed_ = true;
+    rbuf_ = buf;
+    rn_ = n;
+    if (inner_) {
+      ArmChaos();
+      if (inner_) {
+        inner_->StartRecv(buf, n);
+        TouchInner();
+        return;
+      }
+    }
+    eng_.StartRecv(buf, n);
+  }
+
+  Status Progress() override {
+    if (failed_) return err_;
+    // The engine is always pumped: in preferred mode it is the control
+    // channel (degrade / probe frames), in degraded mode the data path.
+    Status st = eng_.Pump();
+    if (!st.ok()) return Fail(st);
+    if (inner_) {
+      if (chaos_stall_until_ > 0) {
+        if (MonoUs() < chaos_stall_until_) {
+          // Suppressed pump: the ring makes no progress; the stall
+          // deadline below decides whether this window degrades.
+          CheckStall();
+          return failed_ ? err_ : Status::OK();
+        }
+        chaos_stall_until_ = 0;
+      }
+      Status ist = inner_->Progress();
+      if (!ist.ok()) {
+        Degrade("inner link failed: " + ist.reason, 0);
+      } else {
+        CheckStall();
+      }
+    }
+    return failed_ ? err_ : Status::OK();
+  }
+
+  bool SendDone() const override {
+    return inner_ ? inner_->SendDone() : eng_.SendDone();
+  }
+  bool RecvDone() const override {
+    return inner_ ? inner_->RecvDone() : eng_.RecvDone();
+  }
+  size_t RecvBytes() const override {
+    return inner_ ? inner_->RecvBytes() : eng_.RecvBytes();
+  }
+
+  int PollFd(short* events) const override {
+    // Engine-only paths are pollable on the mesh fd; with a live inner
+    // link progress comes from the peer process / stripe workers, so
+    // the pump must keep spinning (and keeps the ctrl channel drained).
+    if (inner_) return -1;
+    return eng_.PollFd(events);
+  }
+
+  LinkHealth Health() const override {
+    if (failed_) return LinkHealth::kFailed;
+    if (degraded_.load(std::memory_order_relaxed)) return LinkHealth::kDegraded;
+    return inner_ ? inner_->Health() : LinkHealth::kOk;
+  }
+
+  std::string Describe() const override {
+    char head[128];
+    std::snprintf(head, sizeof(head),
+                  "peer %d heal[%s]: epoch %llu, failovers %d, settled %llu; ",
+                  peer_, BackendName(preferred_),
+                  static_cast<unsigned long long>(epoch_),
+                  failover_count_.load(std::memory_order_relaxed),
+                  static_cast<unsigned long long>(settled_));
+    std::string out = head;
+    {
+      std::lock_guard<std::mutex> lk(note_mu_);
+      if (!note_.empty()) out += note_ + "; ";
+    }
+    if (inner_) out += "inner: " + inner_->Describe() + "; ";
+    out += eng_.Describe();
+    return out;
+  }
+
+  void Shutdown() override {
+    if (inner_) inner_->Shutdown();
+  }
+
+ private:
+  // ---- exchange-group settling + probe rendezvous ------------------------
+  //
+  // Exchange groups are the directions armed between consecutive
+  // settles; a group closes when a direction is armed a second time.
+  // Matched pairs arm the complementary direction string, so both ends
+  // partition the stream into identical groups and `settled_` counts
+  // agree — the shared clock the kProbe rendezvous is scheduled on.
+
+  void OnArm(bool is_send) {
+    bool dbl = is_send ? send_armed_ : recv_armed_;
+    if (dbl) Settle();
+  }
+
+  void Settle() {
+    ++settled_;
+    send_armed_ = recv_armed_ = false;
+    bool degraded = degraded_.load(std::memory_order_relaxed);
+    if (degraded && self_ < peer_ && rebuild_ && probe_target_ == 0 &&
+        MonoUs() - degraded_since_ >= probe_us_) {
+      // Initiator: schedule the rebuild after the NEXT group settles.
+      // The frame precedes every frame of that group in the stream, so
+      // the peer always learns the target before it can reach it.
+      probe_target_ = settled_ + 1;
+      eng_.QueueCtrl(kFProbe, epoch_, probe_target_);
+    }
+    if (probe_target_ != 0 && settled_ >= probe_target_) DoRebuild();
+  }
+
+  void DoRebuild() {
+    probe_target_ = 0;
+    // Both ends reach this settle count with the engine quiescent and
+    // at the same stream position: the raw-socket rebuild handshake
+    // (e.g. the shm offer/ack) slots cleanly between engine frames.
+    std::unique_ptr<Link> fresh = rebuild_ ? rebuild_() : nullptr;
+    if (fresh) {
+      inner_ = std::move(fresh);
+      degraded_.store(false, std::memory_order_relaxed);
+      // kDegraded is a gauge: re-promotion takes this link back out.
+      Bump(preferred_, degraded_level_, Counter::kDegraded, -1);
+      ++epoch_;
+      ResetStallTracker();
+      SetNote("re-promoted to " + std::string(BackendName(preferred_)));
+      LOG(Info) << "transport peer " << peer_ << ": re-promoted to "
+                << BackendName(preferred_) << " (epoch " << epoch_ << ")";
+    } else {
+      degraded_since_ = MonoUs();  // stay degraded, re-arm the probe timer
+      SetNote("probe rebuild failed; still degraded");
+    }
+  }
+
+  // ---- degrade ----------------------------------------------------------
+
+  // peer_epoch == 0: locally initiated.  Otherwise: the peer proposed
+  // `peer_epoch` via kDegrade.
+  void Degrade(const std::string& why, uint64_t peer_epoch) {
+    if (!inner_) {
+      // Already degraded.  A matching proposal from a simultaneous
+      // local decision needs no reply; acknowledge anything else so the
+      // peer's handshake always terminates.
+      if (peer_epoch > epoch_) epoch_ = peer_epoch;
+      return;
+    }
+    epoch_ = peer_epoch > 0 ? peer_epoch : epoch_ + 1;
+    eng_.QueueCtrl(peer_epoch > 0 ? kFDegradeAck : kFDegrade, epoch_, 0);
+    size_t floor = recv_armed_ ? inner_->RecvBytes() : 0;
+    inner_->Shutdown();
+    inner_.reset();
+    degraded_.store(true, std::memory_order_relaxed);
+    degraded_since_ = MonoUs();
+    failover_count_.fetch_add(1, std::memory_order_relaxed);
+    Bump(preferred_, CurrentLevel(), Counter::kFailovers);
+    // kDegraded is a gauge; remember the cell so re-promotion can undo
+    // exactly this bump even if the thread-local level changed since.
+    degraded_level_ = CurrentLevel();
+    Bump(preferred_, degraded_level_, Counter::kDegraded);
+    SetNote("degraded to socket: " + why);
+    LOG(Warning) << "transport peer " << peer_ << ": "
+                 << BackendName(preferred_)
+                 << " link degraded to socket (epoch " << epoch_
+                 << "): " << why;
+    // Restart the in-flight exchange on the engine.  The sender resends
+    // from offset 0 (its buffer is held until SendDone); the receiver
+    // keeps the already-consumed watermark as a floor.
+    if (send_armed_) eng_.StartSend(sbuf_, sn_);
+    if (recv_armed_) {
+      eng_.StartRecv(rbuf_, rn_);
+      eng_.SetFloor(floor);
+    }
+  }
+
+  void OnCtrl(const WireFrame& f) {
+    switch (f.kind) {
+      case kFDegrade:
+        Degrade("peer requested degrade", f.seq);
+        break;
+      case kFDegradeAck:
+        if (f.seq > epoch_) epoch_ = f.seq;
+        break;
+      case kFProbe:
+        // Responder side of the rebuild rendezvous.
+        if (f.offset > settled_) probe_target_ = f.offset;
+        break;
+      default:
+        break;
+    }
+  }
+
+  // ---- stall detection (shm inner) --------------------------------------
+
+  void TouchInner() {
+    last_change_us_ = MonoUs();
+    if (inner_) {
+      last_rb_ = inner_->RecvBytes();
+      last_sd_ = inner_->SendDone();
+      last_rd_ = inner_->RecvDone();
+    }
+  }
+
+  void ResetStallTracker() {
+    chaos_stall_until_ = 0;
+    TouchInner();
+  }
+
+  void CheckStall() {
+    if (preferred_ != Backend::kShm || !inner_ || stall_ms_ <= 0) return;
+    bool pending = (send_armed_ && !inner_->SendDone()) ||
+                   (recv_armed_ && !inner_->RecvDone());
+    if (!pending) return;
+    size_t rb = inner_->RecvBytes();
+    bool sd = inner_->SendDone(), rd = inner_->RecvDone();
+    if (rb != last_rb_ || sd != last_sd_ || rd != last_rd_) {
+      last_rb_ = rb;
+      last_sd_ = sd;
+      last_rd_ = rd;
+      last_change_us_ = MonoUs();
+      return;
+    }
+    if (MonoUs() - last_change_us_ > stall_ms_ * 1000) {
+      Degrade("shm ring stalled past HOROVOD_SHM_STALL_MS=" +
+                  std::to_string(stall_ms_),
+              0);
+    }
+  }
+
+  // ---- chaos ------------------------------------------------------------
+
+  void ArmChaos() {
+    // Per armed exchange, only while an inner link is up.
+    if (chaos::Arm(chaos::Kind::kLinkReset) >= 0) {
+      Degrade("chaos link_reset", 0);
+      return;
+    }
+    if (preferred_ == Backend::kShm) {
+      double ms = chaos::Arm(chaos::Kind::kShmStall);
+      if (ms >= 0) {
+        if (ms == 0) ms = 2.0 * static_cast<double>(stall_ms_);
+        chaos_stall_until_ = MonoUs() + static_cast<int64_t>(ms * 1000);
+      }
+    }
+  }
+
+  Status Fail(const Status& st) {
+    if (!failed_) {
+      failed_ = true;
+      err_ = st;
+    }
+    return err_;
+  }
+
+  void SetNote(const std::string& s) {
+    std::lock_guard<std::mutex> lk(note_mu_);
+    note_ = s;
+  }
+
+  const int self_;
+  const int peer_;
+  const Backend preferred_;
+  std::unique_ptr<Link> inner_;
+  FrameEngine eng_;
+  std::function<std::unique_ptr<Link>()> rebuild_;
+  const int64_t stall_ms_;
+  const int64_t probe_us_;
+
+  bool send_armed_ = false;
+  bool recv_armed_ = false;
+  const void* sbuf_ = nullptr;
+  size_t sn_ = 0;
+  void* rbuf_ = nullptr;
+  size_t rn_ = 0;
+
+  uint64_t settled_ = 0;
+  uint64_t probe_target_ = 0;
+  uint64_t epoch_ = 0;
+  std::atomic<bool> degraded_{false};
+  int64_t degraded_since_ = 0;
+  Level degraded_level_ = Level::kFlat;
+
+  int64_t last_change_us_ = 0;
+  size_t last_rb_ = 0;
+  bool last_sd_ = false;
+  bool last_rd_ = false;
+  int64_t chaos_stall_until_ = 0;
+
+  bool failed_ = false;
+  Status err_;
+  std::atomic<int> failover_count_{0};
+  mutable std::mutex note_mu_;
+  std::string note_;
+};
+
+}  // namespace
+
+std::unique_ptr<Link> MakeHealingLink(
+    int self, int peer, Backend preferred, std::unique_ptr<Link> inner,
+    TcpSocket* mesh, std::function<std::unique_ptr<Link>()> rebuild) {
+  return std::make_unique<HealingLink>(self, peer, preferred,
+                                       std::move(inner), mesh,
+                                       std::move(rebuild));
+}
+
+}  // namespace transport
+}  // namespace hvd

@@ -94,7 +94,13 @@ class ShmLink : public Link {
   Status AttachRings() {
     Status st = tx_.Attach(tx_map_.base, tx_map_.bytes);
     if (!st.ok()) return st;
-    return rx_.Attach(rx_map_.base, rx_map_.bytes);
+    st = rx_.Attach(rx_map_.base, rx_map_.bytes);
+    if (!st.ok()) return st;
+    // Both peers derive this from the same process-wide env setting, so
+    // the rings always agree on whether slots carry a CRC.
+    tx_.set_checksum(ChecksumEnabled());
+    rx_.set_checksum(ChecksumEnabled());
+    return Status::OK();
   }
 
   Backend backend() const override { return Backend::kShm; }
@@ -128,7 +134,14 @@ class ShmLink : public Link {
       if (t0 == 0) t0 = PumpClockUs();
       Status st = Status::OK();
       int64_t n = rx_.TryPop(recv_ptr_, recv_left_, &st);
-      if (n < 0) return st;
+      if (n < 0) {
+        // Slot-level corruption is unrecoverable in place (the ring has
+        // no retransmit), but it is counted here so the healing wrapper
+        // that degrades us to socket leaves an audit trail.
+        if (st.reason.find("CRC") != std::string::npos)
+          Bump(Backend::kShm, CurrentLevel(), Counter::kCrcErrors);
+        return st;
+      }
       if (n == 0) break;
       recv_ptr_ += n;
       recv_left_ -= static_cast<size_t>(n);

@@ -4,19 +4,40 @@
 // caps the link.
 //
 // The sender deals granule-sized chunks round-robin over its ACTIVE
-// stripes (live-tunable, <= configured stripes); every frame is
-// self-describing ({u32 seq, u32 len, u64 offset}, host order like the
-// rest of the wire protocol), so the receiver never needs to know the
-// sender's stripe count or granule — stripe_plan.h's Reassembly merges
-// whatever arrives and exposes the contiguous prefix as the pipelined
-// on_recv watermark.
+// stripes (live-tunable, <= configured stripes, dead stripes excluded);
+// every frame is self-describing ({u32 seq, u32 len, u64 offset,
+// u32 kind, u32 crc}, host order like the rest of the wire protocol),
+// so the receiver never needs to know the sender's stripe count or
+// granule — stripe_plan.h's Reassembly merges whatever arrives and
+// exposes the contiguous prefix as the pipelined on_recv watermark.
 //
 // Seq gating keeps serialized exchanges safe without extra round trips:
 // each side numbers its sends and recvs 1, 2, 3...; a stripe that has
-// parsed a frame header for a seq the receiver has not armed yet simply
+// parsed a data header for a seq the receiver has not armed yet simply
 // parks (the payload stays in the kernel buffer) until StartRecv
 // advances the armed seq.  Per-stripe TCP ordering guarantees a parsed
-// seq is never behind the armed one.
+// seq is never behind the armed one — except for retransmits, which are
+// drained and re-acked.
+//
+// Self-healing (docs/fault_tolerance.md, "Transport self-healing"):
+//
+//   wire integrity   every data frame carries a CRC32C when
+//                    HOROVOD_TRANSPORT_CHECKSUM is on; a corrupt frame
+//                    is NAK'd and retransmitted with jittered backoff,
+//                    bounded by HOROVOD_LINK_RETRIES per chunk.
+//   completion acks  SendDone is gated on the receiver's kAck, so the
+//                    send buffer stays valid for retransmits and a
+//                    "sent" exchange is a *verified* exchange.
+//   stripe failover  a dead stripe re-enqueues ALL its chunks of the
+//                    in-flight exchange onto surviving stripes (the
+//                    receiver dedups via Reassembly::Covered), re-acks
+//                    the last completed recv (the ack may have died
+//                    with the stripe), and broadcasts kStripeDown so
+//                    the peer retires its end too.  Subsequent sends
+//                    plan over the survivors (stripe count renegotiated
+//                    down).  The last stripe dying fails the link and
+//                    the healing wrapper (link_heal.h) degrades the
+//                    pair to the mesh socket.
 #include <errno.h>
 #include <fcntl.h>
 #include <poll.h>
@@ -26,9 +47,13 @@
 
 #include <cstdio>
 #include <cstring>
+#include <deque>
+#include <map>
 #include <mutex>
 #include <thread>
 
+#include "crc32c.h"
+#include "link_heal.h"
 #include "socket.h"
 #include "stripe_plan.h"
 #include "trace.h"
@@ -41,22 +66,48 @@ namespace {
 
 std::atomic<int64_t> g_active_stripes{0};
 
+enum StripeFrameKind : uint32_t {
+  kSData = 0,        // payload chunk of exchange `seq`
+  kSNak = 1,         // chunk {offset, len} of `seq` failed its CRC
+  kSAck = 2,         // exchange `seq` fully received and verified
+  kSStripeDown = 3,  // sender's stripe `offset` died; retire your end
+};
+
 struct FrameHeader {
   uint32_t seq;
-  uint32_t len;
-  uint64_t offset;
+  uint32_t len;      // payload length; 0 for control kinds
+  uint64_t offset;   // data/nak: chunk offset; stripe_down: stripe index
+  uint32_t kind;
+  uint32_t crc;      // CRC32C of the payload (kSData, checksum on), else 0
 };
-static_assert(sizeof(FrameHeader) == 16, "frame header layout");
+static_assert(sizeof(FrameHeader) == 24, "frame header layout");
 
 // Chunks dealt per exchange per stripe: enough rounds that active
 // stripes stay balanced even when TCP throughput varies between them.
 constexpr uint64_t kRoundsPerStripe = 2;
 constexpr uint64_t kMinGranule = 64 * 1024;
 
+int64_t MonoUsStriped() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
+}
+
+// Jittered exponential backoff before retransmitting a NAK'd chunk
+// (same discipline as the control-plane control_call retries).
+int64_t StripeRetryBackoffUs(int attempt, unsigned* seed) {
+  int64_t d = int64_t(200) << (attempt > 8 ? 8 : attempt);
+  if (d > 50000) d = 50000;
+  double jitter = 0.5 + 0.5 * (rand_r(seed) / (RAND_MAX + 1.0));
+  return static_cast<int64_t>(d * jitter);
+}
+
 class StripedLink : public Link {
  public:
   StripedLink(int peer, std::vector<TcpSocket> socks)
-      : peer_(peer), socks_(std::move(socks)) {
+      : peer_(peer), socks_(std::move(socks)),
+        checksum_(ChecksumEnabled()),
+        max_retries_(static_cast<int>(EnvInt("HOROVOD_LINK_RETRIES", 4))) {
     for (size_t s = 0; s < socks_.size(); ++s) {
       int fl = ::fcntl(socks_[s].fd(), F_GETFL, 0);
       ::fcntl(socks_[s].fd(), F_SETFL, fl | O_NONBLOCK);
@@ -81,31 +132,62 @@ class StripedLink : public Link {
 
   void StartSend(const void* buf, size_t n) override {
     if (n == 0) {
-      zero_send_ = true;
+      zero_send_.store(true, std::memory_order_relaxed);
       return;
     }
-    zero_send_ = false;
+    zero_send_.store(false, std::memory_order_relaxed);
     link_level_.store(static_cast<int>(CurrentLevel()),
                       std::memory_order_relaxed);
     send_buf_ = static_cast<const char*>(buf);
     uint64_t seq = armed_send_seq_.load(std::memory_order_relaxed) + 1;
+    {
+      // A fresh exchange invalidates every pending retransmit (ack
+      // gating means the previous exchange was fully verified).
+      std::lock_guard<std::mutex> lk(ctrl_mu_);
+      retx_.clear();
+      retry_counts_.clear();
+    }
+    // Plan over surviving stripes only: a dead stripe renegotiates the
+    // effective stripe count down for every later exchange.
+    std::vector<int> alive;
+    for (size_t s = 0; s < stripes_.size(); ++s)
+      if (stripes_[s]->alive.load(std::memory_order_acquire))
+        alive.push_back(static_cast<int>(s));
     int active = ActiveCount();
+    if (active > static_cast<int>(alive.size()))
+      active = static_cast<int>(alive.size());
+    if (active < 1) active = 1;  // all-dead: Fail() already pending
     uint64_t granule = n / (static_cast<uint64_t>(active) * kRoundsPerStripe);
     if (granule < kMinGranule) granule = kMinGranule;
     auto plan = stripe::Plan(n, granule, static_cast<uint32_t>(active));
     for (auto& st : stripes_) st->tx_chunks.clear();
-    for (const auto& c : plan)
-      stripes_[c.stripe]->tx_chunks.push_back(c);
+    if (!alive.empty()) {
+      for (auto& c : plan) {
+        c.stripe = static_cast<uint32_t>(alive[c.stripe]);
+        stripes_[c.stripe]->tx_chunks.push_back(c);
+      }
+    }
     // Publish: workers acquire this and see the chunk lists + buffer.
     armed_send_seq_.store(seq, std::memory_order_release);
+    // A stripe that died between the alive-snapshot and the publish
+    // never deals its list; push those chunks to the shared retransmit
+    // queue (duplicates are harmless — the receiver dedups).
+    for (int s : alive) {
+      if (!stripes_[s]->alive.load(std::memory_order_acquire) &&
+          !stripes_[s]->tx_chunks.empty()) {
+        std::lock_guard<std::mutex> lk(ctrl_mu_);
+        for (const auto& c : stripes_[s]->tx_chunks)
+          retx_.push_back(Retx{seq, c.offset, c.len, 0});
+      }
+    }
   }
 
   void StartRecv(void* buf, size_t n) override {
     if (n == 0) {
-      zero_recv_ = true;
+      zero_recv_.store(true, std::memory_order_relaxed);
       return;
     }
-    zero_recv_ = false;
+    zero_recv_.store(false, std::memory_order_relaxed);
     link_level_.store(static_cast<int>(CurrentLevel()),
                       std::memory_order_relaxed);
     recv_buf_ = static_cast<char*>(buf);
@@ -129,35 +211,60 @@ class StripedLink : public Link {
   }
 
   bool SendDone() const override {
-    if (zero_send_) return true;
-    uint64_t seq = armed_send_seq_.load(std::memory_order_relaxed);
-    for (const auto& st : stripes_)
-      if (st->tx_done.load(std::memory_order_acquire) < seq) return false;
-    return true;
+    if (zero_send_.load(std::memory_order_relaxed)) return true;
+    // Ack-gated: "sent" means the receiver verified every chunk, which
+    // also keeps send_buf_ valid for any retransmit.
+    return peer_acked_seq_.load(std::memory_order_acquire) >=
+           armed_send_seq_.load(std::memory_order_relaxed);
   }
 
   bool RecvDone() const override {
-    if (zero_recv_) return true;
+    if (zero_recv_.load(std::memory_order_relaxed)) return true;
     return rx_total_.load(std::memory_order_acquire) >= recv_expected_;
   }
 
   size_t RecvBytes() const override {
-    if (zero_recv_) return 0;
+    if (zero_recv_.load(std::memory_order_relaxed)) return 0;
     return static_cast<size_t>(rx_contig_.load(std::memory_order_acquire));
+  }
+
+  LinkHealth Health() const override {
+    if (failed_.load(std::memory_order_acquire)) return LinkHealth::kFailed;
+    for (const auto& st : stripes_)
+      if (!st->alive.load(std::memory_order_acquire))
+        return LinkHealth::kDegraded;
+    return LinkHealth::kOk;
   }
 
   std::string Describe() const override {
     uint64_t sseq = armed_send_seq_.load(std::memory_order_relaxed);
     uint64_t rseq = armed_recv_seq_.load(std::memory_order_relaxed);
-    char head[96];
+    size_t retx_depth;
+    int64_t naks = 0;
+    {
+      std::lock_guard<std::mutex> lk(ctrl_mu_);
+      retx_depth = retx_.size();
+      for (const auto& kv : retry_counts_) naks += kv.second;
+    }
+    char head[160];
     std::snprintf(head, sizeof(head),
-                  "peer %d striped x%zu (send seq %llu, recv seq %llu):",
+                  "peer %d striped x%zu (send seq %llu acked %llu, recv seq "
+                  "%llu, retx queue %zu, naks %lld):",
                   peer_, stripes_.size(),
                   static_cast<unsigned long long>(sseq),
-                  static_cast<unsigned long long>(rseq));
+                  static_cast<unsigned long long>(
+                      peer_acked_seq_.load(std::memory_order_relaxed)),
+                  static_cast<unsigned long long>(rseq), retx_depth,
+                  static_cast<long long>(naks));
     std::string out = head;
     for (size_t s = 0; s < stripes_.size(); ++s) {
       const Stripe& st = *stripes_[s];
+      if (!st.alive.load(std::memory_order_relaxed)) {
+        char buf[32];
+        std::snprintf(buf, sizeof(buf), " [s%zu DEAD]", s);
+        out += buf;
+        continue;
+      }
       char buf[128];
       std::snprintf(buf, sizeof(buf),
                     " [s%zu tx %zu/%zu chunks%s%s]", s,
@@ -181,6 +288,14 @@ class StripedLink : public Link {
     std::atomic<uint64_t> tx_done{0};
     std::atomic<size_t> tx_chunk_idx{0};
     std::atomic<bool> rx_gated{false};
+    std::atomic<bool> alive{true};
+  };
+
+  struct Retx {
+    uint64_t seq;
+    uint64_t offset;
+    uint32_t len;
+    int64_t not_before;
   };
 
   int ActiveCount() const {
@@ -188,6 +303,10 @@ class StripedLink : public Link {
     int n = static_cast<int>(stripes_.size());
     if (a <= 0 || a > n) return n;
     return static_cast<int>(a);
+  }
+
+  Level LinkLevel() const {
+    return static_cast<Level>(link_level_.load(std::memory_order_relaxed));
   }
 
   void Fail(const Status& st) {
@@ -198,41 +317,107 @@ class StripedLink : public Link {
     failed_.store(true, std::memory_order_release);
   }
 
+  // Retire stripe s.  Called only by worker s itself (self-report on
+  // its own socket error), so tx cursors and chunk lists are never
+  // touched cross-thread; the kStripeDown broadcast makes the peer's
+  // worker s self-report too (via shutdown -> socket error).
+  void MarkStripeDead(int s, const std::string& why) {
+    Stripe& st = *stripes_[s];
+    if (!st.alive.exchange(false, std::memory_order_acq_rel))
+      return;  // already retired
+    ::shutdown(socks_[s].fd(), SHUT_RDWR);
+    Bump(Backend::kStriped, LinkLevel(), Counter::kFailovers);
+    int survivors = 0;
+    for (const auto& other : stripes_)
+      if (other->alive.load(std::memory_order_acquire)) ++survivors;
+    LOG(Warning) << "striped link to rank " << peer_ << ": stripe " << s
+                 << " died (" << why << "); " << survivors
+                 << " stripe(s) surviving";
+    if (survivors == 0) {
+      Fail(Status::Unknown("striped: all stripes to rank " +
+                           std::to_string(peer_) + " dead; last error: " +
+                           why));
+      return;
+    }
+    uint64_t armed = armed_send_seq_.load(std::memory_order_acquire);
+    bool unacked =
+        !zero_send_.load(std::memory_order_relaxed) &&
+        peer_acked_seq_.load(std::memory_order_acquire) < armed;
+    std::lock_guard<std::mutex> lk(ctrl_mu_);
+    if (unacked) {
+      // Re-enqueue EVERY chunk this stripe owned: fully-sent chunks may
+      // still be sitting in a now-dead kernel buffer, and the receiver
+      // dedups whatever actually landed (Reassembly::Covered).
+      for (const auto& c : st.tx_chunks)
+        retx_.push_back(Retx{armed, c.offset, c.len, 0});
+    }
+    // Tell the peer to retire its end of this stripe, and re-issue our
+    // last completed-exchange ack — it may have died with the stripe.
+    ctrl_bcast_.push_back(
+        FrameHeader{0, 0, static_cast<uint64_t>(s), kSStripeDown, 0});
+    uint64_t done = last_done_recv_seq_.load(std::memory_order_relaxed);
+    if (done > 0)
+      ctrl_bcast_.push_back(
+          FrameHeader{static_cast<uint32_t>(done), 0, 0, kSAck, 0});
+  }
+
   struct TxCursor {
-    uint64_t seq = 0;       // exchange currently being written (0 = idle)
-    size_t chunk = 0;       // index into tx_chunks
-    size_t hdr_off = 0;     // header bytes already written
-    size_t pay_off = 0;     // payload bytes already written
+    bool active = false;    // a frame is being written
+    bool is_retx = false;
     FrameHeader hdr{};
+    const char* pay = nullptr;  // nullptr for control frames
+    size_t hdr_off = 0;
+    size_t pay_off = 0;
+    uint64_t seq = 0;       // exchange whose fresh chunks are being dealt
+    size_t chunk = 0;       // index into own tx_chunks
   };
   struct RxCursor {
-    size_t hdr_off = 0;     // header bytes already read
-    size_t pay_off = 0;     // payload bytes already read
+    size_t hdr_off = 0;
+    size_t pay_off = 0;
+    char* pay_dst = nullptr;
+    bool stale = false;     // draining a duplicate for a completed seq
     FrameHeader hdr{};
+    std::vector<char> scratch;
   };
 
+  // Pick the next frame for stripe s: control broadcasts first, then
+  // fresh chunks, then due retransmits.  Returns false when idle.
+  bool NextTxFrame(int s, TxCursor& tx, unsigned* seed);
   // One full-duplex pump round for stripe s.  Returns bytes moved, or
-  // -1 after Fail().
-  int64_t PumpOnce(int s, TxCursor& tx, RxCursor& rx);
+  // -1 when the stripe died / the link failed (worker exits).
+  int64_t PumpOnce(int s, TxCursor& tx, RxCursor& rx, unsigned* seed);
+  Status HandleCtrl(int s, const FrameHeader& f, unsigned* seed);
+  void FinishRxChunk(int s, RxCursor& rx);
 
   void WorkerLoop(int s);
 
   int peer_;
   std::vector<TcpSocket> socks_;
   std::vector<std::unique_ptr<Stripe>> stripes_;
+  const bool checksum_;
+  const int max_retries_;
 
   const char* send_buf_ = nullptr;
   std::atomic<uint64_t> armed_send_seq_{0};
-  bool zero_send_ = false;
+  std::atomic<uint64_t> peer_acked_seq_{0};
+  std::atomic<bool> zero_send_{false};
 
   char* recv_buf_ = nullptr;
   size_t recv_expected_ = 0;
   std::atomic<uint64_t> armed_recv_seq_{0};
-  bool zero_recv_ = false;
+  std::atomic<uint64_t> last_done_recv_seq_{0};
+  std::atomic<bool> zero_recv_{false};
   std::mutex reasm_mu_;
   stripe::Reassembly reasm_;
   std::atomic<uint64_t> rx_total_{0};
   std::atomic<uint64_t> rx_contig_{0};
+
+  // Shared control-frame broadcast queue (acks, NAKs, stripe-down) and
+  // retransmit queue: any surviving stripe may carry them.
+  mutable std::mutex ctrl_mu_;
+  std::deque<FrameHeader> ctrl_bcast_;
+  std::deque<Retx> retx_;
+  std::map<uint64_t, int> retry_counts_;  // NAK retries per chunk offset
 
   // Level of the exchange currently armed, captured from the arming
   // thread's TLS so workers account against the right series.
@@ -244,67 +429,213 @@ class StripedLink : public Link {
   Status err_;
 };
 
-int64_t StripedLink::PumpOnce(int s, TxCursor& tx, RxCursor& rx) {
+bool StripedLink::NextTxFrame(int s, TxCursor& tx, unsigned* seed) {
+  Stripe& st = *stripes_[s];
+  tx.hdr_off = 0;
+  tx.pay_off = 0;
+  tx.pay = nullptr;
+  tx.is_retx = false;
+  {
+    std::lock_guard<std::mutex> lk(ctrl_mu_);
+    if (!ctrl_bcast_.empty()) {
+      tx.hdr = ctrl_bcast_.front();
+      ctrl_bcast_.pop_front();
+      tx.active = true;
+      return true;
+    }
+  }
+  uint64_t want = armed_send_seq_.load(std::memory_order_acquire);
+  if (st.tx_done.load(std::memory_order_relaxed) < want) {
+    if (tx.seq != want) {
+      tx.seq = want;
+      tx.chunk = 0;
+      st.tx_chunk_idx.store(0, std::memory_order_relaxed);
+    }
+    if (tx.chunk >= st.tx_chunks.size()) {
+      st.tx_done.store(want, std::memory_order_release);
+    } else {
+      const stripe::Chunk& c = st.tx_chunks[tx.chunk];
+      // Chaos passage: a firing stripe_kill takes down THIS stripe at
+      // the moment it would deal a data frame; the resulting socket
+      // error drives the normal self-report path.
+      if (chaos::Arm(chaos::Kind::kStripeKill) >= 0)
+        ::shutdown(socks_[s].fd(), SHUT_RDWR);
+      uint32_t crc = 0;
+      if (checksum_) {
+        crc = crc32c::Value(send_buf_ + c.offset, c.len);
+        if (chaos::Arm(chaos::Kind::kFrameCorrupt) >= 0) crc ^= 0x5A5A5A5Au;
+      }
+      tx.hdr = FrameHeader{static_cast<uint32_t>(want), c.len, c.offset,
+                           kSData, crc};
+      tx.pay = send_buf_ + c.offset;
+      tx.active = true;
+      return true;
+    }
+  }
+  {
+    std::lock_guard<std::mutex> lk(ctrl_mu_);
+    while (!retx_.empty()) {
+      const Retx& r = retx_.front();
+      if (r.seq != want) {  // stale entry from a finished exchange
+        retx_.pop_front();
+        continue;
+      }
+      if (MonoUsStriped() < r.not_before) break;
+      uint32_t crc = 0;
+      if (checksum_) {
+        crc = crc32c::Value(send_buf_ + r.offset, r.len);
+        if (chaos::Arm(chaos::Kind::kFrameCorrupt) >= 0) crc ^= 0x5A5A5A5Au;
+      }
+      tx.hdr = FrameHeader{static_cast<uint32_t>(r.seq), r.len, r.offset,
+                           kSData, crc};
+      tx.pay = send_buf_ + r.offset;
+      tx.is_retx = true;
+      tx.active = true;
+      retx_.pop_front();
+      return true;
+    }
+  }
+  (void)seed;
+  return false;
+}
+
+Status StripedLink::HandleCtrl(int s, const FrameHeader& f, unsigned* seed) {
+  switch (f.kind) {
+    case kSAck: {
+      uint64_t prev = peer_acked_seq_.load(std::memory_order_relaxed);
+      while (f.seq > prev &&
+             !peer_acked_seq_.compare_exchange_weak(
+                 prev, f.seq, std::memory_order_release,
+                 std::memory_order_relaxed)) {
+      }
+      return Status::OK();
+    }
+    case kSNak: {
+      uint64_t armed = armed_send_seq_.load(std::memory_order_acquire);
+      if (f.seq != armed) return Status::OK();  // stale NAK
+      std::lock_guard<std::mutex> lk(ctrl_mu_);
+      int tries = ++retry_counts_[f.offset];
+      if (tries > max_retries_)
+        return Status::Unknown(
+            "striped: chunk at offset " + std::to_string(f.offset) +
+            " to rank " + std::to_string(peer_) +
+            " exceeded HOROVOD_LINK_RETRIES=" + std::to_string(max_retries_));
+      retx_.push_back(Retx{armed, f.offset, f.len,
+                           MonoUsStriped() +
+                               StripeRetryBackoffUs(tries - 1, seed)});
+      return Status::OK();
+    }
+    case kSStripeDown: {
+      // Peer's stripe k died; shut our end so OUR worker k self-reports
+      // (never mutate another worker's cursors from this thread).
+      size_t k = static_cast<size_t>(f.offset);
+      if (k < socks_.size() &&
+          stripes_[k]->alive.load(std::memory_order_acquire))
+        ::shutdown(socks_[k].fd(), SHUT_RDWR);
+      return Status::OK();
+    }
+    default:
+      return Status::Unknown("striped: unknown frame kind " +
+                             std::to_string(f.kind) + " from rank " +
+                             std::to_string(peer_) + " stripe " +
+                             std::to_string(s));
+  }
+}
+
+// A data chunk fully drained: verify, merge, ack.
+void StripedLink::FinishRxChunk(int s, RxCursor& rx) {
+  if (rx.stale) {
+    // Duplicate for an exchange we already completed: the ack that
+    // finished it may have been lost with a dead stripe — re-ack.
+    rx.stale = false;
+    std::lock_guard<std::mutex> lk(ctrl_mu_);
+    ctrl_bcast_.push_back(FrameHeader{rx.hdr.seq, 0, 0, kSAck, 0});
+    return;
+  }
+  if (checksum_) {
+    uint32_t got = crc32c::Value(rx.pay_dst, rx.hdr.len);
+    if (got != rx.hdr.crc) {
+      Bump(Backend::kStriped, LinkLevel(), Counter::kCrcErrors);
+      LOG(Warning) << "striped link to rank " << peer_ << " stripe " << s
+                   << ": CRC mismatch on chunk " << rx.hdr.offset << "+"
+                   << rx.hdr.len << " of seq " << rx.hdr.seq
+                   << "; requesting retransmit";
+      std::lock_guard<std::mutex> lk(ctrl_mu_);
+      ctrl_bcast_.push_back(FrameHeader{rx.hdr.seq, rx.hdr.len, rx.hdr.offset,
+                                        kSNak, 0});
+      return;  // not merged; the retransmit overwrites in place
+    }
+  }
+  bool completed = false;
+  {
+    std::lock_guard<std::mutex> lk(reasm_mu_);
+    // Dedup: a stripe-death re-enqueue resends chunks that may already
+    // have landed through the dead stripe's kernel buffer.
+    if (!reasm_.Covered(rx.hdr.offset)) {
+      reasm_.Add(rx.hdr.offset, rx.hdr.len);
+      rx_contig_.store(reasm_.contiguous(), std::memory_order_release);
+      if (reasm_.complete() &&
+          last_done_recv_seq_.load(std::memory_order_relaxed) < rx.hdr.seq) {
+        last_done_recv_seq_.store(rx.hdr.seq, std::memory_order_relaxed);
+        completed = true;
+      }
+      rx_total_.store(reasm_.total(), std::memory_order_release);
+    }
+  }
+  if (completed) {
+    std::lock_guard<std::mutex> lk(ctrl_mu_);
+    ctrl_bcast_.push_back(FrameHeader{rx.hdr.seq, 0, 0, kSAck, 0});
+  }
+}
+
+int64_t StripedLink::PumpOnce(int s, TxCursor& tx, RxCursor& rx,
+                              unsigned* seed) {
   Stripe& st = *stripes_[s];
   int fd = socks_[s].fd();
   int64_t moved = 0;
 
   // ---- TX ----
-  uint64_t want = armed_send_seq_.load(std::memory_order_acquire);
-  if (tx.seq != want &&
-      st.tx_done.load(std::memory_order_relaxed) < want) {
-    tx.seq = want;
-    tx.chunk = 0;
-    tx.hdr_off = 0;
-    tx.pay_off = 0;
-    st.tx_chunk_idx.store(0, std::memory_order_relaxed);
-  }
-  while (tx.seq == want &&
-         st.tx_done.load(std::memory_order_relaxed) < want) {
-    if (tx.chunk >= st.tx_chunks.size()) {
-      st.tx_done.store(want, std::memory_order_release);
-      tx.seq = 0;
-      break;
-    }
-    const stripe::Chunk& c = st.tx_chunks[tx.chunk];
-    if (tx.hdr_off < sizeof(FrameHeader)) {
-      if (tx.hdr_off == 0)
-        tx.hdr = FrameHeader{static_cast<uint32_t>(want), c.len, c.offset};
+  while (true) {
+    if (!tx.active && !NextTxFrame(s, tx, seed)) break;
+    bool tx_err = false;
+    while (tx.hdr_off < sizeof(FrameHeader)) {
       const char* p = reinterpret_cast<const char*>(&tx.hdr) + tx.hdr_off;
       ssize_t n = ::send(fd, p, sizeof(FrameHeader) - tx.hdr_off,
                          MSG_DONTWAIT | MSG_NOSIGNAL);
       if (n < 0) {
         if (errno == EAGAIN || errno == EWOULDBLOCK) break;
         if (errno == EINTR) continue;
-        Fail(Status::Unknown("striped send header to rank " +
-                             std::to_string(peer_) + " stripe " +
-                             std::to_string(s) + ": " + strerror(errno)));
+        MarkStripeDead(s, std::string("send header: ") + strerror(errno));
         return -1;
       }
       tx.hdr_off += static_cast<size_t>(n);
       moved += n;
-      if (tx.hdr_off < sizeof(FrameHeader)) break;
     }
-    {
-      const char* p = send_buf_ + c.offset + tx.pay_off;
-      ssize_t n = ::send(fd, p, c.len - tx.pay_off,
+    if (tx.hdr_off < sizeof(FrameHeader)) break;  // EAGAIN mid-header
+    while (tx.pay != nullptr && tx.pay_off < tx.hdr.len) {
+      ssize_t n = ::send(fd, tx.pay + tx.pay_off, tx.hdr.len - tx.pay_off,
                          MSG_DONTWAIT | MSG_NOSIGNAL);
       if (n < 0) {
         if (errno == EAGAIN || errno == EWOULDBLOCK) break;
         if (errno == EINTR) continue;
-        Fail(Status::Unknown("striped send payload to rank " +
-                             std::to_string(peer_) + " stripe " +
-                             std::to_string(s) + ": " + strerror(errno)));
-        return -1;
+        tx_err = true;
+        break;
       }
       tx.pay_off += static_cast<size_t>(n);
       moved += n;
-      if (tx.pay_off < c.len) break;
+    }
+    if (tx_err) {
+      MarkStripeDead(s, std::string("send payload: ") + strerror(errno));
+      return -1;
+    }
+    if (tx.pay != nullptr && tx.pay_off < tx.hdr.len) break;  // EAGAIN
+    // Frame complete.
+    if (tx.is_retx) Bump(Backend::kStriped, LinkLevel(), Counter::kRetransmits);
+    if (tx.pay != nullptr && !tx.is_retx && tx.hdr.kind == kSData) {
       ++tx.chunk;
       st.tx_chunk_idx.store(tx.chunk, std::memory_order_relaxed);
-      tx.hdr_off = 0;
-      tx.pay_off = 0;
     }
+    tx.active = false;
   }
 
   // ---- RX ----
@@ -314,67 +645,98 @@ int64_t StripedLink::PumpOnce(int s, TxCursor& tx, RxCursor& rx) {
       ssize_t n = ::recv(fd, p, sizeof(FrameHeader) - rx.hdr_off,
                          MSG_DONTWAIT);
       if (n == 0) {
-        Fail(Status::Unknown("striped: rank " + std::to_string(peer_) +
-                             " closed stripe " + std::to_string(s)));
+        MarkStripeDead(s, "peer closed stripe");
         return -1;
       }
       if (n < 0) {
         if (errno == EAGAIN || errno == EWOULDBLOCK) break;
         if (errno == EINTR) continue;
-        Fail(Status::Unknown("striped recv header from rank " +
-                             std::to_string(peer_) + " stripe " +
-                             std::to_string(s) + ": " + strerror(errno)));
+        MarkStripeDead(s, std::string("recv header: ") + strerror(errno));
         return -1;
       }
       rx.hdr_off += static_cast<size_t>(n);
       moved += n;
       if (rx.hdr_off < sizeof(FrameHeader)) break;
+      if (rx.hdr.kind != kSData) {
+        rx.hdr_off = 0;
+        Status st2 = HandleCtrl(s, rx.hdr, seed);
+        if (!st2.ok()) {
+          Fail(st2);
+          return -1;
+        }
+        continue;
+      }
+      // Data frame: route the payload before draining it.
+      uint64_t armed = armed_recv_seq_.load(std::memory_order_acquire);
+      if (rx.hdr.seq > armed) {
+        // Frame for an exchange the receiver has not armed yet: park.
+        // Per-stripe TCP ordering means everything for the armed seq on
+        // this stripe already arrived, so parking cannot deadlock it.
+        rx.hdr_off = sizeof(FrameHeader);  // keep the parsed header
+        st.rx_gated.store(true, std::memory_order_relaxed);
+        break;
+      }
+      st.rx_gated.store(false, std::memory_order_relaxed);
+      rx.stale = false;
+      if (rx.hdr.seq < armed) {
+        // Retransmit for a completed exchange: drain to scratch, re-ack.
+        if (rx.scratch.size() < rx.hdr.len) rx.scratch.resize(rx.hdr.len);
+        rx.pay_dst = rx.scratch.data();
+        rx.stale = true;
+      } else if (rx.hdr.offset + rx.hdr.len > recv_expected_) {
+        Fail(Status::Unknown(
+            "striped: protocol violation from rank " + std::to_string(peer_) +
+            " stripe " + std::to_string(s) + ": frame offset " +
+            std::to_string(rx.hdr.offset) + "+" + std::to_string(rx.hdr.len) +
+            " expected " + std::to_string(recv_expected_)));
+        return -1;
+      } else {
+        rx.pay_dst = recv_buf_ + rx.hdr.offset;
+      }
+      rx.pay_off = 0;
     }
-    uint64_t armed = armed_recv_seq_.load(std::memory_order_acquire);
-    if (rx.hdr.seq > armed) {
-      // Frame for an exchange the receiver has not armed yet: park.
-      // Per-stripe TCP ordering means everything for the armed seq on
-      // this stripe already arrived, so parking cannot deadlock it.
-      st.rx_gated.store(true, std::memory_order_relaxed);
-      break;
+    // Re-check the gate on re-entry with a parked header.
+    if (st.rx_gated.load(std::memory_order_relaxed)) {
+      uint64_t armed = armed_recv_seq_.load(std::memory_order_acquire);
+      if (rx.hdr.seq > armed) break;
+      st.rx_gated.store(false, std::memory_order_relaxed);
+      rx.stale = rx.hdr.seq < armed;
+      if (rx.stale) {
+        if (rx.scratch.size() < rx.hdr.len) rx.scratch.resize(rx.hdr.len);
+        rx.pay_dst = rx.scratch.data();
+      } else if (rx.hdr.offset + rx.hdr.len > recv_expected_) {
+        Fail(Status::Unknown("striped: parked frame exceeds armed recv"));
+        return -1;
+      } else {
+        rx.pay_dst = recv_buf_ + rx.hdr.offset;
+      }
+      rx.pay_off = 0;
     }
-    st.rx_gated.store(false, std::memory_order_relaxed);
-    if (rx.hdr.seq < armed ||
-        rx.hdr.offset + rx.hdr.len > recv_expected_) {
-      Fail(Status::Unknown(
-          "striped: protocol violation from rank " + std::to_string(peer_) +
-          " stripe " + std::to_string(s) + ": frame seq " +
-          std::to_string(rx.hdr.seq) + " armed " + std::to_string(armed) +
-          " offset " + std::to_string(rx.hdr.offset) + "+" +
-          std::to_string(rx.hdr.len) + " expected " +
-          std::to_string(recv_expected_)));
-      return -1;
+    if (rx.pay_off >= rx.hdr.len) {
+      // Degenerate zero-length data frame (never planned, but cheap to
+      // tolerate): complete it without touching the socket.
+      FinishRxChunk(s, rx);
+      rx.hdr_off = 0;
+      rx.pay_off = 0;
+      continue;
     }
     {
-      char* p = recv_buf_ + rx.hdr.offset + rx.pay_off;
-      ssize_t n = ::recv(fd, p, rx.hdr.len - rx.pay_off, MSG_DONTWAIT);
+      ssize_t n = ::recv(fd, rx.pay_dst + rx.pay_off,
+                         rx.hdr.len - rx.pay_off, MSG_DONTWAIT);
       if (n == 0) {
-        Fail(Status::Unknown("striped: rank " + std::to_string(peer_) +
-                             " closed stripe " + std::to_string(s)));
+        MarkStripeDead(s, "peer closed stripe mid-frame");
         return -1;
       }
       if (n < 0) {
         if (errno == EAGAIN || errno == EWOULDBLOCK) break;
         if (errno == EINTR) continue;
-        Fail(Status::Unknown("striped recv payload from rank " +
-                             std::to_string(peer_) + " stripe " +
-                             std::to_string(s) + ": " + strerror(errno)));
+        MarkStripeDead(s, std::string("recv payload: ") + strerror(errno));
         return -1;
       }
       rx.pay_off += static_cast<size_t>(n);
       moved += n;
       if (rx.pay_off < rx.hdr.len) break;
-      {
-        std::lock_guard<std::mutex> lk(reasm_mu_);
-        reasm_.Add(rx.hdr.offset, rx.hdr.len);
-        rx_contig_.store(reasm_.contiguous(), std::memory_order_release);
-      }
-      rx_total_.fetch_add(rx.hdr.len, std::memory_order_release);
+      FinishRxChunk(s, rx);
       rx.hdr_off = 0;
       rx.pay_off = 0;
     }
@@ -387,16 +749,16 @@ void StripedLink::WorkerLoop(int s) {
   Stripe& st = *stripes_[s];
   TxCursor tx;
   RxCursor rx;
+  unsigned seed = static_cast<unsigned>(0x9E3779B9u ^ (peer_ << 8) ^ s);
   int idle_rounds = 0;
   while (!stop_.load(std::memory_order_acquire)) {
     if (failed_.load(std::memory_order_acquire)) return;
+    if (!st.alive.load(std::memory_order_acquire)) return;
     int64_t t0 = PumpClockUs();
-    int64_t moved = PumpOnce(s, tx, rx);
+    int64_t moved = PumpOnce(s, tx, rx, &seed);
     if (moved < 0) return;
     if (moved > 0) {
-      AccountAt(Backend::kStriped,
-                static_cast<Level>(link_level_.load(std::memory_order_relaxed)),
-                moved, PumpClockUs() - t0);
+      AccountAt(Backend::kStriped, LinkLevel(), moved, PumpClockUs() - t0);
       idle_rounds = 0;
       continue;
     }
@@ -405,6 +767,10 @@ void StripedLink::WorkerLoop(int s) {
     bool tx_pending =
         st.tx_done.load(std::memory_order_relaxed) <
         armed_send_seq_.load(std::memory_order_relaxed);
+    {
+      std::lock_guard<std::mutex> lk(ctrl_mu_);
+      if (!ctrl_bcast_.empty() || !retx_.empty()) tx_pending = true;
+    }
     bool gated = st.rx_gated.load(std::memory_order_relaxed);
     if (gated && !tx_pending) {
       // Data is readable but parked behind the seq gate: polling POLLIN

@@ -59,6 +59,27 @@ const char* LevelName(Level l) {
   }
 }
 
+const char* HealthName(LinkHealth h) {
+  switch (h) {
+    case LinkHealth::kDegraded: return "degraded";
+    case LinkHealth::kFailed: return "failed";
+    default: return "ok";
+  }
+}
+
+bool ChecksumEnabled() {
+  static const bool enabled = [] {
+    std::string v = EnvStr("HOROVOD_TRANSPORT_CHECKSUM", "auto");
+    if (v == "off" || v == "0" || v == "false") return false;
+    if (v != "auto" && v != "on" && v != "1" && v != "true") {
+      LOG(Warning) << "HOROVOD_TRANSPORT_CHECKSUM=" << v
+                   << " not recognized (auto|on|off); using auto (on)";
+    }
+    return true;  // auto == on: CRC32C is hardware-accelerated everywhere
+  }();
+  return enabled;
+}
+
 Backend Enabled(Mode mode, bool same_host, int stripes) {
   switch (mode) {
     case Mode::kSocket:
@@ -102,6 +123,11 @@ void AccountAt(Backend b, Level l, int64_t bytes, int64_t micros) {
   row[static_cast<int>(Counter::kMicros)].fetch_add(
       micros, std::memory_order_relaxed);
   row[static_cast<int>(Counter::kOps)].fetch_add(1, std::memory_order_relaxed);
+}
+
+void Bump(Backend b, Level l, Counter c, int64_t n) {
+  g_counters[static_cast<int>(b)][static_cast<int>(l)][static_cast<int>(c)]
+      .fetch_add(n, std::memory_order_relaxed);
 }
 
 int64_t CounterValue(int backend, int level, int counter) {
@@ -255,8 +281,30 @@ std::string DescribeAll() {
   for (Link* l : g_links) {
     out += "\n  [";
     out += BackendName(l->backend());
+    out += " ";
+    out += HealthName(l->Health());
     out += "] ";
     out += l->Describe();
+  }
+  // Global resilience totals so a flapping link is diagnosable from the
+  // stall report alone (summed over backend x level).
+  int64_t retx = 0, crc = 0, fo = 0, deg = 0;
+  for (int b = 0; b < kNumBackends; ++b) {
+    for (int lv = 0; lv < kNumLevels; ++lv) {
+      retx += CounterValue(b, lv, static_cast<int>(Counter::kRetransmits));
+      crc += CounterValue(b, lv, static_cast<int>(Counter::kCrcErrors));
+      fo += CounterValue(b, lv, static_cast<int>(Counter::kFailovers));
+      deg += CounterValue(b, lv, static_cast<int>(Counter::kDegraded));
+    }
+  }
+  if (retx + crc + fo + deg > 0) {
+    char buf[160];
+    std::snprintf(buf, sizeof(buf),
+                  "\n  resilience: retransmits=%lld crc_errors=%lld "
+                  "failovers=%lld degraded_events=%lld",
+                  static_cast<long long>(retx), static_cast<long long>(crc),
+                  static_cast<long long>(fo), static_cast<long long>(deg));
+    out += buf;
   }
   return out;
 }

@@ -1,0 +1,348 @@
+// Self-healing transport oracles (link_heal.h, striped_transport.cc):
+//   1. CRC32C reference vectors + hardware/soft kernel agreement
+//   2. engine frame round-trip over a socketpair, including a chaos
+//      frame_corrupt -> NAK -> retransmit cycle that must still deliver
+//      bitwise-identical bytes
+//   3. striped stripe-death mid-exchange: chunk re-enqueue onto the
+//      surviving stripe, receiver dedup, renegotiated follow-up exchange
+//   4. HealingLink shm-stall detection -> mid-exchange degrade to the
+//      mesh socket, then probe-rendezvous re-promotion to the preferred
+//      backend
+// Everything runs in-process over socketpairs; the chaos rules come
+// through the same HOROVOD_FAULT_SPEC grammar the Python suites use.
+#include <stdlib.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "crc32c.h"
+#include "link_heal.h"
+#include "socket.h"
+#include "transport.h"
+
+using hvd::Status;
+using hvd::TcpSocket;
+using namespace hvd::transport;
+
+namespace {
+
+int64_t CounterSum(Counter c) {
+  int64_t total = 0;
+  for (int b = 0; b < kNumBackends; ++b)
+    for (int lv = 0; lv < kNumLevels; ++lv)
+      total += CounterValue(b, lv, static_cast<int>(c));
+  return total;
+}
+
+void SetSpec(const char* spec) {
+  if (spec)
+    setenv("HOROVOD_FAULT_SPEC", spec, 1);
+  else
+    unsetenv("HOROVOD_FAULT_SPEC");
+  chaos::ReloadForTest();
+}
+
+std::vector<char> Pattern(size_t n, uint32_t seedv) {
+  std::vector<char> out(n);
+  uint32_t x = seedv;
+  for (size_t i = 0; i < n; ++i) {
+    x = x * 1664525u + 1013904223u;
+    out[i] = static_cast<char>(x >> 24);
+  }
+  return out;
+}
+
+// Pump two links until the armed exchange completes (or a deadline).
+void PumpPair(Link* a, Link* b, bool (*done)(Link*, Link*), int secs = 30) {
+  for (int i = 0; i < secs * 10000; ++i) {
+    Status sa = a->Progress();
+    Status sb = b->Progress();
+    if (!sa.ok()) {
+      std::fprintf(stderr, "link a failed: %s\n", sa.reason.c_str());
+      assert(false);
+    }
+    if (!sb.ok()) {
+      std::fprintf(stderr, "link b failed: %s\n", sb.reason.c_str());
+      assert(false);
+    }
+    if (done(a, b)) return;
+    struct timespec ts {0, 100 * 1000};
+    nanosleep(&ts, nullptr);
+  }
+  std::fprintf(stderr, "pump deadline; a: %s\nb: %s\n", a->Describe().c_str(),
+               b->Describe().c_str());
+  assert(false && "exchange did not complete");
+}
+
+bool OneWayDone(Link* a, Link* b) { return a->SendDone() && b->RecvDone(); }
+
+// --------------------------------------------------------------------------
+// 1. CRC32C.
+// --------------------------------------------------------------------------
+
+void TestCrc32c() {
+  // iSCSI reference vector (RFC 3720 B.4).
+  assert(hvd::crc32c::Value("123456789", 9) == 0xE3069283u);
+  // Empty input.
+  assert(hvd::crc32c::Value("", 0) == 0x00000000u);
+  // Hardware and table kernels must agree on awkward lengths/offsets.
+  auto data = Pattern(4096 + 7, 42);
+  for (size_t len : {0ul, 1ul, 7ul, 8ul, 9ul, 64ul, 1000ul, data.size()}) {
+    uint32_t soft = hvd::crc32c::Finish(
+        hvd::crc32c::detail::Soft(hvd::crc32c::Init(), data.data(), len));
+    assert(hvd::crc32c::Value(data.data(), len) == soft);
+  }
+  // Streaming == one-shot across arbitrary split points.
+  uint32_t st = hvd::crc32c::Init();
+  st = hvd::crc32c::Update(st, data.data(), 13);
+  st = hvd::crc32c::Update(st, data.data() + 13, data.size() - 13);
+  assert(hvd::crc32c::Finish(st) ==
+         hvd::crc32c::Value(data.data(), data.size()));
+  std::printf("crc32c: reference vector + kernel agreement OK\n");
+}
+
+// --------------------------------------------------------------------------
+// 2. Engine framing + NAK/retransmit.
+// --------------------------------------------------------------------------
+
+struct EnginePair {
+  TcpSocket sa, sb;
+  std::unique_ptr<Link> a, b;
+
+  EnginePair() {
+    int sv[2];
+    assert(::socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
+    sa = TcpSocket(sv[0]);
+    sb = TcpSocket(sv[1]);
+    a = MakeHealingLink(0, 1, Backend::kSocket, nullptr, &sa, nullptr);
+    b = MakeHealingLink(1, 0, Backend::kSocket, nullptr, &sb, nullptr);
+  }
+};
+
+void TestEngineRoundTrip() {
+  SetSpec(nullptr);
+  EnginePair p;
+  // Multi-granule payload (engine granule is 1 MB).
+  auto payload = Pattern((1 << 21) + 12345, 7);
+  std::vector<char> out(payload.size(), 0);
+  p.a->StartSend(payload.data(), payload.size());
+  p.b->StartRecv(out.data(), out.size());
+  PumpPair(p.a.get(), p.b.get(), OneWayDone);
+  assert(std::memcmp(payload.data(), out.data(), payload.size()) == 0);
+  assert(p.b->RecvBytes() == payload.size());
+
+  // Reverse direction over the same pair (per-direction seq counters).
+  auto back = Pattern(100000, 9);
+  std::vector<char> out2(back.size(), 0);
+  p.b->StartSend(back.data(), back.size());
+  p.a->StartRecv(out2.data(), out2.size());
+  PumpPair(p.b.get(), p.a.get(), OneWayDone);
+  assert(std::memcmp(back.data(), out2.data(), back.size()) == 0);
+
+  // Zero-byte exchange completes immediately.
+  p.a->StartSend(payload.data(), 0);
+  p.b->StartRecv(out.data(), 0);
+  assert(p.a->SendDone() && p.b->RecvDone());
+  assert(p.a->Health() == LinkHealth::kOk);
+  std::printf("engine: framed round-trip (fwd/rev/zero) OK\n");
+}
+
+void TestEngineCorruptRetransmit() {
+  // Corrupt the CRC of two outgoing frames: the receiver must NAK and
+  // the retransmits must deliver bitwise-identical data.
+  int64_t retx0 = CounterSum(Counter::kRetransmits);
+  int64_t crc0 = CounterSum(Counter::kCrcErrors);
+  SetSpec("rank=*,site=transport,kind=frame_corrupt:2");
+  EnginePair p;
+  auto payload = Pattern(3 << 20, 11);
+  std::vector<char> out(payload.size(), 0);
+  p.a->StartSend(payload.data(), payload.size());
+  p.b->StartRecv(out.data(), out.size());
+  PumpPair(p.a.get(), p.b.get(), OneWayDone);
+  assert(std::memcmp(payload.data(), out.data(), payload.size()) == 0);
+  assert(CounterSum(Counter::kCrcErrors) - crc0 >= 2);
+  assert(CounterSum(Counter::kRetransmits) - retx0 >= 2);
+  SetSpec(nullptr);
+  std::printf("engine: corrupt-frame NAK -> retransmit, bitwise OK\n");
+}
+
+// --------------------------------------------------------------------------
+// 3. Striped stripe death.
+// --------------------------------------------------------------------------
+
+void TestStripeDeathFailover() {
+  int64_t fo0 = CounterSum(Counter::kFailovers);
+  // Kill one stripe at the 3rd data frame it deals (after the exchange
+  // is well underway on both stripes).
+  SetSpec("rank=*,site=transport,after=2,kind=stripe_kill:1");
+  int s0[2], s1[2];
+  assert(::socketpair(AF_UNIX, SOCK_STREAM, 0, s0) == 0);
+  assert(::socketpair(AF_UNIX, SOCK_STREAM, 0, s1) == 0);
+  std::vector<TcpSocket> socks_a, socks_b;
+  socks_a.emplace_back(s0[0]);
+  socks_a.emplace_back(s1[0]);
+  socks_b.emplace_back(s0[1]);
+  socks_b.emplace_back(s1[1]);
+  auto a = MakeStripedLink(0, 1, std::move(socks_a));
+  auto b = MakeStripedLink(1, 0, std::move(socks_b));
+  assert(a && b);
+
+  auto payload = Pattern(4 << 20, 13);  // 4 chunks of 1 MB over 2 stripes
+  std::vector<char> out(payload.size(), 0);
+  a->StartSend(payload.data(), payload.size());
+  b->StartRecv(out.data(), out.size());
+  PumpPair(a.get(), b.get(), OneWayDone);
+  assert(std::memcmp(payload.data(), out.data(), payload.size()) == 0);
+  assert(CounterSum(Counter::kFailovers) - fo0 >= 1);
+  assert(a->Health() == LinkHealth::kDegraded);
+
+  // The link keeps working on the renegotiated (single-stripe) config,
+  // in both directions.
+  SetSpec(nullptr);
+  auto back = Pattern(1 << 20, 17);
+  std::vector<char> out2(back.size(), 0);
+  b->StartSend(back.data(), back.size());
+  a->StartRecv(out2.data(), out2.size());
+  PumpPair(b.get(), a.get(), OneWayDone);
+  assert(std::memcmp(back.data(), out2.data(), back.size()) == 0);
+  a->Shutdown();
+  b->Shutdown();
+  std::printf("striped: stripe death -> re-enqueue + renegotiated OK\n");
+}
+
+// --------------------------------------------------------------------------
+// 4. Shm-stall degrade + probe re-promotion.
+// --------------------------------------------------------------------------
+
+// In-process stand-in for an shm ring pair: two endpoints over mutexed
+// byte queues, with a shared freeze switch standing in for a stalled /
+// dead peer process.
+struct FakePipe {
+  std::mutex mu;
+  std::deque<char> ab, ba;
+  std::atomic<bool> frozen{false};
+};
+
+class PipeLink : public Link {
+ public:
+  PipeLink(int peer, std::shared_ptr<FakePipe> pipe, bool a_side)
+      : peer_(peer), pipe_(std::move(pipe)), a_side_(a_side) {}
+
+  Backend backend() const override { return Backend::kShm; }
+  int peer() const override { return peer_; }
+  void StartSend(const void* buf, size_t n) override {
+    sbuf_ = static_cast<const char*>(buf);
+    sn_ = n;
+    soff_ = 0;
+  }
+  void StartRecv(void* buf, size_t n) override {
+    rbuf_ = static_cast<char*>(buf);
+    rn_ = n;
+    roff_ = 0;
+  }
+  Status Progress() override {
+    if (pipe_->frozen.load(std::memory_order_relaxed))
+      return Status::OK();  // stalled peer: alive but silent
+    std::lock_guard<std::mutex> lk(pipe_->mu);
+    auto& out = a_side_ ? pipe_->ab : pipe_->ba;
+    auto& in = a_side_ ? pipe_->ba : pipe_->ab;
+    while (soff_ < sn_) out.push_back(sbuf_[soff_++]);
+    while (roff_ < rn_ && !in.empty()) {
+      rbuf_[roff_++] = in.front();
+      in.pop_front();
+    }
+    return Status::OK();
+  }
+  bool SendDone() const override { return soff_ >= sn_; }
+  bool RecvDone() const override { return roff_ >= rn_; }
+  size_t RecvBytes() const override { return roff_; }
+  std::string Describe() const override { return "fake shm pipe"; }
+
+ private:
+  int peer_;
+  std::shared_ptr<FakePipe> pipe_;
+  bool a_side_;
+  const char* sbuf_ = nullptr;
+  size_t sn_ = 0, soff_ = 0;
+  char* rbuf_ = nullptr;
+  size_t rn_ = 0, roff_ = 0;
+};
+
+void TestShmStallDegradeAndReprobe() {
+  SetSpec(nullptr);
+  setenv("HOROVOD_SHM_STALL_MS", "50", 1);
+  setenv("HOROVOD_LINK_PROBE_SECONDS", "0.01", 1);
+  int64_t fo0 = CounterSum(Counter::kFailovers);
+  int sv[2];
+  assert(::socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
+  TcpSocket mesh_a(sv[0]), mesh_b(sv[1]);
+  auto pipe1 = std::make_shared<FakePipe>();
+  auto pipe2 = std::make_shared<FakePipe>();
+  auto a = MakeHealingLink(
+      0, 1, Backend::kShm, std::make_unique<PipeLink>(1, pipe1, true),
+      &mesh_a, [&]() { return std::make_unique<PipeLink>(1, pipe2, true); });
+  auto b = MakeHealingLink(
+      1, 0, Backend::kShm, std::make_unique<PipeLink>(0, pipe1, false),
+      &mesh_b, [&]() { return std::make_unique<PipeLink>(0, pipe2, false); });
+
+  // Exchange 1: healthy preferred path.
+  auto p1 = Pattern(1 << 20, 19);
+  std::vector<char> o1(p1.size(), 0);
+  a->StartSend(p1.data(), p1.size());
+  b->StartRecv(o1.data(), o1.size());
+  PumpPair(a.get(), b.get(), OneWayDone);
+  assert(std::memcmp(p1.data(), o1.data(), p1.size()) == 0);
+  assert(a->Health() == LinkHealth::kOk);
+
+  // Exchange 2: ring frozen mid-job -> stall deadline -> degrade to the
+  // mesh socket; the collective must still finish, bitwise intact.
+  pipe1->frozen.store(true, std::memory_order_relaxed);
+  auto p2 = Pattern(1 << 20, 23);
+  std::vector<char> o2(p2.size(), 0);
+  a->StartSend(p2.data(), p2.size());
+  b->StartRecv(o2.data(), o2.size());
+  PumpPair(a.get(), b.get(), OneWayDone);
+  assert(std::memcmp(p2.data(), o2.data(), p2.size()) == 0);
+  assert(a->Health() == LinkHealth::kDegraded);
+  assert(b->Health() == LinkHealth::kDegraded);
+  assert(CounterSum(Counter::kFailovers) - fo0 >= 1);
+
+  // Exchanges 3..5: past the probe interval the lower rank schedules a
+  // rebuild rendezvous; both sides re-promote onto the fresh pipe.
+  struct timespec ts {0, 30 * 1000 * 1000};
+  nanosleep(&ts, nullptr);  // exceed HOROVOD_LINK_PROBE_SECONDS
+  for (int i = 0; i < 3; ++i) {
+    auto px = Pattern(200000, 29 + i);
+    std::vector<char> ox(px.size(), 0);
+    a->StartSend(px.data(), px.size());
+    b->StartRecv(ox.data(), ox.size());
+    PumpPair(a.get(), b.get(), OneWayDone);
+    assert(std::memcmp(px.data(), ox.data(), px.size()) == 0);
+  }
+  assert(a->Health() == LinkHealth::kOk);
+  assert(b->Health() == LinkHealth::kOk);
+  unsetenv("HOROVOD_SHM_STALL_MS");
+  unsetenv("HOROVOD_LINK_PROBE_SECONDS");
+  std::printf("healing: shm stall -> degrade -> probe re-promotion OK\n");
+}
+
+}  // namespace
+
+int main() {
+  TestCrc32c();
+  TestEngineRoundTrip();
+  TestEngineCorruptRetransmit();
+  TestStripeDeathFailover();
+  TestShmStallDegradeAndReprobe();
+  std::printf("test_link_failover: all OK\n");
+  return 0;
+}

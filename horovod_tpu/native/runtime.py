@@ -374,11 +374,14 @@ class Runtime:
 
     def transport_counters(self) -> dict:
         """The native transport counter matrix as
-        ``{(backend, level): {"bytes", "seconds", "ops"}}``, omitting
-        all-zero cells.  Backends: socket/shm/striped; levels mirror the
+        ``{(backend, level): {"bytes", "seconds", "ops", "retransmits",
+        "crc_errors", "failovers", "degraded"}}``, omitting all-zero
+        cells.  Backends: socket/shm/striped; levels mirror the
         hierarchical routing (flat/local/cross).  Counters are monotonic
-        since process start; the np=2 CI gate asserts engagement from
-        them (shm bytes > 0, socket bytes == 0 intra-host)."""
+        since process start (``degraded`` is a gauge of currently-
+        degraded links); the np=2 CI gates assert engagement and
+        self-healing from them (shm bytes > 0 intra-host; failovers /
+        retransmits nonzero under transport chaos)."""
         fn = self._transport_counter_fn
         if fn is None or self._lib is None:
             return {}
@@ -388,9 +391,15 @@ class Runtime:
                 by = int(fn(b, lv, 0))
                 us = int(fn(b, lv, 1))
                 ops = int(fn(b, lv, 2))
-                if by or us or ops:
+                retx = max(int(fn(b, lv, 3)), 0)
+                crc = max(int(fn(b, lv, 4)), 0)
+                fo = max(int(fn(b, lv, 5)), 0)
+                deg = max(int(fn(b, lv, 6)), 0)
+                if by or us or ops or retx or crc or fo or deg:
                     out[(backend, level)] = {
-                        "bytes": by, "seconds": us / 1e6, "ops": ops}
+                        "bytes": by, "seconds": us / 1e6, "ops": ops,
+                        "retransmits": retx, "crc_errors": crc,
+                        "failovers": fo, "degraded": deg}
         return out
 
     def transport_describe(self) -> str:
@@ -713,6 +722,28 @@ class Runtime:
                      "Transport pump rounds that moved bytes (socket "
                      "drains, shm slot pushes, stripe pumps)",
                      2, 1.0, b, lv, backend, level)
+                bump("hvd_transport_retransmits_total",
+                     "Wire frames resent after a NAK (self-healing "
+                     "transport retransmit ladder)",
+                     3, 1.0, b, lv, backend, level)
+                bump("hvd_transport_crc_errors_total",
+                     "Frames or shm slots rejected by the CRC32C "
+                     "integrity check", 4, 1.0, b, lv, backend, level)
+                bump("hvd_transport_failovers_total",
+                     "Link failovers: stripe deaths absorbed plus "
+                     "backend degrades to the mesh socket",
+                     5, 1.0, b, lv, backend, level)
+                # Currently-degraded links is a gauge (re-promotion
+                # takes links back out), so publish the level, not a
+                # delta.
+                deg = int(fn(b, lv, 6))
+                if deg > 0 or (b, lv, 6) in self._transport_published:
+                    self._transport_published[(b, lv, 6)] = deg
+                    telemetry.gauge(
+                        "hvd_transport_degraded_links_total",
+                        "Links currently degraded off their preferred "
+                        "backend (gauge; falls on re-promotion)",
+                        backend=backend, level=level).set(max(deg, 0))
 
     # -- collectives -------------------------------------------------------
 

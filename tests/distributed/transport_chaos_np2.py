@@ -1,0 +1,101 @@
+"""Transport self-healing chaos gate (run: hvdrun -np 2, see
+ci/run_tests.sh "transport chaos gate").
+
+Two runs over the striped backend, selected by ``TRANSPORT_CHAOS_MODE``:
+
+* ``clean``: no fault spec — the baseline.  Each rank dumps its
+  deterministic eager-allreduce outputs to
+  ``$TRANSPORT_GATE_DIR/chaos_clean_r<rank>.npy``.
+* ``chaos``: the CI lane arms ``HOROVOD_FAULT_SPEC`` with
+  ``site=transport`` rules (a ``stripe_kill`` mid-exchange plus
+  ``frame_corrupt`` firings) and the same workload must finish
+  *in-process* — no elastic restart — with outputs dumped to
+  ``chaos_<rank>.npy``.  The lane byte-compares the dumps against the
+  clean run: self-healing must never change the math, not even a low
+  mantissa bit.
+
+The chaos run also proves the healing actually engaged rather than the
+faults silently missing: the merged ``hvd_transport_failovers_total``
+across ranks must be >= 1 (a stripe died and the link renegotiated) and
+merged retransmits >= 1 (a corrupted frame was NAK'd and resent).
+Counters come from ``Runtime.transport_counters()`` — the same source
+feeding the ``hvd_transport_*`` telemetry series.
+"""
+import json
+import os
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import basics
+
+hvd.init()
+rank, size = hvd.rank(), hvd.size()
+assert size == 2, f"this workload expects -np 2, got size={size}"
+
+mode = os.environ["TRANSPORT_CHAOS_MODE"]
+assert mode in ("clean", "chaos"), mode
+out_dir = os.environ["TRANSPORT_GATE_DIR"]
+os.makedirs(out_dir, exist_ok=True)
+
+# Proof of zero elastic restarts: chaos recovery happens inside the
+# process, so this run must still be attempt 0 when it finishes.
+assert int(os.environ.get("HOROVOD_RESTART_ATTEMPT", "0") or 0) == 0
+
+# Multi-chunk payloads (the striped granule is 1 MiB) so a stripe death
+# lands mid-exchange with chunks still in flight, plus one odd length
+# against alignment assumptions.  Non-integer float32 values make the
+# bitwise clean-vs-chaos comparison meaningful.
+rng = np.random.RandomState(4321 + rank)
+outputs = []
+for step, n in enumerate([1 << 20, 1 << 22, 1000003]):
+    x = rng.standard_normal(n).astype(np.float32)
+    out = hvd.allreduce(x, average=False, name=f"chaos.step{step}")
+    outputs.append(np.asarray(out))
+# Follow-up ops prove the renegotiated link keeps working after the
+# fault episode settles (and give retransmit backoffs time to drain).
+for s in range(4):
+    out = hvd.allreduce(rng.standard_normal(1 << 18).astype(np.float32),
+                        average=False, name=f"chaos.post{s}")
+    outputs.append(np.asarray(out))
+
+blob = np.concatenate(outputs)
+tag = "chaos_clean" if mode == "clean" else "chaos"
+np.save(os.path.join(out_dir, f"{tag}_r{rank}.npy"), blob)
+
+rt = basics.runtime()
+counters = rt.transport_counters()
+totals = {"retransmits": 0, "crc_errors": 0, "failovers": 0}
+for _key, kinds in counters.items():
+    for k in totals:
+        totals[k] += kinds.get(k, 0)
+
+if mode == "clean":
+    assert totals["failovers"] == 0, \
+        f"rank {rank}: clean run saw failovers: {counters}"
+else:
+    # Fault firing is rank-local (the spec pins ranks); merge the two
+    # ranks' counter views through the shared gate dir before asserting.
+    with open(os.path.join(out_dir, f"chaos_counters_r{rank}.json"),
+              "w") as f:
+        json.dump(totals, f)
+    hvd.barrier(name="chaos.counters")
+    merged = {k: 0 for k in totals}
+    for r in range(size):
+        with open(os.path.join(out_dir,
+                               f"chaos_counters_r{r}.json")) as f:
+            for k, v in json.load(f).items():
+                merged[k] += v
+    assert merged["failovers"] >= 1, \
+        f"stripe_kill never drove a failover: {merged}"
+    assert merged["retransmits"] >= 1, \
+        f"frame_corrupt never drove a retransmit: {merged}"
+    assert merged["crc_errors"] >= 1, \
+        f"corrupted frames were never detected: {merged}"
+    # The per-link health state must name the casualty.
+    desc = rt.transport_describe()
+    assert desc, "transport_describe() returned nothing"
+
+print(f"TRANSPORT_CHAOS_OK rank={rank} mode={mode} "
+      f"retx={totals['retransmits']} crc={totals['crc_errors']} "
+      f"failovers={totals['failovers']}", flush=True)
