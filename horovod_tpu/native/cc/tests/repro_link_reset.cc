@@ -1,0 +1,116 @@
+// Repro: chaos link_reset firing inside HealingLink::StartSend/StartRecv
+// double-arms the frame engine (Degrade arms it, then the fall-through
+// arms it again), desyncing per-direction seq counters.
+#include <stdlib.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <vector>
+
+#include "link_heal.h"
+#include "socket.h"
+#include "transport.h"
+
+using hvd::Status;
+using hvd::TcpSocket;
+using namespace hvd::transport;
+
+namespace {
+
+struct FakePipe {
+  std::mutex mu;
+  std::deque<char> ab, ba;
+};
+
+class PipeLink : public Link {
+ public:
+  PipeLink(int peer, std::shared_ptr<FakePipe> pipe, bool a_side)
+      : peer_(peer), pipe_(std::move(pipe)), a_side_(a_side) {}
+  Backend backend() const override { return Backend::kShm; }
+  int peer() const override { return peer_; }
+  void StartSend(const void* buf, size_t n) override {
+    sbuf_ = static_cast<const char*>(buf); sn_ = n; soff_ = 0;
+  }
+  void StartRecv(void* buf, size_t n) override {
+    rbuf_ = static_cast<char*>(buf); rn_ = n; roff_ = 0;
+  }
+  Status Progress() override {
+    std::lock_guard<std::mutex> lk(pipe_->mu);
+    auto& out = a_side_ ? pipe_->ab : pipe_->ba;
+    auto& in = a_side_ ? pipe_->ba : pipe_->ab;
+    while (soff_ < sn_) out.push_back(sbuf_[soff_++]);
+    while (roff_ < rn_ && !in.empty()) { rbuf_[roff_++] = in.front(); in.pop_front(); }
+    return Status::OK();
+  }
+  bool SendDone() const override { return soff_ >= sn_; }
+  bool RecvDone() const override { return roff_ >= rn_; }
+  size_t RecvBytes() const override { return roff_; }
+  std::string Describe() const override { return "fake pipe"; }
+ private:
+  int peer_;
+  std::shared_ptr<FakePipe> pipe_;
+  bool a_side_;
+  const char* sbuf_ = nullptr;
+  size_t sn_ = 0, soff_ = 0;
+  char* rbuf_ = nullptr;
+  size_t rn_ = 0, roff_ = 0;
+};
+
+std::vector<char> Pattern(size_t n, uint32_t seedv) {
+  std::vector<char> out(n);
+  uint32_t x = seedv;
+  for (size_t i = 0; i < n; ++i) { x = x * 1664525u + 1013904223u; out[i] = (char)(x >> 24); }
+  return out;
+}
+
+}  // namespace
+
+int main() {
+  // Only rank 0's link fires link_reset (the real chaos specs pin ranks).
+  setenv("HOROVOD_RANK", "0", 1);
+  setenv("HOROVOD_FAULT_SPEC", "rank=0,site=transport,kind=link_reset:1", 1);
+  chaos::ReloadForTest();
+
+  int sv[2];
+  assert(::socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
+  TcpSocket mesh_a(sv[0]), mesh_b(sv[1]);
+  auto pipe = std::make_shared<FakePipe>();
+  auto a = MakeHealingLink(0, 1, Backend::kShm,
+                           std::make_unique<PipeLink>(1, pipe, true),
+                           &mesh_a, nullptr);
+  auto b = MakeHealingLink(1, 0, Backend::kShm,
+                           std::make_unique<PipeLink>(0, pipe, false),
+                           &mesh_b, nullptr);
+
+  auto payload = Pattern(1 << 20, 7);
+  std::vector<char> out(payload.size(), 0);
+  a->StartSend(payload.data(), payload.size());  // link_reset fires here
+  b->StartRecv(out.data(), out.size());
+
+  for (int i = 0; i < 200000; ++i) {
+    Status sa = a->Progress();
+    Status sb = b->Progress();
+    if (!sa.ok() || !sb.ok()) {
+      std::printf("FAILED: a=%s b=%s\n", sa.reason.c_str(), sb.reason.c_str());
+      return 1;
+    }
+    if (a->SendDone() && b->RecvDone()) {
+      bool same = std::memcmp(payload.data(), out.data(), payload.size()) == 0;
+      std::printf("completed, bitwise %s\n", same ? "OK" : "MISMATCH");
+      return same ? 0 : 1;
+    }
+    struct timespec ts {0, 100 * 1000};
+    nanosleep(&ts, nullptr);
+  }
+  std::printf("HANG: exchange never completed after deadline\n");
+  std::printf("a: %s\n", a->Describe().c_str());
+  std::printf("b: %s\n", b->Describe().c_str());
+  return 2;
+}
