@@ -17,6 +17,11 @@ import sys
 
 BASELINE_IMG_SEC_PER_CHIP = 1656.82 / 16.0
 
+# Watchdog verdict for "fallback artifact written, benchmark child timed
+# out": 75 is EX_TEMPFAIL, the same retryable-failure convention the
+# launcher's preemption protocol uses (resilience.PREEMPTION_RC).
+WATCHDOG_TIMEOUT_RC = 75
+
 
 def main():
     # 256/chip measured fastest on v5e (2358 vs 2234 img/s at 128); the
@@ -243,8 +248,11 @@ def _watchdog_main():
                       "2582 img/s, 31.2% MFU resnet; 19.1k tok/s, "
                       "75.2% MFU lm"),
         }))
-        # A hang is "reported successfully"; a crash stays a crash.
-        return 0 if timed_out else (rc or 1)
+        # A hang leaves the artifact but is NOT a pass: rc 75
+        # (EX_TEMPFAIL, docs/benchmarks.md "Watchdog contract") lets
+        # automation tell "artifact written, backend wedged" from both a
+        # clean run (0) and a crash (child's rc).
+        return WATCHDOG_TIMEOUT_RC if timed_out else (rc or 1)
     return rc
 
 

@@ -110,6 +110,33 @@ if [ "$CHAOS_RC" -ne 0 ]; then
   exit "$CHAOS_RC"
 fi
 
+echo "--- $VARIANT: np=3 -> 2 fail-in-place reformation under chaos
+--- (rank_kill SIGKILLs rank 2 mid-exchange; survivors drain in-flight
+--- entries with the membership-changed status, re-rendezvous
+--- IN-PROCESS and train on — the drain/latch/re-init handover is
+--- shared state across the event loop, the controller and the waiter
+--- threads: exactly the code a race would hide in).  Heartbeats run at
+--- 1s (5s liveness window): the instrumented teardown is slow enough
+--- that the stock 0.2s cadence false-positives the health plane."
+FIPSAN_DIR="$(mktemp -d)"
+set +e
+env LD_PRELOAD="$PRELOAD" "$SAN_KEY=$SAN_VAL" \
+  JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  HOROVOD_METRICS_FILE="$FIPSAN_DIR/metrics.json" \
+  HOROVOD_TERMINATE_GRACE_SECONDS=3 \
+  HOROVOD_FAULT_SPEC="rank=2,site=transport,kind=rank_kill,after=140" \
+  python -m horovod_tpu.runner -np 3 \
+  --heartbeat-interval 1 --min-np 2 --on-rank-failure shrink \
+  python tests/distributed/failinplace_np3.py
+FIPSAN_RC=$?
+set -e
+rm -rf "$FIPSAN_DIR"
+if [ "$FIPSAN_RC" -ne 0 ]; then
+  echo "$VARIANT: fail-in-place reformation workload failed" \
+       "(rc=$FIPSAN_RC)" >&2
+  exit "$FIPSAN_RC"
+fi
+
 # --- triage: suppressed noise vs frames that fail the lane -------------
 shopt -s nullglob
 LOGS=("$LOG_BASE".*)

@@ -372,6 +372,52 @@ grep -q "WARM_OK attempt=1 rank=0 size=1 source=spill committed=4" \
   "$WARM_DIR/out.log"
 rm -rf "$WARM_DIR"
 
+echo "--- fail-in-place gate (np=3 -> 2 over fake ssh): a rank_kill
+--- chaos rule SIGKILLs rank 2 from inside an armed transport exchange
+--- mid-training; the survivors must reform the collective world
+--- IN-PROCESS — zero elastic restarts, membership epoch 0 -> 1,
+--- exactly one reformation in the merged metrics — recover the
+--- committed step from peer spills and train to the uninterrupted
+--- run's final state (docs/fault_tolerance.md, 'Fail-in-place')"
+FIP_DIR="$(mktemp -d)"
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+  HOROVOD_SSH_CMD="ci/fake_ssh.sh" \
+  HOROVOD_METRICS_FILE="$FIP_DIR/metrics.json" \
+  HOROVOD_TERMINATE_GRACE_SECONDS=3 \
+  HOROVOD_FAULT_SPEC="rank=2,site=transport,kind=rank_kill,after=140" \
+  timeout 150 \
+  python -m horovod_tpu.runner -np 3 -H localhost:2,127.0.1.1:1 \
+  --heartbeat-interval 0.2 --min-np 2 --on-rank-failure shrink \
+  python tests/distributed/failinplace_np3.py \
+  2> "$FIP_DIR/err.log" | tee "$FIP_DIR/out.log"
+cat "$FIP_DIR/err.log" >&2
+grep -q "firing kind=rank_kill at site=transport" \
+  "$FIP_DIR/out.log" "$FIP_DIR/err.log"
+grep -q "reforming the world in-process as epoch 1 with 2 rank(s)" \
+  "$FIP_DIR/err.log"
+grep -q "absorbed by in-process reformation (2 survivor(s) continue)" \
+  "$FIP_DIR/err.log"
+test "$(grep -c "FIP_OK rank=[01] size=2 epoch=1 source=spill" \
+  "$FIP_DIR/out.log")" -eq 2
+PYTHONPATH="$PWD" python - "$FIP_DIR/metrics.json" <<'PYEOF'
+import json, sys
+from horovod_tpu.telemetry import aggregate
+doc = json.load(open(sys.argv[1]))
+m = doc["merged"]
+# The tentpole claim: the shrink was an IN-PROCESS event, not a
+# relaunch — one reformation, zero elastic restarts, both survivors
+# timed their reformation.
+assert aggregate.counter_total(
+    m, "hvd_failinplace_reformations_total") == 1, sorted(m.keys())
+assert aggregate.counter_total(m, "hvd_elastic_restarts_total") == 0, \
+    "an elastic restart leaked into the fail-in-place gate"
+h, = m["hvd_failinplace_reformation_seconds"]["values"]
+assert h["count"] == 2, h
+print("FAILINPLACE_METRICS_OK reformations=1 elastic_restarts=0 "
+      f"reform_seconds_mean={h['sum'] / h['count']:.2f}")
+PYEOF
+rm -rf "$FIP_DIR"
+
 echo "--- coordination protocol simulator, fast lane (docs/
 --- control_plane.md): agreement safety, bounded fan-in, chaos
 --- convergence — pure-Python virtual network, no sockets"

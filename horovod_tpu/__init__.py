@@ -40,6 +40,7 @@ from horovod_tpu.basics import (
     local_size,
     cross_rank,
     cross_size,
+    world_epoch,
     num_devices,
     local_devices,
     mesh,
@@ -123,6 +124,7 @@ __all__ = [
     # lifecycle / topology
     "init", "shutdown", "is_initialized",
     "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",
+    "world_epoch",
     "num_devices", "local_devices", "mesh", "topology", "Topology",
     "coordinator", "CoordinatorInfo",
     "mpi_threads_supported",

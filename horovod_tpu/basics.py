@@ -272,6 +272,20 @@ def cross_size() -> int:
     return _state.cross_size
 
 
+def world_epoch() -> int:
+    """Membership epoch of the current world: 0 at launch, +1 for every
+    in-process reformation this process survived (fail-in-place,
+    docs/fault_tolerance.md).  Mirrors the native ``hvd_world_epoch()``
+    C API; falls back to ``HOROVOD_WORLD_EPOCH`` when the native
+    runtime is not loaded (size-1 worlds)."""
+    _check_initialized()
+    if _state.runtime is not None:
+        epoch = _state.runtime.world_epoch()
+        if epoch is not None:
+            return int(epoch)
+    return config.env_int("HOROVOD_WORLD_EPOCH", 0) or 0
+
+
 class Topology(NamedTuple):
     """The job's host→slots map plus this rank's place in it — the Python
     face of the launcher's ``HOROVOD_TOPOLOGY`` export (the LOCAL/CROSS

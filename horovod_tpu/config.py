@@ -292,6 +292,18 @@ _var("HOROVOD_ELASTIC_PREV_SIZE", "int", None,
      "restart")
 _var("HOROVOD_RESTART_ATTEMPT", "int", 0,
      "Elastic attempt counter injected by the launcher", native=True)
+_var("HOROVOD_ON_RANK_FAILURE", "str", "restart",
+     "Rank-death policy: restart (today's elastic relaunch), shrink "
+     "(survivors reform the world in-process), shrink-then-restart "
+     "(fall back to relaunch if reformation fails or the world would "
+     "drop below --min-np)", native=True)
+_var("HOROVOD_WORLD_EPOCH", "int", 0,
+     "Membership epoch, bumped by the launcher once per in-process "
+     "reformation; stale reformation specs are discarded against it",
+     native=True)
+_var("HOROVOD_REFORM_TIMEOUT", "float", 60.0,
+     "Seconds a survivor waits for the launcher's reformation spec "
+     "before falling back to the restart path")
 _var("HOROVOD_TERMINATE_GRACE_SECONDS", "float", 30.0,
      "Grace between SIGTERM and SIGKILL when tearing ranks down")
 _var("HOROVOD_HEALTH_RPC", "str", None,

@@ -100,7 +100,14 @@ Keys:
              driving mid-job fallback to the socket backend),
              ``link_reset[:N]`` (data plane: force N immediate backend
              degrades — default 1 — exercising the epoch-stamped
-             degrade handshake without waiting for a stall deadline).
+             degrade handshake without waiting for a stall deadline),
+             ``rank_kill[:N]`` (data plane: SIGKILL this rank from
+             inside the Nth armed transport exchange — default the
+             first — dying exactly as a host loss would: no unwind, no
+             shutdown handshake, peers left holding half-open links
+             mid-collective; the fail-in-place simulation
+             ``HOROVOD_ON_RANK_FAILURE=shrink`` must absorb
+             in-process).
 ``count``    maximum number of firings (default: unlimited for
              ``delay``/``error``/``nan``/``corrupt``/
              ``heartbeat_drop``/``spill_corrupt`` — chaos tests that
@@ -116,7 +123,9 @@ hooks — :func:`drop_heartbeat` in the heartbeat sender (site
 ``heartbeat``), :func:`mangle_spill` in the spill writer (site
 ``spill``) and :func:`drop_residual` in the compressed training step
 (site ``compression``) — never at :func:`inject`; the fleet kinds
-(``preempt_storm``/``host_flap``) fire only at :func:`fleet_chaos`,
+(``preempt_storm``/``host_flap``, plus ``rank_kill`` when its rule
+says ``site=fleet`` — the controller then kills one rank of a victim
+job through its watchdog) fire only at :func:`fleet_chaos`,
 which the fleet controller polls once per scheduler tick (site
 ``fleet``); and the serving kinds (``replica_crash``/``request_storm``)
 fire only at :func:`crash_replica` (replica decode loop) and
@@ -126,7 +135,8 @@ and the control kinds (``msg_drop``/``msg_dup``/``msg_delay``/
 polled per coordination-message send by the live control wire and
 armed per virtual send by ``tools/coordsim`` (site ``control``).
 The transport kinds (``frame_corrupt``/``stripe_kill``/``shm_stall``/
-``link_reset``, site ``transport``) are consumed *natively*: the data
+``link_reset``/``rank_kill``, site ``transport``) are consumed
+*natively*: the data
 plane parses the same env-passed spec inside ``libhorovod_tpu.so``
 (``src/link_heal.cc``) and arms them per wire frame / per exchange,
 emitting the same ``horovod_tpu.faults: firing`` announce line — this
@@ -157,7 +167,8 @@ _KINDS = ("crash", "exit", "hang", "delay", "error", "nan", "corrupt",
           "heartbeat_drop", "spill_corrupt", "preempt_storm", "host_flap",
           "residual_drop", "replica_crash", "request_storm",
           "msg_drop", "msg_dup", "msg_delay", "partition", "coord_crash",
-          "frame_corrupt", "stripe_kill", "shm_stall", "link_reset")
+          "frame_corrupt", "stripe_kill", "shm_stall", "link_reset",
+          "rank_kill")
 
 # Kinds that mutate an op's *output value* instead of disrupting control
 # flow; they fire at corrupt_output(), never at inject().
@@ -191,7 +202,7 @@ CONTROL_KINDS = ("msg_drop", "msg_dup", "msg_delay", "partition",
 # armed per wire frame / per exchange there — Python only validates the
 # grammar and never fires these from any of its own hooks.
 TRANSPORT_KINDS = ("frame_corrupt", "stripe_kill", "shm_stall",
-                   "link_reset")
+                   "link_reset", "rank_kill")
 
 SITES = (
     "allreduce", "allgather", "broadcast", "alltoall", "reducescatter",
@@ -445,7 +456,7 @@ def parse_spec(spec: str) -> List[FaultRule]:
                                 f"kind shm_stall:{arg} must stall "
                                 f"> 0 milliseconds")
                     elif kind in ("frame_corrupt", "stripe_kill",
-                                  "link_reset"):
+                                  "link_reset", "rank_kill"):
                         arg = int(kind_arg) if kind_arg else None
                         if arg is not None and arg < 1:
                             raise FaultSpecError(
@@ -504,8 +515,8 @@ def parse_spec(spec: str) -> List[FaultRule]:
         # (count says how many stalls).  All default to one firing so
         # the chaos episode settles and recovery stays observable —
         # mirrored by the native parser in src/link_heal.cc.
-        if kind in ("frame_corrupt", "stripe_kill", "link_reset") \
-                and count is None:
+        if kind in ("frame_corrupt", "stripe_kill", "link_reset",
+                    "rank_kill") and count is None:
             count = arg if arg is not None else 1
         if kind == "shm_stall" and count is None:
             count = 1
@@ -670,7 +681,12 @@ def fleet_chaos() -> List[str]:
         return []
     fired: List[str] = []
     for rule in plan:
-        if rule.kind not in FLEET_KINDS:
+        # rank_kill is dual-site: natively armed per exchange at site
+        # ``transport`` (SIGKILL from inside the data plane), or fired
+        # here at site ``fleet`` where the controller picks a victim
+        # job and kills one of its ranks through the job's watchdog.
+        if rule.kind not in FLEET_KINDS and \
+                not (rule.kind == "rank_kill" and rule.site == "fleet"):
             continue
         if rule.arm("fleet", _context_rank(None)):
             rule._announce("fleet", None, None)

@@ -29,6 +29,15 @@ int hvd_hierarchical_enabled();
 int hvd_hierarchical_allgather_enabled();
 int hvd_is_initialized();
 
+// Fail-in-place (HOROVOD_ON_RANK_FAILURE=shrink|shrink-then-restart):
+// membership epoch this world was initialized under (HOROVOD_WORLD_EPOCH,
+// bumped by the launcher per in-process reformation; 0 first init), and
+// 1 once a peer death latched a pending membership change.  Ops drained
+// by the change complete with status code 6 (kMembershipChanged); the
+// flag is guaranteed set before any waiter observes that code.
+int64_t hvd_world_epoch();
+int hvd_membership_changed();
+
 // Live adaptive-control-plane introspection (stall reports, telemetry
 // gauges).  Values reflect the latest TunedParams applied from the
 // response stream (or the env-configured defaults when autotuning is

@@ -118,6 +118,11 @@ enum class StatusCode : int32_t {
   kAborted = 3,
   kInvalidArgument = 4,
   kInProgress = 5,
+  // Retryable: the collective world changed underneath this op (a rank
+  // died and HOROVOD_ON_RANK_FAILURE allows in-process reformation).
+  // The Python layer converts this code into MembershipChangedError and
+  // runs the fail-in-place ladder instead of tearing the process down.
+  kMembershipChanged = 6,
 };
 
 struct Status {
@@ -130,6 +135,7 @@ struct Status {
   static Status Precondition(std::string r) { return Error(StatusCode::kPreconditionError, std::move(r)); }
   static Status InvalidArgument(std::string r) { return Error(StatusCode::kInvalidArgument, std::move(r)); }
   static Status Aborted(std::string r) { return Error(StatusCode::kAborted, std::move(r)); }
+  static Status MembershipChanged(std::string r) { return Error(StatusCode::kMembershipChanged, std::move(r)); }
   bool ok() const { return code == StatusCode::kOk; }
 };
 

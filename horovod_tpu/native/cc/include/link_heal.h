@@ -58,6 +58,10 @@ namespace transport {
 //                      stall deadline)
 //   link_reset[:N]     per armed exchange (hard-fails the inner link,
 //                      forcing an immediate backend degrade)
+//   rank_kill[:N]      per armed exchange (raises SIGKILL on the Nth
+//                      passage — the fail-in-place chaos trigger: the
+//                      process dies exactly as a host loss would kill
+//                      it, mid-exchange with links half-open)
 // ----------------------------------------------------------------------
 
 namespace chaos {
@@ -67,6 +71,7 @@ enum class Kind : int {
   kStripeKill = 1,
   kShmStall = 2,
   kLinkReset = 3,
+  kRankKill = 4,
 };
 
 // Count one passage through the transport chaos site.  Returns the

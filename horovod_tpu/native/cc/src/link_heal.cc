@@ -7,6 +7,7 @@
 
 #include <errno.h>
 #include <poll.h>
+#include <signal.h>
 #include <string.h>
 #include <sys/socket.h>
 #include <time.h>
@@ -50,6 +51,7 @@ const char* KindName(Kind k) {
     case Kind::kFrameCorrupt: return "frame_corrupt";
     case Kind::kStripeKill: return "stripe_kill";
     case Kind::kShmStall: return "shm_stall";
+    case Kind::kRankKill: return "rank_kill";
     default: return "link_reset";
   }
 }
@@ -77,7 +79,8 @@ Spec g_spec;
 // consumes: site must be `transport` or `*`, kind must be a transport
 // kind (Python skips those kinds at its own hooks), and the count
 // shorthand `kind:N` means N firings for frame_corrupt / stripe_kill /
-// link_reset and a milliseconds argument for shm_stall.  Unknown keys
+// link_reset / rank_kill and a milliseconds argument for shm_stall.
+// Unknown keys
 // or non-transport kinds are simply ignored here — faults.load() is the
 // grammar authority and raises on real typos.
 void ParseLocked() {
@@ -127,6 +130,7 @@ void ParseLocked() {
         else if (name == "stripe_kill") r.kind = Kind::kStripeKill;
         else if (name == "shm_stall") r.kind = Kind::kShmStall;
         else if (name == "link_reset") r.kind = Kind::kLinkReset;
+        else if (name == "rank_kill") r.kind = Kind::kRankKill;
         else { bad = true; continue; }
         kind_ok = true;
         if (!arg.empty()) {
@@ -653,6 +657,7 @@ class HealingLink : public Link {
   int peer() const override { return peer_; }
 
   void StartSend(const void* buf, size_t n) override {
+    ArmRankKill();
     OnArm(/*is_send=*/true);
     send_armed_ = true;
     sbuf_ = buf;
@@ -662,13 +667,17 @@ class HealingLink : public Link {
       if (inner_) {
         inner_->StartSend(buf, n);
         TouchInner();
-        return;
       }
+      // If ArmChaos() degraded the link, Degrade() already re-armed the
+      // engine from the saved buffer; arming again here would advance
+      // the per-direction seq a second time and desync from the peer.
+      return;
     }
     eng_.StartSend(buf, n);
   }
 
   void StartRecv(void* buf, size_t n) override {
+    ArmRankKill();
     OnArm(/*is_send=*/false);
     recv_armed_ = true;
     rbuf_ = buf;
@@ -678,8 +687,10 @@ class HealingLink : public Link {
       if (inner_) {
         inner_->StartRecv(buf, n);
         TouchInner();
-        return;
       }
+      // Same as StartSend: a chaos-triggered Degrade() already armed
+      // the engine (and set the consumed-byte floor); never arm twice.
+      return;
     }
     eng_.StartRecv(buf, n);
   }
@@ -902,6 +913,18 @@ class HealingLink : public Link {
   }
 
   // ---- chaos ------------------------------------------------------------
+
+  void ArmRankKill() {
+    // Fail-in-place chaos trigger: die exactly as a host loss would —
+    // no unwind, no shutdown handshake, peers left with half-open
+    // links mid-exchange.  Armed per exchange direction on EVERY
+    // backend (a host loss does not care which transport was in
+    // flight), so unlike ArmChaos it runs even when the pair rides the
+    // bare frame-engine socket path with no inner link.  The announce
+    // line flushed inside Arm(), so the chaos suites can still prove
+    // the fault fired from the dead rank's captured stderr.
+    if (chaos::Arm(chaos::Kind::kRankKill) >= 0) raise(SIGKILL);
+  }
 
   void ArmChaos() {
     // Per armed exchange, only while an inner link is up.

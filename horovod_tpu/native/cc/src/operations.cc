@@ -108,6 +108,15 @@ struct GlobalState {
 
   std::mutex err_mu;
   std::string last_error;
+
+  // Fail-in-place: the membership epoch this world was initialized under
+  // (HOROVOD_WORLD_EPOCH, bumped by the launcher on every in-process
+  // reformation) and a latch set when a peer death is detected under a
+  // shrink-capable HOROVOD_ON_RANK_FAILURE policy.  The latch flips
+  // BEFORE pending waiters are woken, so hvd_membership_changed() is
+  // already 1 by the time any hvd_wait returns kMembershipChanged.
+  int64_t world_epoch = 0;
+  std::atomic<bool> membership_changed{false};
 };
 
 GlobalState* g = nullptr;
@@ -117,6 +126,34 @@ void SetLastError(const std::string& msg) {
   if (g == nullptr) return;
   std::lock_guard<std::mutex> lk(g->err_mu);
   g->last_error = msg;
+}
+
+// HOROVOD_ON_RANK_FAILURE policy (fail-in-place): `restart` (default)
+// keeps today's behavior — peer death is fatal and the launcher's
+// elastic loop relaunches.  `shrink` / `shrink-then-restart` make peer
+// death a retryable membership change: pending ops drain with
+// kMembershipChanged and the Python layer reforms the world in-process.
+// Read per-failure (cold path) so a launcher-injected policy flip
+// between init epochs takes effect without re-exec.
+bool ShrinkOnRankFailure() {
+  const std::string policy = EnvStr("HOROVOD_ON_RANK_FAILURE", "restart");
+  return policy == "shrink" || policy == "shrink-then-restart";
+}
+
+// Rewrites a fatal peer-loss status into the retryable membership-change
+// status under a shrink-capable policy, latching the process-wide flag
+// BEFORE any waiter can observe the rewritten code.  Transport/peer
+// failures surface as kUnknownError (data plane) or kAborted
+// (controller-cycle drain); config errors (kInvalidArgument,
+// kPreconditionError) stay fatal — shrinking can't fix a bad argument.
+Status MaybeMembershipChange(Status st) {
+  if (st.ok() || g == nullptr) return st;
+  if (st.code != StatusCode::kUnknownError &&
+      st.code != StatusCode::kAborted)
+    return st;
+  if (!ShrinkOnRankFailure()) return st;
+  g->membership_changed.store(true);
+  return Status::MembershipChanged(st.reason);
 }
 
 // ---------------------------------------------------------------------------
@@ -291,7 +328,8 @@ int64_t ExecuteResponse(const Response& resp) {
     }
   }
 
-  auto complete_all = [&](const Status& st) {
+  auto complete_all = [&](const Status& st_in) {
+    const Status st = MaybeMembershipChange(st_in);
     for (auto& e : entries) g->queue.Complete(e, st);
   };
 
@@ -820,7 +858,12 @@ void BackgroundThread() {
     if (!s.ok()) {
       LOG(Error) << "controller cycle failed: " << s.reason;
       SetLastError(s.reason);
-      g->queue.FailAll(Status::Aborted(s.reason));
+      // Fail-in-place: a dead peer first surfaces here on the ranks that
+      // were not mid-exchange with it (the coordinator round-trip fails
+      // when the master's fan-in hits the dead socket).  Under a shrink
+      // policy the drain is retryable — survivors keep the process alive
+      // and wait for the launcher's reformation spec.
+      g->queue.FailAll(MaybeMembershipChange(Status::Aborted(s.reason)));
       break;
     }
     if (!responses.abort_message.empty()) {
@@ -949,6 +992,10 @@ int hvd_init(int rank, int size, int local_rank, int local_size,
   g->local_size = local_size;
   g->rendezvous_addr = rendezvous_addr ? rendezvous_addr : "127.0.0.1";
   g->rendezvous_port = rendezvous_port;
+  // Fail-in-place: the fresh state starts with membership_changed=false
+  // (a reformed world is whole again) and the epoch the launcher's
+  // reformation spec stamped into the environment (0 for a first init).
+  g->world_epoch = EnvInt("HOROVOD_WORLD_EPOCH", 0);
   g->background = std::thread(BackgroundThread);
 
   // Reference busy-waits initialization_done (operations.cc:596-598).
@@ -990,6 +1037,15 @@ int hvd_hierarchical_allgather_enabled() {
   return g && g->hierarchical_allgather_enabled ? 1 : 0;
 }
 int hvd_is_initialized() { return g && g->initialized.load() ? 1 : 0; }
+
+// Fail-in-place introspection: the membership epoch this world was
+// initialized under, and whether a peer death latched a pending
+// membership change (already 1 by the time any waiter observes a
+// kMembershipChanged status — see MaybeMembershipChange).
+int64_t hvd_world_epoch() { return g ? g->world_epoch : 0; }
+int hvd_membership_changed() {
+  return g && g->membership_changed.load() ? 1 : 0;
+}
 
 double hvd_tuned_cycle_time_ms() {
   return g ? g->tuned_cycle_ms.load() : 0.0;

@@ -51,6 +51,18 @@ class EagerStallError(RuntimeError):
     the message names the stuck tensor and the suspected missing ranks."""
 
 
+# StatusCode::kMembershipChanged (hvd_common.h) as returned by hvd_wait.
+_MEMBERSHIP_CHANGED_RC = 6
+
+
+class MembershipChangedError(RuntimeError):
+    """The collective world changed underneath this op: a peer died and
+    ``HOROVOD_ON_RANK_FAILURE`` allows in-process reformation.  Retryable
+    — the caller (``resilience.reform_world``) tears down the old world,
+    re-inits against the launcher's reformation spec and replays from the
+    warm-restore ladder instead of letting the process exit."""
+
+
 def _env_float(name: str, default: Optional[float]) -> Optional[float]:
     # Registry-checked read (python -m tools.hvdlint, env-registry rule).
     return config.env_float(name, default)
@@ -288,6 +300,14 @@ class Runtime:
         if self._trace_dropped_fn is not None:
             self._trace_dropped_fn.restype = ctypes.c_longlong
         self._trace_dropped_seen = 0
+        # Fail-in-place introspection: the membership epoch this world
+        # was initialized under and the peer-death latch (set natively
+        # BEFORE any waiter observes a kMembershipChanged status).
+        self._world_epoch_fn = getattr(lib, "hvd_world_epoch", None)
+        if self._world_epoch_fn is not None:
+            self._world_epoch_fn.restype = ctypes.c_longlong
+        self._membership_changed_fn = getattr(
+            lib, "hvd_membership_changed", None)
         # The telemetry at-exit export can run before basics.shutdown()
         # (atexit LIFO) — give it a hook to pull the native buffer while
         # this runtime is still alive.
@@ -360,6 +380,24 @@ class Runtime:
         """True when the bootstrap agreement enabled the 2-level
         allgather (HOROVOD_HIERARCHICAL_ALLGATHER)."""
         return bool(self._hier_ag_fn and self._hier_ag_fn())
+
+    def world_epoch(self) -> int:
+        """The membership epoch this world was initialized under
+        (HOROVOD_WORLD_EPOCH; bumped by the launcher once per in-process
+        reformation, 0 for a first init)."""
+        if self._world_epoch_fn is None or self._lib is None:
+            return 0
+        return int(self._world_epoch_fn())
+
+    def membership_changed(self) -> bool:
+        """True once a peer death latched a pending membership change
+        under a shrink-capable HOROVOD_ON_RANK_FAILURE policy.  Set
+        natively before any waiter observes a kMembershipChanged status,
+        so a wait that drained with a generic abort can still tell the
+        two cases apart."""
+        if self._membership_changed_fn is None or self._lib is None:
+            return False
+        return bool(self._membership_changed_fn())
 
     def coord_tree_enabled(self) -> bool:
         """True when tree coordination is active (HOROVOD_COORD_TREE=1
@@ -956,6 +994,13 @@ class Runtime:
                     op=op_kind).inc()
             err = self._lib.hvd_last_error().decode()
             self._lib.hvd_release(h)   # drop the native table entry
+            # Fail-in-place: ops drained by a peer death under a shrink
+            # policy carry the retryable kMembershipChanged code.  The
+            # latch check also catches ops that raced the detection and
+            # drained with a generic abort — once the flag is up, EVERY
+            # failed wait means "the world changed", not "the op broke".
+            if rc == _MEMBERSHIP_CHANGED_RC or self.membership_changed():
+                raise MembershipChangedError(err)
             raise RuntimeError(err)
         if entry is not None:
             name, t0, nbytes = entry[1], entry[2], entry[5]

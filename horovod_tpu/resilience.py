@@ -90,6 +90,7 @@ import numpy as np
 from jax import lax
 
 from horovod_tpu import basics, faults, telemetry
+from horovod_tpu.native.runtime import MembershipChangedError  # noqa: F401
 from horovod_tpu.ops import collective as _c
 from horovod_tpu.utils.logging import get_logger
 
@@ -963,6 +964,11 @@ class HeartbeatSender:
         self.rank = int(rank)
         self.interval = max(0.05, float(interval))
         self.epoch = config.env_int("HOROVOD_COORD_EPOCH")
+        # Membership epoch (fail-in-place): a fresh sender starts after
+        # every reform_world() re-init, so reading the env once here is
+        # enough for the launcher to tell old-world heartbeats (still
+        # keyed by pre-reformation ranks) from reformed-world ones.
+        self.world_epoch = config.env_int("HOROVOD_WORLD_EPOCH", 0) or 0
         self.partition_grace = config.env_float(
             "HOROVOD_PARTITION_GRACE_SECONDS")
         self._seq = 0
@@ -1017,7 +1023,8 @@ class HeartbeatSender:
                     self.addr, self.port,
                     {"kind": "heartbeat", "rank": self.rank,
                      "step": step, "progress_ts": ts,
-                     "epoch": self.epoch, "seq": self._seq},
+                     "epoch": self.epoch, "seq": self._seq,
+                     "world_epoch": self.world_epoch},
                     self.key, timeout=max(1.0, self.interval),
                     retries=0)
                 self._last_ok = _time.monotonic()
@@ -1030,6 +1037,13 @@ class HeartbeatSender:
                             "hvd_coord_lease_renewals_total",
                             "Coordinator lease renewals (rank 0 "
                             "heartbeats that reached the launcher)").inc()
+                if isinstance(resp, dict) and resp.get("reform"):
+                    # Fail-in-place: the launcher computed the survivors'
+                    # new world and delivers this rank's slice of it in
+                    # the heartbeat reply (the same channel remote
+                    # preemption rides — the launcher can't signal a
+                    # remote rank directly).  reform_world() consumes it.
+                    _deliver_reform_spec(resp["reform"])
                 if isinstance(resp, dict) and resp.get("preempt") and \
                         not _preempt_event.is_set():
                     # The launcher can't SIGTERM a remote rank (only its
@@ -1102,6 +1116,135 @@ def stop_heartbeat() -> None:
         if _heartbeat_sender is not None:
             _heartbeat_sender.stop()
             _heartbeat_sender = None
+
+
+# ---------------------------------------------------------------------------
+# Fail-in-place: in-process world reformation on rank death
+# (HOROVOD_ON_RANK_FAILURE=shrink|shrink-then-restart)
+# ---------------------------------------------------------------------------
+
+_reform_lock = threading.Lock()
+_reform_event = threading.Event()
+_reform_spec: Optional[dict] = None
+
+
+def _deliver_reform_spec(spec) -> None:
+    """Latch a launcher-delivered reformation spec (heartbeat reply).
+
+    Stale specs — epoch not beyond the world this process is already
+    running under — are dropped: after a reformation the heartbeat keys
+    collide with the OLD rank numbering for a reply or two until the
+    launcher's pending table clears, and re-applying the same spec would
+    tear down the freshly reformed world."""
+    global _reform_spec
+    if not isinstance(spec, dict):
+        return
+    from horovod_tpu import config
+    current = config.env_int("HOROVOD_WORLD_EPOCH", 0) or 0
+    if int(spec.get("epoch", 0)) <= current:
+        return
+    with _reform_lock:
+        _reform_spec = dict(spec)
+        _reform_event.set()
+    log.info("reformation spec received: epoch %s, new rank %s of %s",
+             spec.get("epoch"), spec.get("rank"), spec.get("size"))
+
+
+def _take_reform_spec(timeout: float) -> Optional[dict]:
+    global _reform_spec
+    if not _reform_event.wait(timeout):
+        return None
+    with _reform_lock:
+        spec, _reform_spec = _reform_spec, None
+        _reform_event.clear()
+    return spec
+
+
+def reform_world(params, opt_state, *, ckpt_dir: Optional[str] = None,
+                 timeout: Optional[float] = None):
+    """Reform the collective world in-process after a peer death.
+
+    The recovery rung ABOVE transport self-healing and BELOW the elastic
+    relaunch (docs/fault_tolerance.md): called from the training loop's
+    ``except MembershipChangedError`` handler when
+    ``HOROVOD_ON_RANK_FAILURE`` is ``shrink`` / ``shrink-then-restart``.
+    Sequence:
+
+    1. **wait for the spec** — the launcher detects the death, computes
+       the survivors' contiguous re-ranking and delivers each rank its
+       slice via the heartbeat reply (the sender is still running — the
+       old world is broken, not this process);
+    2. **tear down** the old world (``hvd.shutdown()``: drains the
+       queue, closes transport links, stops the heartbeat);
+    3. **adopt** the spec: new rank/size/local topology, the fresh
+       rendezvous port, ``HOROVOD_WORLD_EPOCH`` and
+       ``HOROVOD_ELASTIC_PREV_SIZE`` (so PR 5's elastic-continuity
+       lr/accumulate policy sees the N->N-1 shrink);
+    4. **re-init** (``hvd.init()``: new rendezvous among survivors, flat
+       ring + hierarchical levels + shm/striped links rebuilt against
+       the new peer set; heartbeat restarts under the new rank);
+    5. **recover state** with the :func:`warm_restore` ladder (Max-step
+       election, peer-spill re-broadcast, ZeRO re-shard for N-1).
+
+    Returns ``(params, opt_state, step, source, extra)`` exactly like
+    :func:`warm_restore`.  Raises ``TimeoutError`` when no spec arrives
+    within ``timeout`` (default ``HOROVOD_REFORM_TIMEOUT``, 60s) — the
+    caller re-raises the original failure and the job falls back to the
+    relaunch path (shrink-then-restart) or dies (shrink)."""
+    import time as _time
+    from horovod_tpu import config
+    if timeout is None:
+        timeout = config.env_float("HOROVOD_REFORM_TIMEOUT", 60.0)
+    t0 = _time.monotonic()
+    pre_step, _ = progress()
+    spec = _take_reform_spec(float(timeout))
+    if spec is None:
+        raise TimeoutError(
+            f"no reformation spec from the launcher within {timeout:g}s "
+            f"(HOROVOD_REFORM_TIMEOUT) — falling back to the restart "
+            f"path")
+    basics.shutdown()
+    os.environ["HOROVOD_ELASTIC_PREV_SIZE"] = str(
+        spec.get("prev_size", int(spec["size"]) + 1))
+    os.environ["HOROVOD_WORLD_EPOCH"] = str(spec["epoch"])
+    os.environ["HOROVOD_RANK"] = str(spec["rank"])
+    os.environ["HOROVOD_SIZE"] = str(spec["size"])
+    os.environ["HOROVOD_LOCAL_RANK"] = str(spec["local_rank"])
+    os.environ["HOROVOD_LOCAL_SIZE"] = str(spec["local_size"])
+    # Overwrite unconditionally: the launch-time values are stale for
+    # the reformed world and basics.init() would otherwise read them.
+    os.environ["HOROVOD_CROSS_RANK"] = str(spec.get(
+        "cross_rank", int(spec["rank"]) // max(int(spec["local_size"]), 1)))
+    os.environ["HOROVOD_CROSS_SIZE"] = str(spec.get("cross_size", 1))
+    os.environ["HOROVOD_RENDEZVOUS_ADDR"] = str(spec["rendezvous_addr"])
+    os.environ["HOROVOD_RENDEZVOUS_PORT"] = str(spec["rendezvous_port"])
+    if spec.get("topology"):
+        os.environ["HOROVOD_TOPOLOGY"] = str(spec["topology"])
+    basics.init()
+    new_params, new_opt, step, source, extra = warm_restore(
+        params, opt_state, ckpt_dir=ckpt_dir)
+    seconds = _time.monotonic() - t0
+    if telemetry.enabled():
+        telemetry.histogram(
+            "hvd_failinplace_reformation_seconds",
+            "Wall time from membership-change detection to the reformed "
+            "world's state recovery completing",
+            bounds=telemetry.DEFAULT_TIME_BUCKETS).observe(seconds)
+        telemetry.gauge(
+            "hvd_failinplace_world_epoch",
+            "Membership epoch this rank is running under (0 = never "
+            "reformed)").set(int(spec["epoch"]))
+        if basics.rank() == 0 and pre_step >= 0 and step >= 0:
+            # New rank 0 only, so the merged summary books the loss once.
+            telemetry.counter(
+                "hvd_failinplace_steps_lost_total",
+                "Steps rolled back by in-process reformations (progress "
+                "high-water minus the recovered committed step)").inc(
+                    max(pre_step - step, 0))
+    log.info("fail-in-place: reformed world epoch %s as rank %d/%d in "
+             "%.2fs (recovered step %d from %s)", spec["epoch"],
+             basics.rank(), basics.size(), seconds, step, source)
+    return new_params, new_opt, step, source, extra
 
 
 # ---------------------------------------------------------------------------

@@ -192,6 +192,9 @@ class _Job:
         self.infos: List[hosts.RankInfo] = []
         self.control: Optional[launch.JobControl] = None
         self.health = None          # per-job _HealthPlane, if enabled
+        self.chaos_kills: List = [] # pending (rank, reason) kill orders
+                                    # from fleet-site rank_kill chaos,
+                                    # drained by the job's watchdog
         self.thread: Optional[threading.Thread] = None
         self.result = None          # (rc, report) set by the job thread
         self.starve_logged = False
@@ -457,6 +460,24 @@ class FleetController:
                 victim = min(victims,
                              key=lambda j: (j.priority, -j.started_at))
                 self._preempt(victim, "chaos preempt_storm")
+            elif kind == "rank_kill":
+                # Fleet-site rank death: SIGKILL one rank of the lowest-
+                # priority running job through its watchdog — the same
+                # kill path a heartbeat death takes, so the job's
+                # configured rank-failure policy (restart budget or
+                # fail-in-place shrink) handles the aftermath.
+                victims = self._running()
+                if not victims:
+                    continue
+                victim = min(victims,
+                             key=lambda j: (j.priority, -j.started_at))
+                rank = max((i.rank for i in victim.infos), default=None)
+                if rank is None:
+                    continue
+                victim.chaos_kills.append(
+                    (rank, "chaos rank_kill (fleet fault injection)"))
+                self._log(f"chaos rank_kill: killing rank {rank} of "
+                          f"job {victim.name}")
             elif kind == "host_flap":
                 host = self.pool[-1].hostname
                 if host in self._flapped:
@@ -811,7 +832,15 @@ class FleetController:
             if control.preempt_requested.is_set() or \
                     control.stop_requested.is_set():
                 return []
-            return health.watchdog() if health is not None else []
+            out: list = []
+            if job.chaos_kills:
+                # Swap-then-drain: the controller tick appends, this
+                # (job-thread) side consumes — no partial reads.
+                pending, job.chaos_kills = job.chaos_kills, []
+                out.extend(pending)
+            if health is not None:
+                out.extend(health.watchdog())
+            return out
 
         return watchdog
 

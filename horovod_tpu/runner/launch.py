@@ -253,7 +253,9 @@ def launch_job(rank_infos: List[RankInfo], command: List[str],
                watchdog: Optional[Callable[[], list]] = None,
                install_signal_handlers: bool = True,
                control: Optional[JobControl] = None,
-               label: Optional[str] = None) -> int:
+               label: Optional[str] = None,
+               reform: Optional[Callable[
+                   [RankInfo, int, List[RankInfo]], bool]] = None) -> int:
     """Run all ranks; on any non-zero exit terminate the rest (reference
     gloo_run.py:256-262).  Returns the job exit code.
 
@@ -262,6 +264,17 @@ def launch_job(rank_infos: List[RankInfo], command: List[str],
     every rank that exited non-zero on its own (operator-stop SIGTERMs
     excluded — those are not host failures), ``report["signalled"]`` =
     True when the launcher's own SIGINT/SIGTERM handler fired.
+
+    ``reform``, when given, is the fail-in-place hook
+    (HOROVOD_ON_RANK_FAILURE=shrink|shrink-then-restart): called with
+    ``(dead_info, exit_code, survivor_infos)`` when a rank dies on its
+    own (crash / watchdog SIGKILL; never preemption or operator stop).
+    Returning True means the death was absorbed — the survivors reform
+    the collective world in-process, supervision continues over them,
+    and the dead rank is reported under ``report["reformed"]`` instead
+    of ``report["failed"]`` (a non-restart event: no teardown fan-out,
+    no host blame).  Returning False falls through to the normal
+    terminate-everyone path.
 
     ``watchdog``, when given, is polled in the supervision loop and
     returns ``(rank, reason)`` pairs for ranks the health plane declared
@@ -314,6 +327,8 @@ def launch_job(rank_infos: List[RankInfo], command: List[str],
         exit_code = 0
         running = set(range(len(procs)))
         by_rank = {p.info.rank: p for p in procs}
+        reformed = []            # (rank, hostname, exit_code) absorbed
+        reformed_ranks = set()   # global ranks excluded from blame below
         while running and not stop.is_set():
             if control is not None and control.stop_requested.is_set():
                 signalled.set()
@@ -340,6 +355,27 @@ def launch_job(rank_infos: List[RankInfo], command: List[str],
                     continue
                 running.discard(i)
                 if rc != 0:
+                    # Fail-in-place: offer the death to the reform hook
+                    # before the teardown fan-out.  Only genuine solo
+                    # deaths qualify — preemption, operator stop and
+                    # launcher teardown keep their existing semantics.
+                    if (reform is not None and rc != PREEMPTION_RC and
+                            not procs[i].terminated_by_launcher and
+                            not signalled.is_set() and
+                            not (control is not None and
+                                 control.preempt_requested.is_set())):
+                        survivors = [procs[j].info for j in sorted(running)]
+                        if survivors and reform(procs[i].info, rc,
+                                                survivors):
+                            dead = procs[i].info
+                            sys.stderr.write(
+                                f"hvdrun: rank {dead.rank} exited with "
+                                f"code {rc}; absorbed by in-process "
+                                f"reformation ({len(survivors)} "
+                                f"survivor(s) continue).\n")
+                            reformed.append((dead.rank, dead.hostname, rc))
+                            reformed_ranks.add(dead.rank)
+                            continue
                     exit_code = rc
                     if rc == PREEMPTION_RC:
                         sys.stderr.write(
@@ -394,6 +430,11 @@ def launch_job(rank_infos: List[RankInfo], command: List[str],
         for p in procs:
             p.proc.wait()
             rc = p.proc.returncode
+            if p.info.rank in reformed_ranks:
+                # Absorbed by in-process reformation: the survivors'
+                # exits define the job outcome; the dead rank neither
+                # sets the exit code nor blames its host.
+                continue
             if rc not in (0, None) and exit_code == 0:
                 exit_code = rc
             if rc not in (0, None) and not p.terminated_by_launcher:
@@ -444,6 +485,7 @@ def launch_job(rank_infos: List[RankInfo], command: List[str],
             report["failed"] = failed
             report["preempted"] = preempted
             report["signalled"] = signalled.is_set()
+            report["reformed"] = reformed
         return exit_code
     finally:
         if install_signal_handlers:

@@ -95,6 +95,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "restarts it.  Requires --heartbeat-interval. "
                         "Defaults to HOROVOD_HANG_DEADLINE; 0 disables "
                         "hang detection.")
+    p.add_argument("--on-rank-failure", dest="on_rank_failure",
+                   choices=["restart", "shrink", "shrink-then-restart"],
+                   default=None,
+                   help="Policy when a rank dies mid-job (docs/"
+                        "fault_tolerance.md, 'Fail-in-place').  restart "
+                        "(default): today's whole-job elastic restart.  "
+                        "shrink: survivors reform the collective world "
+                        "IN-PROCESS — in-flight collectives drain with a "
+                        "retryable membership-changed status, the "
+                        "launcher delivers each survivor's new rank over "
+                        "the heartbeat plane, and training resumes via "
+                        "resilience.reform_world() with no relaunch.  "
+                        "shrink-then-restart: try the in-process path, "
+                        "fall back to the elastic restart budget when "
+                        "reformation fails or would drop below --min-np. "
+                        "Shrink modes require --heartbeat-interval.  "
+                        "Defaults to HOROVOD_ON_RANK_FAILURE.")
     p.add_argument("--network-interface", dest="network_interface",
                    default=None,
                    help="Comma-separated NIC name(s), in preference "
@@ -374,6 +391,31 @@ def run_command(args) -> int:
         config.env_float("HOROVOD_COORD_LEASE_SECONDS"))
     if health is not None:
         health.coord = coord
+    # Rank-failure policy (docs/fault_tolerance.md "Fail-in-place").
+    # The default — restart — keeps today's behavior untouched: the env
+    # var is NOT injected and no reform hook is armed, so ranks and
+    # native runtime run the exact pre-policy code paths.
+    on_rank_failure = (getattr(args, "on_rank_failure", None) or
+                      config.env_str("HOROVOD_ON_RANK_FAILURE", "").strip()
+                      or "restart")
+    if on_rank_failure not in ("restart", "shrink", "shrink-then-restart"):
+        print(f"hvdrun: unknown HOROVOD_ON_RANK_FAILURE="
+              f"{on_rank_failure!r}; using 'restart'",
+              file=sys.stderr, flush=True)
+        on_rank_failure = "restart"
+    if on_rank_failure != "restart" and health is None:
+        # The reform spec travels in heartbeat replies and dead-rank
+        # detection leans on the keepalive monitor — without the health
+        # plane the in-process path cannot work.
+        print(f"hvdrun: --on-rank-failure {on_rank_failure} requires the "
+              f"heartbeat health plane (--heartbeat-interval); falling "
+              f"back to 'restart'", file=sys.stderr, flush=True)
+        on_rank_failure = "restart"
+    if on_rank_failure != "restart":
+        # Ranks (and the native runtime through them) must see the same
+        # policy so a dead peer drains in-flight collectives with the
+        # retryable membership-changed status instead of a fatal abort.
+        extra_env["HOROVOD_ON_RANK_FAILURE"] = on_rank_failure
     # Warm-restart spill scratch dir: one per JOB, stable across elastic
     # restart attempts so a new attempt's ranks find the old attempt's
     # spills.  A user-provided HOROVOD_SPILL_DIR is respected (and never
@@ -395,7 +437,7 @@ def run_command(args) -> int:
     extra_env["HOROVOD_SHM_DIR"] = shm_dir
     owned_spill_dir = None
     spill_scratch = config.env_str("HOROVOD_SPILL_DIR", "").strip()
-    if restarts > 0 and not spill_scratch:
+    if (restarts > 0 or on_rank_failure != "restart") and not spill_scratch:
         # Name the job in the prefix when running under the fleet
         # controller so two jobs' scratch dirs are tellable apart on a
         # shared host (the fleet normally provisions HOROVOD_SPILL_DIR
@@ -494,6 +536,9 @@ def run_command(args) -> int:
                    if collector is not None else {})
             if health is not None:
                 mkw["health"] = health
+            if on_rank_failure != "restart":
+                mkw["on_rank_failure"] = on_rank_failure
+                mkw["min_np"] = min_np
             if tracer is not None:
                 mkw["trace_dir"] = trace_dir
                 mkw["tracer"] = tracer
@@ -572,6 +617,15 @@ class _HealthPlane:
         self._preempt = False
         self._last_gauge = 0.0
         self.coord: Optional["_CoordinationPlane"] = None
+        # Fail-in-place state (docs/fault_tolerance.md): the membership
+        # epoch of the CURRENT attempt's world, pending reform specs
+        # keyed by OLD rank, and the new->old rank alias so watchdog
+        # verdicts on the reformed world map back to the launcher's
+        # process table (which stays keyed by launch-time ranks).
+        self.world_epoch = 0
+        self._reform_specs: dict = {}
+        self._rank_alias: dict = {}
+        self._current_to_launch: dict = {}
         self._server = rpc.RpcServer(rpc.job_key_bytes(secret),
                                      self._handle)
 
@@ -586,6 +640,32 @@ class _HealthPlane:
                 # A straggler from before the failover: its heartbeat
                 # must not resurrect the dead epoch's liveness state.
                 return {"ok": False, "stale_epoch": True}
+            try:
+                wepoch = int(req.get("world_epoch", 0))
+            except (TypeError, ValueError):
+                wepoch = 0
+            if wepoch < self.world_epoch and not self._reform_specs:
+                # Pre-reformation straggler after the handover finished:
+                # its OLD rank number now names a different process.
+                return {"ok": False, "stale_epoch": True}
+            if self._reform_specs and wepoch < self.world_epoch:
+                # Reformation in flight and this heartbeat still carries
+                # the old world's numbering: deliver the rank's slice of
+                # the new world but keep it OUT of the liveness monitor
+                # (its old rank number will fall silent by design the
+                # moment it re-inits, and must not read as a death).
+                spec = self._reform_specs.get(
+                    self._current_to_launch.get(rank, rank))
+                return ({"ok": True, "reform": spec} if spec
+                        else {"ok": True})
+            if self._reform_specs:
+                # First heartbeat from a reformed rank: its slice of the
+                # handover is done.  (The rank-side epoch guard makes a
+                # late duplicate delivery harmless, so dropping the spec
+                # here — rather than on delivery — doubles as the retry
+                # path for lost replies.)
+                self._reform_specs.pop(self._rank_alias.get(rank, rank),
+                                       None)
             try:
                 self.monitor.progress(rank, int(req.get("step", -1)))
             except (TypeError, ValueError):
@@ -618,6 +698,31 @@ class _HealthPlane:
         self.monitor.forget_all()
         self._killed.clear()
         self._preempt = False   # the new attempt starts unpreempted
+        # Fresh processes start at membership epoch 0 (reformations are
+        # in-process events scoped to one attempt).
+        self.world_epoch = 0
+        self._reform_specs = {}
+        self._rank_alias = {}
+        self._current_to_launch = {}
+
+    def request_reform(self, specs: dict, alias: dict,
+                       epoch: int) -> None:
+        """Arm an in-process world reformation: pending per-LAUNCH-rank
+        specs ride out in heartbeat replies, the liveness monitor is
+        wiped (old-rank silence during the handover is expected, not
+        death — ranks re-register under their new numbers as they
+        re-init), and watchdog verdicts translate through ``alias``
+        (new rank -> launch-time rank) from here on."""
+        self.monitor.forget_all()
+        self._killed.clear()
+        # Survivors still heartbeat under the numbering of the world
+        # being torn down; after a SECOND reformation that numbering is
+        # the previous alias's "new" side, not the launch ranks the
+        # specs are keyed by.
+        self._current_to_launch = dict(self._rank_alias)
+        self._reform_specs = dict(specs)
+        self._rank_alias = dict(alias)
+        self.world_epoch = int(epoch)
 
     def watchdog(self) -> list:
         """``(rank, reason)`` pairs newly declared dead or hung since the
@@ -636,13 +741,15 @@ class _HealthPlane:
         for r in self.monitor.dead_tasks():
             if r not in self._killed:
                 self._killed.add(r)
-                out.append((r, f"sent no heartbeat for > "
-                               f"{self.deadline:g}s"))
+                out.append((self._rank_alias.get(r, r),
+                            f"sent no heartbeat for > "
+                            f"{self.deadline:g}s"))
         for r in self.monitor.hung_tasks():
             if r not in self._killed:
                 self._killed.add(r)
-                out.append((r, f"is hung: heartbeats alive but the step "
-                               f"stalled > {self.hang_deadline:g}s"))
+                out.append((self._rank_alias.get(r, r),
+                            f"is hung: heartbeats alive but the step "
+                            f"stalled > {self.hang_deadline:g}s"))
         return out
 
     def shutdown(self) -> None:
@@ -921,9 +1028,50 @@ def _demote_failed_hosts(blacklist, host_list, failed, min_np) -> None:
                   file=sys.stderr, flush=True)
 
 
+def _plan_reformation(survivors, addr, port, epoch):
+    """Contiguous re-ranking of the survivors: per-OLD-rank reform
+    specs plus the new->old rank alias.
+
+    Survivor order is launch-rank order, which keeps ranks host-major-
+    contiguous (hosts.allocate is host-major and removal preserves
+    order), so per-host local/cross coordinates and the topology string
+    recompute directly from the ordered hostname sequence."""
+    ordered = sorted(survivors, key=lambda i: i.rank)
+    new_size = len(ordered)
+    local_size = {}
+    for info in ordered:
+        local_size[info.hostname] = local_size.get(info.hostname, 0) + 1
+    host_order = list(dict.fromkeys(i.hostname for i in ordered))
+    topology = hosts.topology_string(ordered)
+    specs, alias = {}, {}
+    local_rank = {}
+    for new_rank, info in enumerate(ordered):
+        lr = local_rank.get(info.hostname, 0)
+        local_rank[info.hostname] = lr + 1
+        specs[info.rank] = {
+            "epoch": epoch,
+            "rank": new_rank,
+            "size": new_size,
+            "local_rank": lr,
+            "local_size": local_size[info.hostname],
+            "cross_rank": host_order.index(info.hostname),
+            "cross_size": len(host_order),
+            "rendezvous_addr": addr,
+            "rendezvous_port": port,
+            "topology": topology,
+            # One death per reformation event: the world being torn
+            # down had exactly one more rank (RankInfo.size would be
+            # stale after a SECOND reformation in the same attempt).
+            "prev_size": new_size + 1,
+        }
+        alias[new_rank] = info.rank
+    return specs, alias
+
+
 def _launch_once(args, infos, addr, extra_env, report=None,
                  metrics_file=None, collector=None, health=None,
-                 trace_dir=None, tracer=None) -> int:
+                 trace_dir=None, tracer=None, on_rank_failure=None,
+                 min_np=None) -> int:
     port = args.rendezvous_port or launch.find_free_port()
     if getattr(args, "jax_distributed", False):
         # The jax.distributed coordinator runs INSIDE rank 0 (unlike the
@@ -971,12 +1119,55 @@ def _launch_once(args, infos, addr, extra_env, report=None,
             print(f"hvdrun: rank {info.rank} -> {info.hostname} "
                   f"(local {info.local_rank}/{info.local_size}, "
                   f"cross {info.cross_rank}/{info.cross_size})")
+    reform = None
+    if health is not None and on_rank_failure in ("shrink",
+                                                  "shrink-then-restart"):
+        def reform(dead_info, rc, survivors):
+            floor = min_np or 1
+            if len(survivors) < floor:
+                print(f"hvdrun: not reforming in-process: "
+                      f"{len(survivors)} survivor(s) < --min-np {floor}",
+                      file=sys.stderr, flush=True)
+                return False
+            epoch = health.world_epoch + 1
+            # Fresh rendezvous port: the dead world's listener may
+            # linger in TIME_WAIT and survivors must not rejoin it.
+            new_port = launch.find_free_port()
+            ordered = sorted(survivors, key=lambda i: i.rank)
+            new_addr = ("127.0.0.1"
+                        if all(launch.is_local(i.hostname)
+                               for i in ordered)
+                        else ordered[0].hostname)
+            specs, alias = _plan_reformation(ordered, new_addr,
+                                             new_port, epoch)
+            health.request_reform(specs, alias, epoch)
+            # Booked ONCE, launcher-side, so the merged metrics count
+            # each reformation event exactly once regardless of how
+            # many ranks survive it.
+            telemetry.counter(
+                "hvd_failinplace_reformations_total",
+                "In-process world reformations after a rank death "
+                "(fail-in-place shrink, no elastic restart)").inc()
+            telemetry.gauge(
+                "hvd_failinplace_world_epoch",
+                "Membership epoch of the running attempt's world "
+                "(0 = never reformed)").set(float(epoch))
+            print(f"hvdrun: fail-in-place: rank {dead_info.rank} "
+                  f"(host {dead_info.hostname}) died with code {rc}; "
+                  f"reforming the world in-process as epoch {epoch} "
+                  f"with {len(ordered)} rank(s)",
+                  file=sys.stderr, flush=True)
+            return True
+    # Keyword only when armed: callers (and tests) that stub launch_job
+    # with the historical signature stay compatible on the default path.
+    lkw = {"reform": reform} if reform is not None else {}
     return launch.launch_job(
         infos, args.command, env_per_rank,
         output_dir=args.output_filename,
         start_timeout=args.start_timeout,
         report=report,
-        watchdog=watchdog)
+        watchdog=watchdog,
+        **lkw)
 
 
 def main(argv: List[str] = None) -> int:

@@ -131,7 +131,7 @@ def exec_on_tpu(x) -> bool:
         if not _warned_no_abstract_device:
             _warned_no_abstract_device = True
             import logging
-            logging.getLogger(__name__).debug(
+            logging.getLogger(__name__).warning(
                 "AbstractMesh.abstract_device.device_kind unavailable on "
                 "this JAX; falling back to jax.default_backend() for the "
                 "executing-mesh platform gate")

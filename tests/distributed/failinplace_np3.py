@@ -1,0 +1,100 @@
+"""Fail-in-place gate workload (run: hvdrun -np 3 over fake ssh with
+--heartbeat-interval, --min-np 2 and --on-rank-failure shrink — see
+ci/run_tests.sh).
+
+A ``rank_kill`` chaos rule SIGKILLs rank 2 from inside an armed
+transport exchange mid-training — no unwind, no shutdown handshake,
+exactly a host loss.  The two survivors' in-flight collectives drain
+with the retryable membership-changed status, the training loop
+catches :class:`MembershipChangedError` and calls
+:func:`horovod_tpu.resilience.reform_world`: the launcher delivers the
+contiguous re-ranking over the heartbeat plane, the survivors
+re-rendezvous IN-PROCESS (same PIDs — asserted), recover the committed
+step from the peer spills, apply the 3 -> 2 elastic-continuity policy,
+and train to the exact final state an uninterrupted run produces.
+The launcher must count ZERO elastic restarts and exactly ONE
+reformation (asserted on the merged metrics by the gate).
+"""
+import os
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import resilience, telemetry
+from horovod_tpu.native.runtime import MembershipChangedError
+
+hvd.init()
+rank, size = hvd.rank(), hvd.size()
+PID = os.getpid()
+TOTAL = 12
+W0, DECAY = 8.0, 0.75    # w <- w - 0.25 * mean(w) each step
+
+assert size == 3, f"gate must start at np=3, got {size}"
+assert hvd.world_epoch() == 0, hvd.world_epoch()
+
+params = {"w": np.full(4, W0, np.float32)}
+opt_state = {"m": np.zeros(4, np.float32)}
+guard = resilience.StepGuard(policy="rollback", nan_burst=1,
+                             snapshot_interval=1, sentinel_interval=0)
+
+params, opt_state, committed, source, extra = resilience.warm_restore(
+    params, opt_state)
+assert (source, committed) == ("fresh", -1), (source, committed)
+
+step = committed + 1
+reformed = False
+prev_loss = None
+while step < TOTAL:
+    try:
+        # Every rank holds the same deterministic w, so the allreduce
+        # mean equals w and the trajectory is identical at np=3 and
+        # np=2 — the shrink must not change the math.
+        g = np.asarray(hvd.allreduce(params["w"], name=f"fip.{step}"))
+        params = {"w": params["w"] - 0.25 * g}
+        loss = float(0.5 * (params["w"] ** 2).sum())
+        if prev_loss is not None:
+            assert loss < prev_loss, \
+                f"rank {rank} step {step}: loss {loss} >= {prev_loss}"
+        prev_loss = loss
+        params, opt_state, ev = guard.after_step(
+            params, opt_state, step, loss)
+        assert ev.action == "ok", f"rank {rank} step {step}: {ev}"
+        step += 1
+    except MembershipChangedError as e:
+        assert not reformed, f"second membership change: {e}"
+        reformed = True
+        params, opt_state, committed, source, extra = \
+            resilience.reform_world(params, opt_state)
+        rank, size = hvd.rank(), hvd.size()
+        # In-process: same PID, new world, bumped epoch, shrunken size.
+        assert os.getpid() == PID
+        assert size == 2, f"expected surviving world of 2, got {size}"
+        assert hvd.world_epoch() == 1, hvd.world_epoch()
+        assert source == "spill", \
+            f"expected peer-spill recovery, got {source!r}"
+        assert committed >= 0, committed
+        # 3 -> 2 continuity policy (launcher-free: reform_world injected
+        # HOROVOD_ELASTIC_PREV_SIZE in-process).
+        prev, lr_scale, accum = hvd.elastic_transition(policy="lr_scale")
+        assert prev == 3 and abs(lr_scale - 2.0 / 3.0) < 1e-6, \
+            (prev, lr_scale, accum)
+        step = committed + 1
+        # The recovered w is the step-`committed` value; recompute the
+        # matching loss baseline for the monotonicity check.
+        prev_loss = float(0.5 * (params["w"] ** 2).sum())
+
+assert reformed, "chaos never fired: the gate proved nothing"
+want = W0 * DECAY ** TOTAL
+np.testing.assert_allclose(params["w"], np.full(4, want, np.float32),
+                           rtol=1e-5)
+
+if telemetry.enabled():
+    snap = hvd.metrics_snapshot()
+    from horovod_tpu.telemetry import aggregate
+    assert aggregate.counter_total(
+        snap, "hvd_warm_restart_spills_total") >= 1, "no spill recorded"
+    epochs = snap.get("hvd_failinplace_world_epoch", {}).get("values")
+    assert epochs and epochs[0]["value"] == 1.0, epochs
+
+print(f"FIP_OK rank={rank} size={size} epoch={hvd.world_epoch()} "
+      f"source={source} committed={committed} pid_stable=1", flush=True)

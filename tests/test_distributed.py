@@ -135,6 +135,9 @@ def test_operator_stop_does_not_elastic_restart(tmp_path):
     rc = proc.wait(timeout=60)
     assert rc == 130, (rc, out)
     assert "elastic restart" not in out, out
+
+
+def test_adasum_three_ranks(tmp_path):
     """Non-power-of-2 Adasum: rank 2 folds into rank 0 before the 2-rank
     butterfly and receives the result back; every rank must hold the
     oracle value bitwise-identically (native AdasumButterfly,

@@ -214,3 +214,35 @@ def test_drop_residual_skipped_by_inject(monkeypatch):
 
 def test_drop_residual_noop_without_spec():
     assert faults.drop_residual() is False
+
+
+# -- rank_kill (dual-site: native at transport, controller at fleet) ---------
+
+def test_parse_rank_kill_defaults_and_shorthand():
+    (r,) = faults.parse_spec("rank=2,site=transport,kind=rank_kill")
+    assert r.kind == "rank_kill" and r.count == 1
+    (r,) = faults.parse_spec("site=fleet,kind=rank_kill:3")
+    assert r.count == 3                # :N is shorthand for count=N
+    with pytest.raises(faults.FaultSpecError, match="rank_kill"):
+        faults.parse_spec("site=transport,kind=rank_kill:0")
+
+
+def test_rank_kill_never_fires_at_inject(monkeypatch):
+    # The transport site is consumed natively inside libhorovod_tpu.so;
+    # a Python-side firing would SIGKILL the test runner itself.
+    monkeypatch.setenv(faults.ENV_VAR, "site=transport,kind=rank_kill")
+    faults.reset()
+    faults.inject("allreduce")
+    faults.inject("transport")
+
+
+def test_rank_kill_fires_at_fleet_chaos_only_for_fleet_site(monkeypatch):
+    monkeypatch.setenv(
+        faults.ENV_VAR,
+        "site=fleet,kind=rank_kill;rank=2,site=transport,kind=rank_kill")
+    faults.reset()
+    # Only the site=fleet rule reaches the controller hook — the
+    # transport rule belongs to the native data plane and must never
+    # double-fire here.
+    assert faults.fleet_chaos() == ["rank_kill"]
+    assert faults.fleet_chaos() == []
